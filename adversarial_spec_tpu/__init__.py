@@ -1,0 +1,24 @@
+"""adversarial_spec_tpu — a TPU-native adversarial spec-debate framework.
+
+A ground-up rebuild of the capabilities of ``zscole/adversarial-spec``
+(multi-model adversarial critique of PRDs / tech specs, looping until all
+models agree) with the remote-API inference substrate replaced by an in-tree
+JAX/XLA engine: a ``tpu://`` provider loads HF checkpoints into pjit-sharded
+JAX models over an ICI mesh, per-opponent fan-out becomes one batched decode,
+and the decode hot loop uses Pallas TPU kernels.
+
+Layer map (mirrors reference SURVEY §1, substrate swapped):
+
+- ``adversarial_spec_tpu.cli``      — CLI front-end (reference: scripts/debate.py)
+- ``adversarial_spec_tpu.debate``   — round orchestration, parsing, convergence,
+  usage/cost, sessions, profiles, prompts (reference: models.py/session.py/
+  providers.py/prompts.py)
+- ``adversarial_spec_tpu.engine``   — inference engines: mock + TPU
+  (reference L1: litellm HTTP / CLI subprocess transport)
+- ``adversarial_spec_tpu.models``   — JAX transformer model families
+- ``adversarial_spec_tpu.ops``      — Pallas TPU kernels + attention ops
+- ``adversarial_spec_tpu.parallel`` — mesh, sharding rules, collectives,
+  ring attention
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,694 @@
+"""CLI front-end — the L4 layer.
+
+Behavioral parity with reference scripts/debate.py: same action set
+(``critique, providers, send-final, diff, export-tasks, focus-areas,
+personas, profiles, save-profile, sessions`` — reference :397-413), with the
+reference's ``bedrock`` gateway action replaced by the TPU-native analog
+``registry`` (local model registry management, SURVEY §2.3). Same exit-code
+contract (0 ok / 1 runtime error / 2 validation failure, reference :39-43),
+same stderr-human/stdout-JSON split, and the same JSON output schema
+(reference :909-941) so the L5 agent protocol can drive either
+implementation unchanged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from adversarial_spec_tpu.debate import prompts
+from adversarial_spec_tpu.debate.core import RoundConfig, run_round
+from adversarial_spec_tpu.debate.parsing import extract_tasks, generate_diff
+from adversarial_spec_tpu.debate.profiles import (
+    apply_profile,
+    list_profiles,
+    load_profile,
+    save_profile,
+)
+from adversarial_spec_tpu.debate.session import (
+    InvalidSessionId,
+    SessionState,
+    save_checkpoint,
+)
+from adversarial_spec_tpu.debate.usage import CostTracker
+from adversarial_spec_tpu.engine import registry as model_registry
+from adversarial_spec_tpu.engine.dispatch import get_engine
+from adversarial_spec_tpu.engine.types import ChatRequest, SamplingParams
+
+EXIT_OK = 0
+EXIT_ERROR = 1
+EXIT_VALIDATION = 2
+
+ACTIONS = [
+    "critique",
+    "providers",
+    "send-final",
+    "diff",
+    "export-tasks",
+    "focus-areas",
+    "personas",
+    "profiles",
+    "save-profile",
+    "sessions",
+    "registry",
+]
+
+DEFAULT_MODELS = ["mock://critic?agree_after=3"]
+
+
+def _err(msg: str) -> None:
+    print(msg, file=sys.stderr)
+
+
+def create_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="debate",
+        description="TPU-native adversarial spec debate engine",
+    )
+    parser.add_argument("action", choices=ACTIONS, help="Command to run")
+
+    g = parser.add_argument_group("debate")
+    g.add_argument(
+        "--models",
+        "-m",
+        help="Comma-separated model ids (mock://... or tpu://alias)",
+    )
+    g.add_argument(
+        "--doc-type",
+        choices=["prd", "tech", "generic"],
+        default=None,
+        help="Document type (default: generic)",
+    )
+    g.add_argument("--round", type=int, default=1, help="Debate round number")
+    g.add_argument("--focus", help="Focus area (see focus-areas action)")
+    g.add_argument("--persona", help="Persona key or freeform persona text")
+    g.add_argument(
+        "--preserve-intent",
+        action="store_true",
+        help="Constrain critique to preserve the author's intent",
+    )
+    g.add_argument(
+        "--press",
+        action="store_true",
+        help="Press round: force models to re-justify quick agreement",
+    )
+    g.add_argument(
+        "--context",
+        action="append",
+        default=None,
+        help="Context file injected into prompts (repeatable)",
+    )
+
+    s = parser.add_argument_group("session")
+    s.add_argument("--session", help="Session id to create/update")
+    s.add_argument("--resume", help="Resume a previous session by id")
+    s.add_argument("--profile", help="Load settings from a saved profile")
+    s.add_argument("--name", help="Profile name (for save-profile)")
+
+    o = parser.add_argument_group("output")
+    o.add_argument("--json", "-j", action="store_true", help="JSON output")
+    o.add_argument(
+        "--show-cost", action="store_true", help="Print cost/usage summary"
+    )
+    o.add_argument("--previous", help="Previous spec file (diff action)")
+    o.add_argument("--current", help="Current spec file (diff action)")
+    o.add_argument(
+        "--notify",
+        action="store_true",
+        help="Send round summary to Telegram and poll for feedback",
+    )
+    o.add_argument(
+        "--feedback-timeout",
+        type=int,
+        default=0,
+        help="Seconds to wait for Telegram feedback (0 = don't poll)",
+    )
+
+    d = parser.add_argument_group("decode")
+    d.add_argument(
+        "--max-new-tokens",
+        type=int,
+        default=None,
+        help="Response token cap (default 1024)",
+    )
+    d.add_argument(
+        "--temperature", type=float, default=None, help="Sampling temperature"
+    )
+    d.add_argument(
+        "--greedy", action="store_true", help="Greedy (argmax) decoding"
+    )
+    d.add_argument("--seed", type=int, default=None, help="Sampling PRNG seed")
+    d.add_argument(
+        "--timeout",
+        type=float,
+        default=600.0,
+        help="Per-round wall-clock budget in seconds",
+    )
+
+    r = parser.add_argument_group("registry")
+    r.add_argument("--checkpoint", help="HF checkpoint dir (registry add-model)")
+    r.add_argument(
+        "--family",
+        choices=["llama", "mistral", "gemma2", "qwen2"],
+        default="llama",
+    )
+    r.add_argument("--size", default="tiny", help="Named size config")
+    r.add_argument("--tokenizer", default="", help="Tokenizer path")
+    r.add_argument("--dtype", default=None, help="Param dtype (bfloat16)")
+    r.add_argument("--tp", type=int, default=0, help="Tensor-parallel degree")
+    return parser
+
+
+def parse_models(args: argparse.Namespace) -> list[str]:
+    """Comma-separated ids, or the default opponent when unset.
+
+    Parity: reference parse_models + default-model auto-detection
+    (debate.py:553-611, providers.py:394-415) — here "available" means mock
+    (always) plus any registry alias whose checkpoint resolves.
+    """
+    if args.models:
+        return [m.strip() for m in args.models.split(",") if m.strip()]
+    return list(DEFAULT_MODELS)
+
+
+def validate_models_before_run(models: list[str]) -> list[str]:
+    """Collect actionable validation errors (exit code 2 when non-empty).
+
+    Parity: reference validate_models_before_run (debate.py:976-1022) →
+    credential preflight; here it is provider-prefix + registry/checkpoint
+    validation via each engine's ``validate``.
+    """
+    errors = []
+    reg = None
+    for m in models:
+        if m.startswith("tpu://"):
+            if reg is None:
+                reg = model_registry.load_registry()
+            err = model_registry.validate_tpu_model(m, registry=reg)
+            if err is None:
+                try:
+                    get_engine(m)
+                except ValueError as e:
+                    err = str(e)
+        else:
+            try:
+                err = get_engine(m).validate(m)
+            except ValueError as e:
+                err = str(e)
+        if err:
+            errors.append(f"{m}: {err}")
+    return errors
+
+
+def _read_spec_stdin() -> str:
+    spec = sys.stdin.read().strip()
+    if not spec:
+        _err("error: no spec provided on stdin")
+        raise SystemExit(EXIT_VALIDATION)
+    return spec
+
+
+def _sampling_from_args(args: argparse.Namespace) -> SamplingParams:
+    return SamplingParams(
+        max_new_tokens=args.max_new_tokens or 1024,
+        temperature=0.7 if args.temperature is None else args.temperature,
+        greedy=bool(args.greedy),
+        seed=args.seed,
+        timeout_s=max(0.0, float(args.timeout or 0.0)),
+    )
+
+
+def load_or_resume_session(
+    args: argparse.Namespace,
+) -> tuple[str, SessionState | None]:
+    """Returns (spec, session_state). Resume restores args wholesale
+    (parity: reference debate.py:739-795)."""
+    if args.resume:
+        state = SessionState.load(args.resume)
+        args.round = state.round
+        args.doc_type = state.doc_type
+        if state.models:
+            args.models = ",".join(state.models)
+        args.focus = state.focus
+        args.persona = state.persona
+        args.preserve_intent = state.preserve_intent
+        args.session = state.session_id
+        return state.spec, state
+    spec = _read_spec_stdin()
+    if args.session:
+        state = SessionState(
+            session_id=args.session,
+            spec=spec,
+            round=args.round,
+            doc_type=args.doc_type or "generic",
+        )
+        return spec, state
+    return spec, None
+
+
+def run_critique(args: argparse.Namespace) -> int:
+    spec, session_state = load_or_resume_session(args)
+    models = parse_models(args)
+    errors = validate_models_before_run(models)
+    if errors:
+        for e in errors:
+            _err(f"validation error: {e}")
+        return EXIT_VALIDATION
+
+    cfg = RoundConfig(
+        doc_type=args.doc_type or "generic",
+        focus=args.focus,
+        persona=args.persona,
+        preserve_intent=args.preserve_intent,
+        press=args.press,
+        context_files=args.context or [],
+        sampling=_sampling_from_args(args),
+    )
+    _err(
+        f"Round {args.round}: querying {len(models)} model(s): "
+        + ", ".join(models)
+    )
+    result = run_round(spec, models, round_num=args.round, cfg=cfg)
+
+    for r in result.failed:
+        _err(f"warning: {r.model} failed: {r.error}")
+
+    tracker = CostTracker()
+    for r in result.responses:
+        tracker.add(r.model, r.usage)
+
+    # The revised spec for the next round: last successful revision wins
+    # (the L5 agent synthesizes across critiques; this is the raw material).
+    revised = next(
+        (r.revised_spec for r in reversed(result.successful) if r.revised_spec),
+        None,
+    )
+
+    if session_state is not None:
+        save_checkpoint(spec, args.round, session_state.session_id)
+        session_state.spec = revised or spec
+        session_state.round = args.round + 1
+        session_state.models = models
+        session_state.focus = args.focus
+        session_state.persona = args.persona
+        session_state.preserve_intent = args.preserve_intent
+        session_state.history.append(
+            {
+                "round": args.round,
+                "all_agreed": result.all_agreed,
+                "models": {r.model: r.agreed for r in result.successful},
+            }
+        )
+        session_state.save()
+
+    user_feedback = None
+    if args.notify:
+        user_feedback = _telegram_notify(args, result, tracker)
+
+    output_results(args, result, models, tracker, session_state, user_feedback)
+    return EXIT_OK
+
+
+def _telegram_notify(args, result, tracker) -> str | None:
+    from adversarial_spec_tpu.debate import telegram
+
+    config = telegram.get_config()
+    if config is None:
+        _err(
+            "warning: Telegram not configured "
+            "(set TELEGRAM_BOT_TOKEN and TELEGRAM_CHAT_ID); skipping notify"
+        )
+        return None
+    try:
+        return telegram.notify_round(
+            config,
+            result,
+            total_cost=tracker.total_cost,
+            feedback_timeout=args.feedback_timeout,
+        )
+    except Exception as e:  # notify must never kill the round
+        _err(f"warning: Telegram notify failed: {e}")
+        return None
+
+
+def output_results(
+    args: argparse.Namespace,
+    result,
+    models: list[str],
+    tracker: CostTracker,
+    session_state: SessionState | None,
+    user_feedback: str | None = None,
+) -> None:
+    """Emit round results. JSON schema parity: reference debate.py:909-941."""
+    if args.json:
+        out = {
+            "all_agreed": result.all_agreed,
+            "round": args.round,
+            "doc_type": args.doc_type or "generic",
+            "models": models,
+            "focus": args.focus,
+            "persona": args.persona,
+            "preserve_intent": bool(args.preserve_intent),
+            "session": session_state.session_id if session_state else args.session,
+            "results": [
+                {
+                    "model": r.model,
+                    "agreed": r.agreed,
+                    "response": r.critique,
+                    "spec": r.revised_spec,
+                    "error": r.error,
+                    "input_tokens": r.usage.input_tokens,
+                    "output_tokens": r.usage.output_tokens,
+                    "cost": round(r.usage.cost_for(r.model), 6),
+                }
+                for r in result.responses
+            ],
+            "cost": tracker.report(),
+        }
+        if user_feedback:
+            out["user_feedback"] = user_feedback
+        print(json.dumps(out, indent=2))
+        return
+
+    doc_name = prompts.get_doc_type_name(args.doc_type or "generic")
+    print(f"\n=== Round {args.round} Results ({doc_name}) ===\n")
+    for r in result.responses:
+        print(f"--- {r.model} ---")
+        if r.error:
+            print(f"ERROR: {r.error}")
+        elif r.agreed:
+            print("[AGREE]")
+        else:
+            print(r.critique)
+        print()
+    if result.all_agreed:
+        print("=== ALL MODELS AGREE ===")
+    else:
+        agreed = [r.model for r in result.successful if r.agreed]
+        disagreed = [r.model for r in result.successful if not r.agreed]
+        if agreed:
+            print(f"Agreed: {', '.join(agreed)}")
+        if disagreed:
+            print(f"Critiqued: {', '.join(disagreed)}")
+    if user_feedback:
+        print("\n=== User Feedback ===")
+        print(user_feedback)
+    if args.show_cost:
+        print()
+        print(tracker.format_text())
+
+
+def handle_export_tasks(args: argparse.Namespace) -> int:
+    """Spec → structured task list via the first model.
+
+    Parity: reference handle_export_tasks (debate.py:688-736) — stdin spec,
+    EXPORT_TASKS_PROMPT, low temperature, ``extract_tasks``, ``--json``.
+    """
+    spec = _read_spec_stdin()
+    models = parse_models(args)
+    errors = validate_models_before_run(models[:1])
+    if errors:
+        for e in errors:
+            _err(f"validation error: {e}")
+        return EXIT_VALIDATION
+    model = models[0]
+    req = ChatRequest(
+        model=model, system="", user=prompts.EXPORT_TASKS_PROMPT.format(spec=spec)
+    )
+    params = SamplingParams(
+        max_new_tokens=args.max_new_tokens or 2048,
+        temperature=0.3 if args.temperature is None else args.temperature,
+        seed=args.seed,
+    )
+    comp = get_engine(model).chat([req], params)[0]
+    if not comp.ok:
+        _err(f"error: {model} failed: {comp.error}")
+        return EXIT_ERROR
+    tasks = extract_tasks(comp.text)
+    if args.json:
+        print(json.dumps([t.to_dict() for t in tasks], indent=2))
+    else:
+        if not tasks:
+            print("No [TASK] blocks found in model response.")
+        for i, t in enumerate(tasks, 1):
+            print(f"{i}. [{t.priority}] {t.title}")
+            if t.description:
+                print(f"   {t.description}")
+            if t.dependencies:
+                print(f"   depends on: {', '.join(t.dependencies)}")
+            if t.estimate:
+                print(f"   estimate: {t.estimate}")
+    return EXIT_OK
+
+
+def handle_diff(args: argparse.Namespace) -> int:
+    if not args.previous or not args.current:
+        _err("error: diff requires --previous and --current spec files")
+        return EXIT_VALIDATION
+    try:
+        old = open(args.previous).read()
+        new = open(args.current).read()
+    except OSError as e:
+        _err(f"error: {e}")
+        return EXIT_VALIDATION
+    diff = generate_diff(old, new)
+    print(diff if diff else "No differences.")
+    return EXIT_OK
+
+
+def handle_providers(args: argparse.Namespace) -> int:
+    """List servable models: mock behaviors + registry entries + devices.
+
+    Parity: reference ``providers`` action (providers.py:247-333) listing
+    providers with availability; here availability = checkpoint resolves.
+    """
+    reg = model_registry.load_registry()
+    entries = []
+    for alias, spec in sorted(reg.items()):
+        err = model_registry.validate_tpu_model(f"tpu://{alias}", registry=reg)
+        entries.append(
+            {
+                "model": f"tpu://{alias}",
+                "family": spec.family,
+                "size": spec.size,
+                "checkpoint": spec.checkpoint,
+                "available": err is None,
+                "error": err,
+            }
+        )
+    mock_models = [
+        {"model": "mock://agree", "available": True},
+        {"model": "mock://critic", "available": True},
+        {"model": "mock://critic?agree_after=N", "available": True},
+    ]
+    if args.json:
+        print(
+            json.dumps(
+                {"tpu": entries, "mock": mock_models, "devices": _device_info()},
+                indent=2,
+            )
+        )
+        return EXIT_OK
+    print("TPU models (local registry):")
+    for e in entries:
+        status = "ok" if e["available"] else f"UNAVAILABLE: {e['error']}"
+        print(f"  {e['model']:28s} {e['family']:8s} {e['size']:5s} [{status}]")
+    print("Mock models (always available):")
+    for e in mock_models:
+        print(f"  {e['model']}")
+    return EXIT_OK
+
+
+def _device_info() -> dict:
+    try:
+        import jax
+
+        devs = jax.devices()
+        return {
+            "platform": devs[0].platform if devs else "none",
+            "device_count": len(devs),
+        }
+    except Exception as e:
+        return {"platform": "unavailable", "error": str(e)}
+
+
+def handle_registry(args: argparse.Namespace, rest: list[str]) -> int:
+    """Local model registry management — the Bedrock-mode analog.
+
+    Subcommands mirror reference handle_bedrock_command
+    (providers.py:489-656): status / list-models / add-model / remove-model.
+    """
+    sub = rest[0] if rest else "status"
+    if sub in ("status", "list-models"):
+        reg = model_registry.load_registry()
+        if args.json:
+            print(json.dumps({a: s.to_dict() for a, s in sorted(reg.items())}, indent=2))
+        else:
+            print(f"Registry: {model_registry.REGISTRY_PATH}")
+            for alias, spec in sorted(reg.items()):
+                print(
+                    f"  {alias:24s} family={spec.family:8s} size={spec.size:5s} "
+                    f"checkpoint={spec.checkpoint}"
+                )
+        return EXIT_OK
+    if sub == "add-model":
+        if len(rest) < 2:
+            _err("usage: debate registry add-model <alias> --checkpoint DIR")
+            return EXIT_VALIDATION
+        alias = rest[1]
+        spec = model_registry.ModelSpec(
+            alias=alias,
+            family=args.family,
+            checkpoint=args.checkpoint or "random",
+            tokenizer=args.tokenizer,
+            size=args.size,
+            dtype=args.dtype or "bfloat16",
+            mesh={"tp": args.tp} if args.tp else {},
+        )
+        model_registry.save_registry_entry(spec)
+        print(f"registered tpu://{alias}")
+        return EXIT_OK
+    if sub == "remove-model":
+        if len(rest) < 2:
+            _err("usage: debate registry remove-model <alias>")
+            return EXIT_VALIDATION
+        if model_registry.remove_registry_entry(rest[1]):
+            print(f"removed {rest[1]}")
+            return EXIT_OK
+        _err(f"error: no registry entry named {rest[1]}")
+        return EXIT_VALIDATION
+    _err(f"error: unknown registry subcommand {sub!r}")
+    return EXIT_VALIDATION
+
+
+def handle_send_final(args: argparse.Namespace) -> int:
+    """Send the final document to the configured Telegram chat.
+
+    Parity: reference handle_send_final (debate.py:670-685).
+    """
+    from adversarial_spec_tpu.debate import telegram
+
+    doc = _read_spec_stdin()
+    config = telegram.get_config()
+    if config is None:
+        _err("error: Telegram not configured (TELEGRAM_BOT_TOKEN/CHAT_ID)")
+        return EXIT_VALIDATION
+    telegram.send_long_message(config, "FINAL DOCUMENT\n\n" + doc)
+    print("Final document sent.")
+    return EXIT_OK
+
+
+def handle_info_command(args: argparse.Namespace) -> int | None:
+    if args.action == "focus-areas":
+        payload = {
+            k: v.strip().splitlines()[0] for k, v in prompts.FOCUS_AREAS.items()
+        }
+        if args.json:
+            print(json.dumps(payload, indent=2))
+        else:
+            for k, first_line in payload.items():
+                print(f"{k}: {first_line}")
+        return EXIT_OK
+    if args.action == "personas":
+        if args.json:
+            print(json.dumps(prompts.PERSONAS, indent=2))
+        else:
+            for k, v in prompts.PERSONAS.items():
+                print(f"{k}: {v[:88]}...")
+        return EXIT_OK
+    if args.action == "profiles":
+        profs = list_profiles()
+        if args.json:
+            print(json.dumps(profs, indent=2))
+        elif not profs:
+            print("No saved profiles.")
+        else:
+            for name, settings in profs.items():
+                print(f"{name}: {json.dumps(settings)}")
+        return EXIT_OK
+    if args.action == "sessions":
+        sessions = SessionState.list_sessions()
+        if args.json:
+            print(json.dumps(sessions, indent=2))
+        elif not sessions:
+            print("No saved sessions.")
+        else:
+            for s in sessions:
+                print(
+                    f"{s['session_id']}: round {s['round']}, "
+                    f"{s['doc_type']}, models={','.join(s['models'])}"
+                )
+        return EXIT_OK
+    if args.action == "providers":
+        return handle_providers(args)
+    return None
+
+
+def handle_save_profile(args: argparse.Namespace) -> int:
+    if not args.name:
+        _err("error: save-profile requires --name")
+        return EXIT_VALIDATION
+    settings = {}
+    if args.models:
+        settings["models"] = [m.strip() for m in args.models.split(",")]
+    if args.doc_type:
+        settings["doc_type"] = args.doc_type
+    if args.focus:
+        settings["focus"] = args.focus
+    if args.persona:
+        settings["persona"] = args.persona
+    if args.preserve_intent:
+        settings["preserve_intent"] = True
+    if args.max_new_tokens:
+        settings["max_new_tokens"] = args.max_new_tokens
+    if args.temperature is not None:
+        settings["temperature"] = args.temperature
+    save_profile(args.name, settings)
+    print(f"Profile '{args.name}' saved.")
+    return EXIT_OK
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = create_parser()
+    args, rest = parser.parse_known_args(argv)
+
+    try:
+        if args.profile and args.action in ("critique", "export-tasks"):
+            profile = load_profile(args.profile)
+            # Profile "models" come back as a list; args wants a CSV string.
+            if "models" in profile and not args.models:
+                args.models = ",".join(profile.pop("models"))
+            applied = apply_profile(args, profile)
+            if applied:
+                _err(f"profile '{args.profile}' applied: {', '.join(applied)}")
+
+        info = handle_info_command(args)
+        if info is not None:
+            return info
+        if args.action == "critique":
+            return run_critique(args)
+        if args.action == "export-tasks":
+            return handle_export_tasks(args)
+        if args.action == "diff":
+            return handle_diff(args)
+        if args.action == "registry":
+            return handle_registry(args, rest)
+        if args.action == "send-final":
+            return handle_send_final(args)
+        if args.action == "save-profile":
+            return handle_save_profile(args)
+        _err(f"error: unhandled action {args.action}")
+        return EXIT_ERROR
+    except SystemExit as e:
+        return int(e.code or 0)
+    except (FileNotFoundError, InvalidSessionId) as e:
+        _err(f"error: {e}")
+        return EXIT_VALIDATION
+    except Exception as e:
+        _err(f"error: {type(e).__name__}: {e}")
+        return EXIT_ERROR
+
+
+if __name__ == "__main__":
+    sys.exit(main())

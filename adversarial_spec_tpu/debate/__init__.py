@@ -1,0 +1,23 @@
+"""Debate orchestration: rounds, parsing, convergence, usage, sessions."""
+
+from adversarial_spec_tpu.debate.types import ModelResponse, RoundResult
+from adversarial_spec_tpu.debate.parsing import (
+    detect_agreement,
+    extract_spec,
+    extract_tasks,
+    get_critique_summary,
+    generate_diff,
+)
+from adversarial_spec_tpu.debate.usage import Usage, CostTracker
+
+__all__ = [
+    "ModelResponse",
+    "RoundResult",
+    "detect_agreement",
+    "extract_spec",
+    "extract_tasks",
+    "get_critique_summary",
+    "generate_diff",
+    "Usage",
+    "CostTracker",
+]

@@ -1,0 +1,161 @@
+"""Round orchestration: fan a spec out to N opponents, collect responses.
+
+Reference hot path: ``run_critique`` → ``call_models_parallel`` →
+ThreadPoolExecutor(thread per model) → per-model HTTP/subprocess call
+(scripts/debate.py:798-888, models.py:681-722). TPU-native restructure
+(SURVEY §1 "TPU mapping"): opponents are *grouped by engine* and each group is
+executed as ONE batched ``chat`` call — on the TPU engine that is N rows of a
+single sharded decode over the mesh, not N threads. The retry loop survives
+(it now covers recompile/OOM/transient device errors instead of HTTP 429s)
+with the reference's exact policy: 3 attempts, exponential backoff 1s/2s/4s
+(models.py:46-47), errors captured rather than raised, and rounds degrading
+gracefully when some opponents fail (debate.py:845-853).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from adversarial_spec_tpu.debate import prompts
+from adversarial_spec_tpu.debate.parsing import (
+    detect_agreement,
+    extract_spec,
+    has_malformed_spec,
+)
+from adversarial_spec_tpu.debate.types import ModelResponse, RoundResult
+from adversarial_spec_tpu.engine.dispatch import get_engine
+from adversarial_spec_tpu.engine.types import ChatRequest, Completion, SamplingParams
+
+MAX_RETRIES = 3
+RETRY_BASE_DELAY = 1.0
+
+
+@dataclass
+class RoundConfig:
+    """Everything that shapes one critique round's prompts and decode."""
+
+    doc_type: str = "generic"
+    focus: str | None = None
+    persona: str | None = None
+    preserve_intent: bool = False
+    press: bool = False
+    context_files: list[str] = field(default_factory=list)
+    sampling: SamplingParams = field(default_factory=SamplingParams)
+    # Injected for tests; defaults to real sleep for backoff.
+    sleep = staticmethod(time.sleep)
+
+
+def load_context_files(paths: list[str]) -> str:
+    """Concatenate supporting context files into a prompt block.
+
+    Parity: reference scripts/models.py:130-146 — repeatable ``--context``
+    flag, each file labeled, missing files raise with a clear message.
+    """
+    if not paths:
+        return ""
+    blocks = []
+    for p in paths:
+        path = Path(p)
+        if not path.is_file():
+            raise FileNotFoundError(f"context file not found: {p}")
+        blocks.append(f"--- CONTEXT FILE: {path.name} ---\n{path.read_text()}")
+    return "\n\n".join(blocks) + "\n\n"
+
+
+def build_request(
+    model: str, spec: str, round_num: int, cfg: RoundConfig
+) -> ChatRequest:
+    """Assemble one opponent's system+user messages."""
+    system = prompts.get_system_prompt(
+        doc_type=cfg.doc_type,
+        focus=cfg.focus,
+        persona=cfg.persona,
+        preserve_intent=cfg.preserve_intent,
+    )
+    template = (
+        prompts.PRESS_PROMPT_TEMPLATE if cfg.press else prompts.REVIEW_PROMPT_TEMPLATE
+    )
+    user = load_context_files(cfg.context_files) + template.format(
+        round=round_num, spec=spec
+    )
+    return ChatRequest(model=model, system=system, user=user)
+
+
+def _to_response(model: str, comp: Completion, latency_s: float) -> ModelResponse:
+    if not comp.ok:
+        return ModelResponse(
+            model=model, error=comp.error, usage=comp.usage, latency_s=latency_s
+        )
+    resp = ModelResponse(
+        model=model,
+        critique=comp.text,
+        agreed=detect_agreement(comp.text),
+        revised_spec=extract_spec(comp.text),
+        usage=comp.usage,
+        latency_s=latency_s,
+    )
+    if has_malformed_spec(comp.text):
+        # Parity: warn-not-crash on malformed [SPEC] (models.py:633-637);
+        # surfaced via the response so the CLI can print the warning.
+        resp.critique += "\n\n[warning: unterminated [SPEC] tag in response]"
+    return resp
+
+
+def run_round(
+    spec: str,
+    models: list[str],
+    round_num: int = 1,
+    cfg: RoundConfig | None = None,
+) -> RoundResult:
+    """Execute one critique round across all opponents.
+
+    Opponents are grouped by serving engine; each group is one batched chat
+    call. Transient per-request failures are retried with exponential
+    backoff (3 attempts total, sleeping 1 s then 2 s between them — the
+    reference's policy); retries re-batch only the failed requests, and a
+    nonzero ``sampling.timeout_s`` bounds the whole round (no retry starts
+    past the deadline).
+    """
+    cfg = cfg or RoundConfig()
+    deadline = (
+        time.monotonic() + cfg.sampling.timeout_s
+        if cfg.sampling.timeout_s > 0
+        else None
+    )
+    requests = [build_request(m, spec, round_num, cfg) for m in models]
+
+    # Group indices by engine so co-resident models batch together.
+    groups: dict[int, tuple[object, list[int]]] = {}
+    for i, req in enumerate(requests):
+        engine = get_engine(req.model)
+        groups.setdefault(id(engine), (engine, []))[1].append(i)
+
+    results: list[ModelResponse | None] = [None] * len(requests)
+    for engine, indices in groups.values():
+        pending = list(indices)
+        for attempt in range(MAX_RETRIES):
+            batch = [requests[i] for i in pending]
+            t0 = time.monotonic()
+            completions = engine.chat(batch, cfg.sampling)
+            latency = time.monotonic() - t0
+            still_pending = []
+            for i, comp in zip(pending, completions):
+                if not comp.ok and comp.transient and attempt < MAX_RETRIES - 1:
+                    still_pending.append(i)
+                else:
+                    results[i] = _to_response(requests[i].model, comp, latency)
+            pending = still_pending
+            if not pending:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break  # round budget exhausted: no further retries
+            cfg.sleep(RETRY_BASE_DELAY * (2**attempt))
+        for i in pending:  # exhausted retries
+            results[i] = ModelResponse(
+                model=requests[i].model, error="retries exhausted"
+            )
+
+    return RoundResult(responses=[r for r in results if r is not None],
+                       round_num=round_num)

@@ -1,0 +1,121 @@
+"""Session persistence and per-round spec checkpoints.
+
+Behavioral parity with reference scripts/session.py:
+- ``SessionState`` serialized as JSON under a sessions dir, written after
+  every round with ``round`` advanced and history appended
+  (session.py:16-39, debate.py:865-878).
+- ``--resume`` restores all debate arguments and the current spec
+  (session.py:41-50, debate.py:753-773).
+- Per-round spec snapshots under ``./.adversarial-spec-checkpoints/`` for
+  manual rollback (session.py:74-82).
+- Path-traversal guard on session ids (session.py:37-38, 45-46).
+
+All directories are module-level constants precisely so tests can patch them
+(the reference's patch-the-module-constant fixture pattern, SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import time
+from dataclasses import dataclass, field, asdict
+from pathlib import Path
+
+SESSIONS_DIR = Path.home() / ".config" / "adversarial-spec-tpu" / "sessions"
+CHECKPOINTS_DIR = Path(".adversarial-spec-checkpoints")
+
+_SESSION_ID_RE = re.compile(r"^[A-Za-z0-9._-]+$")
+
+
+class InvalidSessionId(ValueError):
+    pass
+
+
+def _validate_session_id(session_id: str) -> str:
+    if not session_id or not _SESSION_ID_RE.match(session_id):
+        raise InvalidSessionId(
+            f"invalid session id {session_id!r}: only letters, digits, "
+            "dot, underscore and dash are allowed"
+        )
+    return session_id
+
+
+@dataclass
+class SessionState:
+    """Resumable debate state: spec + round + all debate arguments."""
+
+    session_id: str
+    spec: str = ""
+    round: int = 1
+    doc_type: str = "generic"
+    models: list[str] = field(default_factory=list)
+    focus: str | None = None
+    persona: str | None = None
+    preserve_intent: bool = False
+    created_at: float = 0.0
+    updated_at: float = 0.0
+    # Per-round history: [{"round", "all_agreed", "models": {name: agreed}}].
+    history: list[dict] = field(default_factory=list)
+
+    def save(self, sessions_dir: Path | None = None) -> Path:
+        directory = Path(sessions_dir or SESSIONS_DIR)
+        _validate_session_id(self.session_id)
+        directory.mkdir(parents=True, exist_ok=True)
+        now = time.time()
+        if not self.created_at:
+            self.created_at = now
+        self.updated_at = now
+        path = directory / f"{self.session_id}.json"
+        path.write_text(json.dumps(asdict(self), indent=2))
+        return path
+
+    @classmethod
+    def load(
+        cls, session_id: str, sessions_dir: Path | None = None
+    ) -> "SessionState":
+        directory = Path(sessions_dir or SESSIONS_DIR)
+        _validate_session_id(session_id)
+        path = directory / f"{session_id}.json"
+        data = json.loads(path.read_text())
+        known = {f for f in cls.__dataclass_fields__}
+        return cls(**{k: v for k, v in data.items() if k in known})
+
+    @classmethod
+    def list_sessions(cls, sessions_dir: Path | None = None) -> list[dict]:
+        """Summaries of saved sessions, most recently updated first."""
+        directory = Path(sessions_dir or SESSIONS_DIR)
+        if not directory.is_dir():
+            return []
+        sessions = []
+        for path in directory.glob("*.json"):
+            try:
+                data = json.loads(path.read_text())
+            except (json.JSONDecodeError, OSError):
+                continue
+            sessions.append(
+                {
+                    "session_id": data.get("session_id", path.stem),
+                    "round": data.get("round", 1),
+                    "doc_type": data.get("doc_type", "generic"),
+                    "models": data.get("models", []),
+                    "updated_at": data.get("updated_at", 0.0),
+                }
+            )
+        sessions.sort(key=lambda s: s["updated_at"], reverse=True)
+        return sessions
+
+
+def save_checkpoint(
+    spec: str,
+    round_num: int,
+    session_id: str | None = None,
+    checkpoints_dir: Path | None = None,
+) -> Path:
+    """Snapshot the spec for this round to a rollback file."""
+    directory = Path(checkpoints_dir or CHECKPOINTS_DIR)
+    directory.mkdir(parents=True, exist_ok=True)
+    prefix = f"{_validate_session_id(session_id)}-" if session_id else ""
+    path = directory / f"{prefix}round-{round_num}.md"
+    path.write_text(spec)
+    return path

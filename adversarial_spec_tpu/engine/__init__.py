@@ -1,0 +1,31 @@
+"""Inference engines.
+
+This package replaces the reference's L1 transport layer (litellm HTTP to
+remote APIs, scripts/models.py:607-678; CLI subprocesses, :274-454) with
+in-process engines behind one interface:
+
+- ``mock://``  — scripted engine for tests/CI and BASELINE config 1.
+- ``tpu://``   — JAX/XLA engine: HF checkpoints → pjit-sharded params →
+  batched autoregressive decode on the TPU mesh.
+
+The prefix-dispatch seam mirrors the reference's ``model.startswith(prefix)``
+provider routing (scripts/models.py:506-558) — identified in SURVEY §5 as the
+cleanest extension point in the reference design.
+"""
+
+from adversarial_spec_tpu.engine.types import (
+    ChatRequest,
+    Completion,
+    SamplingParams,
+    Engine,
+)
+from adversarial_spec_tpu.engine.dispatch import get_engine, clear_engine_cache
+
+__all__ = [
+    "ChatRequest",
+    "Completion",
+    "SamplingParams",
+    "Engine",
+    "get_engine",
+    "clear_engine_cache",
+]

@@ -1,0 +1,47 @@
+"""Provider dispatch: model-id prefix → engine instance.
+
+Mirrors the reference's prefix routing (``codex/``, ``gemini-cli/``, else
+litellm — scripts/models.py:506-558), which SURVEY §5 calls out as the seam
+where ``tpu://`` slots in. Engines are cached: all ``tpu://`` models share one
+``TpuEngine`` so co-resident opponents can batch onto one mesh.
+"""
+
+from __future__ import annotations
+
+from adversarial_spec_tpu.engine.types import Engine
+
+_ENGINE_CACHE: dict[str, Engine] = {}
+
+
+def get_engine(model: str) -> Engine:
+    """Return the (cached) engine that serves this model id."""
+    if model.startswith("mock://"):
+        key = "mock"
+    elif model.startswith("tpu://"):
+        key = "tpu"
+    else:
+        raise ValueError(
+            f"unknown provider for model {model!r}: expected a 'mock://' or "
+            "'tpu://' id (remote HTTP providers are intentionally not part "
+            "of this framework — register a local checkpoint instead)"
+        )
+    if key not in _ENGINE_CACHE:
+        if key == "mock":
+            from adversarial_spec_tpu.engine.mock import MockEngine
+
+            _ENGINE_CACHE[key] = MockEngine()
+        else:
+            # Deferred import: pulls in jax; mock-only flows never pay it.
+            try:
+                from adversarial_spec_tpu.engine.tpu import TpuEngine
+            except ImportError as e:
+                raise ValueError(
+                    f"tpu:// engine unavailable in this installation: {e}"
+                ) from e
+            _ENGINE_CACHE[key] = TpuEngine()
+    return _ENGINE_CACHE[key]
+
+
+def clear_engine_cache() -> None:
+    """Test hook: drop cached engines (and their loaded weights)."""
+    _ENGINE_CACHE.clear()

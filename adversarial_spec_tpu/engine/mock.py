@@ -1,0 +1,148 @@
+"""Scripted mock engine — the fake backend at the engine seam.
+
+The reference's tests mock only the transport seam (``completion``,
+``subprocess.run``) and run everything above it for real (SURVEY §4). The TPU
+analog is this engine: it implements the same ``Engine`` interface as the TPU
+engine, so the entire debate loop — CLI, rounds, parsing, convergence,
+sessions, cost — runs unmodified on CPU with scripted critiques. It is also
+BASELINE config 1 (1-round critique, 1 opponent, mock provider, CPU).
+
+Model-id grammar (query params configure behavior):
+
+- ``mock://agree``                      — replies [AGREE] immediately.
+- ``mock://critic``                     — critiques forever, revising the spec.
+- ``mock://critic?agree_after=3``       — critiques rounds 1-2, agrees from 3.
+- ``mock://tasks``                      — emits structured [TASK] blocks
+                                          (for export-tasks flows).
+- ``mock://error``                      — permanent failure every call.
+- ``mock://flaky?fail=2``               — transient failures on the first 2
+                                          calls, then behaves like ``critic``.
+- any id with ``&tps=N``                — simulates N tokens/sec decode speed
+                                          in the reported usage (no sleeping).
+
+The round number is recovered from the round template's "Debate round {N}"
+header (prompts.REVIEW_PROMPT_TEMPLATE), the same information a real opponent
+sees.
+"""
+
+from __future__ import annotations
+
+import re
+from urllib.parse import parse_qs, urlparse
+
+from adversarial_spec_tpu.debate.usage import Usage
+from adversarial_spec_tpu.engine.types import ChatRequest, Completion, SamplingParams
+
+_ROUND_RE = re.compile(r"Debate round (\d+)")
+
+_CRITIQUES = [
+    "The error-handling section does not define behavior when the backing "
+    "store is unavailable; specify a timeout, retry policy, and user-facing "
+    "failure mode.",
+    "Success metrics are unmeasurable as written; attach a concrete metric "
+    "and measurement window to each goal.",
+    "The API section omits versioning; define how breaking changes reach "
+    "old clients.",
+    "No capacity assumptions are stated; add expected request rate and data "
+    "growth, and size the design against 10x those numbers.",
+    "The rollout section lacks a rollback trigger; define the metric "
+    "threshold that aborts the rollout.",
+]
+
+
+def _estimate_tokens(text: str) -> int:
+    """Cheap whitespace-ish token estimate (parity: the reference estimates
+    tokens for CLI providers that report none, scripts/models.py:274-454)."""
+    return max(1, len(text) // 4)
+
+
+class MockEngine:
+    """Deterministic scripted engine; safe to share across calls."""
+
+    def __init__(self) -> None:
+        # Per-model-id call counter, for flaky/fail-N behaviors. Mutated
+        # only from the (single-threaded) debate core.
+        self._calls: dict[str, int] = {}
+
+    def validate(self, model: str) -> str | None:
+        if not model.startswith("mock://"):
+            return f"not a mock model id: {model}"
+        return None
+
+    def chat(
+        self, requests: list[ChatRequest], params: SamplingParams
+    ) -> list[Completion]:
+        return [self._one(req, params) for req in requests]
+
+    def _one(self, req: ChatRequest, params: SamplingParams) -> Completion:
+        parsed = urlparse(req.model)
+        behavior = parsed.netloc or parsed.path.lstrip("/")
+        opts = {k: v[-1] for k, v in parse_qs(parsed.query).items()}
+        self._calls[req.model] = self._calls.get(req.model, 0) + 1
+        n_call = self._calls[req.model]
+
+        m = _ROUND_RE.search(req.user)
+        round_num = int(m.group(1)) if m else 1
+
+        if behavior == "tasks":
+            text = (
+                "[TASK]\ntitle: Define data model\ndescription: Schema and "
+                "migrations for the core entities.\npriority: critical\n"
+                "dependencies:\nestimate: 1d\n[/TASK]\n"
+                "[TASK]\ntitle: Implement API\ndescription: CRUD endpoints "
+                "with validation and error handling.\npriority: high\n"
+                "dependencies: Define data model\nestimate: 2d\n[/TASK]\n"
+                "[TASK]\ntitle: Add observability\ndescription: Metrics, "
+                "structured logs, and alerts for the API.\npriority: medium\n"
+                "dependencies: Implement API\nestimate: 1d\n[/TASK]"
+            )
+            out_tokens = _estimate_tokens(text)
+            return Completion(
+                text=text,
+                usage=Usage(
+                    input_tokens=_estimate_tokens(req.user),
+                    output_tokens=out_tokens,
+                    decode_tokens=out_tokens,
+                ),
+            )
+        if behavior == "error":
+            return Completion(
+                error=f"mock permanent failure (call {n_call})", transient=False
+            )
+        if behavior == "flaky":
+            fail_n = int(opts.get("fail", "1"))
+            if n_call <= fail_n:
+                return Completion(
+                    error=f"mock transient failure {n_call}/{fail_n}",
+                    transient=True,
+                )
+            behavior = "critic"
+
+        agree_after = int(opts.get("agree_after", "0"))
+        if behavior == "agree" or (agree_after and round_num >= agree_after):
+            text = "[AGREE]\nNo remaining objections; the document is ready."
+        else:
+            crit = _CRITIQUES[(round_num - 1) % len(_CRITIQUES)]
+            spec = _extract_document(req.user)
+            revised = spec + f"\n\n## Revision note (round {round_num})\n" + crit
+            text = (
+                f"1. {crit}\n\n[SPEC]\n{revised}\n[/SPEC]"
+            )
+
+        out_tokens = min(_estimate_tokens(text), params.max_new_tokens)
+        tps = float(opts.get("tps", "0"))
+        usage = Usage(
+            input_tokens=_estimate_tokens(req.system) + _estimate_tokens(req.user),
+            output_tokens=out_tokens,
+            decode_tokens=out_tokens,
+            decode_time_s=out_tokens / tps if tps > 0 else 0.0,
+        )
+        return Completion(text=text, usage=usage)
+
+
+def _extract_document(user_prompt: str) -> str:
+    start = user_prompt.find("--- DOCUMENT ---")
+    end = user_prompt.find("--- END DOCUMENT ---")
+    if start == -1 or end == -1:
+        return user_prompt.strip()
+    return user_prompt[start + len("--- DOCUMENT ---") : end].strip()

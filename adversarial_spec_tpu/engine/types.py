@@ -1,0 +1,75 @@
+"""Engine interface types.
+
+The engine interface is *batched by design*: one ``chat`` call takes N
+requests and may execute them as N rows of a single sharded decode. This is
+the TPU-native replacement for the reference's thread-per-model fan-out
+(scripts/models.py:681-722) — concurrency moves from Python threads into the
+batch dimension of one XLA program (SURVEY §2.3).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Protocol, runtime_checkable
+
+from adversarial_spec_tpu.debate.usage import Usage
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    """Decode-time sampling configuration (one set per chat call)."""
+
+    max_new_tokens: int = 1024
+    temperature: float = 0.7
+    top_p: float = 1.0
+    top_k: int = 0
+    greedy: bool = False
+    seed: int | None = None
+    # Best-effort wall-clock budget for one chat call; engines stop decoding
+    # (returning what they have) when exceeded. 0 = unlimited.
+    timeout_s: float = 0.0
+
+
+@dataclass(frozen=True)
+class ChatRequest:
+    """One opponent's prompt: model id + system/user messages."""
+
+    model: str
+    system: str
+    user: str
+    # Opaque metadata echoed back on the completion (e.g. persona label).
+    tag: str = ""
+
+
+@dataclass
+class Completion:
+    """One model's completion; ``error`` set instead of raising so a batch
+    can partially fail (parity: reference captures errors into
+    ModelResponse.error, scripts/models.py:553-555, 676-678)."""
+
+    text: str = ""
+    error: str | None = None
+    # Transient errors are retried by the caller; permanent ones are not.
+    transient: bool = False
+    usage: Usage = field(default_factory=Usage)
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None
+
+
+@runtime_checkable
+class Engine(Protocol):
+    """Minimal engine surface the debate core depends on."""
+
+    def chat(
+        self, requests: list[ChatRequest], params: SamplingParams
+    ) -> list[Completion]:
+        """Complete every request; must return len(requests) completions."""
+        ...
+
+    def validate(self, model: str) -> str | None:
+        """Return None if ``model`` is servable, else an actionable error
+        message (parity: credential preflight, reference
+        scripts/providers.py:418-486)."""
+        ...

@@ -1,0 +1,37 @@
+"""Test bootstrap.
+
+Runs on CPU with a virtual 8-device mesh (SURVEY §4: the reference mocks its
+transport seam and runs everything above it for real; our analogs are the
+mock engine plus ``--xla_force_host_platform_device_count=8`` so sharding
+code executes real collectives in one process). Env vars must be set before
+jax initializes, hence at conftest import time.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _isolate_state(tmp_path, monkeypatch):
+    """Point every persistence dir at tmp and reset engine singletons."""
+    from adversarial_spec_tpu.debate import session, profiles
+    from adversarial_spec_tpu.engine import registry, dispatch
+
+    monkeypatch.setattr(session, "SESSIONS_DIR", tmp_path / "sessions")
+    monkeypatch.setattr(session, "CHECKPOINTS_DIR", tmp_path / "checkpoints")
+    monkeypatch.setattr(profiles, "PROFILES_DIR", tmp_path / "profiles")
+    monkeypatch.setattr(
+        profiles, "GLOBAL_CONFIG_PATH", tmp_path / "config.json"
+    )
+    monkeypatch.setattr(registry, "REGISTRY_PATH", tmp_path / "registry.json")
+    dispatch.clear_engine_cache()
+    yield
+    dispatch.clear_engine_cache()

@@ -1,0 +1,154 @@
+"""Mock engine + round orchestration tests (reference analog:
+tests/test_model_calls.py — mixed agree/critique/error rounds, retry
+backoff sequencing)."""
+
+from adversarial_spec_tpu.debate.core import (
+    RoundConfig,
+    build_request,
+    load_context_files,
+    run_round,
+)
+from adversarial_spec_tpu.debate.prompts import PRESS_PROMPT_TEMPLATE
+from adversarial_spec_tpu.engine.mock import MockEngine
+from adversarial_spec_tpu.engine.types import ChatRequest, SamplingParams
+
+import pytest
+
+SPEC = "# Widget Service\n\nStores widgets."
+PARAMS = SamplingParams(max_new_tokens=512)
+
+
+def _req(model, round_num=1, spec=SPEC):
+    return build_request(model, spec, round_num, RoundConfig(doc_type="tech"))
+
+
+class TestMockEngine:
+    def test_agree_model(self):
+        comp = MockEngine().chat([_req("mock://agree")], PARAMS)[0]
+        assert comp.ok
+        assert "[AGREE]" in comp.text
+
+    def test_critic_produces_spec_revision(self):
+        comp = MockEngine().chat([_req("mock://critic")], PARAMS)[0]
+        assert "[SPEC]" in comp.text and "[/SPEC]" in comp.text
+        assert "[AGREE]" not in comp.text
+        assert comp.usage.input_tokens > 0
+        assert comp.usage.output_tokens > 0
+
+    def test_agree_after_round_threshold(self):
+        eng = MockEngine()
+        model = "mock://critic?agree_after=3"
+        assert "[AGREE]" not in eng.chat([_req(model, 1)], PARAMS)[0].text
+        assert "[AGREE]" not in eng.chat([_req(model, 2)], PARAMS)[0].text
+        assert "[AGREE]" in eng.chat([_req(model, 3)], PARAMS)[0].text
+
+    def test_error_model_permanent(self):
+        comp = MockEngine().chat([_req("mock://error")], PARAMS)[0]
+        assert not comp.ok
+        assert not comp.transient
+
+    def test_flaky_recovers(self):
+        eng = MockEngine()
+        model = "mock://flaky?fail=2"
+        first = eng.chat([_req(model)], PARAMS)[0]
+        assert not first.ok and first.transient
+        second = eng.chat([_req(model)], PARAMS)[0]
+        assert not second.ok and second.transient
+        third = eng.chat([_req(model)], PARAMS)[0]
+        assert third.ok
+
+    def test_simulated_tps_in_usage(self):
+        comp = MockEngine().chat([_req("mock://critic?tps=100")], PARAMS)[0]
+        assert comp.usage.decode_time_s > 0
+        assert (
+            abs(
+                comp.usage.decode_tokens / comp.usage.decode_time_s - 100.0
+            )
+            < 1e-6
+        )
+
+    def test_batch_returns_one_completion_per_request(self):
+        reqs = [_req("mock://agree"), _req("mock://critic")]
+        comps = MockEngine().chat(reqs, PARAMS)
+        assert len(comps) == 2
+
+    def test_validate(self):
+        assert MockEngine().validate("mock://agree") is None
+        assert MockEngine().validate("tpu://x") is not None
+
+
+class TestBuildRequest:
+    def test_press_uses_press_template(self):
+        cfg = RoundConfig(press=True)
+        req = build_request("m", SPEC, 2, cfg)
+        assert "PRESS ROUND" in req.user
+        assert PRESS_PROMPT_TEMPLATE.splitlines()[0].startswith(
+            "Debate round"
+        )
+
+    def test_round_number_embedded(self):
+        req = _req("m", round_num=7)
+        assert "Debate round 7" in req.user
+
+    def test_context_files_injected(self, tmp_path):
+        f = tmp_path / "notes.md"
+        f.write_text("remember the API limits")
+        cfg = RoundConfig(context_files=[str(f)])
+        req = build_request("m", SPEC, 1, cfg)
+        assert "CONTEXT FILE: notes.md" in req.user
+        assert "remember the API limits" in req.user
+
+    def test_missing_context_file_raises(self):
+        with pytest.raises(FileNotFoundError):
+            load_context_files(["/definitely/not/here.md"])
+
+
+class TestRunRound:
+    def test_mixed_agree_and_critique(self):
+        result = run_round(
+            SPEC, ["mock://agree", "mock://critic"], round_num=1
+        )
+        assert len(result.responses) == 2
+        by_model = {r.model: r for r in result.responses}
+        assert by_model["mock://agree"].agreed
+        assert not by_model["mock://critic"].agreed
+        assert by_model["mock://critic"].revised_spec is not None
+        assert not result.all_agreed
+
+    def test_all_agreed(self):
+        result = run_round(SPEC, ["mock://agree", "mock://agree"], 1)
+        assert result.all_agreed
+
+    def test_failed_model_excluded_from_agreement(self):
+        result = run_round(SPEC, ["mock://agree", "mock://error"], 1)
+        assert len(result.failed) == 1
+        assert result.all_agreed  # only successful responses count
+
+    def test_all_failed_means_not_agreed(self):
+        result = run_round(SPEC, ["mock://error"], 1)
+        assert not result.all_agreed
+
+    def test_transient_failure_retried_with_backoff(self, monkeypatch):
+        delays = []
+        cfg = RoundConfig()
+        monkeypatch.setattr(RoundConfig, "sleep", staticmethod(delays.append))
+        result = run_round(SPEC, ["mock://flaky?fail=2"], 1, cfg)
+        assert result.responses[0].ok
+        # Reference backoff policy: 1s then 2s (models.py:46-47).
+        assert delays == [1.0, 2.0]
+
+    def test_permanent_failure_not_retried(self, monkeypatch):
+        delays = []
+        monkeypatch.setattr(RoundConfig, "sleep", staticmethod(delays.append))
+        result = run_round(SPEC, ["mock://error"], 1)
+        assert delays == []
+        assert not result.responses[0].ok
+
+    def test_retries_exhausted(self, monkeypatch):
+        monkeypatch.setattr(RoundConfig, "sleep", staticmethod(lambda _: None))
+        result = run_round(SPEC, ["mock://flaky?fail=99"], 1)
+        assert not result.responses[0].ok
+
+    def test_usage_populated(self):
+        result = run_round(SPEC, ["mock://critic"], 1)
+        assert result.total_usage.total_tokens > 0

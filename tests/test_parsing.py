@@ -1,0 +1,138 @@
+"""Tag-protocol parser tests (reference analog: tests/test_models.py parser
+sections — mutation-hardened assertions on exact boundaries)."""
+
+from adversarial_spec_tpu.debate.parsing import (
+    detect_agreement,
+    extract_spec,
+    extract_tasks,
+    generate_diff,
+    get_critique_summary,
+    has_malformed_spec,
+)
+
+
+class TestDetectAgreement:
+    def test_bare_marker(self):
+        assert detect_agreement("[AGREE]")
+
+    def test_marker_with_commentary(self):
+        assert detect_agreement("Looks great.\n[AGREE]\nShip it.")
+
+    def test_no_marker(self):
+        assert not detect_agreement("I agree with most of this")
+
+    def test_case_sensitive(self):
+        assert not detect_agreement("[agree]")
+
+    def test_empty(self):
+        assert not detect_agreement("")
+
+
+class TestExtractSpec:
+    def test_simple(self):
+        assert extract_spec("x [SPEC]the spec[/SPEC] y") == "the spec"
+
+    def test_strips_whitespace(self):
+        assert extract_spec("[SPEC]\n  body \n[/SPEC]") == "body"
+
+    def test_missing_open(self):
+        assert extract_spec("no tags here") is None
+
+    def test_missing_close(self):
+        assert extract_spec("[SPEC] unterminated") is None
+
+    def test_close_before_open(self):
+        assert extract_spec("[/SPEC] backwards [SPEC]") is None
+
+    def test_widest_span_preserves_nested_tags(self):
+        text = "[SPEC]outer [SPEC]inner[/SPEC] tail[/SPEC]"
+        assert extract_spec(text) == "outer [SPEC]inner[/SPEC] tail"
+
+    def test_multiline(self):
+        spec = "# Title\n\nBody line 1\nBody line 2"
+        assert extract_spec(f"critique\n[SPEC]\n{spec}\n[/SPEC]\ndone") == spec
+
+    def test_malformed_detection(self):
+        assert has_malformed_spec("[SPEC] oops no close")
+        assert not has_malformed_spec("[SPEC]ok[/SPEC]")
+        assert not has_malformed_spec("no tags")
+
+
+class TestExtractTasks:
+    def test_full_fields(self):
+        text = """[TASK]
+title: Build the API
+description: REST endpoints for CRUD.
+priority: high
+dependencies: Schema design, Auth
+estimate: 3d
+[/TASK]"""
+        tasks = extract_tasks(text)
+        assert len(tasks) == 1
+        t = tasks[0]
+        assert t.title == "Build the API"
+        assert t.description == "REST endpoints for CRUD."
+        assert t.priority == "high"
+        assert t.dependencies == ["Schema design", "Auth"]
+        assert t.estimate == "3d"
+
+    def test_multiple_blocks(self):
+        text = "[TASK]\ntitle: A\n[/TASK]\nnoise\n[TASK]\ntitle: B\n[/TASK]"
+        assert [t.title for t in extract_tasks(text)] == ["A", "B"]
+
+    def test_priority_normalized(self):
+        text = "[TASK]\ntitle: X\npriority: URGENT!!\n[/TASK]"
+        assert extract_tasks(text)[0].priority == "medium"
+
+    def test_priority_case_insensitive(self):
+        text = "[TASK]\ntitle: X\npriority: CRITICAL\n[/TASK]"
+        assert extract_tasks(text)[0].priority == "critical"
+
+    def test_unstructured_block_uses_first_line_as_title(self):
+        text = "[TASK]\nDo the thing\nwith details\n[/TASK]"
+        t = extract_tasks(text)[0]
+        assert t.title == "Do the thing"
+        assert t.description == "with details"
+
+    def test_empty_block_skipped(self):
+        assert extract_tasks("[TASK]\n\n[/TASK]") == []
+
+    def test_no_blocks(self):
+        assert extract_tasks("just prose") == []
+
+    def test_bulleted_fields(self):
+        text = "[TASK]\n- title: Bulleted\n- priority: low\n[/TASK]"
+        t = extract_tasks(text)[0]
+        assert t.title == "Bulleted"
+        assert t.priority == "low"
+
+
+class TestCritiqueSummary:
+    def test_first_line(self):
+        assert get_critique_summary("First point.\nSecond.") == "First point."
+
+    def test_strips_agree_and_spec(self):
+        text = "[AGREE]\n[SPEC]hidden[/SPEC]\nActual comment"
+        assert get_critique_summary(text) == "Actual comment"
+
+    def test_truncation_boundary(self):
+        # Mutation hardening: exactly max_chars passes through untruncated.
+        line = "x" * 200
+        assert get_critique_summary(line, max_chars=200) == line
+        longer = "x" * 201
+        out = get_critique_summary(longer, max_chars=200)
+        assert len(out) == 200 and out.endswith("...")
+
+    def test_empty(self):
+        assert get_critique_summary("") == ""
+
+
+class TestGenerateDiff:
+    def test_identical(self):
+        assert generate_diff("same\n", "same\n") == ""
+
+    def test_labels_and_change(self):
+        d = generate_diff("a\nb\n", "a\nc\n")
+        assert "--- previous_spec" in d
+        assert "+++ revised_spec" in d
+        assert "-b" in d and "+c" in d

@@ -1,0 +1,103 @@
+"""Profile and global-config tests (reference analog: profile sections of
+tests/test_providers.py — flag-over-profile precedence)."""
+
+import argparse
+
+import pytest
+
+from adversarial_spec_tpu.debate.profiles import (
+    apply_profile,
+    list_profiles,
+    load_global_config,
+    load_profile,
+    save_global_config,
+    save_profile,
+)
+
+
+class TestProfiles:
+    def test_save_load_roundtrip(self):
+        save_profile("fast", {"models": ["mock://agree"], "doc_type": "tech"})
+        p = load_profile("fast")
+        assert p == {"models": ["mock://agree"], "doc_type": "tech"}
+
+    def test_unknown_fields_rejected_on_save(self):
+        with pytest.raises(ValueError, match="unknown profile fields"):
+            save_profile("bad", {"nonsense": 1})
+
+    def test_unknown_fields_filtered_on_load(self, tmp_path, monkeypatch):
+        from adversarial_spec_tpu.debate import profiles as mod
+
+        mod.PROFILES_DIR.mkdir(parents=True, exist_ok=True)
+        (mod.PROFILES_DIR / "hand.json").write_text(
+            '{"doc_type": "prd", "hacked": true}'
+        )
+        assert load_profile("hand") == {"doc_type": "prd"}
+
+    def test_load_missing_raises(self):
+        with pytest.raises(FileNotFoundError):
+            load_profile("absent")
+
+    def test_list_profiles(self):
+        save_profile("a", {"doc_type": "prd"})
+        save_profile("b", {"focus": "cost"})
+        profs = list_profiles()
+        assert set(profs) == {"a", "b"}
+
+    def test_list_profiles_empty(self):
+        assert list_profiles() == {}
+
+
+class TestApplyProfile:
+    def _args(self, **kw):
+        ns = argparse.Namespace(
+            models=None,
+            doc_type=None,
+            focus=None,
+            persona=None,
+            preserve_intent=False,
+            timeout=None,
+            max_new_tokens=None,
+            temperature=None,
+            mesh=None,
+            dtype=None,
+        )
+        for k, v in kw.items():
+            setattr(ns, k, v)
+        return ns
+
+    def test_fills_unset_only(self):
+        args = self._args(doc_type="tech")
+        applied = apply_profile(
+            args, {"doc_type": "prd", "focus": "security"}
+        )
+        assert args.doc_type == "tech"  # explicit flag wins
+        assert args.focus == "security"
+        assert applied == ["focus"]
+
+    def test_preserve_intent_false_is_fillable(self):
+        args = self._args()
+        apply_profile(args, {"preserve_intent": True})
+        assert args.preserve_intent is True
+
+    def test_unknown_profile_keys_ignored(self):
+        args = self._args()
+        applied = apply_profile(args, {"rogue": 1})
+        assert applied == []
+        assert not hasattr(args, "rogue")
+
+
+class TestGlobalConfig:
+    def test_roundtrip(self):
+        save_global_config({"default_mesh": {"tp": 4}})
+        assert load_global_config() == {"default_mesh": {"tp": 4}}
+
+    def test_missing_returns_empty(self):
+        assert load_global_config() == {}
+
+    def test_corrupt_returns_empty(self, tmp_path):
+        from adversarial_spec_tpu.debate import profiles as mod
+
+        mod.GLOBAL_CONFIG_PATH.parent.mkdir(parents=True, exist_ok=True)
+        mod.GLOBAL_CONFIG_PATH.write_text("{broken")
+        assert load_global_config() == {}

@@ -1,0 +1,95 @@
+"""Prompt-library integrity tests (reference analog: tests/test_prompts.py —
+content assertions on placeholders, keys, and lookup normalization)."""
+
+from adversarial_spec_tpu.debate import prompts
+
+
+class TestConstants:
+    def test_six_focus_areas(self):
+        assert set(prompts.FOCUS_AREAS) == {
+            "security",
+            "scalability",
+            "performance",
+            "ux",
+            "reliability",
+            "cost",
+        }
+
+    def test_ten_personas(self):
+        assert len(prompts.PERSONAS) == 10
+        assert "security-engineer" in prompts.PERSONAS
+        assert "legal-compliance" in prompts.PERSONAS
+
+    def test_personas_start_with_you_are(self):
+        for key, text in prompts.PERSONAS.items():
+            assert text.startswith("You are"), key
+
+    def test_round_placeholder_in_templates(self):
+        assert "{round}" in prompts.REVIEW_PROMPT_TEMPLATE
+        assert "{spec}" in prompts.REVIEW_PROMPT_TEMPLATE
+        assert "{round}" in prompts.PRESS_PROMPT_TEMPLATE
+        assert "{spec}" in prompts.PRESS_PROMPT_TEMPLATE
+        assert "{spec}" in prompts.EXPORT_TASKS_PROMPT
+
+    def test_templates_format_cleanly(self):
+        out = prompts.REVIEW_PROMPT_TEMPLATE.format(round=3, spec="S")
+        assert "Debate round 3" in out and "S" in out
+
+    def test_system_prompts_carry_protocol(self):
+        for p in (
+            prompts.SYSTEM_PROMPT_PRD,
+            prompts.SYSTEM_PROMPT_TECH,
+            prompts.SYSTEM_PROMPT_GENERIC,
+        ):
+            assert "[AGREE]" in p
+            assert "[SPEC]" in p and "[/SPEC]" in p
+
+
+class TestGetSystemPrompt:
+    def test_doc_type_selection(self):
+        assert "Product Requirements" in prompts.get_system_prompt("prd")
+        assert "technical specification" in prompts.get_system_prompt("tech")
+        assert prompts.get_system_prompt("nonsense") == prompts.get_system_prompt(
+            "generic"
+        )
+
+    def test_focus_appended(self):
+        p = prompts.get_system_prompt("tech", focus="security")
+        assert "PRIORITY FOCUS: security" in p
+
+    def test_unknown_focus_ignored(self):
+        base = prompts.get_system_prompt("tech")
+        assert prompts.get_system_prompt("tech", focus="nope") == base
+
+    def test_persona_key_lookup_and_normalization(self):
+        p = prompts.get_system_prompt("prd", persona="Security Engineer")
+        assert p.startswith(prompts.PERSONAS["security-engineer"])
+        p2 = prompts.get_system_prompt("prd", persona="security_engineer")
+        assert p2.startswith(prompts.PERSONAS["security-engineer"])
+
+    def test_freeform_persona_passthrough(self):
+        custom = "You are a grumpy kernel maintainer."
+        p = prompts.get_system_prompt("tech", persona=custom)
+        assert p.startswith(custom)
+
+    def test_preserve_intent_appended(self):
+        p = prompts.get_system_prompt("prd", preserve_intent=True)
+        assert "preserve the author's intent" in p
+
+    def test_all_options_compose(self):
+        p = prompts.get_system_prompt(
+            "tech",
+            focus="reliability",
+            persona="qa-engineer",
+            preserve_intent=True,
+        )
+        assert p.startswith(prompts.PERSONAS["qa-engineer"])
+        assert "PRIORITY FOCUS: reliability" in p
+        assert "preserve the author's intent" in p
+
+
+class TestDocTypeName:
+    def test_names(self):
+        assert prompts.get_doc_type_name("prd") == "Product Requirements Document"
+        assert prompts.get_doc_type_name("tech") == "Technical Specification"
+        assert prompts.get_doc_type_name("other") == "Document"

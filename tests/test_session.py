@@ -1,0 +1,108 @@
+"""Session persistence tests (reference analog: tests/test_session.py —
+exact filenames, defaults, sort order, traversal guard)."""
+
+import json
+
+import pytest
+
+from adversarial_spec_tpu.debate.session import (
+    InvalidSessionId,
+    SessionState,
+    save_checkpoint,
+)
+from adversarial_spec_tpu.debate import session as session_mod
+
+
+class TestSessionState:
+    def test_save_load_roundtrip(self):
+        s = SessionState(
+            session_id="proj-1",
+            spec="# Spec",
+            round=4,
+            doc_type="tech",
+            models=["mock://critic"],
+            focus="security",
+            persona="qa-engineer",
+            preserve_intent=True,
+            history=[{"round": 3, "all_agreed": False, "models": {}}],
+        )
+        path = s.save()
+        assert path.name == "proj-1.json"
+        loaded = SessionState.load("proj-1")
+        assert loaded.spec == "# Spec"
+        assert loaded.round == 4
+        assert loaded.doc_type == "tech"
+        assert loaded.models == ["mock://critic"]
+        assert loaded.focus == "security"
+        assert loaded.preserve_intent is True
+        assert loaded.history[0]["round"] == 3
+
+    def test_save_sets_timestamps(self):
+        s = SessionState(session_id="t")
+        s.save()
+        assert s.created_at > 0
+        assert s.updated_at >= s.created_at
+        created = s.created_at
+        s.save()
+        assert s.created_at == created  # created_at stable across saves
+
+    def test_load_missing_raises(self):
+        with pytest.raises(FileNotFoundError):
+            SessionState.load("absent")
+
+    def test_path_traversal_rejected(self):
+        for bad in ("../evil", "a/b", "", "x\\y", "a b"):
+            with pytest.raises(InvalidSessionId):
+                SessionState.save(SessionState(session_id=bad))
+            if bad:
+                with pytest.raises(InvalidSessionId):
+                    SessionState.load(bad)
+
+    def test_load_ignores_unknown_fields(self):
+        d = session_mod.SESSIONS_DIR
+        d.mkdir(parents=True)
+        (d / "x.json").write_text(
+            json.dumps({"session_id": "x", "spec": "s", "bogus": 1})
+        )
+        assert SessionState.load("x").spec == "s"
+
+    def test_list_sessions_sorted_most_recent_first(self):
+        a = SessionState(session_id="a")
+        a.save()
+        b = SessionState(session_id="b")
+        b.save()
+        b.updated_at = a.updated_at + 100
+        (session_mod.SESSIONS_DIR / "b.json").write_text(
+            json.dumps(
+                {"session_id": "b", "updated_at": b.updated_at, "round": 2}
+            )
+        )
+        sessions = SessionState.list_sessions()
+        assert [s["session_id"] for s in sessions] == ["b", "a"]
+
+    def test_list_sessions_empty_dir(self):
+        assert SessionState.list_sessions() == []
+
+    def test_list_sessions_skips_corrupt(self):
+        d = session_mod.SESSIONS_DIR
+        d.mkdir(parents=True)
+        (d / "bad.json").write_text("{not json")
+        SessionState(session_id="good").save()
+        assert [s["session_id"] for s in SessionState.list_sessions()] == [
+            "good"
+        ]
+
+
+class TestCheckpoints:
+    def test_checkpoint_filename_without_session(self):
+        p = save_checkpoint("spec text", 3)
+        assert p.name == "round-3.md"
+        assert p.read_text() == "spec text"
+
+    def test_checkpoint_filename_with_session(self):
+        p = save_checkpoint("s", 1, session_id="proj")
+        assert p.name == "proj-round-1.md"
+
+    def test_checkpoint_session_id_validated(self):
+        with pytest.raises(InvalidSessionId):
+            save_checkpoint("s", 1, session_id="../evil")

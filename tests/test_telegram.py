@@ -1,0 +1,198 @@
+"""Telegram channel tests (reference analog: tests/test_telegram_bot.py —
+mocked urlopen, chunk-boundary assertions, stepped clocks for polling)."""
+
+import io
+import json
+from unittest.mock import MagicMock, patch
+
+import pytest
+
+from adversarial_spec_tpu.debate import telegram
+from adversarial_spec_tpu.debate.types import ModelResponse, RoundResult
+
+CFG = telegram.TelegramConfig(token="tok", chat_id="42")
+
+
+def _mock_urlopen(payloads):
+    """urlopen mock returning successive JSON payloads as context managers."""
+    responses = []
+    for p in payloads:
+        cm = MagicMock()
+        cm.__enter__.return_value = io.BytesIO(json.dumps(p).encode())
+        responses.append(cm)
+    return MagicMock(side_effect=responses)
+
+
+class TestConfig:
+    def test_present(self, monkeypatch):
+        monkeypatch.setenv("TELEGRAM_BOT_TOKEN", "t")
+        monkeypatch.setenv("TELEGRAM_CHAT_ID", "c")
+        cfg = telegram.get_config()
+        assert cfg == telegram.TelegramConfig(token="t", chat_id="c")
+
+    def test_missing(self, monkeypatch):
+        monkeypatch.delenv("TELEGRAM_BOT_TOKEN", raising=False)
+        monkeypatch.delenv("TELEGRAM_CHAT_ID", raising=False)
+        assert telegram.get_config() is None
+
+    def test_blank_is_missing(self, monkeypatch):
+        monkeypatch.setenv("TELEGRAM_BOT_TOKEN", "  ")
+        monkeypatch.setenv("TELEGRAM_CHAT_ID", "c")
+        assert telegram.get_config() is None
+
+
+class TestApiCall:
+    def test_ok_payload(self):
+        with patch.object(
+            telegram.urllib.request,
+            "urlopen",
+            _mock_urlopen([{"ok": True, "result": {"x": 1}}]),
+        ) as m:
+            out = telegram.api_call("tok", "sendMessage", {"a": "b"})
+        assert out == {"x": 1}
+        req = m.call_args[0][0]
+        assert "bottok/sendMessage" in req.full_url
+        assert m.call_args[1]["timeout"] == telegram.API_TIMEOUT_S
+
+    def test_not_ok_raises(self):
+        with patch.object(
+            telegram.urllib.request,
+            "urlopen",
+            _mock_urlopen([{"ok": False, "description": "bad"}]),
+        ):
+            with pytest.raises(RuntimeError, match="sendMessage failed"):
+                telegram.api_call("tok", "sendMessage")
+
+
+class TestSplitMessage:
+    def test_short_single_chunk(self):
+        assert telegram.split_message("hello") == ["hello"]
+
+    def test_empty(self):
+        assert telegram.split_message("") == []
+
+    def test_exact_limit_not_split(self):
+        text = "x" * telegram.MAX_MESSAGE_LEN
+        assert telegram.split_message(text) == [text]
+
+    def test_over_limit_splits(self):
+        text = "x" * (telegram.MAX_MESSAGE_LEN + 1)
+        chunks = telegram.split_message(text)
+        assert len(chunks) == 2
+        assert all(len(c) <= telegram.MAX_MESSAGE_LEN for c in chunks)
+
+    def test_prefers_paragraph_boundary(self):
+        a = "a" * 3000
+        b = "b" * 2000
+        chunks = telegram.split_message(a + "\n\n" + b)
+        assert chunks[0] == a
+        assert chunks[1] == b
+
+    def test_break_only_in_second_half(self):
+        # A space at position 10 must NOT be used (first half of window).
+        text = "y" * 10 + " " + "z" * 5000
+        chunks = telegram.split_message(text, limit=100)
+        assert len(chunks[0]) == 100
+
+    def test_content_preserved(self):
+        words = ("word " * 2000).strip()
+        chunks = telegram.split_message(words, limit=500)
+        assert "".join(chunks).replace("\n", " ").split() == words.split()
+
+
+class TestSendLongMessage:
+    def test_paced_chunks(self):
+        sleeps = []
+        sent = []
+        with patch.object(
+            telegram, "send_message", lambda cfg, text: sent.append(text)
+        ):
+            n = telegram.send_long_message(
+                CFG, "a" * 5000, sleep=sleeps.append
+            )
+        assert n == 2 and len(sent) == 2
+        assert sleeps == [telegram.CHUNK_PACING_S]  # no sleep after last
+
+
+class TestPolling:
+    def test_reply_from_right_chat(self):
+        payloads = [
+            {
+                "ok": True,
+                "result": [
+                    {
+                        "update_id": 7,
+                        "message": {"chat": {"id": 99}, "text": "wrong chat"},
+                    },
+                    {
+                        "update_id": 8,
+                        "message": {"chat": {"id": 42}, "text": "do it"},
+                    },
+                ],
+            }
+        ]
+        with patch.object(
+            telegram.urllib.request, "urlopen", _mock_urlopen(payloads)
+        ):
+            reply = telegram.poll_for_reply(
+                CFG, after_update_id=5, timeout_s=10
+            )
+        assert reply == "do it"
+
+    def test_timeout_returns_none(self):
+        clock_vals = iter([0.0, 0.0, 5.0, 11.0, 11.0])
+        payloads = [{"ok": True, "result": []}] * 5
+        with patch.object(
+            telegram.urllib.request, "urlopen", _mock_urlopen(payloads)
+        ):
+            reply = telegram.poll_for_reply(
+                CFG,
+                after_update_id=0,
+                timeout_s=10,
+                clock=lambda: next(clock_vals),
+            )
+        assert reply is None
+
+    def test_get_last_update_id(self):
+        payloads = [
+            {"ok": True, "result": [{"update_id": 3}, {"update_id": 9}]}
+        ]
+        with patch.object(
+            telegram.urllib.request, "urlopen", _mock_urlopen(payloads)
+        ):
+            assert telegram.get_last_update_id(CFG) == 9
+
+    def test_get_last_update_id_empty(self):
+        with patch.object(
+            telegram.urllib.request,
+            "urlopen",
+            _mock_urlopen([{"ok": True, "result": []}]),
+        ):
+            assert telegram.get_last_update_id(CFG) == 0
+
+
+class TestRoundSummary:
+    def test_format(self):
+        result = RoundResult(
+            responses=[
+                ModelResponse(model="a", agreed=True, critique="[AGREE]"),
+                ModelResponse(
+                    model="b", critique="1. Needs error handling."
+                ),
+                ModelResponse(model="c", error="boom"),
+            ],
+            round_num=2,
+        )
+        text = telegram.format_round_summary(result, total_cost=0.12)
+        assert "Debate round 2" in text
+        assert "✓ a: AGREE" in text
+        assert "Needs error handling" in text
+        assert "✗ c: ERROR boom" in text
+        assert "Debate continues." in text
+        assert "$0.1200" in text
+
+    def test_all_agree_banner(self):
+        result = RoundResult(
+            responses=[ModelResponse(model="a", agreed=True)], round_num=1
+        )
+        assert "All models agree!" in telegram.format_round_summary(result)

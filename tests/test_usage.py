@@ -1,0 +1,85 @@
+"""Usage/CostTracker tests (reference analog: CostTracker tests in
+tests/test_models.py — including the documented '/ to *' mutation kill)."""
+
+from adversarial_spec_tpu.debate.usage import (
+    CostTracker,
+    Usage,
+    model_cost_rates,
+)
+
+
+class TestUsage:
+    def test_add(self):
+        a = Usage(input_tokens=10, output_tokens=5, device_time_s=1.0)
+        b = Usage(input_tokens=1, output_tokens=2, device_time_s=0.5)
+        c = a + b
+        assert c.input_tokens == 11
+        assert c.output_tokens == 7
+        assert c.device_time_s == 1.5
+
+    def test_total_tokens(self):
+        assert Usage(input_tokens=3, output_tokens=4).total_tokens == 7
+
+    def test_cost_division_by_million(self):
+        # Mutation kill: '/' → '*' would make this astronomically large.
+        u = Usage(input_tokens=1_000_000, output_tokens=1_000_000)
+        assert u.cost_for("mock://critic") == 3.0  # $1 in + $2 out
+
+    def test_tpu_models_are_free(self):
+        u = Usage(input_tokens=1_000_000, output_tokens=1_000_000)
+        assert u.cost_for("tpu://random-8b") == 0.0
+
+    def test_unknown_model_default_cost(self):
+        assert Usage(input_tokens=1000).cost_for("unknown://x") == 0.0
+
+
+class TestModelCostRates:
+    def test_longest_prefix_wins(self):
+        assert model_cost_rates("mock://critic?agree_after=2") == (1.0, 2.0)
+
+    def test_bare_prefix(self):
+        assert model_cost_rates("mock://other") == (1.0, 2.0)
+
+
+class TestCostTracker:
+    def test_accumulates_per_model(self):
+        t = CostTracker()
+        t.add("m1", Usage(input_tokens=10, output_tokens=1))
+        t.add("m1", Usage(input_tokens=5, output_tokens=2))
+        t.add("m2", Usage(input_tokens=7))
+        assert t.by_model["m1"].input_tokens == 15
+        assert t.by_model["m1"].output_tokens == 3
+        assert t.by_model["m2"].input_tokens == 7
+        assert t.total_usage.total_tokens == 25
+
+    def test_total_cost(self):
+        t = CostTracker()
+        t.add("mock://a", Usage(input_tokens=2_000_000))
+        t.add("tpu://x", Usage(input_tokens=2_000_000))
+        assert t.total_cost == 2.0
+
+    def test_tokens_per_sec(self):
+        t = CostTracker()
+        t.add("m", Usage(decode_tokens=100, decode_time_s=2.0))
+        assert t.tokens_per_sec() == 50.0
+        assert t.tokens_per_sec("m") == 50.0
+        assert t.tokens_per_sec("absent") == 0.0
+
+    def test_report_shape(self):
+        t = CostTracker()
+        t.add("m", Usage(input_tokens=1, output_tokens=2, device_time_s=0.1))
+        rep = t.report()
+        assert set(rep) == {
+            "models",
+            "total_tokens",
+            "total_cost_usd",
+            "total_device_time_s",
+        }
+        assert rep["models"]["m"]["input_tokens"] == 1
+        assert "cost_usd" in rep["models"]["m"]
+
+    def test_format_text_contains_total(self):
+        t = CostTracker()
+        t.add("m", Usage(input_tokens=1, output_tokens=1))
+        text = t.format_text()
+        assert "TOTAL" in text and "m:" in text
