@@ -142,8 +142,8 @@ def create_parser() -> argparse.ArgumentParser:
     d.add_argument(
         "--timeout",
         type=float,
-        default=600.0,
-        help="Per-round wall-clock budget in seconds",
+        default=None,
+        help="Per-round wall-clock budget in seconds (default 600)",
     )
 
     r = parser.add_argument_group("registry")
@@ -215,7 +215,7 @@ def _sampling_from_args(args: argparse.Namespace) -> SamplingParams:
         temperature=0.7 if args.temperature is None else args.temperature,
         greedy=bool(args.greedy),
         seed=args.seed,
-        timeout_s=max(0.0, float(args.timeout or 0.0)),
+        timeout_s=max(0.0, float(600.0 if args.timeout is None else args.timeout)),
     )
 
 

@@ -23,7 +23,7 @@ GLOBAL_CONFIG_PATH = (
 
 # Settings a profile may carry. Mirrors the reference's profile surface
 # (models/doc-type/focus/persona/preserve-intent/timeout) plus TPU-native
-# fields (mesh shape, dtype, max new tokens).
+# decode fields. Mesh/dtype live in the model registry, not profiles.
 PROFILE_FIELDS = (
     "models",
     "doc_type",
@@ -33,8 +33,6 @@ PROFILE_FIELDS = (
     "timeout",
     "max_new_tokens",
     "temperature",
-    "mesh",
-    "dtype",
 )
 
 

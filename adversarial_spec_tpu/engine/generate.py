@@ -1,0 +1,306 @@
+"""Batched autoregressive generation: jitted prefill + chunked decode.
+
+Execution model (TPU-first, SURVEY §3.1 "TPU mapping" — the reference's
+network boundary becomes a device-program dispatch; its per-model retry hot
+loop becomes this decode loop):
+
+- **Left-padded static batches.** N opponents' prompts are left-padded to a
+  shared bucketed length, so every row's KV lands at the same slot index
+  (one ``dynamic_update_slice`` per layer, no per-row scatter) and the last
+  prompt logit is always at slot ``S-1``. Bucketing (powers of two) bounds
+  the number of compiled prefill programs.
+- **Prefill** is one jitted forward over the whole padded prompt (MXU-sized
+  matmuls), returning the first sampled token.
+- **Decode** runs as a ``lax.while_loop`` of single-token steps *inside*
+  jit, emitted in host-level chunks of ``DECODE_CHUNK`` steps: the loop
+  early-exits when every row hit EOS, and the host checks the wall-clock
+  budget between chunks (the enforcement point for SamplingParams.timeout_s
+  — an XLA program cannot be interrupted mid-flight).
+
+The same code path serves 1 opponent on 1 chip and N opponents TP-sharded
+over a mesh: sharding enters via the params/cache shardings baked into the
+jitted functions (parallel/sharding.py), not via this file's logic.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from adversarial_spec_tpu.engine.sampling import sample_tokens
+from adversarial_spec_tpu.models.config import ModelConfig
+from adversarial_spec_tpu.models.transformer import (
+    Cache,
+    Params,
+    forward,
+    init_cache,
+)
+
+DECODE_CHUNK = 64
+MIN_BUCKET = 128
+
+
+def bucket_length(n: int, minimum: int = MIN_BUCKET) -> int:
+    """Next power-of-two bucket ≥ n (≥ minimum) — bounds recompiles."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+def pad_batch(
+    prompt_ids: list[list[int]], pad_id: int, bucket: int | None = None
+) -> tuple[np.ndarray, np.ndarray]:
+    """Left-pad prompts to a shared bucketed length.
+
+    Returns (tokens [B, S] int32, pad_lens [B] int32).
+    """
+    max_len = max(len(p) for p in prompt_ids)
+    S = bucket if bucket is not None else bucket_length(max_len)
+    if S < max_len:
+        raise ValueError(f"bucket {S} smaller than longest prompt {max_len}")
+    B = len(prompt_ids)
+    tokens = np.full((B, S), pad_id, dtype=np.int32)
+    pad_lens = np.zeros((B,), dtype=np.int32)
+    for i, p in enumerate(prompt_ids):
+        tokens[i, S - len(p) :] = np.asarray(p, dtype=np.int32)
+        pad_lens[i] = S - len(p)
+    return tokens, pad_lens
+
+
+@partial(jax.jit, static_argnames=("cfg", "total_len", "greedy", "top_k"))
+def prefill_step(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, S] left-padded
+    pad_lens: jnp.ndarray,  # [B]
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    top_p: jnp.ndarray,
+    *,
+    total_len: int,
+    greedy: bool,
+    top_k: int,
+) -> tuple[Cache, jnp.ndarray]:
+    """Run the prompt through the model; sample the first new token."""
+    B, S = tokens.shape
+    cache = init_cache(cfg, B, total_len, dtype=params["embed"].dtype)
+    positions = jnp.maximum(
+        jnp.arange(S, dtype=jnp.int32)[None, :] - pad_lens[:, None], 0
+    )
+    kv_valid = jnp.arange(total_len)[None, :] >= pad_lens[:, None]
+    logits, cache = forward(
+        params, cfg, tokens, positions, cache, jnp.int32(0), kv_valid
+    )
+    first = sample_tokens(
+        logits[:, -1],
+        key,
+        greedy=greedy,
+        top_k=top_k,
+        temperature=temperature,
+        top_p=top_p,
+    )
+    return cache, first
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "prompt_len", "chunk", "greedy", "top_k"),
+    donate_argnames=("cache", "out_buf"),
+)
+def decode_chunk_steps(
+    params: Params,
+    cfg: ModelConfig,
+    cache: Cache,
+    cur_tokens: jnp.ndarray,  # [B] last sampled token per row
+    pad_lens: jnp.ndarray,  # [B]
+    finished: jnp.ndarray,  # [B] bool
+    out_buf: jnp.ndarray,  # [B, max_new]
+    start_step: jnp.ndarray,  # scalar: decode step at chunk entry
+    stop_at: jnp.ndarray,  # scalar: decode no further than this step
+    eos_ids: jnp.ndarray,  # [E]
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    top_p: jnp.ndarray,
+    *,
+    prompt_len: int,
+    chunk: int,
+    greedy: bool,
+    top_k: int,
+) -> tuple[Cache, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Up to ``chunk`` single-token decode steps inside one XLA program.
+
+    The while_loop early-exits once every row is finished, so converged
+    batches don't burn MXU cycles padding out the chunk.
+    """
+    B = cur_tokens.shape[0]
+    T = cache["k"].shape[2]
+    max_new = out_buf.shape[1]
+    kv_base = jnp.arange(T)[None, :] >= pad_lens[:, None]
+
+    def cond(state):
+        step, _, _, finished, _, _ = state
+        bound = jnp.minimum(
+            jnp.minimum(start_step + chunk, stop_at), max_new
+        )
+        return (step < bound) & ~finished.all()
+
+    def body(state):
+        step, cur, cache, finished, out_buf, key = state
+        # ``cur`` is the token at out index step-1, i.e. sequence slot
+        # prompt_len + step - 1 (slot prompt_len holds the first sampled
+        # token; prompt KV occupies [0, prompt_len)).
+        cache_index = prompt_len + step - 1
+        positions = (cache_index - pad_lens)[:, None]
+        kv_valid = kv_base & (jnp.arange(T)[None, :] <= cache_index)
+        logits, cache = forward(
+            params,
+            cfg,
+            cur[:, None],
+            positions,
+            cache,
+            cache_index,
+            kv_valid,
+        )
+        key, sub = jax.random.split(key)
+        nxt = sample_tokens(
+            logits[:, 0],
+            sub,
+            greedy=greedy,
+            top_k=top_k,
+            temperature=temperature,
+            top_p=top_p,
+        )
+        is_eos = (nxt[:, None] == eos_ids[None, :]).any(axis=-1)
+        nxt = jnp.where(finished, 0, nxt)
+        out_buf = jax.lax.dynamic_update_slice(
+            out_buf, nxt[:, None], (0, step)
+        )
+        finished = finished | is_eos
+        return step + 1, nxt, cache, finished, out_buf, key
+
+    step, cur, cache, finished, out_buf, key = jax.lax.while_loop(
+        cond,
+        body,
+        (start_step, cur_tokens, cache, finished, out_buf, key),
+    )
+    return cache, cur, finished, out_buf, step
+
+
+@dataclass
+class GenerateResult:
+    tokens: np.ndarray  # [B, <=max_new] generated ids (0 past each row's end)
+    n_generated: np.ndarray  # [B] tokens produced per row (incl. EOS)
+    prefill_time_s: float
+    decode_time_s: float
+    decode_tokens: int  # total across batch (north-star numerator)
+    timed_out: bool = False
+
+
+def generate(
+    params: Params,
+    cfg: ModelConfig,
+    prompt_ids: list[list[int]],
+    *,
+    max_new_tokens: int,
+    eos_ids: list[int],
+    pad_id: int = 0,
+    greedy: bool = False,
+    temperature: float = 0.7,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    seed: int | None = None,
+    timeout_s: float = 0.0,
+) -> GenerateResult:
+    """End-to-end batched generation (host orchestration)."""
+    tokens_np, pad_lens_np = pad_batch(prompt_ids, pad_id)
+    B, S = tokens_np.shape
+    max_new = bucket_length(max_new_tokens, minimum=DECODE_CHUNK)
+    total_len = S + max_new
+
+    tokens = jnp.asarray(tokens_np)
+    pad_lens = jnp.asarray(pad_lens_np)
+    key = jax.random.key(seed if seed is not None else 0)
+    key, prefill_key = jax.random.split(key)
+    temp = jnp.float32(temperature)
+    tp = jnp.float32(top_p)
+    eos = jnp.asarray(sorted(set(eos_ids)) or [-1], dtype=jnp.int32)
+
+    deadline = time.monotonic() + timeout_s if timeout_s > 0 else None
+
+    t0 = time.monotonic()
+    cache, first = prefill_step(
+        params,
+        cfg,
+        tokens,
+        pad_lens,
+        prefill_key,
+        temp,
+        tp,
+        total_len=total_len,
+        greedy=greedy,
+        top_k=top_k,
+    )
+    first.block_until_ready()
+    prefill_time = time.monotonic() - t0
+
+    out_buf = jnp.zeros((B, max_new), jnp.int32)
+    is_eos_first = (first[:, None] == eos[None, :]).any(axis=-1)
+    out_buf = out_buf.at[:, 0].set(first)
+    finished = is_eos_first
+    cur = first
+    step = jnp.int32(1)
+    timed_out = False
+
+    t1 = time.monotonic()
+    while int(step) < max_new_tokens and not bool(finished.all()):
+        if deadline is not None and time.monotonic() >= deadline:
+            timed_out = True
+            break
+        key, chunk_key = jax.random.split(key)
+        cache, cur, finished, out_buf, step = decode_chunk_steps(
+            params,
+            cfg,
+            cache,
+            cur,
+            pad_lens,
+            finished,
+            out_buf,
+            step,
+            jnp.int32(max_new_tokens),
+            eos,
+            chunk_key,
+            temp,
+            tp,
+            prompt_len=S,
+            chunk=DECODE_CHUNK,
+            greedy=greedy,
+            top_k=top_k,
+        )
+        step.block_until_ready()
+    decode_time = time.monotonic() - t1
+
+    out_np = np.asarray(out_buf)[:, :max_new_tokens]
+    n_steps = min(int(step), max_new_tokens)
+    eos_np = np.asarray(sorted(set(eos_ids)) or [-1])
+    n_generated = np.zeros((B,), np.int64)
+    for b in range(B):
+        row = out_np[b, :n_steps]
+        eos_hits = np.isin(row, eos_np)
+        if eos_hits.any():
+            n_generated[b] = int(np.argmax(eos_hits)) + 1
+        else:
+            n_generated[b] = n_steps
+    return GenerateResult(
+        tokens=out_np,
+        n_generated=n_generated,
+        prefill_time_s=prefill_time,
+        decode_time_s=decode_time,
+        decode_tokens=int(n_generated.sum()),
+        timed_out=timed_out,
+    )

@@ -1,0 +1,171 @@
+"""Checkpoint materialization: HF safetensors → layer-stacked JAX pytrees.
+
+TPU-native replacement for the reference's "model access" (API keys →
+remote weights, scripts/providers.py:418-486): here access = reading HF
+checkpoint dirs (``*.safetensors`` + config) into the transformer's
+layer-stacked param pytree (models/transformer.py), transposing Linear
+weights from torch's [out, in] to matmul-friendly [in, out] and stacking
+per-layer tensors along a leading ``n_layers`` axis for scan-over-layers.
+
+``checkpoint == "random"`` materializes synthetic weights of the family's
+real shape (zero-egress test/bench path). Sharded materialization for big
+models: the loader yields tensors one at a time so the caller can place
+each shard on-device before the next is read (host RAM stays bounded —
+SURVEY §7 hard part (c)).
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from adversarial_spec_tpu.models.config import ModelConfig, get_config
+from adversarial_spec_tpu.models.transformer import Params, init_params
+
+# Our layer-param name → HF per-layer tensor name (layers.{i} prefix added).
+_HF_LAYER_MAP = {
+    "attn_norm": "input_layernorm.weight",
+    "wq": "self_attn.q_proj.weight",
+    "wk": "self_attn.k_proj.weight",
+    "wv": "self_attn.v_proj.weight",
+    "wo": "self_attn.o_proj.weight",
+    "bq": "self_attn.q_proj.bias",
+    "bk": "self_attn.k_proj.bias",
+    "bv": "self_attn.v_proj.bias",
+    "ffn_norm": "post_attention_layernorm.weight",
+    "w_gate": "mlp.gate_proj.weight",
+    "w_up": "mlp.up_proj.weight",
+    "w_down": "mlp.down_proj.weight",
+    # Gemma-2 sandwich norms (HF names).
+    "post_attn_norm": "post_attention_layernorm.weight",
+    "ffn_norm_gemma2": "pre_feedforward_layernorm.weight",
+    "post_ffn_norm": "post_feedforward_layernorm.weight",
+}
+
+_TRANSPOSE = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}
+
+
+def _open_safetensors(ckpt_dir: Path):
+    """Return {tensor_name: (file, name)} across all shards."""
+    from safetensors import safe_open
+
+    index_path = ckpt_dir / "model.safetensors.index.json"
+    files: dict[str, Path] = {}
+    if index_path.is_file():
+        index = json.loads(index_path.read_text())
+        for name, fname in index["weight_map"].items():
+            files[name] = ckpt_dir / fname
+    else:
+        shards = sorted(ckpt_dir.glob("*.safetensors"))
+        if not shards:
+            raise FileNotFoundError(f"no *.safetensors under {ckpt_dir}")
+        for shard in shards:
+            with safe_open(str(shard), framework="numpy") as f:
+                for name in f.keys():
+                    files[name] = shard
+    return files
+
+
+def _read_tensor(files: dict, name: str) -> np.ndarray:
+    from safetensors import safe_open
+
+    if name not in files:
+        raise KeyError(f"tensor {name!r} missing from checkpoint")
+    with safe_open(str(files[name]), framework="numpy") as f:
+        return f.get_tensor(name)
+
+
+def load_hf_checkpoint(
+    ckpt_dir: str | Path,
+    cfg: ModelConfig,
+    family: str,
+    dtype: jnp.dtype = jnp.bfloat16,
+    device_put=None,
+) -> Params:
+    """Read an HF checkpoint dir into the layer-stacked pytree.
+
+    ``device_put(path_tuple, np_array) -> jax.Array`` lets the caller shard
+    each tensor as it is read (defaults to plain jnp.asarray on the default
+    device).
+    """
+    ckpt_dir = Path(ckpt_dir)
+    files = _open_safetensors(ckpt_dir)
+    put = device_put or (lambda path, arr: jnp.asarray(arr, dtype=dtype))
+
+    prefix = "model."
+
+    def hf_name(layer_key: str) -> str:
+        if family == "gemma2" and layer_key == "ffn_norm":
+            return _HF_LAYER_MAP["ffn_norm_gemma2"]
+        return _HF_LAYER_MAP[layer_key]
+
+    def stack(layer_key: str) -> np.ndarray:
+        suffix = hf_name(layer_key)
+        per_layer = []
+        for i in range(cfg.n_layers):
+            t = _read_tensor(files, f"{prefix}layers.{i}.{suffix}")
+            t = np.asarray(t)
+            if layer_key in _TRANSPOSE:
+                t = t.T  # torch Linear [out, in] → [in, out]
+            per_layer.append(t)
+        return np.stack(per_layer)
+
+    layer_keys = [
+        "attn_norm",
+        "wq",
+        "wk",
+        "wv",
+        "wo",
+        "ffn_norm",
+        "w_gate",
+        "w_up",
+        "w_down",
+    ]
+    if cfg.qkv_bias:
+        layer_keys += ["bq", "bk", "bv"]
+    if cfg.post_norms:
+        layer_keys += ["post_attn_norm", "post_ffn_norm"]
+
+    layers = {
+        k: put(("layers", k), stack(k)) for k in layer_keys
+    }
+    params: Params = {
+        "embed": put(
+            ("embed",), np.asarray(_read_tensor(files, f"{prefix}embed_tokens.weight"))
+        ),
+        "layers": layers,
+        "final_norm": put(
+            ("final_norm",), np.asarray(_read_tensor(files, f"{prefix}norm.weight"))
+        ),
+    }
+    if not cfg.tied_embeddings:
+        head = np.asarray(_read_tensor(files, "lm_head.weight")).T
+        params["lm_head"] = put(("lm_head",), head)
+    return params
+
+
+def materialize_params(
+    checkpoint: str,
+    family: str,
+    size: str,
+    dtype: jnp.dtype = jnp.bfloat16,
+    seed: int = 0,
+    max_seq_len: int = 0,
+    device_put=None,
+) -> tuple[Params, ModelConfig]:
+    """checkpoint == "random" → synthetic init; else HF safetensors dir."""
+    cfg = get_config(family, size, max_seq_len=max_seq_len)
+    if checkpoint == "random":
+        params = init_params(jax.random.key(seed), cfg, dtype=dtype)
+        if device_put is not None:
+            params = jax.tree_util.tree_map_with_path(
+                lambda path, x: device_put(path, np.asarray(x)), params
+            )
+        return params, cfg
+    return load_hf_checkpoint(
+        checkpoint, cfg, family, dtype=dtype, device_put=device_put
+    ), cfg

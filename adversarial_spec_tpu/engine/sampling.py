@@ -1,0 +1,50 @@
+"""Token sampling: greedy, temperature, top-k, top-p.
+
+Split static/dynamic for XLA friendliness: ``greedy`` and ``top_k`` change
+the traced graph (static), while ``temperature`` and ``top_p`` are runtime
+scalars — changing them never recompiles the decode step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_tokens(
+    logits: jnp.ndarray,  # [B, V] f32
+    key: jax.Array,
+    *,
+    greedy: bool,
+    top_k: int,
+    temperature: jnp.ndarray,  # scalar f32
+    top_p: jnp.ndarray,  # scalar f32
+) -> jnp.ndarray:
+    """Sample one token per row. Returns [B] int32."""
+    if greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    # temperature == 0 degrades to greedy without retracing.
+    safe_t = jnp.maximum(temperature, 1e-6)
+    scaled = logits / safe_t
+
+    if top_k > 0 and top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+
+    # Top-p (nucleus): drop tokens outside the smallest prefix of the
+    # probability-sorted vocab whose mass exceeds top_p. top_p >= 1 is a
+    # no-op via the mask.
+    sorted_logits = jnp.sort(scaled, axis=-1)[..., ::-1]
+    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cumulative = jnp.cumsum(sorted_probs, axis=-1)
+    # Keep the first token whose cumulative crosses top_p, drop the rest.
+    cutoff_mask = cumulative - sorted_probs > top_p
+    cutoff_logit = jnp.min(
+        jnp.where(cutoff_mask, jnp.inf, sorted_logits), axis=-1, keepdims=True
+    )
+    scaled = jnp.where(scaled < cutoff_logit, -jnp.inf, scaled)
+
+    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    argmax = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, argmax, sampled)

@@ -1,0 +1,197 @@
+"""The ``tpu://`` engine: local JAX inference over the device mesh.
+
+The reference's L1 transport (litellm HTTP to remote APIs,
+scripts/models.py:607-678) becomes: registry alias → checkpoint
+materialized as a sharded param pytree on a {dp,tp,sp} mesh → batched
+prefill + chunked decode (engine/generate.py). The thread-per-opponent
+fan-out (models.py:699) becomes rows of one batch: every request for the
+same model in a ``chat`` call decodes as one XLA program.
+
+Heterogeneous opponent pools (SURVEY §7 hard part (b)): requests are
+grouped by model alias; groups run sequentially with an LRU of loaded
+models (weight swap). Same-model opponents — the common debate setup —
+always batch.
+
+Failure semantics (parity with reference retry/degrade policy,
+models.py:46-47, 538-555): per-group exceptions are captured into
+``Completion.error``; OOM/transient device errors are marked transient so
+the debate core's backoff retries them; a failed group never kills the
+round.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from adversarial_spec_tpu.debate.usage import Usage
+from adversarial_spec_tpu.engine import registry as registry_mod
+from adversarial_spec_tpu.engine.generate import generate
+from adversarial_spec_tpu.engine.loader import materialize_params
+from adversarial_spec_tpu.engine.registry import ModelSpec
+from adversarial_spec_tpu.engine.tokenizer import (
+    apply_chat_template,
+    load_tokenizer,
+)
+from adversarial_spec_tpu.engine.types import ChatRequest, Completion, SamplingParams
+from adversarial_spec_tpu.models.config import ModelConfig
+from adversarial_spec_tpu.parallel.mesh import make_mesh
+from adversarial_spec_tpu.parallel.sharding import make_device_put
+
+# Loaded models kept resident before weight-swap eviction (LRU).
+MAX_RESIDENT_MODELS = 2
+
+_DTYPES = {
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float16": jnp.float16,
+}
+
+_TRANSIENT_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "OUT_OF_RANGE",
+    "UNAVAILABLE",
+    "DEADLINE_EXCEEDED",
+)
+
+
+@dataclass
+class LoadedModel:
+    spec: ModelSpec
+    cfg: ModelConfig
+    params: dict
+    tokenizer: object
+    mesh: object
+    last_used: float = 0.0
+
+
+class TpuEngine:
+    """Serves every ``tpu://`` alias; caches loaded models (weight swap)."""
+
+    def __init__(self) -> None:
+        self._models: dict[str, LoadedModel] = {}
+
+    def validate(self, model: str) -> str | None:
+        return registry_mod.validate_tpu_model(model)
+
+    # -- model residency ---------------------------------------------------
+
+    def _load(self, alias: str) -> LoadedModel:
+        if alias in self._models:
+            lm = self._models[alias]
+            lm.last_used = time.monotonic()
+            return lm
+        spec = registry_mod.resolve_model_spec(f"tpu://{alias}")
+        dtype = _DTYPES.get(spec.dtype, jnp.bfloat16)
+        mesh = make_mesh(spec.mesh)
+        device_put = make_device_put(mesh, dtype)
+        params, cfg = materialize_params(
+            spec.checkpoint,
+            spec.family,
+            spec.size,
+            dtype=dtype,
+            max_seq_len=spec.max_seq_len,
+            device_put=device_put,
+        )
+        tokenizer = load_tokenizer(spec.tokenizer)
+        lm = LoadedModel(
+            spec=spec,
+            cfg=cfg,
+            params=params,
+            tokenizer=tokenizer,
+            mesh=mesh,
+            last_used=time.monotonic(),
+        )
+        self._evict_to(MAX_RESIDENT_MODELS - 1)
+        self._models[alias] = lm
+        return lm
+
+    def _evict_to(self, keep: int) -> None:
+        while len(self._models) > keep:
+            oldest = min(self._models, key=lambda a: self._models[a].last_used)
+            del self._models[oldest]
+
+    # -- serving -----------------------------------------------------------
+
+    def chat(
+        self, requests: list[ChatRequest], params: SamplingParams
+    ) -> list[Completion]:
+        # Group by alias: same-model opponents batch into one decode.
+        groups: dict[str, list[int]] = {}
+        for i, req in enumerate(requests):
+            alias = registry_mod.parse_tpu_model_id(req.model)
+            groups.setdefault(alias, []).append(i)
+
+        out: list[Completion | None] = [None] * len(requests)
+        for alias, indices in groups.items():
+            batch = [requests[i] for i in indices]
+            try:
+                completions = self._chat_one_model(alias, batch, params)
+            except Exception as e:  # degrade, never raise (parity: ref)
+                msg = f"{type(e).__name__}: {e}"
+                transient = any(m in msg for m in _TRANSIENT_MARKERS)
+                completions = [
+                    Completion(error=msg, transient=transient)
+                    for _ in batch
+                ]
+            for i, comp in zip(indices, completions):
+                out[i] = comp
+        return [c for c in out if c is not None]
+
+    def _chat_one_model(
+        self, alias: str, batch: list[ChatRequest], params: SamplingParams
+    ) -> list[Completion]:
+        lm = self._load(alias)
+        tok = lm.tokenizer
+        instruct = lm.spec.checkpoint != "random"
+
+        prompts = []
+        for req in batch:
+            text = apply_chat_template(
+                lm.spec.family, req.system, req.user, instruct
+            )
+            ids = tok.encode(text)
+            # Reserve room for generation within the model's context.
+            budget = lm.cfg.max_seq_len - params.max_new_tokens
+            if budget > 0 and len(ids) > budget:
+                ids = ids[:1] + ids[len(ids) - (budget - 1) :]
+            prompts.append(ids)
+
+        t0 = time.monotonic()
+        with lm.mesh:
+            result = generate(
+                lm.params,
+                lm.cfg,
+                prompts,
+                max_new_tokens=params.max_new_tokens,
+                eos_ids=list(tok.eos_ids),
+                pad_id=tok.pad_id,
+                greedy=params.greedy,
+                temperature=params.temperature,
+                top_k=params.top_k,
+                top_p=params.top_p,
+                seed=params.seed,
+                timeout_s=params.timeout_s,
+            )
+        total_time = time.monotonic() - t0
+
+        completions = []
+        for row, req in enumerate(batch):
+            n = int(result.n_generated[row])
+            text = tok.decode(result.tokens[row, :n])
+            completions.append(
+                Completion(
+                    text=text,
+                    usage=Usage(
+                        input_tokens=len(prompts[row]),
+                        output_tokens=n,
+                        device_time_s=total_time / len(batch),
+                        decode_tokens=n,
+                        decode_time_s=result.decode_time_s / len(batch),
+                    ),
+                )
+            )
+        return completions

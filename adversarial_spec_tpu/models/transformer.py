@@ -1,0 +1,279 @@
+"""Generic decoder-only transformer — pure-functional JAX, scan-over-layers.
+
+TPU-first design decisions (why this is not a torch translation):
+
+- **Pure functions over param pytrees.** ``init_params`` builds a pytree;
+  ``forward`` is a pure function of (params, tokens, cache). Sharding is
+  applied by annotating the pytree leaves (parallel/sharding.py) and jitting
+  — the model code itself is mesh-oblivious.
+- **Layer-stacked params + ``lax.scan``.** Every per-layer weight carries a
+  leading ``n_layers`` dim and the layer loop is one ``scan`` — one traced
+  layer body regardless of depth, which keeps XLA compile time flat from
+  2-layer test configs to 80-layer 70B.
+- **Static shapes everywhere.** Batches are left-padded to a bucketed length
+  (engine/generate.py); the KV cache is a dense preallocated
+  ``[L, B, S_max, H_kv, D]`` buffer written with ``dynamic_update_slice``.
+  No data-dependent Python control flow — decode early-exit lives in a
+  ``lax.while_loop`` in the generation loop, not here.
+- **bf16 params/activations, f32 where it matters** (RMSNorm accumulation,
+  attention softmax, final logits).
+
+Family coverage (flags in models/config.py): Llama-3, Mistral (sliding
+window), Gemma-2 (sandwich norms, softcaps, scaled/tied embeddings,
+alternating window), Qwen-2 (QKV bias). GQA throughout.
+
+Replaces (reference): nothing — the reference delegates all inference to
+remote APIs (SURVEY §2: zero tensor math in the tree). This module is the
+"native component" obligation of the TPU build (SURVEY §2, BASELINE north
+star).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from adversarial_spec_tpu.models.config import ModelConfig
+from adversarial_spec_tpu.ops.rope import apply_rope, rope_angles
+
+Params = dict[str, Any]
+Cache = dict[str, jnp.ndarray]
+
+
+def init_params(
+    rng: jax.Array, cfg: ModelConfig, dtype: jnp.dtype = jnp.bfloat16
+) -> Params:
+    """Random init with truncated-normal fan-in scaling (for synthetic
+    checkpoints and tests; real weights come from engine/loader.py)."""
+    keys = iter(jax.random.split(rng, 16))
+
+    def dense(key, shape, fan_in):
+        w = jax.random.truncated_normal(key, -2.0, 2.0, shape, jnp.float32)
+        return (w / math.sqrt(fan_in)).astype(dtype)
+
+    L, D, F = cfg.n_layers, cfg.dim, cfg.ffn_dim
+    QD = cfg.n_heads * cfg.head_dim
+    KD = cfg.n_kv_heads * cfg.head_dim
+    layers: dict[str, jnp.ndarray] = {
+        "attn_norm": jnp.ones((L, D), dtype),
+        "wq": dense(next(keys), (L, D, QD), D),
+        "wk": dense(next(keys), (L, D, KD), D),
+        "wv": dense(next(keys), (L, D, KD), D),
+        "wo": dense(next(keys), (L, QD, D), QD),
+        "ffn_norm": jnp.ones((L, D), dtype),
+        "w_gate": dense(next(keys), (L, D, F), D),
+        "w_up": dense(next(keys), (L, D, F), D),
+        "w_down": dense(next(keys), (L, F, D), F),
+    }
+    if cfg.qkv_bias:
+        layers["bq"] = jnp.zeros((L, QD), dtype)
+        layers["bk"] = jnp.zeros((L, KD), dtype)
+        layers["bv"] = jnp.zeros((L, KD), dtype)
+    if cfg.post_norms:
+        layers["post_attn_norm"] = jnp.ones((L, D), dtype)
+        layers["post_ffn_norm"] = jnp.ones((L, D), dtype)
+    if cfg.norm_scale_plus_one:
+        # Gemma stores RMSNorm scale as (1 + w); init w at zero.
+        for name in ("attn_norm", "ffn_norm", "post_attn_norm", "post_ffn_norm"):
+            if name in layers:
+                layers[name] = jnp.zeros_like(layers[name])
+
+    params: Params = {
+        "embed": dense(next(keys), (cfg.vocab_size, D), D),
+        "layers": layers,
+        "final_norm": (
+            jnp.zeros((D,), dtype)
+            if cfg.norm_scale_plus_one
+            else jnp.ones((D,), dtype)
+        ),
+    }
+    if not cfg.tied_embeddings:
+        params["lm_head"] = dense(next(keys), (D, cfg.vocab_size), D)
+    return params
+
+
+def init_cache(
+    cfg: ModelConfig,
+    batch: int,
+    max_seq: int,
+    dtype: jnp.dtype = jnp.bfloat16,
+) -> Cache:
+    shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def rms_norm(
+    x: jnp.ndarray, weight: jnp.ndarray, eps: float, plus_one: bool
+) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    norm = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    scale = weight.astype(jnp.float32)
+    if plus_one:
+        scale = scale + 1.0
+    return (norm * scale).astype(x.dtype)
+
+
+def _softcap(x: jnp.ndarray, cap: float) -> jnp.ndarray:
+    return jnp.tanh(x / cap) * cap
+
+
+def _activation(x: jnp.ndarray, kind: str) -> jnp.ndarray:
+    if kind == "gelu":
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu(x)
+
+
+def attention(
+    q: jnp.ndarray,  # [B, S, Hq, D]
+    k: jnp.ndarray,  # [B, T, Hkv, D]
+    v: jnp.ndarray,  # [B, T, Hkv, D]
+    mask: jnp.ndarray,  # [B, S, T] bool — True = attend
+    attn_softcap: float = 0.0,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Masked GQA attention, f32 softmax. Returns [B, S, Hq, D]."""
+    B, S, Hq, D = q.shape
+    T = k.shape[1]
+    Hkv = k.shape[2]
+    g = Hq // Hkv
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    qg = q.reshape(B, S, Hkv, g, D)
+    # [B, Hkv, g, S, T]
+    logits = jnp.einsum(
+        "bshgd,bthd->bhgst", qg, k, preferred_element_type=jnp.float32
+    )
+    logits = logits * scale
+    if attn_softcap > 0.0:
+        logits = _softcap(logits, attn_softcap)
+    neg = jnp.finfo(jnp.float32).min
+    logits = jnp.where(mask[:, None, None, :, :], logits, neg)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum(
+        "bhgst,bthd->bshgd", probs.astype(v.dtype), v
+    )
+    return out.reshape(B, S, Hq, D)
+
+
+def forward(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, S] int32
+    positions: jnp.ndarray,  # [B, S] rope positions (0 at each row's start)
+    cache: Cache,
+    cache_index: jnp.ndarray,  # scalar: slot where this chunk's KV goes
+    kv_valid: jnp.ndarray,  # [B, T] bool: slots holding real tokens
+) -> tuple[jnp.ndarray, Cache]:
+    """One forward pass over a chunk (prefill: S=chunk, decode: S=1).
+
+    The caller maintains left-padded rows so every row writes its KV at the
+    same ``cache_index`` (static-shape dynamic_update_slice), and passes
+    ``kv_valid`` marking which cache slots are real (pads excluded).
+    Returns (logits [B, S, vocab] f32, updated cache).
+    """
+    B, S = tokens.shape
+    T = cache["k"].shape[2]
+
+    x = params["embed"][tokens]
+    if cfg.scale_embeddings:
+        x = (x.astype(jnp.float32) * math.sqrt(cfg.dim)).astype(x.dtype)
+
+    cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+
+    # Masks shared by all layers. Slot j is visible to in-chunk query i iff
+    # it holds a real token and j <= cache_index + i (causality in slot
+    # space — valid because rows are left-padded so slot order = position
+    # order).
+    slot_ids = jnp.arange(T)[None, None, :]  # [1, 1, T]
+    q_slot = cache_index + jnp.arange(S)[None, :, None]  # [1, S, 1]
+    causal = slot_ids <= q_slot
+    base_mask = kv_valid[:, None, :] & causal  # [B, S, T]
+    if cfg.sliding_window > 0:
+        window_mask = base_mask & (slot_ids > q_slot - cfg.sliding_window)
+    else:
+        window_mask = base_mask
+
+    layer_ids = jnp.arange(cfg.n_layers)
+
+    def layer_body(x, scanned):
+        lp, layer_id, k_cache, v_cache = scanned
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps, cfg.norm_scale_plus_one)
+        q = h @ lp["wq"]
+        k = h @ lp["wk"]
+        v = h @ lp["wv"]
+        if cfg.qkv_bias:
+            q = q + lp["bq"]
+            k = k + lp["bk"]
+            v = v + lp["bv"]
+        q = q.reshape(B, S, cfg.n_heads, cfg.head_dim)
+        k = k.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+        v = v.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k.astype(k_cache.dtype), (0, cache_index, 0, 0)
+        )
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v.astype(v_cache.dtype), (0, cache_index, 0, 0)
+        )
+
+        if cfg.sliding_window > 0 and cfg.sliding_window_pattern > 1:
+            # Gemma-2: alternate windowed / global layers.
+            use_window = (layer_id % cfg.sliding_window_pattern) == 0
+            mask = jnp.where(use_window, window_mask, base_mask)
+        elif cfg.sliding_window > 0:
+            mask = window_mask
+        else:
+            mask = base_mask
+
+        out = attention(
+            q, k_cache, v_cache, mask, attn_softcap=cfg.attn_softcap
+        )
+        out = out.reshape(B, S, cfg.n_heads * cfg.head_dim) @ lp["wo"]
+        if cfg.post_norms:
+            out = rms_norm(
+                out, lp["post_attn_norm"], cfg.rms_eps, cfg.norm_scale_plus_one
+            )
+        x = x + out
+
+        h = rms_norm(x, lp["ffn_norm"], cfg.rms_eps, cfg.norm_scale_plus_one)
+        ff = _activation(h @ lp["w_gate"], cfg.activation) * (h @ lp["w_up"])
+        ff = ff @ lp["w_down"]
+        if cfg.post_norms:
+            ff = rms_norm(
+                ff, lp["post_ffn_norm"], cfg.rms_eps, cfg.norm_scale_plus_one
+            )
+        x = x + ff
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_body,
+        x,
+        (params["layers"], layer_ids, cache["k"], cache["v"]),
+    )
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_eps, cfg.norm_scale_plus_one)
+    if cfg.tied_embeddings:
+        logits = jnp.einsum(
+            "bsd,vd->bsv",
+            x,
+            params["embed"],
+            preferred_element_type=jnp.float32,
+        )
+    else:
+        logits = jnp.einsum(
+            "bsd,dv->bsv",
+            x,
+            params["lm_head"],
+            preferred_element_type=jnp.float32,
+        )
+    if cfg.logit_softcap > 0.0:
+        logits = _softcap(logits, cfg.logit_softcap)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def count_params(params: Params) -> int:
+    return sum(x.size for x in jax.tree.leaves(params))

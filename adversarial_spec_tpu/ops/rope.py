@@ -1,0 +1,41 @@
+"""Rotary position embeddings.
+
+Half-rotation (NeoX/Llama) layout: features are split into two halves that
+rotate together — the layout HF Llama/Mistral/Gemma/Qwen checkpoints use, so
+loaded weights need no permutation.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_angles(
+    positions: jnp.ndarray, head_dim: int, theta: float
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin tables for integer positions.
+
+    positions: [...]; returns cos/sin of shape [..., head_dim//2], f32.
+    """
+    half = head_dim // 2
+    freqs = 1.0 / (
+        theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
+    )
+    ang = positions.astype(jnp.float32)[..., None] * freqs
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(
+    x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray
+) -> jnp.ndarray:
+    """Rotate feature pairs (x1, x2) = (x[..:half], x[half:..]).
+
+    x: [B, S, H, D]; cos/sin: [B, S, D//2] (broadcast over heads).
+    """
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    c = cos[..., None, :]  # [B, S, 1, D/2]
+    s = sin[..., None, :]
+    rot1 = x1 * c - x2 * s
+    rot2 = x2 * c + x1 * s
+    return jnp.concatenate([rot1, rot2], axis=-1).astype(x.dtype)

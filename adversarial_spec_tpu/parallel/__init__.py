@@ -1,1 +1,28 @@
-"""parallel subpackage."""
+"""Parallelism: mesh construction, sharding rules, collectives, ring attention.
+
+TPU-native replacement for the reference's "distributed backend" — which is
+HTTPS fan-out to remote APIs (SURVEY §2.3: no NCCL/MPI/Gloo, nothing to wrap).
+Here the backend is XLA collectives over ICI driven by sharding annotations:
+pick a mesh, annotate params/activations, let GSPMD insert all-gathers/
+reduce-scatters/ppermutes (the scaling-book recipe).
+"""
+
+from adversarial_spec_tpu.parallel.mesh import (
+    MeshAxes,
+    make_mesh,
+    mesh_shape_from_spec,
+)
+from adversarial_spec_tpu.parallel.sharding import (
+    param_sharding_rules,
+    shard_params,
+    cache_sharding,
+)
+
+__all__ = [
+    "MeshAxes",
+    "make_mesh",
+    "mesh_shape_from_spec",
+    "param_sharding_rules",
+    "shard_params",
+    "cache_sharding",
+]

@@ -1,0 +1,77 @@
+"""Device mesh construction.
+
+Axis convention (used by every sharding rule in the framework):
+
+- ``dp`` — data/batch parallel: opponents of a debate round are rows of one
+  batch; dp splits rows across mesh slices (the TPU-native replacement for
+  the reference's thread-per-opponent fan-out, SURVEY §2.3).
+- ``tp`` — tensor parallel: attention heads / FFN columns (Megatron-style,
+  collectives inserted by GSPMD over ICI).
+- ``sp`` — sequence/context parallel: long-context ring attention
+  (parallel/ring.py) shards the sequence axis across ICI neighbors.
+
+Multi-host: ``jax.distributed.initialize`` is invoked when the runtime env
+indicates a multi-process job; ``jax.devices()`` then spans all hosts and
+the same mesh code covers v5e-1 through multi-host v5p pods (DCN between
+slices is handled by XLA's collective lowering, not by this code).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DP, TP, SP = "dp", "tp", "sp"
+MeshAxes = (DP, TP, SP)
+
+
+def maybe_initialize_distributed() -> None:
+    """Bring up the multi-host runtime when launched as one process per
+    host (JAX reads coordinator/process env vars). Safe no-op otherwise."""
+    if os.environ.get("JAX_COORDINATOR_ADDRESS") and jax.process_count() == 1:
+        jax.distributed.initialize()
+
+
+def mesh_shape_from_spec(
+    mesh_spec: dict[str, int] | None, n_devices: int | None = None
+) -> dict[str, int]:
+    """Normalize a registry mesh spec {axis: size} to a full {dp,tp,sp}.
+
+    Unspecified axes default to 1; leftover devices go to dp so a spec like
+    {"tp": 2} on 8 devices yields dp=4, tp=2, sp=1.
+    """
+    n = n_devices if n_devices is not None else len(jax.devices())
+    spec = dict(mesh_spec or {})
+    unknown = set(spec) - set(MeshAxes)
+    if unknown:
+        raise ValueError(f"unknown mesh axes {sorted(unknown)}; use {MeshAxes}")
+    tp = int(spec.get(TP, 1))
+    sp = int(spec.get(SP, 1))
+    if n % (tp * sp) != 0:
+        raise ValueError(
+            f"mesh tp={tp} sp={sp} does not divide device count {n}"
+        )
+    dp = int(spec.get(DP, n // (tp * sp)))
+    if dp * tp * sp != n:
+        raise ValueError(
+            f"mesh dp*tp*sp = {dp * tp * sp} != device count {n}"
+        )
+    return {DP: dp, TP: tp, SP: sp}
+
+
+def make_mesh(
+    mesh_spec: dict[str, int] | None = None,
+    devices: list | None = None,
+) -> Mesh:
+    """Create the {dp, tp, sp} mesh over the available devices.
+
+    TP is placed on the fastest-varying axis of the device array so
+    tensor-parallel collectives ride adjacent ICI links.
+    """
+    devs = devices if devices is not None else jax.devices()
+    shape = mesh_shape_from_spec(mesh_spec, n_devices=len(devs))
+    arr = np.asarray(devs).reshape(shape[DP], shape[SP], shape[TP])
+    return Mesh(arr, (DP, SP, TP))

@@ -1,0 +1,107 @@
+"""Parameter and cache sharding rules (Megatron-style TP via GSPMD).
+
+The model code (models/transformer.py) is mesh-oblivious; parallelism is
+expressed entirely by placing params/cache with NamedShardings and letting
+GSPMD propagate through the jitted forward:
+
+- ``wq/wk/wv`` and ``w_gate/w_up`` are column-sharded over ``tp`` (each
+  device owns a slice of heads / FFN columns);
+- ``wo`` and ``w_down`` are row-sharded over ``tp`` — GSPMD inserts the
+  all-reduce (psum over ICI) after their matmuls;
+- the KV cache shards its head axis over ``tp`` and batch over ``dp``;
+- embeddings/norms are replicated; ``lm_head`` is column-sharded so the
+  final logits are vocab-sharded until sampling.
+
+This is the "NCCL-equivalent" seam of the framework (SURVEY §2.3): the
+collectives exist only as XLA lowerings of these annotations.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from adversarial_spec_tpu.parallel.mesh import DP, TP
+
+# Pytree path suffix → PartitionSpec. Layer-stacked params carry a leading
+# n_layers dim (never sharded).
+_PARAM_RULES: dict[str, P] = {
+    "embed": P(),
+    "final_norm": P(),
+    "lm_head": P(None, TP),
+    "attn_norm": P(None, None),
+    "ffn_norm": P(None, None),
+    "post_attn_norm": P(None, None),
+    "post_ffn_norm": P(None, None),
+    "wq": P(None, None, TP),
+    "wk": P(None, None, TP),
+    "wv": P(None, None, TP),
+    "bq": P(None, TP),
+    "bk": P(None, TP),
+    "bv": P(None, TP),
+    "wo": P(None, TP, None),
+    "w_gate": P(None, None, TP),
+    "w_up": P(None, None, TP),
+    "w_down": P(None, TP, None),
+}
+
+
+def _leaf_name(path) -> str:
+    for entry in reversed(path):
+        if isinstance(entry, jax.tree_util.DictKey):
+            return str(entry.key)
+    raise ValueError(f"cannot name pytree path {path}")
+
+
+def param_sharding_rules(path) -> P:
+    name = _leaf_name(path)
+    if name not in _PARAM_RULES:
+        raise KeyError(f"no sharding rule for param {name!r}")
+    return _PARAM_RULES[name]
+
+
+def param_shardings(mesh: Mesh, params) -> dict:
+    """NamedSharding pytree matching ``params``."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: NamedSharding(mesh, param_sharding_rules(path)),
+        params,
+    )
+
+
+def shard_params(mesh: Mesh, params):
+    """Place a host/any-device param pytree onto the mesh per the rules."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, x: jax.device_put(
+            x, NamedSharding(mesh, param_sharding_rules(path))
+        ),
+        params,
+    )
+
+
+def make_device_put(mesh: Mesh, dtype):
+    """Loader hook: place each tensor as it is read (bounded host RAM)."""
+    import jax.numpy as jnp
+
+    def put(path_names: tuple, arr: np.ndarray):
+        name = path_names[-1]
+        spec = _PARAM_RULES.get(name, P())
+        return jax.device_put(
+            jnp.asarray(arr, dtype=dtype), NamedSharding(mesh, spec)
+        )
+
+    return put
+
+
+def cache_sharding(mesh: Mesh) -> NamedSharding:
+    """KV cache [L, B, S, H_kv, D]: batch over dp, heads over tp."""
+    return NamedSharding(mesh, P(None, DP, None, TP, None))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Token/batch arrays [B, ...]: rows over dp."""
+    return NamedSharding(mesh, P(DP))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

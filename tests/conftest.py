@@ -19,6 +19,32 @@ if "xla_force_host_platform_device_count" not in _flags:
 import pytest  # noqa: E402
 
 
+def _force_cpu_only_backends() -> None:
+    """Drop every non-CPU PJRT backend before first jax use.
+
+    The environment may inject a TPU-tunnel plugin via sitecustomize into
+    every interpreter (importing jax before this file runs, so env vars
+    are already snapshotted); its client init dials a remote service and
+    can block the whole test run if the tunnel is wedged. Tests are
+    CPU-only by contract, so force the platform list via jax.config and
+    unregister the other factories while backends are uninitialized.
+    """
+    try:
+        import jax
+        from jax._src import xla_bridge as xb
+    except ImportError:
+        return
+    if getattr(xb, "_backends", None):
+        return  # backends already initialized; too late (and unnecessary)
+    jax.config.update("jax_platforms", "cpu")
+    for name in list(getattr(xb, "_backend_factories", {})):
+        if name != "cpu":
+            xb._backend_factories.pop(name, None)
+
+
+_force_cpu_only_backends()
+
+
 @pytest.fixture(autouse=True)
 def _isolate_state(tmp_path, monkeypatch):
     """Point every persistence dir at tmp and reset engine singletons."""

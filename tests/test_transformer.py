@@ -1,0 +1,188 @@
+"""Transformer numerics tests.
+
+The reference tests everything above its transport seam with fakes
+(SURVEY §4); our model layer has no reference analog, so the ground truth
+here is (a) self-consistency — incremental decode must reproduce the full
+forward — and (b) parity with the HuggingFace torch implementations of the
+same architectures on tiny random checkpoints.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from adversarial_spec_tpu.models import transformer as T
+from adversarial_spec_tpu.models.config import get_config
+
+FAMILIES = ["llama", "mistral", "gemma2", "qwen2"]
+
+
+def _full_forward(params, cfg, ids, total_len):
+    B, S = ids.shape
+    cache = T.init_cache(cfg, B, total_len, dtype=jnp.float32)
+    positions = jnp.tile(jnp.arange(S, dtype=jnp.int32)[None, :], (B, 1))
+    kv_valid = jnp.arange(total_len)[None, :] < total_len
+    return T.forward(
+        params, cfg, ids, positions, cache, jnp.int32(0), kv_valid
+    )
+
+
+@pytest.mark.parametrize("family", FAMILIES)
+def test_incremental_decode_matches_full_forward(family):
+    """Prefill(prefix) + per-token decode must equal one full forward."""
+    cfg = get_config(family, "tiny")
+    rng = jax.random.key(0)
+    params = T.init_params(rng, cfg, dtype=jnp.float32)
+    S, extra = 8, 4
+    total = S + extra
+    ids = jax.random.randint(jax.random.key(1), (1, total), 0, cfg.vocab_size)
+
+    full_logits, _ = _full_forward(params, cfg, ids, total)
+
+    # Prefill on the first S tokens, then decode the rest one at a time.
+    cache = T.init_cache(cfg, 1, total, dtype=jnp.float32)
+    positions = jnp.arange(S, dtype=jnp.int32)[None, :]
+    kv_valid = jnp.arange(total)[None, :] >= 0
+    logits, cache = T.forward(
+        params, cfg, ids[:, :S], positions, cache, jnp.int32(0), kv_valid
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(full_logits[:, :S]), rtol=2e-4, atol=2e-4
+    )
+    for i in range(extra):
+        pos = jnp.array([[S + i]], dtype=jnp.int32)
+        step_logits, cache = T.forward(
+            params,
+            cfg,
+            ids[:, S + i : S + i + 1],
+            pos,
+            cache,
+            jnp.int32(S + i),
+            kv_valid,
+        )
+        np.testing.assert_allclose(
+            np.asarray(step_logits[:, 0]),
+            np.asarray(full_logits[:, S + i]),
+            rtol=2e-4,
+            atol=2e-4,
+        )
+
+
+def test_left_padding_invariance():
+    """A row's logits must not depend on how much left-padding it has."""
+    cfg = get_config("llama", "tiny")
+    params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+    seq = jax.random.randint(jax.random.key(2), (1, 6), 0, cfg.vocab_size)
+    total = 16
+
+    def run(pad):
+        S = pad + 6
+        ids = jnp.concatenate(
+            [jnp.zeros((1, pad), jnp.int32), seq], axis=1
+        )
+        cache = T.init_cache(cfg, 1, total, dtype=jnp.float32)
+        positions = jnp.maximum(
+            jnp.arange(S, dtype=jnp.int32)[None, :] - pad, 0
+        )
+        kv_valid = jnp.arange(total)[None, :] >= pad
+        logits, _ = T.forward(
+            params, cfg, ids, positions, cache, jnp.int32(0), kv_valid
+        )
+        return np.asarray(logits[:, -1])
+
+    np.testing.assert_allclose(run(0), run(5), rtol=2e-4, atol=2e-4)
+
+
+def test_sliding_window_masks_distant_tokens():
+    """With a window of W, logits at position p must ignore tokens < p-W."""
+    cfg = get_config("mistral", "tiny")  # window 128 — shrink via replace
+    from dataclasses import replace
+
+    cfg = replace(cfg, sliding_window=4, n_layers=1)
+    params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+    total = 12
+    ids_a = jax.random.randint(jax.random.key(3), (1, total), 0, cfg.vocab_size)
+    # Change a token far outside the window of the last position.
+    ids_b = ids_a.at[0, 0].set((ids_a[0, 0] + 1) % cfg.vocab_size)
+
+    la, _ = _full_forward(params, cfg, ids_a, total)
+    lb, _ = _full_forward(params, cfg, ids_b, total)
+    # Last position attends only to the final 4 slots — identical logits.
+    np.testing.assert_allclose(
+        np.asarray(la[:, -1]), np.asarray(lb[:, -1]), rtol=1e-5, atol=1e-5
+    )
+    # But an early position does see the change.
+    assert not np.allclose(np.asarray(la[:, 1]), np.asarray(lb[:, 1]))
+
+
+@pytest.mark.parametrize(
+    "family,hf_name",
+    [("llama", "llama"), ("qwen2", "qwen2"), ("mistral", "mistral"),
+     ("gemma2", "gemma2")],
+)
+def test_hf_parity_tiny(family, hf_name, tmp_path):
+    """Our forward must match transformers' torch forward on the same
+    random tiny checkpoint (validates both the architecture flags and the
+    loader's weight mapping/transposes)."""
+    torch = pytest.importorskip("torch")
+    import transformers
+
+    cfg = get_config(family, "tiny")
+    kwargs = dict(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.dim,
+        num_hidden_layers=cfg.n_layers,
+        num_attention_heads=cfg.n_heads,
+        num_key_value_heads=cfg.n_kv_heads,
+        intermediate_size=cfg.ffn_dim,
+        rope_theta=cfg.rope_theta,
+        rms_norm_eps=cfg.rms_eps,
+        max_position_embeddings=256,
+        tie_word_embeddings=cfg.tied_embeddings,
+    )
+    if family == "llama":
+        hf_cfg = transformers.LlamaConfig(**kwargs)
+    elif family == "qwen2":
+        hf_cfg = transformers.Qwen2Config(**kwargs)
+    elif family == "mistral":
+        hf_cfg = transformers.MistralConfig(
+            **kwargs, sliding_window=cfg.sliding_window
+        )
+    else:
+        hf_cfg = transformers.Gemma2Config(
+            **kwargs,
+            head_dim=cfg.head_dim,
+            hidden_activation="gelu_pytorch_tanh",
+            query_pre_attn_scalar=cfg.head_dim,
+            attn_logit_softcapping=cfg.attn_softcap,
+            final_logit_softcapping=cfg.logit_softcap,
+            sliding_window=cfg.sliding_window,
+        )
+    torch.manual_seed(0)
+    hf_model = transformers.AutoModelForCausalLM.from_config(hf_cfg)
+    hf_model.eval()
+    ckpt = tmp_path / "ckpt"
+    hf_model.save_pretrained(ckpt, safe_serialization=True)
+
+    from adversarial_spec_tpu.engine.loader import load_hf_checkpoint
+
+    params = load_hf_checkpoint(ckpt, cfg, family, dtype=jnp.float32)
+
+    ids = np.array([[1, 7, 42, 9, 100, 3, 250, 11]], dtype=np.int64)
+    with torch.no_grad():
+        hf_logits = hf_model(torch.from_numpy(ids)).logits.numpy()
+
+    ours, _ = _full_forward(params, cfg, jnp.asarray(ids, jnp.int32), 8)
+    np.testing.assert_allclose(
+        np.asarray(ours), hf_logits, rtol=2e-3, atol=2e-3
+    )
+
+
+def test_count_params():
+    cfg = get_config("llama", "tiny")
+    params = T.init_params(jax.random.key(0), cfg)
+    n = T.count_params(params)
+    assert n > 0
+    # Embedding + lm_head dominate: V*D*2 = 512*256*2.
+    assert n > 2 * cfg.vocab_size * cfg.dim
