@@ -216,8 +216,24 @@ def generate(
     top_p: float = 1.0,
     seed: int | None = None,
     timeout_s: float = 0.0,
+    mesh=None,
 ) -> GenerateResult:
-    """End-to-end batched generation (host orchestration)."""
+    """End-to-end batched generation (host orchestration).
+
+    With a ``mesh``, batch rows are sharded over ``dp`` (rows padded up to
+    a dp multiple by replicating the last prompt; extra rows dropped from
+    the result) and token inputs are placed with NamedShardings — GSPMD
+    propagates dp through activations and the KV cache, while params carry
+    their tp shardings from the loader (parallel/sharding.py).
+    """
+    n_real = len(prompt_ids)
+    if mesh is not None:
+        from adversarial_spec_tpu.parallel.mesh import DP
+
+        dp = mesh.shape[DP]
+        short = (-len(prompt_ids)) % dp
+        prompt_ids = prompt_ids + [prompt_ids[-1]] * short
+
     tokens_np, pad_lens_np = pad_batch(prompt_ids, pad_id)
     B, S = tokens_np.shape
     max_new = bucket_length(max_new_tokens, minimum=DECODE_CHUNK)
@@ -225,7 +241,18 @@ def generate(
 
     tokens = jnp.asarray(tokens_np)
     pad_lens = jnp.asarray(pad_lens_np)
-    key = jax.random.key(seed if seed is not None else 0)
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from adversarial_spec_tpu.parallel.mesh import DP
+
+        rows = NamedSharding(mesh, P(DP))
+        tokens = jax.device_put(tokens, NamedSharding(mesh, P(DP, None)))
+        pad_lens = jax.device_put(pad_lens, rows)
+    if seed is None:
+        # Fresh entropy per call: unseeded debate rounds must actually vary
+        # (seed=0 aliasing would make every round's "samples" identical).
+        seed = int.from_bytes(__import__("os").urandom(4), "little")
+    key = jax.random.key(seed)
     key, prefill_key = jax.random.split(key)
     temp = jnp.float32(temperature)
     tp = jnp.float32(top_p)
@@ -285,7 +312,8 @@ def generate(
         step.block_until_ready()
     decode_time = time.monotonic() - t1
 
-    out_np = np.asarray(out_buf)[:, :max_new_tokens]
+    out_np = np.asarray(out_buf)[:n_real, :max_new_tokens]
+    B = n_real  # dp-padding rows dropped
     n_steps = min(int(step), max_new_tokens)
     eos_np = np.asarray(sorted(set(eos_ids)) or [-1])
     n_generated = np.zeros((B,), np.int64)

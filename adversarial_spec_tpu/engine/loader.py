@@ -8,10 +8,12 @@ weights from torch's [out, in] to matmul-friendly [in, out] and stacking
 per-layer tensors along a leading ``n_layers`` axis for scan-over-layers.
 
 ``checkpoint == "random"`` materializes synthetic weights of the family's
-real shape (zero-egress test/bench path). Sharded materialization for big
-models: the loader yields tensors one at a time so the caller can place
-each shard on-device before the next is read (host RAM stays bounded —
-SURVEY §7 hard part (c)).
+real shape (zero-egress test/bench path). Host RAM during load is bounded
+to ONE stacked parameter in the target dtype: each stacked param is
+assembled layer-by-layer into a single preallocated buffer (no per-layer
+list, no np.stack double copy), placed on device via the caller's
+``device_put`` hook, then freed before the next param is read (SURVEY §7
+hard part (c): 70B within host RAM).
 """
 
 from __future__ import annotations
@@ -92,9 +94,14 @@ def load_hf_checkpoint(
     each tensor as it is read (defaults to plain jnp.asarray on the default
     device).
     """
+    import ml_dtypes
+
     ckpt_dir = Path(ckpt_dir)
     files = _open_safetensors(ckpt_dir)
     put = device_put or (lambda path, arr: jnp.asarray(arr, dtype=dtype))
+    np_dtype = np.dtype(
+        {jnp.bfloat16: ml_dtypes.bfloat16}.get(dtype, np.dtype(dtype))
+    )
 
     prefix = "model."
 
@@ -104,15 +111,18 @@ def load_hf_checkpoint(
         return _HF_LAYER_MAP[layer_key]
 
     def stack(layer_key: str) -> np.ndarray:
+        """Assemble one layer-stacked param into a single preallocated
+        target-dtype buffer — peak host RAM is this buffer plus one layer."""
         suffix = hf_name(layer_key)
-        per_layer = []
+        buf = None
         for i in range(cfg.n_layers):
-            t = _read_tensor(files, f"{prefix}layers.{i}.{suffix}")
-            t = np.asarray(t)
+            t = np.asarray(_read_tensor(files, f"{prefix}layers.{i}.{suffix}"))
             if layer_key in _TRANSPOSE:
                 t = t.T  # torch Linear [out, in] → [in, out]
-            per_layer.append(t)
-        return np.stack(per_layer)
+            if buf is None:
+                buf = np.empty((cfg.n_layers,) + t.shape, np_dtype)
+            buf[i] = t.astype(np_dtype)
+        return buf
 
     layer_keys = [
         "attn_norm",

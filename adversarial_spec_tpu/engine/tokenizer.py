@@ -66,13 +66,22 @@ class HFTokenizer:
         # delimiter already present in the template, not a BOS candidate.
         self.bos_id = self._special_id(["<|begin_of_text|>", "<s>", "<bos>"])
         self.pad_id = 0
-        eos = [
-            self._special_id(
-                ["<|end_of_text|>", "</s>", "<eos>", "<|im_end|>",
-                 "<|eot_id|>", "<end_of_turn>"]
+        # Collect EVERY terminator present: instruct models end turns with
+        # chat-turn markers (<|eot_id|>, <end_of_turn>, <|im_end|>) rather
+        # than the document EOS, and decode must stop on any of them.
+        vocab = self._tok.get_vocab()
+        self.eos_ids = [
+            vocab[c]
+            for c in (
+                "<|end_of_text|>",
+                "</s>",
+                "<eos>",
+                "<|im_end|>",
+                "<|eot_id|>",
+                "<end_of_turn>",
             )
+            if c in vocab
         ]
-        self.eos_ids = [e for e in eos if e is not None] or [0]
 
     def _special_id(self, candidates: list[str]) -> int | None:
         vocab = self._tok.get_vocab()

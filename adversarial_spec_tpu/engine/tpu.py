@@ -38,7 +38,10 @@ from adversarial_spec_tpu.engine.tokenizer import (
 )
 from adversarial_spec_tpu.engine.types import ChatRequest, Completion, SamplingParams
 from adversarial_spec_tpu.models.config import ModelConfig
-from adversarial_spec_tpu.parallel.mesh import make_mesh
+from adversarial_spec_tpu.parallel.mesh import (
+    make_mesh,
+    maybe_initialize_distributed,
+)
 from adversarial_spec_tpu.parallel.sharding import make_device_put
 
 # Loaded models kept resident before weight-swap eviction (LRU).
@@ -86,6 +89,10 @@ class TpuEngine:
             return lm
         spec = registry_mod.resolve_model_spec(f"tpu://{alias}")
         dtype = _DTYPES.get(spec.dtype, jnp.bfloat16)
+        # Make room BEFORE materializing: otherwise N+1 full param sets
+        # coexist in HBM during the swap.
+        self._evict_to(MAX_RESIDENT_MODELS - 1)
+        maybe_initialize_distributed()
         mesh = make_mesh(spec.mesh)
         device_put = make_device_put(mesh, dtype)
         params, cfg = materialize_params(
@@ -105,7 +112,6 @@ class TpuEngine:
             mesh=mesh,
             last_used=time.monotonic(),
         )
-        self._evict_to(MAX_RESIDENT_MODELS - 1)
         self._models[alias] = lm
         return lm
 
@@ -175,6 +181,7 @@ class TpuEngine:
                 top_p=params.top_p,
                 seed=params.seed,
                 timeout_s=params.timeout_s,
+                mesh=lm.mesh,
             )
         total_time = time.monotonic() - t0
 

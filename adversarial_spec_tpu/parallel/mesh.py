@@ -30,8 +30,22 @@ MeshAxes = (DP, TP, SP)
 
 def maybe_initialize_distributed() -> None:
     """Bring up the multi-host runtime when launched as one process per
-    host (JAX reads coordinator/process env vars). Safe no-op otherwise."""
-    if os.environ.get("JAX_COORDINATOR_ADDRESS") and jax.process_count() == 1:
+    host (JAX reads coordinator/process env vars). Safe no-op otherwise.
+
+    The idempotence check must NOT touch the backend (jax.process_count /
+    jax.devices would initialize XLA and make distributed.initialize
+    illegal), so it inspects the distributed client state directly.
+    """
+    if not os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        return
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is not None:
+        already = is_init()
+    else:  # older jax: peek at the global client
+        from jax._src import distributed as _dist
+
+        already = _dist.global_state.client is not None
+    if not already:
         jax.distributed.initialize()
 
 

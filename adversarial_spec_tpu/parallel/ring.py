@@ -1,0 +1,131 @@
+"""Ring attention: causal attention with the sequence axis sharded over ICI.
+
+Long-context subsystem (SURVEY §5 "long-context — ABSENT in the reference,
+required new subsystem here"): when a 16k+-token spec exceeds what one
+chip's HBM comfortably holds for prefill, the sequence axis is sharded over
+the ``sp`` mesh axis and attention runs as a ring: each device computes
+attention of its local query block against the K/V block it currently
+holds, accumulates online-softmax statistics (running max / normalizer /
+weighted values — the flash-attention recurrence), and passes its K/V block
+to its ring neighbor with ``ppermute``. After ``sp`` hops every query block
+has seen every key block, with peak memory O(S/sp) and the K/V transfers
+riding neighbor ICI links.
+
+Causality is enforced at two granularities: whole blocks are skipped when
+the key block is entirely in the future (compute still runs — SPMD needs
+identical programs — but is masked), and the diagonal block applies the
+in-block triangular mask.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from adversarial_spec_tpu.parallel.mesh import SP
+
+
+def _block_attend(
+    q: jnp.ndarray,  # [B, Sq, H, D] f32
+    k: jnp.ndarray,  # [B, Sk, Hkv, D]
+    v: jnp.ndarray,  # [B, Sk, Hkv, D]
+    mask: jnp.ndarray,  # [Sq, Sk] bool
+    m: jnp.ndarray,  # [B, H, Sq] running max
+    l: jnp.ndarray,  # [B, H, Sq] running normalizer
+    acc: jnp.ndarray,  # [B, Sq, H, D] running weighted values
+    scale: float,
+):
+    """One flash-attention accumulation step over a K/V block."""
+    B, Sq, H, D = q.shape
+    Hkv = k.shape[2]
+    g = H // Hkv
+    qg = q.reshape(B, Sq, Hkv, g, D)
+    s = jnp.einsum(
+        "bshgd,bthd->bhgst", qg, k, preferred_element_type=jnp.float32
+    ) * scale  # [B, Hkv, g, Sq, Sk]
+    s = s.reshape(B, H, Sq, k.shape[1])
+    neg = jnp.finfo(jnp.float32).min
+    s = jnp.where(mask[None, None, :, :], s, neg)
+
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # Guard fully-masked rows: keep m finite so exp() stays 0, not NaN.
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    alpha = jnp.exp(jnp.where(jnp.isfinite(m), m - m_safe, -jnp.inf))
+    p = jnp.exp(s - m_safe[..., None])  # [B, H, Sq, Sk]
+    l_new = l * alpha + p.sum(axis=-1)
+    pg = p.reshape(B, Hkv, g, Sq, -1)
+    delta = jnp.einsum("bhgst,bthd->bshgd", pg, v.astype(jnp.float32))
+    delta = delta.reshape(B, Sq, H, D)
+    acc_new = acc * alpha.transpose(0, 2, 1)[..., None] + delta
+    return m_new, l_new, acc_new
+
+
+def ring_attention(
+    q: jnp.ndarray,  # [B, S, H, D] — S is the GLOBAL sequence length
+    k: jnp.ndarray,  # [B, S, Hkv, D]
+    v: jnp.ndarray,  # [B, S, Hkv, D]
+    mesh: Mesh,
+    causal: bool = True,
+) -> jnp.ndarray:
+    """Causal attention with sequence sharded over the mesh's ``sp`` axis.
+
+    Inputs/outputs are global arrays; shard_map splits them into per-device
+    sequence blocks and the ring runs ``sp`` ppermute hops.
+    """
+    sp = mesh.shape[SP]
+    S = q.shape[1]
+    if S % sp != 0:
+        raise ValueError(f"sequence {S} not divisible by sp={sp}")
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    block = S // sp
+
+    def local(qb, kb, vb):
+        # qb: [B, block, H, D] — this device's query block.
+        idx = jax.lax.axis_index(SP)
+        B, Sq, H, D = qb.shape
+        m = jnp.full((B, H, Sq), -jnp.inf, jnp.float32)
+        l = jnp.zeros((B, H, Sq), jnp.float32)
+        acc = jnp.zeros((B, Sq, H, D), jnp.float32)
+        rows = jnp.arange(Sq)[:, None]
+        cols = jnp.arange(Sq)[None, :]
+
+        def step(h, carry):
+            m, l, acc, kb, vb = carry
+            # After h hops, we hold the block originally on device idx-h.
+            src = (idx - h) % sp
+            if causal:
+                diag = rows >= cols
+                full = jnp.ones((Sq, Sq), bool)
+                empty = jnp.zeros((Sq, Sq), bool)
+                mask = jnp.where(
+                    src == idx, diag, jnp.where(src < idx, full, empty)
+                )
+            else:
+                mask = jnp.ones((Sq, Sq), bool)
+            m, l, acc = _block_attend(
+                qb.astype(jnp.float32), kb, vb, mask, m, l, acc, scale
+            )
+            perm = [(i, (i + 1) % sp) for i in range(sp)]
+            kb = jax.lax.ppermute(kb, SP, perm)
+            vb = jax.lax.ppermute(vb, SP, perm)
+            return m, l, acc, kb, vb
+
+        m, l, acc, _, _ = jax.lax.fori_loop(
+            0, sp, step, (m, l, acc, kb, vb)
+        )
+        l_safe = jnp.maximum(l, 1e-30)
+        out = acc / l_safe.transpose(0, 2, 1)[..., None]
+        return out.astype(q.dtype)
+
+    spec = P(None, SP, None, None)
+    return jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )(q, k, v)

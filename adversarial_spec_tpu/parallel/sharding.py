@@ -80,15 +80,24 @@ def shard_params(mesh: Mesh, params):
 
 
 def make_device_put(mesh: Mesh, dtype):
-    """Loader hook: place each tensor as it is read (bounded host RAM)."""
-    import jax.numpy as jnp
+    """Loader hook: place each tensor as it is read (bounded host RAM).
 
-    def put(path_names: tuple, arr: np.ndarray):
+    Host buffers go straight to their sharded placement — no intermediate
+    copy on the default device.
+    """
+    import jax.numpy as jnp
+    import ml_dtypes
+
+    np_dtype = np.dtype(
+        {jnp.bfloat16: ml_dtypes.bfloat16}.get(dtype, np.dtype(dtype))
+    )
+
+    def put(path_names: tuple, arr):
         name = path_names[-1]
         spec = _PARAM_RULES.get(name, P())
-        return jax.device_put(
-            jnp.asarray(arr, dtype=dtype), NamedSharding(mesh, spec)
-        )
+        if isinstance(arr, np.ndarray) and arr.dtype != np_dtype:
+            arr = arr.astype(np_dtype)
+        return jax.device_put(arr, NamedSharding(mesh, spec))
 
     return put
 
