@@ -110,7 +110,15 @@ def prefill_step(
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "prompt_len", "chunk", "greedy", "top_k"),
+    static_argnames=(
+        "cfg",
+        "prompt_len",
+        "chunk",
+        "greedy",
+        "top_k",
+        "use_pallas_decode",
+        "pallas_interpret",
+    ),
     donate_argnames=("cache", "out_buf"),
 )
 def decode_chunk_steps(
@@ -132,6 +140,8 @@ def decode_chunk_steps(
     chunk: int,
     greedy: bool,
     top_k: int,
+    use_pallas_decode: bool = False,
+    pallas_interpret: bool = False,
 ) -> tuple[Cache, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Up to ``chunk`` single-token decode steps inside one XLA program.
 
@@ -166,6 +176,8 @@ def decode_chunk_steps(
             cache,
             cache_index,
             kv_valid,
+            use_pallas_decode=use_pallas_decode,
+            pallas_interpret=pallas_interpret,
         )
         key, sub = jax.random.split(key)
         nxt = sample_tokens(
@@ -217,6 +229,7 @@ def generate(
     seed: int | None = None,
     timeout_s: float = 0.0,
     mesh=None,
+    use_pallas_decode: bool | None = None,
 ) -> GenerateResult:
     """End-to-end batched generation (host orchestration).
 
@@ -226,6 +239,13 @@ def generate(
     propagates dp through activations and the KV cache, while params carry
     their tp shardings from the loader (parallel/sharding.py).
     """
+    if use_pallas_decode is None:
+        # Auto: fused kernel on a real single-device TPU; jnp path for
+        # GSPMD-sharded meshes (the kernel isn't partitionable) and CPU.
+        single = mesh is None or mesh.size == 1
+        use_pallas_decode = single and jax.default_backend() == "tpu"
+    pallas_interpret = jax.default_backend() == "cpu"
+
     n_real = len(prompt_ids)
     if mesh is not None:
         from adversarial_spec_tpu.parallel.mesh import DP
@@ -308,6 +328,8 @@ def generate(
             chunk=DECODE_CHUNK,
             greedy=greedy,
             top_k=top_k,
+            use_pallas_decode=use_pallas_decode,
+            pallas_interpret=pallas_interpret,
         )
         step.block_until_ready()
     decode_time = time.monotonic() - t1

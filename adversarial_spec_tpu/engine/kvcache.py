@@ -1,0 +1,154 @@
+"""Paged KV-cache manager: page allocator + device page pool.
+
+Host-side bookkeeping (free list, per-sequence page tables) stays in numpy
+— it is O(pages) integer work with data-dependent control flow that has no
+business inside an XLA program — while the page pool itself lives on
+device as two dense arrays [n_pages, page_size, Hkv, D] per layer group,
+written with vectorized scatters and read by the paged Pallas kernel
+(ops/pallas_paged.py).
+
+Sizing: a debate round's opponents share the pool; ``n_pages`` bounds
+total resident tokens across all rows, not per-row length — the property
+that lets a 16k-context judge coexist with short critics (SURVEY §5
+long-context obligation).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class OutOfPages(RuntimeError):
+    pass
+
+
+@dataclass
+class PagedCacheLayout:
+    n_pages: int
+    page_size: int
+    n_layers: int
+    n_kv_heads: int
+    head_dim: int
+
+    @property
+    def tokens_capacity(self) -> int:
+        return self.n_pages * self.page_size
+
+
+class PageAllocator:
+    """Free-list page allocator with per-sequence ordered page tables."""
+
+    def __init__(self, n_pages: int, page_size: int):
+        self.n_pages = n_pages
+        self.page_size = page_size
+        self._free = list(range(n_pages - 1, -1, -1))  # pop() → page 0 first
+        self._tables: dict[int, list[int]] = {}
+        self._lengths: dict[int, int] = {}
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def new_sequence(self, seq_id: int) -> None:
+        if seq_id in self._tables:
+            raise ValueError(f"sequence {seq_id} already allocated")
+        self._tables[seq_id] = []
+        self._lengths[seq_id] = 0
+
+    def extend(self, seq_id: int, n_tokens: int) -> list[int]:
+        """Reserve room for n_tokens more; returns newly allocated pages."""
+        table = self._tables[seq_id]
+        length = self._lengths[seq_id]
+        needed_pages = -(-(length + n_tokens) // self.page_size)
+        new_pages = []
+        while len(table) < needed_pages:
+            if not self._free:
+                # Roll back this call's allocations before failing.
+                for p in new_pages:
+                    table.remove(p)
+                    self._free.append(p)
+                raise OutOfPages(
+                    f"paged KV cache exhausted: {self.n_pages} pages of "
+                    f"{self.page_size} tokens all in use"
+                )
+            p = self._free.pop()
+            table.append(p)
+            new_pages.append(p)
+        self._lengths[seq_id] = length + n_tokens
+        return new_pages
+
+    def length(self, seq_id: int) -> int:
+        return self._lengths[seq_id]
+
+    def table(self, seq_id: int) -> list[int]:
+        return list(self._tables[seq_id])
+
+    def free_sequence(self, seq_id: int) -> None:
+        for p in self._tables.pop(seq_id):
+            self._free.append(p)
+        del self._lengths[seq_id]
+
+    def table_array(self, seq_ids: list[int], max_pages: int) -> np.ndarray:
+        """Batched page table [B, max_pages], -1-padded, for the kernel."""
+        out = np.full((len(seq_ids), max_pages), -1, np.int32)
+        for i, sid in enumerate(seq_ids):
+            t = self._tables[sid]
+            if len(t) > max_pages:
+                raise ValueError(
+                    f"sequence {sid} spans {len(t)} pages > {max_pages}"
+                )
+            out[i, : len(t)] = t
+        return out
+
+
+def init_page_pool(
+    layout: PagedCacheLayout, dtype=jnp.bfloat16
+) -> dict[str, jnp.ndarray]:
+    """Device page pool: per-layer stacked K/V pages."""
+    shape = (
+        layout.n_layers,
+        layout.n_pages,
+        layout.page_size,
+        layout.n_kv_heads,
+        layout.head_dim,
+    )
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def write_tokens(
+    pool: dict[str, jnp.ndarray],
+    k_new: jnp.ndarray,  # [L, B, S, Hkv, D]
+    v_new: jnp.ndarray,
+    page_ids: np.ndarray,  # [B, S] physical page per token
+    offsets: np.ndarray,  # [B, S] slot within page per token
+) -> dict[str, jnp.ndarray]:
+    """Scatter freshly computed K/V into their pages (vectorized)."""
+    L, B, S = k_new.shape[0], k_new.shape[1], k_new.shape[2]
+    pid = jnp.asarray(page_ids).reshape(-1)  # [B*S]
+    off = jnp.asarray(offsets).reshape(-1)
+    k_flat = k_new.reshape(L, B * S, *k_new.shape[3:])
+    v_flat = v_new.reshape(L, B * S, *v_new.shape[3:])
+    # pool[l, pid[n], off[n]] = new[l, n] for every layer l and token n.
+    return {
+        "k": pool["k"].at[:, pid, off].set(k_flat),
+        "v": pool["v"].at[:, pid, off].set(v_flat),
+    }
+
+
+def token_positions_to_pages(
+    allocator: PageAllocator, seq_ids: list[int], positions: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Map per-row token positions [B, S] → (page_ids, offsets) [B, S]."""
+    B, S = positions.shape
+    page_ids = np.zeros((B, S), np.int32)
+    offsets = np.zeros((B, S), np.int32)
+    for i, sid in enumerate(seq_ids):
+        table = allocator.table(sid)
+        for j in range(S):
+            pos = int(positions[i, j])
+            page_ids[i, j] = table[pos // allocator.page_size]
+            offsets[i, j] = pos % allocator.page_size
+    return page_ids, offsets

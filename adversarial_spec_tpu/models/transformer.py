@@ -165,6 +165,9 @@ def forward(
     cache: Cache,
     cache_index: jnp.ndarray,  # scalar: slot where this chunk's KV goes
     kv_valid: jnp.ndarray,  # [B, T] bool: slots holding real tokens
+    *,
+    use_pallas_decode: bool = False,
+    pallas_interpret: bool = False,
 ) -> tuple[jnp.ndarray, Cache]:
     """One forward pass over a chunk (prefill: S=chunk, decode: S=1).
 
@@ -172,9 +175,14 @@ def forward(
     same ``cache_index`` (static-shape dynamic_update_slice), and passes
     ``kv_valid`` marking which cache slots are real (pads excluded).
     Returns (logits [B, S, vocab] f32, updated cache).
+
+    ``use_pallas_decode`` routes S==1 attention through the fused Pallas
+    flash-decoding kernel (ops/pallas_decode.py) — single-device meshes
+    only; GSPMD-sharded runs keep the partitionable jnp path.
     """
     B, S = tokens.shape
     T = cache["k"].shape[2]
+    pallas_decode = use_pallas_decode and S == 1
 
     x = params["embed"][tokens]
     if cfg.scale_embeddings:
@@ -196,6 +204,14 @@ def forward(
         window_mask = base_mask
 
     layer_ids = jnp.arange(cfg.n_layers)
+
+    if pallas_decode:
+        # Per-row valid window [start, end) for the fused kernel; the
+        # sliding-window start tightening happens per layer below.
+        pallas_start = jnp.argmax(kv_valid.astype(jnp.int32), axis=1).astype(
+            jnp.int32
+        )
+        pallas_end = jnp.full((B,), 0, jnp.int32) + cache_index + 1
 
     def layer_body(x, scanned):
         lp, layer_id, k_cache, v_cache = scanned
@@ -220,18 +236,44 @@ def forward(
             v_cache, v.astype(v_cache.dtype), (0, cache_index, 0, 0)
         )
 
-        if cfg.sliding_window > 0 and cfg.sliding_window_pattern > 1:
-            # Gemma-2: alternate windowed / global layers.
-            use_window = (layer_id % cfg.sliding_window_pattern) == 0
-            mask = jnp.where(use_window, window_mask, base_mask)
-        elif cfg.sliding_window > 0:
-            mask = window_mask
-        else:
-            mask = base_mask
+        if pallas_decode:
+            from adversarial_spec_tpu.ops.pallas_decode import (
+                decode_attention,
+            )
 
-        out = attention(
-            q, k_cache, v_cache, mask, attn_softcap=cfg.attn_softcap
-        )
+            if cfg.sliding_window > 0:
+                win_start = jnp.maximum(
+                    pallas_start, cache_index - cfg.sliding_window + 1
+                )
+                if cfg.sliding_window_pattern > 1:
+                    use_window = (layer_id % cfg.sliding_window_pattern) == 0
+                    start = jnp.where(use_window, win_start, pallas_start)
+                else:
+                    start = win_start
+            else:
+                start = pallas_start
+            bounds = jnp.stack([start, pallas_end], axis=1)
+            out = decode_attention(
+                q[:, 0],
+                k_cache,
+                v_cache,
+                bounds,
+                attn_softcap=cfg.attn_softcap,
+                interpret=pallas_interpret,
+            )[:, None]
+        else:
+            if cfg.sliding_window > 0 and cfg.sliding_window_pattern > 1:
+                # Gemma-2: alternate windowed / global layers.
+                use_window = (layer_id % cfg.sliding_window_pattern) == 0
+                mask = jnp.where(use_window, window_mask, base_mask)
+            elif cfg.sliding_window > 0:
+                mask = window_mask
+            else:
+                mask = base_mask
+
+            out = attention(
+                q, k_cache, v_cache, mask, attn_softcap=cfg.attn_softcap
+            )
         out = out.reshape(B, S, cfg.n_heads * cfg.head_dim) @ lp["wo"]
         if cfg.post_norms:
             out = rms_norm(

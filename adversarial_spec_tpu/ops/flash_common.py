@@ -1,0 +1,49 @@
+"""Shared flash-attention (online-softmax) update for the Pallas kernels.
+
+Both decode kernels (dense ops/pallas_decode.py, paged ops/pallas_paged.py)
+accumulate attention block-by-block with the same recurrence; the -inf
+handling for fully-masked blocks (m stays -inf, alpha forced to 0 so no
+NaN ever enters l/acc) is subtle enough that it must live in exactly one
+place.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def flash_update(
+    q: jnp.ndarray,  # [G, D] f32, pre-scaled
+    k: jnp.ndarray,  # [Tb, D] f32
+    v: jnp.ndarray,  # [Tb, D] f32
+    t0,  # scalar: global slot index of k[0]
+    start,  # scalar: first valid slot (inclusive)
+    end,  # scalar: first invalid slot (exclusive)
+    m: jnp.ndarray,  # [G, 1] running max
+    l: jnp.ndarray,  # [G, 1] running normalizer
+    acc: jnp.ndarray,  # [G, D] running weighted values
+    *,
+    attn_softcap: float,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One online-softmax accumulation over a K/V block; returns (m, l, acc)."""
+    G, Tb = q.shape[0], k.shape[0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )  # [G, Tb]
+    if attn_softcap > 0.0:
+        s = jnp.tanh(s / attn_softcap) * attn_softcap
+    slot = t0 + jax.lax.broadcasted_iota(jnp.int32, (G, Tb), 1)
+    s = jnp.where((slot >= start) & (slot < end), s, -jnp.inf)
+
+    m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+    # Fully-masked-so-far rows keep m = -inf; m_safe pins the exp argument
+    # so those rows contribute exact zeros instead of NaNs.
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    alpha = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), jnp.zeros_like(m))
+    p = jnp.exp(s - m_safe)
+    l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+    acc_new = acc * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    return m_new, l_new, acc_new

@@ -1,0 +1,166 @@
+"""Pallas TPU kernel: single-token (decode) attention over a dense KV cache.
+
+The decode hot loop's attention reads the whole KV cache once per step; the
+XLA fallback materializes [B, H, T] logits through HBM. This kernel fuses
+QK^T → online softmax → PV into one pass with the cache genuinely streamed:
+
+  grid = (B, Hkv, T/block_t); the T dimension lives IN THE GRID, so only
+  one [block_t, D] K tile and V tile are VMEM-resident at a time (Pallas
+  double-buffers the next tile's DMA behind the current tile's compute) —
+  VMEM stays O(block_t·D) regardless of context length, which is what
+  makes 16k+ contexts decodable. Each (row, KV-head) program holds the
+  g = Hq/Hkv query heads (padded to the f32 sublane tile of 8); the
+  online-softmax state (m, l, acc — ops/flash_common.py) persists in VMEM
+  scratch across the sequential innermost grid dimension, initialized at
+  block 0 and finalized at the last block. Per-row validity windows
+  [start, end) ride in as scalar prefetch so left-pad slots and
+  not-yet-written slots never contribute.
+
+North-star relevance: this is the op BASELINE.json names ("autoregressive
+decode ... implemented as Pallas kernels"); tokens/sec/chip during a debate
+round is bounded by this read of the cache (HBM bandwidth).
+
+CPU testing runs the same kernel under ``interpret=True`` against the jnp
+reference (tests/test_pallas.py), the SURVEY §4 fake-at-the-seam strategy
+applied to kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from adversarial_spec_tpu.ops.flash_common import flash_update
+
+BLOCK_T = 256
+_SUBLANE = 8
+
+
+def _decode_attn_kernel(
+    bounds_ref,  # SMEM [B, 2] int32: (start, end) valid-slot window per row
+    q_ref,  # VMEM [1, 1, G8, D]
+    k_ref,  # VMEM [1, block_t, 1, D] — one streamed tile
+    v_ref,  # VMEM [1, block_t, 1, D]
+    o_ref,  # VMEM [1, 1, G8, D]
+    m_ref,  # VMEM scratch [G8, 1]
+    l_ref,  # VMEM scratch [G8, 1]
+    acc_ref,  # VMEM scratch [G8, D]
+    *,
+    scale: float,
+    attn_softcap: float,
+    block_t: int,
+):
+    b = pl.program_id(0)
+    t = pl.program_id(2)
+    n_blocks = pl.num_programs(2)
+    G8, D = q_ref.shape[2], q_ref.shape[3]
+
+    @pl.when(t == 0)
+    def _init():
+        m_ref[:] = jnp.full((G8, 1), -jnp.inf, jnp.float32)
+        l_ref[:] = jnp.zeros((G8, 1), jnp.float32)
+        acc_ref[:] = jnp.zeros((G8, D), jnp.float32)
+
+    start = bounds_ref[b, 0]
+    end = bounds_ref[b, 1]
+    t0 = t * block_t
+
+    # Skip compute for tiles wholly outside the valid window (the DMA still
+    # lands — block skipping is a masking optimization, not a gather).
+    @pl.when((t0 < end) & (t0 + block_t > start))
+    def _accumulate():
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        k = k_ref[0, :, 0].astype(jnp.float32)
+        v = v_ref[0, :, 0].astype(jnp.float32)
+        m, l, acc = flash_update(
+            q,
+            k,
+            v,
+            t0,
+            start,
+            end,
+            m_ref[:],
+            l_ref[:],
+            acc_ref[:],
+            attn_softcap=attn_softcap,
+        )
+        m_ref[:] = m
+        l_ref[:] = l
+        acc_ref[:] = acc
+
+    @pl.when(t == n_blocks - 1)
+    def _finalize():
+        o_ref[0, 0] = (
+            acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("attn_softcap", "interpret")
+)
+def decode_attention(
+    q: jnp.ndarray,  # [B, Hq, D] one query token per row
+    k_cache: jnp.ndarray,  # [B, T, Hkv, D]
+    v_cache: jnp.ndarray,  # [B, T, Hkv, D]
+    bounds: jnp.ndarray,  # [B, 2] int32 (start, end) valid slot window
+    attn_softcap: float = 0.0,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused decode attention. Returns [B, Hq, D] in q.dtype."""
+    B, Hq, D = q.shape
+    T, Hkv = k_cache.shape[1], k_cache.shape[2]
+    g = Hq // Hkv
+    G8 = max(_SUBLANE, g)
+    scale = 1.0 / math.sqrt(D)
+    # Largest tileable block that divides the (static) cache length.
+    block_t = next(
+        (b for b in (BLOCK_T, 128, 64, 32, 16, 8) if T % b == 0), T
+    )
+
+    # [B, Hkv, G8, D] — query heads grouped under their KV head, padded to
+    # the sublane tile. Pad rows attend to garbage harmlessly (dropped).
+    qg = q.reshape(B, Hkv, g, D)
+    if G8 != g:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, G8 - g), (0, 0)))
+
+    grid = (B, Hkv, T // block_t)
+    out = pl.pallas_call(
+        functools.partial(
+            _decode_attn_kernel,
+            scale=scale,
+            attn_softcap=attn_softcap,
+            block_t=block_t,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, G8, D), lambda b, h, t, _: (b, h, 0, 0)
+                ),
+                pl.BlockSpec(
+                    (1, block_t, 1, D), lambda b, h, t, _: (b, t, h, 0)
+                ),
+                pl.BlockSpec(
+                    (1, block_t, 1, D), lambda b, h, t, _: (b, t, h, 0)
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, G8, D), lambda b, h, t, _: (b, h, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((G8, 1), jnp.float32),
+                pltpu.VMEM((G8, 1), jnp.float32),
+                pltpu.VMEM((G8, D), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G8, D), q.dtype),
+        interpret=interpret,
+    )(bounds, qg, k_cache, v_cache)
+
+    return out[:, :, :g, :].reshape(B, Hq, D)

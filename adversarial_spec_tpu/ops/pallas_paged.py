@@ -1,0 +1,157 @@
+"""Pallas TPU kernel: decode attention over a PAGED KV cache.
+
+Paged KV (the second kernel BASELINE.json's north star names): instead of
+one dense [B, T_max, H, D] buffer per batch — which must be sized for the
+longest sequence and reallocated/copied as debates grow — key/value live in
+fixed-size pages [n_pages, page_size, Hkv, D] shared by all sequences, and
+each row owns an ordered page list (the page table). Debate rounds grow
+sequences at different rates (opponents finish at different lengths), so
+paging keeps HBM occupancy at O(tokens actually written) and makes
+prefix-sharing across opponents possible (same spec prompt → same pages,
+a planned optimization).
+
+Kernel shape: grid (B, Hkv, n_pages_per_seq); the page table rides in as a
+scalar-prefetch operand so each grid step's BlockSpec ``index_map`` selects
+the physical page to DMA next — the gather happens in the pipeline, not in
+the kernel body. Online-softmax state (m, l, acc) persists in VMEM scratch
+across the sequential innermost grid dimension: initialized at page 0,
+finalized and written at the last page.
+
+Tested under ``interpret=True`` on CPU against the dense jnp reference
+(tests/test_pallas.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from adversarial_spec_tpu.ops.flash_common import flash_update
+
+_SUBLANE = 8
+
+
+def _paged_attn_kernel(
+    bounds_ref,  # SMEM [B, 2]: (start, end) token window per row
+    table_ref,  # SMEM [B, P]: physical page id per (row, logical page)
+    q_ref,  # VMEM [1, 1, G8, D]
+    k_ref,  # VMEM [1, page, 1, D] — page selected by index_map
+    v_ref,  # VMEM [1, page, 1, D]
+    o_ref,  # VMEM [1, 1, G8, D]
+    m_ref,  # VMEM scratch [G8, 1]
+    l_ref,  # VMEM scratch [G8, 1]
+    acc_ref,  # VMEM scratch [G8, D]
+    *,
+    scale: float,
+    page_size: int,
+    attn_softcap: float,
+):
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    n_pages = pl.num_programs(2)
+    G8, D = q_ref.shape[2], q_ref.shape[3]
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full((G8, 1), -jnp.inf, jnp.float32)
+        l_ref[:] = jnp.zeros((G8, 1), jnp.float32)
+        acc_ref[:] = jnp.zeros((G8, D), jnp.float32)
+
+    start = bounds_ref[b, 0]
+    end = bounds_ref[b, 1]
+    page_id = table_ref[b, p]
+    t0 = p * page_size  # logical token offset of this page
+
+    # Unmapped pages (id < 0) and pages wholly outside [start, end) are
+    # masked; compute still runs (SPMD) but contributes nothing.
+    @pl.when((page_id >= 0) & (t0 < end))
+    def _accumulate():
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        k = k_ref[0, :, 0].astype(jnp.float32)  # [page, D]
+        v = v_ref[0, :, 0].astype(jnp.float32)
+        m, l, acc = flash_update(
+            q,
+            k,
+            v,
+            t0,
+            start,
+            end,
+            m_ref[:],
+            l_ref[:],
+            acc_ref[:],
+            attn_softcap=attn_softcap,
+        )
+        m_ref[:] = m
+        l_ref[:] = l
+        acc_ref[:] = acc
+
+    @pl.when(p == n_pages - 1)
+    def _finalize():
+        o_ref[0, 0] = (
+            acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("attn_softcap", "interpret")
+)
+def paged_decode_attention(
+    q: jnp.ndarray,  # [B, Hq, D]
+    k_pages: jnp.ndarray,  # [n_pages, page_size, Hkv, D]
+    v_pages: jnp.ndarray,  # [n_pages, page_size, Hkv, D]
+    page_table: jnp.ndarray,  # [B, P] int32, -1 = unmapped
+    bounds: jnp.ndarray,  # [B, 2] int32 (start, end) token window
+    attn_softcap: float = 0.0,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Fused paged decode attention. Returns [B, Hq, D]."""
+    B, Hq, D = q.shape
+    page_size, Hkv = k_pages.shape[1], k_pages.shape[2]
+    P = page_table.shape[1]
+    g = Hq // Hkv
+    G8 = max(_SUBLANE, g)
+    scale = 1.0 / math.sqrt(D)
+
+    qg = q.reshape(B, Hkv, g, D)
+    if G8 != g:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, G8 - g), (0, 0)))
+
+    def page_map(b, h, p, bounds_ref, table_ref):
+        return (jnp.maximum(table_ref[b, p], 0), 0, h, 0)
+
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_attn_kernel,
+            scale=scale,
+            page_size=page_size,
+            attn_softcap=attn_softcap,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, Hkv, P),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, G8, D), lambda b, h, p, *_: (b, h, 0, 0)
+                ),
+                pl.BlockSpec((1, page_size, 1, D), page_map),
+                pl.BlockSpec((1, page_size, 1, D), page_map),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, G8, D), lambda b, h, p, *_: (b, h, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((G8, 1), jnp.float32),
+                pltpu.VMEM((G8, 1), jnp.float32),
+                pltpu.VMEM((G8, D), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G8, D), q.dtype),
+        interpret=interpret,
+    )(bounds, page_table, qg, k_pages, v_pages)
+
+    return out[:, :, :g, :].reshape(B, Hq, D)

@@ -31,15 +31,14 @@ def _force_cpu_only_backends() -> None:
     """
     try:
         import jax
-        from jax._src import xla_bridge as xb
     except ImportError:
         return
-    if getattr(xb, "_backends", None):
-        return  # backends already initialized; too late (and unnecessary)
+    # NOTE: do NOT unregister the non-CPU backend factories — their
+    # registration is what makes the "tpu" platform *known* to the MLIR
+    # lowering registry, and Pallas imports register tpu lowering rules.
+    # Restricting jax_platforms is sufficient to keep the remote backend
+    # uninitialized (its client is only dialed at init).
     jax.config.update("jax_platforms", "cpu")
-    for name in list(getattr(xb, "_backend_factories", {})):
-        if name != "cpu":
-            xb._backend_factories.pop(name, None)
 
 
 _force_cpu_only_backends()

@@ -1,0 +1,119 @@
+"""Paged KV-cache manager tests: allocator bookkeeping and pool scatter."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from adversarial_spec_tpu.engine.kvcache import (
+    OutOfPages,
+    PageAllocator,
+    PagedCacheLayout,
+    init_page_pool,
+    token_positions_to_pages,
+    write_tokens,
+)
+
+
+class TestPageAllocator:
+    def test_extend_allocates_minimal_pages(self):
+        a = PageAllocator(n_pages=8, page_size=4)
+        a.new_sequence(0)
+        new = a.extend(0, 3)  # 3 tokens → 1 page
+        assert len(new) == 1
+        assert a.length(0) == 3
+        assert a.extend(0, 1) == []  # 4th token fits the same page
+        new2 = a.extend(0, 1)  # 5th token → second page
+        assert len(new2) == 1
+        assert a.free_pages == 6
+
+    def test_tables_are_ordered(self):
+        a = PageAllocator(n_pages=8, page_size=2)
+        a.new_sequence(1)
+        a.extend(1, 6)
+        assert len(a.table(1)) == 3
+
+    def test_out_of_pages_rolls_back(self):
+        a = PageAllocator(n_pages=2, page_size=2)
+        a.new_sequence(0)
+        a.extend(0, 4)  # both pages used
+        a.new_sequence(1)
+        with pytest.raises(OutOfPages):
+            a.extend(1, 2)
+        assert a.length(1) == 0
+        assert a.free_pages == 0
+        a.free_sequence(0)
+        assert a.free_pages == 2
+        a.extend(1, 2)  # now fits
+
+    def test_free_sequence_recycles(self):
+        a = PageAllocator(n_pages=4, page_size=2)
+        a.new_sequence(0)
+        a.extend(0, 8)
+        assert a.free_pages == 0
+        a.free_sequence(0)
+        assert a.free_pages == 4
+
+    def test_duplicate_sequence_rejected(self):
+        a = PageAllocator(n_pages=4, page_size=2)
+        a.new_sequence(0)
+        with pytest.raises(ValueError, match="already allocated"):
+            a.new_sequence(0)
+
+    def test_table_array_padding(self):
+        a = PageAllocator(n_pages=8, page_size=2)
+        a.new_sequence(0)
+        a.new_sequence(1)
+        a.extend(0, 4)
+        a.extend(1, 2)
+        arr = a.table_array([0, 1], max_pages=4)
+        assert arr.shape == (2, 4)
+        assert (arr[0, :2] >= 0).all() and (arr[0, 2:] == -1).all()
+        assert arr[1, 0] >= 0 and (arr[1, 1:] == -1).all()
+
+    def test_table_array_overflow_raises(self):
+        a = PageAllocator(n_pages=8, page_size=1)
+        a.new_sequence(0)
+        a.extend(0, 5)
+        with pytest.raises(ValueError, match="spans"):
+            a.table_array([0], max_pages=4)
+
+
+class TestPagePool:
+    def test_write_and_readback(self):
+        layout = PagedCacheLayout(
+            n_pages=4, page_size=2, n_layers=2, n_kv_heads=2, head_dim=4
+        )
+        pool = init_page_pool(layout, dtype=jnp.float32)
+        a = PageAllocator(layout.n_pages, layout.page_size)
+        a.new_sequence(0)
+        a.extend(0, 3)
+
+        L, B, S = 2, 1, 3
+        k_new = jnp.arange(L * B * S * 2 * 4, dtype=jnp.float32).reshape(
+            L, B, S, 2, 4
+        )
+        v_new = -k_new
+        positions = np.array([[0, 1, 2]])
+        page_ids, offsets = token_positions_to_pages(a, [0], positions)
+        pool = write_tokens(pool, k_new, v_new, page_ids, offsets)
+
+        table = a.table(0)
+        # Token 0 → page table[0] slot 0; token 2 → page table[1] slot 0.
+        np.testing.assert_array_equal(
+            np.asarray(pool["k"][:, table[0], 0]), np.asarray(k_new[:, 0, 0])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(pool["k"][:, table[0], 1]), np.asarray(k_new[:, 0, 1])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(pool["k"][:, table[1], 0]), np.asarray(k_new[:, 0, 2])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(pool["v"][:, table[0], 0]), np.asarray(v_new[:, 0, 0])
+        )
+
+    def test_capacity(self):
+        layout = PagedCacheLayout(
+            n_pages=16, page_size=128, n_layers=1, n_kv_heads=1, head_dim=8
+        )
+        assert layout.tokens_capacity == 2048

@@ -1,0 +1,172 @@
+"""Pallas kernel tests under interpret mode (CPU) against jnp references,
+plus end-to-end decode parity when the fused kernel is routed into the
+generation loop."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from adversarial_spec_tpu.engine.generate import generate
+from adversarial_spec_tpu.models import transformer as T
+from adversarial_spec_tpu.models.config import get_config
+from adversarial_spec_tpu.ops.pallas_decode import decode_attention
+from adversarial_spec_tpu.ops.pallas_paged import paged_decode_attention
+
+
+def _dense_ref(q, k, v, bounds, attn_softcap=0.0):
+    B, Hq, D = q.shape
+    T_, Hkv = k.shape[1], k.shape[2]
+    g = Hq // Hkv
+    qg = q.reshape(B, Hkv, g, D)
+    s = jnp.einsum("bhgd,bthd->bhgt", qg, k) / math.sqrt(D)
+    if attn_softcap > 0:
+        s = jnp.tanh(s / attn_softcap) * attn_softcap
+    slot = jnp.arange(T_)
+    valid = (slot[None, :] >= bounds[:, 0:1]) & (slot[None, :] < bounds[:, 1:2])
+    s = jnp.where(valid[:, None, None, :], s, -jnp.inf)
+    p = jax.nn.softmax(s, -1)
+    return jnp.einsum("bhgt,bthd->bhgd", p, v).reshape(B, Hq, D)
+
+
+class TestDecodeKernel:
+    def _rand(self, B=3, Hq=8, Hkv=2, D=64, T_=512, dtype=jnp.float32):
+        ks = jax.random.split(jax.random.key(0), 3)
+        q = jax.random.normal(ks[0], (B, Hq, D), dtype)
+        k = jax.random.normal(ks[1], (B, T_, Hkv, D), dtype)
+        v = jax.random.normal(ks[2], (B, T_, Hkv, D), dtype)
+        return q, k, v
+
+    def test_matches_dense(self):
+        q, k, v = self._rand()
+        bounds = jnp.array([[0, 100], [37, 412], [5, 6]], jnp.int32)
+        out = decode_attention(q, k, v, bounds, interpret=True)
+        ref = _dense_ref(q, k, v, bounds)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+    def test_softcap(self):
+        q, k, v = self._rand(T_=256)
+        bounds = jnp.array([[0, 256], [0, 128], [10, 200]], jnp.int32)
+        out = decode_attention(q, k, v, bounds, attn_softcap=50.0, interpret=True)
+        ref = _dense_ref(q, k, v, bounds, attn_softcap=50.0)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+    def test_mha_no_gqa(self):
+        q, k, v = self._rand(Hq=4, Hkv=4, T_=256)
+        bounds = jnp.array([[0, 256], [0, 10], [100, 256]], jnp.int32)
+        out = decode_attention(q, k, v, bounds, interpret=True)
+        ref = _dense_ref(q, k, v, bounds)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+    def test_single_valid_slot(self):
+        """end-start == 1: softmax over one key must return exactly v."""
+        q, k, v = self._rand(B=1, T_=256)
+        bounds = jnp.array([[17, 18]], jnp.int32)
+        out = decode_attention(q, k, v, bounds, interpret=True)
+        g = 8 // 2
+        expect = jnp.repeat(v[:, 17], g, axis=1).reshape(1, 8, 64)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(expect), rtol=1e-5, atol=1e-5
+        )
+
+    def test_non_block_aligned_window(self):
+        """Bounds crossing BLOCK_T boundaries mask correctly."""
+        q, k, v = self._rand(B=1, T_=512)
+        bounds = jnp.array([[250, 270]], jnp.int32)  # spans block edge 256
+        out = decode_attention(q, k, v, bounds, interpret=True)
+        ref = _dense_ref(q, k, v, bounds)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+
+class TestPagedKernel:
+    def test_matches_gathered_dense(self):
+        B, Hq, Hkv, D = 2, 8, 2, 64
+        page_size, n_pages, P = 16, 32, 8
+        ks = jax.random.split(jax.random.key(1), 3)
+        q = jax.random.normal(ks[0], (B, Hq, D), jnp.float32)
+        kp = jax.random.normal(ks[1], (n_pages, page_size, Hkv, D), jnp.float32)
+        vp = jax.random.normal(ks[2], (n_pages, page_size, Hkv, D), jnp.float32)
+        table = np.full((B, P), -1, np.int32)
+        table[0, :3] = [3, 7, 1]
+        table[1, 0] = 5
+        bounds = jnp.array([[2, 40], [0, 9]], jnp.int32)
+
+        out = paged_decode_attention(
+            q, kp, vp, jnp.asarray(table), bounds, interpret=True
+        )
+
+        for b in range(B):
+            pages = [p for p in table[b] if p >= 0]
+            k = jnp.concatenate([kp[p] for p in pages], 0)[None]
+            v = jnp.concatenate([vp[p] for p in pages], 0)[None]
+            ref = _dense_ref(q[b : b + 1], k, v, bounds[b : b + 1])
+            np.testing.assert_allclose(
+                np.asarray(out[b]), np.asarray(ref[0]), rtol=2e-5, atol=2e-5
+            )
+
+    def test_unmapped_rows_after_first_page(self):
+        """A row using 1 of 8 table slots must ignore the -1 slots."""
+        B, Hq, Hkv, D = 1, 4, 2, 64
+        page_size, n_pages, P = 8, 4, 8
+        ks = jax.random.split(jax.random.key(2), 3)
+        q = jax.random.normal(ks[0], (B, Hq, D), jnp.float32)
+        kp = jax.random.normal(ks[1], (n_pages, page_size, Hkv, D), jnp.float32)
+        vp = jax.random.normal(ks[2], (n_pages, page_size, Hkv, D), jnp.float32)
+        table = np.full((B, P), -1, np.int32)
+        table[0, 0] = 2
+        bounds = jnp.array([[0, 8]], jnp.int32)
+        out = paged_decode_attention(
+            q, kp, vp, jnp.asarray(table), bounds, interpret=True
+        )
+        ref = _dense_ref(q, kp[2][None], vp[2][None], bounds)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+
+class TestPallasInGenerate:
+    @pytest.mark.parametrize("family", ["llama", "gemma2", "mistral"])
+    def test_generate_parity_with_jnp_path(self, family):
+        """Routing decode through the fused kernel must not change greedy
+        tokens. Windowed families run with sliding_window=8 so the window
+        start actually exceeds the pad boundary during decode (prompts pad
+        to bucket 128, so cache_index - 8 + 1 > pad_len from the first
+        decode steps) — otherwise the windowed and global paths would
+        compute identical bounds and window bugs would pass unnoticed."""
+        from dataclasses import replace
+
+        cfg = get_config(family, "tiny")
+        if cfg.sliding_window > 0:
+            cfg = replace(cfg, sliding_window=8)
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        prompts = [[1, 5, 9, 3] * 4, [2, 6] * 5]
+        kw = dict(max_new_tokens=12, eos_ids=[], greedy=True)
+        ref = generate(params, cfg, prompts, use_pallas_decode=False, **kw)
+        out = generate(params, cfg, prompts, use_pallas_decode=True, **kw)
+        np.testing.assert_array_equal(ref.tokens, out.tokens)
+
+    def test_window_actually_truncates_in_this_setup(self):
+        """Guard for the test above: with window=8 the pallas bounds start
+        must differ between windowed and unwindowed configs (i.e. the
+        window path is genuinely exercised, not vacuously equal)."""
+        from dataclasses import replace
+
+        cfg = get_config("mistral", "tiny")
+        cfg_w = replace(cfg, sliding_window=8)
+        cfg_g = replace(cfg, sliding_window=0)
+        params = T.init_params(jax.random.key(0), cfg_w, dtype=jnp.float32)
+        prompts = [[1, 5, 9, 3] * 4]
+        kw = dict(max_new_tokens=12, eos_ids=[], greedy=True)
+        out_w = generate(params, cfg_w, prompts, use_pallas_decode=True, **kw)
+        out_g = generate(params, cfg_g, prompts, use_pallas_decode=True, **kw)
+        assert not np.array_equal(out_w.tokens, out_g.tokens)
