@@ -56,6 +56,29 @@ ACTIONS = [
 
 DEFAULT_MODELS = ["mock://critic?agree_after=3"]
 
+# Bigger models make better critics; used to rank registry entries when
+# auto-picking a default opponent (reference analog: priority-ordered
+# default-model detection, providers.py:394-415).
+_SIZE_RANK = {"70b": 6, "9b": 5, "8b": 4, "7b": 3, "3b": 2, "1b": 1, "tiny": 0}
+
+
+def get_default_models() -> list[str]:
+    """Best servable opponent: a registry alias with a real, resolvable
+    checkpoint (largest first); else the mock critic so the loop always
+    runs."""
+    reg = model_registry.load_registry()
+    real = [
+        (spec, alias)
+        for alias, spec in reg.items()
+        if spec.checkpoint != "random"
+        and model_registry.validate_tpu_model(f"tpu://{alias}", registry=reg)
+        is None
+    ]
+    if real:
+        real.sort(key=lambda e: _SIZE_RANK.get(e[0].size, -1), reverse=True)
+        return [f"tpu://{real[0][1]}"]
+    return list(DEFAULT_MODELS)
+
 
 def _err(msg: str) -> None:
     print(msg, file=sys.stderr)
@@ -169,7 +192,9 @@ def parse_models(args: argparse.Namespace) -> list[str]:
     """
     if args.models:
         return [m.strip() for m in args.models.split(",") if m.strip()]
-    return list(DEFAULT_MODELS)
+    models = get_default_models()
+    _err(f"no --models given; defaulting to {','.join(models)}")
+    return models
 
 
 def validate_models_before_run(models: list[str]) -> list[str]:

@@ -384,6 +384,30 @@ class TestRegistry:
         assert code == 2
 
 
+class TestDefaultModels:
+    def test_defaults_to_mock_when_no_real_checkpoints(self):
+        assert cli.get_default_models() == ["mock://critic?agree_after=3"]
+
+    def test_prefers_largest_real_checkpoint(self, tmp_path):
+        from adversarial_spec_tpu.engine.registry import (
+            ModelSpec,
+            save_registry_entry,
+        )
+
+        ckpt = tmp_path / "ckpt"
+        ckpt.mkdir()
+        save_registry_entry(
+            ModelSpec(alias="small", size="1b", checkpoint=str(ckpt))
+        )
+        save_registry_entry(
+            ModelSpec(alias="big", size="8b", checkpoint=str(ckpt))
+        )
+        save_registry_entry(
+            ModelSpec(alias="broken", size="70b", checkpoint="/nope")
+        )
+        assert cli.get_default_models() == ["tpu://big"]
+
+
 class TestParser:
     def test_invalid_action_rejected(self):
         with pytest.raises(SystemExit):
