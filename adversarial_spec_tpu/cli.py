@@ -147,6 +147,10 @@ def create_parser() -> argparse.ArgumentParser:
         default=0,
         help="Seconds to wait for Telegram feedback (0 = don't poll)",
     )
+    o.add_argument(
+        "--profile-dir",
+        help="Write a jax.profiler trace for the round to this directory",
+    )
 
     d = parser.add_argument_group("decode")
     d.add_argument(
@@ -180,6 +184,12 @@ def create_parser() -> argparse.ArgumentParser:
     r.add_argument("--tokenizer", default="", help="Tokenizer path")
     r.add_argument("--dtype", default=None, help="Param dtype (bfloat16)")
     r.add_argument("--tp", type=int, default=0, help="Tensor-parallel degree")
+    r.add_argument(
+        "--quant",
+        choices=["", "int8"],
+        default="",
+        help="Weight-only quantization for this model",
+    )
     return parser
 
 
@@ -273,9 +283,13 @@ def load_or_resume_session(
 
 
 def run_critique(args: argparse.Namespace) -> int:
+    from adversarial_spec_tpu.utils.tracing import Tracer, maybe_profile
+
+    tracer = Tracer()
     spec, session_state = load_or_resume_session(args)
     models = parse_models(args)
-    errors = validate_models_before_run(models)
+    with tracer.span("validate"):
+        errors = validate_models_before_run(models)
     if errors:
         for e in errors:
             _err(f"validation error: {e}")
@@ -294,7 +308,8 @@ def run_critique(args: argparse.Namespace) -> int:
         f"Round {args.round}: querying {len(models)} model(s): "
         + ", ".join(models)
     )
-    result = run_round(spec, models, round_num=args.round, cfg=cfg)
+    with tracer.span("round"), maybe_profile(args.profile_dir):
+        result = run_round(spec, models, round_num=args.round, cfg=cfg)
 
     for r in result.failed:
         _err(f"warning: {r.model} failed: {r.error}")
@@ -302,6 +317,14 @@ def run_critique(args: argparse.Namespace) -> int:
     tracker = CostTracker()
     for r in result.responses:
         tracker.add(r.model, r.usage)
+    tracer.count("decode_tokens", result.total_usage.decode_tokens)
+    tracer.spans["decode"] = result.total_usage.decode_time_s
+    perf = tracer.report()
+    perf["decode_tokens_per_sec"] = round(tracer.rate("decode_tokens", "decode"), 1)
+    _err(
+        f"perf: round {perf['spans'].get('round', 0):.2f}s, "
+        f"decode {perf['decode_tokens_per_sec']} tok/s"
+    )
 
     # The revised spec for the next round: last successful revision wins
     # (the L5 agent synthesizes across critiques; this is the raw material).
@@ -331,7 +354,9 @@ def run_critique(args: argparse.Namespace) -> int:
     if args.notify:
         user_feedback = _telegram_notify(args, result, tracker)
 
-    output_results(args, result, models, tracker, session_state, user_feedback)
+    output_results(
+        args, result, models, tracker, session_state, user_feedback, perf
+    )
     return EXIT_OK
 
 
@@ -364,6 +389,7 @@ def output_results(
     tracker: CostTracker,
     session_state: SessionState | None,
     user_feedback: str | None = None,
+    perf: dict | None = None,
 ) -> None:
     """Emit round results. JSON schema parity: reference debate.py:909-941."""
     if args.json:
@@ -391,6 +417,8 @@ def output_results(
             ],
             "cost": tracker.report(),
         }
+        if perf is not None:
+            out["perf"] = perf
         if user_feedback:
             out["user_feedback"] = user_feedback
         print(json.dumps(out, indent=2))
@@ -570,6 +598,7 @@ def handle_registry(args: argparse.Namespace, rest: list[str]) -> int:
             size=args.size,
             dtype=args.dtype or "bfloat16",
             mesh={"tp": args.tp} if args.tp else {},
+            quant=args.quant,
         )
         model_registry.save_registry_entry(spec)
         print(f"registered tpu://{alias}")

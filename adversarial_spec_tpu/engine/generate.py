@@ -73,39 +73,44 @@ def pad_batch(
     return tokens, pad_lens
 
 
-@partial(jax.jit, static_argnames=("cfg", "total_len", "greedy", "top_k"))
-def prefill_step(
+PREFILL_CHUNK = 1024
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
+def prefill_chunk(
     params: Params,
     cfg: ModelConfig,
-    tokens: jnp.ndarray,  # [B, S] left-padded
+    tokens: jnp.ndarray,  # [B, Sc] one left-padded prompt chunk
     pad_lens: jnp.ndarray,  # [B]
-    key: jax.Array,
-    temperature: jnp.ndarray,
-    top_p: jnp.ndarray,
-    *,
-    total_len: int,
-    greedy: bool,
-    top_k: int,
+    cache: Cache,
+    cache_index: jnp.ndarray,  # scalar: slot of this chunk's first token
 ) -> tuple[Cache, jnp.ndarray]:
-    """Run the prompt through the model; sample the first new token."""
-    B, S = tokens.shape
-    cache = init_cache(cfg, B, total_len, dtype=params["embed"].dtype)
+    """Run ONE prompt chunk through the model.
+
+    Long prompts (16k-context PRDs, BASELINE config 5) prefill as a
+    sequence of fixed-size chunks: activation memory is O(chunk·dim)
+    instead of O(S·dim), and every chunk reuses one compiled program.
+    Returns (cache, last-position logits [B, vocab]).
+    """
+    B, Sc = tokens.shape
+    T = cache["k"].shape[2]
     positions = jnp.maximum(
-        jnp.arange(S, dtype=jnp.int32)[None, :] - pad_lens[:, None], 0
+        cache_index + jnp.arange(Sc, dtype=jnp.int32)[None, :]
+        - pad_lens[:, None],
+        0,
     )
-    kv_valid = jnp.arange(total_len)[None, :] >= pad_lens[:, None]
+    kv_valid = jnp.arange(T)[None, :] >= pad_lens[:, None]
     logits, cache = forward(
-        params, cfg, tokens, positions, cache, jnp.int32(0), kv_valid
+        params,
+        cfg,
+        tokens,
+        positions,
+        cache,
+        cache_index,
+        kv_valid,
+        lm_head_last_only=True,
     )
-    first = sample_tokens(
-        logits[:, -1],
-        key,
-        greedy=greedy,
-        top_k=top_k,
-        temperature=temperature,
-        top_p=top_p,
-    )
-    return cache, first
+    return cache, logits[:, -1]
 
 
 @partial(
@@ -230,6 +235,7 @@ def generate(
     timeout_s: float = 0.0,
     mesh=None,
     use_pallas_decode: bool | None = None,
+    share_prefix: bool = True,
 ) -> GenerateResult:
     """End-to-end batched generation (host orchestration).
 
@@ -238,6 +244,13 @@ def generate(
     the result) and token inputs are placed with NamedShardings — GSPMD
     propagates dp through activations and the KV cache, while params carry
     their tp shardings from the loader (parallel/sharding.py).
+
+    ``share_prefix``: a debate round sends IDENTICAL prompts to every
+    opponent sharing a model (round-level focus/persona apply to all), so
+    when all rows are equal the prompt prefills ONCE (B=1) and the KV
+    cache is tiled to B rows before decode — prefill FLOPs drop by B×,
+    SURVEY §7 hard part (e)'s prefix-caching lever. Rows then diverge via
+    per-row sampling. Applies off-mesh only (dp sharding wants real rows).
     """
     if use_pallas_decode is None:
         # Auto: fused kernel on a real single-device TPU; jnp path for
@@ -280,18 +293,55 @@ def generate(
 
     deadline = time.monotonic() + timeout_s if timeout_s > 0 else None
 
+    # Shared-prefix: identical rows prefill once and tile. Qualifies off-
+    # mesh and on single-device meshes (the TpuEngine always passes a
+    # mesh, so the single-chip case — the common debate setup — must
+    # qualify); dp>1 meshes want real rows for the sharded prefill.
+    shared = (
+        share_prefix
+        and (mesh is None or mesh.size == 1)
+        and B > 1
+        and all(p == prompt_ids[0] for p in prompt_ids[1:])
+    )
+    prefill_tokens = tokens[:1] if shared else tokens
+    prefill_pads = pad_lens[:1] if shared else pad_lens
+
     t0 = time.monotonic()
-    cache, first = prefill_step(
-        params,
+    cache_device = None
+    if mesh is not None and mesh.size > 1:
+        from adversarial_spec_tpu.parallel.sharding import cache_sharding
+
+        # Born sharded: batch over dp, heads over tp — never replicated
+        # through one chip's HBM.
+        cache_device = cache_sharding(mesh)
+    cache = init_cache(
         cfg,
-        tokens,
-        pad_lens,
+        prefill_tokens.shape[0],
+        total_len,
+        dtype=params["embed"].dtype,
+        device=cache_device,
+    )
+    chunk_len = min(S, PREFILL_CHUNK)
+    last_logits = None
+    for ci in range(0, S, chunk_len):
+        cache, last_logits = prefill_chunk(
+            params,
+            cfg,
+            prefill_tokens[:, ci : ci + chunk_len],
+            prefill_pads,
+            cache,
+            jnp.int32(ci),
+        )
+    if shared:
+        cache = jax.tree.map(lambda x: jnp.repeat(x, B, axis=1), cache)
+        last_logits = jnp.repeat(last_logits, B, axis=0)
+    first = sample_tokens(
+        last_logits,
         prefill_key,
-        temp,
-        tp,
-        total_len=total_len,
         greedy=greedy,
         top_k=top_k,
+        temperature=temp,
+        top_p=tp,
     )
     first.block_until_ready()
     prefill_time = time.monotonic() - t0

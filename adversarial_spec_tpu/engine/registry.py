@@ -36,6 +36,7 @@ class ModelSpec:
     dtype: str = "bfloat16"
     mesh: dict[str, int] = field(default_factory=dict)  # e.g. {"tp": 8}
     max_seq_len: int = 8192
+    quant: str = ""  # "" = full precision, "int8" = weight-only int8
 
     def to_dict(self) -> dict:
         return asdict(self)

@@ -103,6 +103,12 @@ class TpuEngine:
             max_seq_len=spec.max_seq_len,
             device_put=device_put,
         )
+        if spec.quant == "int8":
+            from adversarial_spec_tpu.ops.quant import quantize_params
+
+            # On-device requantization; shardings propagate from the
+            # bf16 leaves, old buffers free once replaced.
+            params = quantize_params(params)
         tokenizer = load_tokenizer(spec.tokenizer)
         lm = LoadedModel(
             spec=spec,

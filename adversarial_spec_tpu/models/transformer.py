@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 
 from adversarial_spec_tpu.models.config import ModelConfig
+from adversarial_spec_tpu.ops.quant import matmul
 from adversarial_spec_tpu.ops.rope import apply_rope, rope_angles
 
 Params = dict[str, Any]
@@ -100,9 +101,16 @@ def init_cache(
     batch: int,
     max_seq: int,
     dtype: jnp.dtype = jnp.bfloat16,
+    device=None,
 ) -> Cache:
+    """``device`` may be a Sharding so the cache is born sharded (never
+    materialized replicated on one chip)."""
     shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    kw = {"device": device} if device is not None else {}
+    return {
+        "k": jnp.zeros(shape, dtype, **kw),
+        "v": jnp.zeros(shape, dtype, **kw),
+    }
 
 
 def rms_norm(
@@ -168,6 +176,7 @@ def forward(
     *,
     use_pallas_decode: bool = False,
     pallas_interpret: bool = False,
+    lm_head_last_only: bool = False,
 ) -> tuple[jnp.ndarray, Cache]:
     """One forward pass over a chunk (prefill: S=chunk, decode: S=1).
 
@@ -216,9 +225,9 @@ def forward(
     def layer_body(x, scanned):
         lp, layer_id, k_cache, v_cache = scanned
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps, cfg.norm_scale_plus_one)
-        q = h @ lp["wq"]
-        k = h @ lp["wk"]
-        v = h @ lp["wv"]
+        q = matmul(h, lp["wq"])
+        k = matmul(h, lp["wk"])
+        v = matmul(h, lp["wv"])
         if cfg.qkv_bias:
             q = q + lp["bq"]
             k = k + lp["bk"]
@@ -274,7 +283,7 @@ def forward(
             out = attention(
                 q, k_cache, v_cache, mask, attn_softcap=cfg.attn_softcap
             )
-        out = out.reshape(B, S, cfg.n_heads * cfg.head_dim) @ lp["wo"]
+        out = matmul(out.reshape(B, S, cfg.n_heads * cfg.head_dim), lp["wo"])
         if cfg.post_norms:
             out = rms_norm(
                 out, lp["post_attn_norm"], cfg.rms_eps, cfg.norm_scale_plus_one
@@ -282,8 +291,10 @@ def forward(
         x = x + out
 
         h = rms_norm(x, lp["ffn_norm"], cfg.rms_eps, cfg.norm_scale_plus_one)
-        ff = _activation(h @ lp["w_gate"], cfg.activation) * (h @ lp["w_up"])
-        ff = ff @ lp["w_down"]
+        ff = _activation(matmul(h, lp["w_gate"]), cfg.activation) * matmul(
+            h, lp["w_up"]
+        )
+        ff = matmul(ff, lp["w_down"])
         if cfg.post_norms:
             ff = rms_norm(
                 ff, lp["post_ffn_norm"], cfg.rms_eps, cfg.norm_scale_plus_one
@@ -298,6 +309,10 @@ def forward(
     )
 
     x = rms_norm(x, params["final_norm"], cfg.rms_eps, cfg.norm_scale_plus_one)
+    if lm_head_last_only:
+        # Prompt chunks only ever need the final position's logits; skip
+        # the [B, S, vocab] projection (the largest prefill activation).
+        x = x[:, -1:]
     if cfg.tied_embeddings:
         logits = jnp.einsum(
             "bsd,vd->bsv",
@@ -306,11 +321,8 @@ def forward(
             preferred_element_type=jnp.float32,
         )
     else:
-        logits = jnp.einsum(
-            "bsd,dv->bsv",
-            x,
-            params["lm_head"],
-            preferred_element_type=jnp.float32,
+        logits = matmul(
+            x, params["lm_head"], preferred_element_type=jnp.float32
         )
     if cfg.logit_softcap > 0.0:
         logits = _softcap(logits, cfg.logit_softcap)

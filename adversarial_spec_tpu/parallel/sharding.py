@@ -47,15 +47,29 @@ _PARAM_RULES: dict[str, P] = {
 }
 
 
-def _leaf_name(path) -> str:
-    for entry in reversed(path):
-        if isinstance(entry, jax.tree_util.DictKey):
-            return str(entry.key)
-    raise ValueError(f"cannot name pytree path {path}")
+def _dict_names(path) -> list[str]:
+    return [
+        str(e.key) for e in path if isinstance(e, jax.tree_util.DictKey)
+    ]
 
 
 def param_sharding_rules(path) -> P:
-    name = _leaf_name(path)
+    names = _dict_names(path)
+    if not names:
+        raise ValueError(f"cannot name pytree path {path}")
+    name = names[-1]
+    # int8-quantized weights are dict leaves {"q", "scale"} under the
+    # weight's name (ops/quant.py): "q" shards like the weight; "scale"
+    # ([..., 1, out]) keeps only the output-axis sharding — its kept
+    # contraction axis has size 1 and must stay unsharded.
+    if name in ("q", "scale") and len(names) >= 2:
+        parent = _PARAM_RULES.get(names[-2])
+        if parent is not None:
+            if name == "q":
+                return parent
+            spec = list(parent)
+            spec[-2] = None
+            return P(*spec)
     if name not in _PARAM_RULES:
         raise KeyError(f"no sharding rule for param {name!r}")
     return _PARAM_RULES[name]

@@ -1,0 +1,71 @@
+"""Tracing and profiling.
+
+SURVEY §5: the reference has no tracing at all (its nearest analog is the
+cost tracker), but per-round wall-clock and tokens/sec/chip are this
+framework's north-star metric, so tracing is first-class here:
+
+- ``Tracer`` — lightweight span timers building a per-round phase
+  breakdown (validate / prefill / decode / parse ...), nestable, with a
+  machine-readable report that the CLI attaches to ``--json`` output.
+- ``maybe_profile`` — wraps a block in a ``jax.profiler`` trace when a
+  directory is given (view with TensorBoard / xprof), no-op otherwise.
+
+Kept deliberately pure-Python and allocation-light: a span is two
+``time.monotonic`` calls and a dict entry.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Tracer:
+    """Named wall-clock spans with counters, for one logical operation."""
+
+    spans: dict[str, float] = field(default_factory=dict)
+    counters: dict[str, float] = field(default_factory=dict)
+    _t0: float = field(default_factory=time.monotonic)
+
+    @contextlib.contextmanager
+    def span(self, name: str):
+        start = time.monotonic()
+        try:
+            yield
+        finally:
+            self.spans[name] = self.spans.get(name, 0.0) + (
+                time.monotonic() - start
+            )
+
+    def count(self, name: str, value: float) -> None:
+        self.counters[name] = self.counters.get(name, 0.0) + value
+
+    def rate(self, tokens_key: str, time_key: str) -> float:
+        t = self.spans.get(time_key, 0.0)
+        return self.counters.get(tokens_key, 0.0) / t if t > 0 else 0.0
+
+    def report(self) -> dict:
+        total = time.monotonic() - self._t0
+        out: dict = {
+            "total_s": round(total, 4),
+            "spans": {k: round(v, 4) for k, v in self.spans.items()},
+        }
+        if self.counters:
+            out["counters"] = {
+                k: round(v, 2) for k, v in self.counters.items()
+            }
+        return out
+
+
+@contextlib.contextmanager
+def maybe_profile(trace_dir: str | None):
+    """jax.profiler trace into ``trace_dir`` when given; no-op otherwise."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield

@@ -201,6 +201,66 @@ class TestGenerate:
         expected = seq[len(prompt):]
         assert out.tokens[0, :n_new].tolist() == expected
 
+    def test_chunked_prefill_matches_single_chunk(self, tiny_model, monkeypatch):
+        """A prompt spanning multiple prefill chunks must produce the same
+        greedy tokens as one-shot prefill (chunk boundary correctness)."""
+        from adversarial_spec_tpu.engine import generate as gen_mod
+
+        params, cfg = tiny_model
+        prompt = [((i * 7) % 500) + 3 for i in range(300)]  # bucket 512
+        kw = dict(max_new_tokens=6, eos_ids=[], greedy=True)
+
+        monkeypatch.setattr(gen_mod, "PREFILL_CHUNK", 128)  # 4 chunks
+        chunked = generate(params, cfg, [prompt], **kw)
+        monkeypatch.setattr(gen_mod, "PREFILL_CHUNK", 4096)  # 1 chunk
+        oneshot = generate(params, cfg, [prompt], **kw)
+        np.testing.assert_array_equal(chunked.tokens, oneshot.tokens)
+
+    def test_shared_prefix_matches_unshared_greedy(self, tiny_model):
+        """Identical opponent prompts: prefill-once-and-tile must produce
+        the same greedy tokens as independent per-row prefill."""
+        params, cfg = tiny_model
+        prompts = [[1, 5, 9, 3, 7]] * 3
+        kw = dict(max_new_tokens=6, eos_ids=[], greedy=True)
+        shared = generate(params, cfg, prompts, share_prefix=True, **kw)
+        unshared = generate(params, cfg, prompts, share_prefix=False, **kw)
+        np.testing.assert_array_equal(shared.tokens, unshared.tokens)
+        # All rows identical under greedy (same prompt, same argmax).
+        assert (shared.tokens[0] == shared.tokens[1]).all()
+
+    def test_shared_prefix_fires_on_single_device_mesh(self, tiny_model):
+        """The production path (TpuEngine always passes a mesh; one real
+        chip → mesh.size == 1) must still take the shared-prefix route
+        and produce correct greedy tokens."""
+        import jax
+        from adversarial_spec_tpu.parallel.mesh import make_mesh
+
+        params, cfg = tiny_model
+        mesh = make_mesh({}, devices=jax.devices()[:1])
+        assert mesh.size == 1
+        prompts = [[1, 5, 9, 3, 7]] * 3
+        kw = dict(max_new_tokens=6, eos_ids=[], greedy=True)
+        ref = generate(params, cfg, prompts, share_prefix=False, **kw)
+        with mesh:
+            out = generate(params, cfg, prompts, mesh=mesh, **kw)
+        np.testing.assert_array_equal(ref.tokens, out.tokens)
+
+    def test_shared_prefix_rows_diverge_when_sampling(self, tiny_model):
+        """With temperature, tiled rows must sample independently."""
+        params, cfg = tiny_model
+        prompts = [[1, 5, 9, 3, 7]] * 4
+        out = generate(
+            params,
+            cfg,
+            prompts,
+            max_new_tokens=16,
+            eos_ids=[],
+            temperature=5.0,
+            seed=7,
+        )
+        rows = {tuple(r) for r in out.tokens.tolist()}
+        assert len(rows) > 1
+
     def test_timing_fields_populated(self, tiny_model):
         params, cfg = tiny_model
         out = generate(

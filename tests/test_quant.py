@@ -1,0 +1,118 @@
+"""Int8 weight-only quantization tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from adversarial_spec_tpu.engine.generate import generate
+from adversarial_spec_tpu.models import transformer as T
+from adversarial_spec_tpu.models.config import get_config
+from adversarial_spec_tpu.ops.quant import (
+    is_quantized,
+    matmul,
+    quantize_int8,
+    quantize_params,
+)
+
+
+class TestQuantizeInt8:
+    def test_roundtrip_error_bounded(self):
+        w = jax.random.normal(jax.random.key(0), (64, 32), jnp.float32)
+        qw = quantize_int8(w)
+        assert qw["q"].dtype == jnp.int8
+        assert qw["scale"].shape == (1, 32)
+        deq = qw["q"].astype(jnp.float32) * qw["scale"]
+        # Per-channel symmetric: max error ≤ scale/2 per element.
+        err = jnp.abs(deq - w)
+        assert float((err <= qw["scale"] / 2 + 1e-6).mean()) == 1.0
+
+    def test_matmul_dispatch(self):
+        w = jax.random.normal(jax.random.key(1), (16, 8), jnp.float32)
+        x = jax.random.normal(jax.random.key(2), (4, 16), jnp.float32)
+        plain = matmul(x, w)
+        quant = matmul(x, quantize_int8(w))
+        np.testing.assert_array_equal(np.asarray(plain), np.asarray(x @ w))
+        # Quantized result close to full precision.
+        rel = float(
+            jnp.linalg.norm(quant - plain) / jnp.linalg.norm(plain)
+        )
+        assert rel < 0.02
+
+    def test_layer_stacked_scales(self):
+        w = jax.random.normal(jax.random.key(3), (2, 16, 8), jnp.float32)
+        qw = quantize_int8(w)
+        assert qw["scale"].shape == (2, 1, 8)
+
+    def test_is_quantized(self):
+        assert not is_quantized(jnp.zeros((2, 2)))
+        assert is_quantized(quantize_int8(jnp.ones((2, 2))))
+
+
+class TestQuantizedModel:
+    def test_quantize_params_selective(self):
+        cfg = get_config("llama", "tiny")
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        qp = quantize_params(params)
+        assert is_quantized(qp["layers"]["wq"])
+        assert is_quantized(qp["lm_head"])
+        assert not is_quantized(qp["embed"])
+        assert qp["layers"]["attn_norm"].dtype == jnp.float32
+
+    def test_quantized_forward_close_to_fp(self):
+        cfg = get_config("llama", "tiny")
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        qp = quantize_params(params)
+        ids = jnp.array([[1, 7, 42, 9]], jnp.int32)
+        cache = T.init_cache(cfg, 1, 4, dtype=jnp.float32)
+        pos = jnp.arange(4, dtype=jnp.int32)[None]
+        kv = jnp.ones((1, 4), bool)
+        ref, _ = T.forward(params, cfg, ids, pos, cache, jnp.int32(0), kv)
+        cache2 = T.init_cache(cfg, 1, 4, dtype=jnp.float32)
+        out, _ = T.forward(qp, cfg, ids, pos, cache2, jnp.int32(0), kv)
+        # Cosine similarity of logits stays high under int8 weights.
+        a = np.asarray(ref).reshape(-1)
+        b = np.asarray(out).reshape(-1)
+        cos = a @ b / (np.linalg.norm(a) * np.linalg.norm(b))
+        assert cos > 0.999
+
+    def test_quantized_generate_runs(self):
+        cfg = get_config("qwen2", "tiny")  # exercises bias path too
+        params = quantize_params(
+            T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        )
+        out = generate(
+            params, cfg, [[1, 2, 3]], max_new_tokens=4, eos_ids=[], greedy=True
+        )
+        assert out.tokens.shape == (1, 4)
+        assert (out.tokens >= 0).all()
+
+    def test_quantized_sharding_rules(self):
+        if len(jax.devices()) < 2:
+            pytest.skip("needs multiple devices")
+        from adversarial_spec_tpu.parallel.mesh import make_mesh
+        from adversarial_spec_tpu.parallel.sharding import shard_params
+
+        cfg = get_config("llama", "tiny")
+        params = quantize_params(T.init_params(jax.random.key(0), cfg))
+        mesh = make_mesh({"tp": 2})
+        sharded = shard_params(mesh, params)
+        wq = sharded["layers"]["wq"]
+        assert wq["q"].sharding.spec == jax.sharding.PartitionSpec(
+            None, None, "tp"
+        )
+        # Scale keeps only the output-axis sharding.
+        wo = sharded["layers"]["wo"]
+        assert wo["scale"].sharding.spec == jax.sharding.PartitionSpec(
+            None, None, None
+        )
+
+    def test_registry_quant_field_roundtrip(self):
+        from adversarial_spec_tpu.engine.registry import (
+            ModelSpec,
+            load_registry,
+            save_registry_entry,
+        )
+
+        save_registry_entry(ModelSpec(alias="q8", quant="int8"))
+        assert load_registry()["q8"].quant == "int8"

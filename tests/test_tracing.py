@@ -1,0 +1,70 @@
+"""Tracing subsystem tests + CLI perf-block integration."""
+
+import io
+import json
+import time
+
+from adversarial_spec_tpu.utils.tracing import Tracer, maybe_profile
+from adversarial_spec_tpu import cli
+
+
+class TestTracer:
+    def test_span_accumulates(self):
+        t = Tracer()
+        with t.span("a"):
+            time.sleep(0.01)
+        with t.span("a"):
+            time.sleep(0.01)
+        assert t.spans["a"] >= 0.02
+
+    def test_nested_spans(self):
+        t = Tracer()
+        with t.span("outer"):
+            with t.span("inner"):
+                time.sleep(0.005)
+        assert t.spans["outer"] >= t.spans["inner"]
+
+    def test_span_records_on_exception(self):
+        t = Tracer()
+        try:
+            with t.span("boom"):
+                raise RuntimeError
+        except RuntimeError:
+            pass
+        assert "boom" in t.spans
+
+    def test_counters_and_rate(self):
+        t = Tracer()
+        t.count("tokens", 50)
+        t.count("tokens", 50)
+        t.spans["decode"] = 2.0
+        assert t.rate("tokens", "decode") == 50.0
+        assert t.rate("tokens", "absent") == 0.0
+
+    def test_report_shape(self):
+        t = Tracer()
+        with t.span("x"):
+            pass
+        t.count("n", 3)
+        rep = t.report()
+        assert "total_s" in rep and "x" in rep["spans"]
+        assert rep["counters"]["n"] == 3
+
+    def test_maybe_profile_noop(self):
+        with maybe_profile(None):
+            pass  # must not require jax or a directory
+
+
+class TestCliPerfBlock:
+    def test_json_output_has_perf(self, monkeypatch, capsys):
+        monkeypatch.setattr("sys.stdin", io.StringIO("# Spec"))
+        code = cli.main(
+            ["critique", "--models", "mock://critic?tps=100", "--json"]
+        )
+        out, err = capsys.readouterr()
+        assert code == 0
+        data = json.loads(out)
+        assert "perf" in data
+        assert "round" in data["perf"]["spans"]
+        assert data["perf"]["decode_tokens_per_sec"] > 0
+        assert "perf:" in err  # human line on stderr
