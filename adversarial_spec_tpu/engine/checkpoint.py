@@ -1,0 +1,96 @@
+"""Native checkpoint cache: Orbax save/load of converted param pytrees.
+
+SURVEY §5 (checkpoint/resume): the reference's model-side "checkpointing"
+obligation is checkpoint *loading* — here HF safetensors convert once into
+the layer-stacked native layout and are cached via Orbax, so subsequent
+engine starts restore directly into the target shardings (no per-layer
+stacking, no transposes, no torch-layout work). The debate-state tier
+(sessions/round snapshots, debate/session.py) is unchanged and independent.
+
+Cache location: ``<checkpoint_dir>/.native-cache/<fingerprint>`` beside the
+HF checkpoint, fingerprinted by family/size/dtype/quant so a config change
+never reads a stale layout.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import uuid
+from pathlib import Path
+
+import jax
+
+
+def _source_stat(checkpoint: str) -> list:
+    """Cheap identity of the source weights: (name, size, mtime_ns) of
+    every safetensors/index file — no content read. Replacing the weights
+    in place (fine-tune update) therefore changes the fingerprint."""
+    ckpt = Path(checkpoint)
+    entries = []
+    for pattern in ("*.safetensors", "*.safetensors.index.json"):
+        for f in sorted(ckpt.glob(pattern)):
+            st = f.stat()
+            entries.append([f.name, st.st_size, st.st_mtime_ns])
+    return entries
+
+
+def cache_dir_for(
+    checkpoint: str, family: str, size: str, dtype: str, quant: str = ""
+) -> Path:
+    fingerprint = hashlib.sha1(
+        json.dumps(
+            [family, size, dtype, quant, _source_stat(checkpoint)]
+        ).encode()
+    ).hexdigest()[:12]
+    return Path(checkpoint) / ".native-cache" / fingerprint
+
+
+def save_native(params, cache_dir: Path) -> None:
+    """Write the converted pytree atomically.
+
+    Per-writer unique tmp dir + rename: concurrent cold-cache processes
+    (multi-opponent CLIs, one process per host on a pod) never see each
+    other's partial writes, and whichever rename lands first wins.
+    """
+    import orbax.checkpoint as ocp
+
+    cache_dir = Path(cache_dir)
+    cache_dir.parent.mkdir(parents=True, exist_ok=True)
+    tmp = cache_dir.with_name(
+        f"{cache_dir.name}.tmp-{os.getpid()}-{uuid.uuid4().hex[:6]}"
+    )
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(tmp.resolve(), params)
+    try:
+        tmp.rename(cache_dir)
+    except OSError:
+        if cache_dir.exists():  # another writer won the race — fine
+            shutil.rmtree(tmp, ignore_errors=True)
+        else:
+            raise
+
+
+def load_native(cache_dir: Path, like_params):
+    """Restore into the shardings/dtypes of ``like_params`` (an abstract
+    pytree of jax.ShapeDtypeStruct with shardings is enough)."""
+    import orbax.checkpoint as ocp
+
+    with ocp.StandardCheckpointer() as ckptr:
+        return ckptr.restore(Path(cache_dir).resolve(), like_params)
+
+
+def has_native(cache_dir: Path) -> bool:
+    return Path(cache_dir).is_dir()
+
+
+def abstract_like(params):
+    """ShapeDtypeStruct pytree (with shardings) describing ``params``."""
+    return jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(
+            x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+        ),
+        params,
+    )

@@ -94,21 +94,7 @@ class TpuEngine:
         self._evict_to(MAX_RESIDENT_MODELS - 1)
         maybe_initialize_distributed()
         mesh = make_mesh(spec.mesh)
-        device_put = make_device_put(mesh, dtype)
-        params, cfg = materialize_params(
-            spec.checkpoint,
-            spec.family,
-            spec.size,
-            dtype=dtype,
-            max_seq_len=spec.max_seq_len,
-            device_put=device_put,
-        )
-        if spec.quant == "int8":
-            from adversarial_spec_tpu.ops.quant import quantize_params
-
-            # On-device requantization; shardings propagate from the
-            # bf16 leaves, old buffers free once replaced.
-            params = quantize_params(params)
+        params, cfg = self._materialize(spec, dtype, mesh)
         tokenizer = load_tokenizer(spec.tokenizer)
         lm = LoadedModel(
             spec=spec,
@@ -120,6 +106,76 @@ class TpuEngine:
         )
         self._models[alias] = lm
         return lm
+
+    def _materialize(self, spec: ModelSpec, dtype, mesh):
+        """Params via the fastest available source: native Orbax cache
+        (converted once, restored straight into target shardings) →
+        HF safetensors conversion (then cached) → synthetic init."""
+        from adversarial_spec_tpu.engine import checkpoint as ckpt_mod
+        from adversarial_spec_tpu.models.config import get_config
+        from adversarial_spec_tpu.models.transformer import init_params
+        from adversarial_spec_tpu.ops.quant import quantize_params
+        from adversarial_spec_tpu.parallel.sharding import param_shardings
+
+        import shutil
+        import sys
+
+        quantize = spec.quant == "int8"
+        cache_path = None
+        if spec.checkpoint != "random":
+            cache_path = ckpt_mod.cache_dir_for(
+                spec.checkpoint, spec.family, spec.size, spec.dtype, spec.quant
+            )
+        if cache_path is not None and ckpt_mod.has_native(cache_path):
+            # Cache is an optimization in BOTH directions: a corrupt or
+            # layout-incompatible cache falls back to HF conversion
+            # instead of permanently breaking the model.
+            try:
+                cfg = get_config(
+                    spec.family, spec.size, max_seq_len=spec.max_seq_len
+                )
+
+                def build():
+                    p = init_params(jax.random.key(0), cfg, dtype)
+                    return quantize_params(p) if quantize else p
+
+                shapes = jax.eval_shape(build)
+                shardings = param_shardings(mesh, shapes)
+                abstract = jax.tree.map(
+                    lambda s, sh: jax.ShapeDtypeStruct(
+                        s.shape, s.dtype, sharding=sh
+                    ),
+                    shapes,
+                    shardings,
+                )
+                return ckpt_mod.load_native(cache_path, abstract), cfg
+            except Exception as e:
+                print(
+                    f"warning: native checkpoint cache unreadable "
+                    f"({e}); reconverting from HF",
+                    file=sys.stderr,
+                )
+                shutil.rmtree(cache_path, ignore_errors=True)
+
+        params, cfg = materialize_params(
+            spec.checkpoint,
+            spec.family,
+            spec.size,
+            dtype=dtype,
+            max_seq_len=spec.max_seq_len,
+            device_put=make_device_put(mesh, dtype),
+        )
+        if quantize:
+            params = quantize_params(params)
+        if cache_path is not None:
+            try:  # write side is best-effort too
+                ckpt_mod.save_native(params, cache_path)
+            except Exception as e:
+                print(
+                    f"warning: native checkpoint cache write failed: {e}",
+                    file=sys.stderr,
+                )
+        return params, cfg
 
     def _evict_to(self, keep: int) -> None:
         while len(self._models) > keep:

@@ -1,0 +1,33 @@
+"""Mutation-testing configuration (mutmut).
+
+Parity with the reference's mutmut_config.py (SURVEY component #8): skip
+mutants in configuration data, prompt text, and logging so the mutation
+score measures *logic*, not constants a human would never get wrong twice.
+
+Run: ``mutmut run`` (dev-only; mutmut is not a runtime dependency).
+"""
+
+from __future__ import annotations
+
+_SKIP_PATH_FRAGMENTS = (
+    "/prompts.py",  # prompt text: every word is a mutable "constant"
+    "/config.py",  # model-shape tables
+    "/tests/",
+)
+
+_SKIP_LINE_MARKERS = (
+    "print(",  # logging/stderr output
+    "_err(",
+    "description=",  # argparse help strings
+    "help=",
+)
+
+
+def pre_mutation(context) -> None:
+    path = (context.filename or "").replace("\\", "/")
+    if any(frag in path for frag in _SKIP_PATH_FRAGMENTS):
+        context.skip = True
+        return
+    line = context.current_source_line or ""
+    if any(marker in line for marker in _SKIP_LINE_MARKERS):
+        context.skip = True

@@ -1,0 +1,151 @@
+"""Native (Orbax) checkpoint cache tests: HF converts once, restores fast
+and bit-identically thereafter."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from adversarial_spec_tpu.engine import checkpoint as ckpt_mod
+from adversarial_spec_tpu.models import transformer as T
+from adversarial_spec_tpu.models.config import get_config
+
+
+@pytest.fixture()
+def hf_tiny_checkpoint(tmp_path):
+    torch = pytest.importorskip("torch")
+    import transformers
+
+    cfg = get_config("llama", "tiny")
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.dim,
+        num_hidden_layers=cfg.n_layers,
+        num_attention_heads=cfg.n_heads,
+        num_key_value_heads=cfg.n_kv_heads,
+        intermediate_size=cfg.ffn_dim,
+        rope_theta=cfg.rope_theta,
+        rms_norm_eps=cfg.rms_eps,
+        max_position_embeddings=256,
+    )
+    torch.manual_seed(0)
+    model = transformers.AutoModelForCausalLM.from_config(hf_cfg)
+    ckpt = tmp_path / "hf"
+    model.save_pretrained(ckpt, safe_serialization=True)
+    return str(ckpt)
+
+
+class TestNativeCacheRoundtrip:
+    def test_save_load_identical(self, tmp_path):
+        cfg = get_config("llama", "tiny")
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        cache_dir = tmp_path / "native" / "abc"
+        ckpt_mod.save_native(params, cache_dir)
+        assert ckpt_mod.has_native(cache_dir)
+        restored = ckpt_mod.load_native(
+            cache_dir, ckpt_mod.abstract_like(params)
+        )
+        for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(restored)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_fingerprint_distinguishes_configs(self):
+        a = ckpt_mod.cache_dir_for("/x", "llama", "8b", "bfloat16")
+        b = ckpt_mod.cache_dir_for("/x", "llama", "8b", "bfloat16", "int8")
+        c = ckpt_mod.cache_dir_for("/x", "llama", "70b", "bfloat16")
+        assert len({a.name, b.name, c.name}) == 3
+        assert a.parent == b.parent == c.parent
+
+    def test_atomic_save_no_tmp_left(self, tmp_path):
+        cfg = get_config("llama", "tiny")
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        cache_dir = tmp_path / "n" / "fp"
+        ckpt_mod.save_native(params, cache_dir)
+        assert not (tmp_path / "n" / "fp.tmp").exists()
+
+
+class TestCacheRobustness:
+    def test_fingerprint_changes_when_weights_replaced(self, tmp_path):
+        ckpt = tmp_path / "hf"
+        ckpt.mkdir()
+        f = ckpt / "model.safetensors"
+        f.write_bytes(b"v1-weights")
+        a = ckpt_mod.cache_dir_for(str(ckpt), "llama", "8b", "bfloat16")
+        f.write_bytes(b"v2-weights-longer")  # in-place update
+        b = ckpt_mod.cache_dir_for(str(ckpt), "llama", "8b", "bfloat16")
+        assert a.name != b.name
+
+    def test_corrupt_cache_falls_back_to_hf(
+        self, hf_tiny_checkpoint, monkeypatch, capsys
+    ):
+        from adversarial_spec_tpu.engine.registry import (
+            ModelSpec,
+            save_registry_entry,
+        )
+        from adversarial_spec_tpu.engine.tpu import TpuEngine
+        from adversarial_spec_tpu.engine.types import ChatRequest, SamplingParams
+
+        save_registry_entry(
+            ModelSpec(
+                alias="hf-tiny2",
+                family="llama",
+                size="tiny",
+                checkpoint=hf_tiny_checkpoint,
+                dtype="float32",
+            )
+        )
+        cache_path = ckpt_mod.cache_dir_for(
+            hf_tiny_checkpoint, "llama", "tiny", "float32", ""
+        )
+        cache_path.mkdir(parents=True)
+        (cache_path / "garbage").write_text("not an orbax checkpoint")
+
+        comp = TpuEngine().chat(
+            [ChatRequest(model="tpu://hf-tiny2", system="s", user="u")],
+            SamplingParams(max_new_tokens=4, greedy=True),
+        )[0]
+        assert comp.ok, comp.error  # fell back to HF conversion
+        err = capsys.readouterr().err
+        assert "cache unreadable" in err
+
+
+class TestEngineUsesNativeCache:
+    def test_second_load_hits_cache_and_matches(
+        self, hf_tiny_checkpoint, monkeypatch
+    ):
+        from adversarial_spec_tpu.engine import loader as loader_mod
+        from adversarial_spec_tpu.engine.registry import (
+            ModelSpec,
+            save_registry_entry,
+        )
+        from adversarial_spec_tpu.engine.tpu import TpuEngine
+        from adversarial_spec_tpu.engine.types import ChatRequest, SamplingParams
+
+        save_registry_entry(
+            ModelSpec(
+                alias="hf-tiny",
+                family="llama",
+                size="tiny",
+                checkpoint=hf_tiny_checkpoint,
+                dtype="float32",
+            )
+        )
+        params = SamplingParams(max_new_tokens=4, greedy=True)
+        req = ChatRequest(model="tpu://hf-tiny", system="s", user="u")
+
+        eng1 = TpuEngine()
+        first = eng1.chat([req], params)[0]
+        assert first.ok, first.error
+        cache_path = ckpt_mod.cache_dir_for(
+            hf_tiny_checkpoint, "llama", "tiny", "float32", ""
+        )
+        assert ckpt_mod.has_native(cache_path)
+
+        # Fresh engine: safetensors conversion must NOT run again.
+        def boom(*a, **k):
+            raise AssertionError("HF conversion ran despite native cache")
+
+        monkeypatch.setattr(loader_mod, "load_hf_checkpoint", boom)
+        eng2 = TpuEngine()
+        second = eng2.chat([req], params)[0]
+        assert second.ok, second.error
+        assert second.text == first.text  # identical params → identical greedy

@@ -1,0 +1,94 @@
+"""Failure detection / recovery tests with injected engine faults.
+
+SURVEY §5: the reference's failure story is per-model retry with backoff,
+errors captured not raised, and graceful round degradation; its fault
+*injection* exists only as mock side_effects in tests. Same strategy here,
+but the faults injected are the TPU engine's real failure modes
+(RESOURCE_EXHAUSTED on OOM, transient device unavailability) at the
+generate seam inside the real TpuEngine.
+"""
+
+import pytest
+
+from adversarial_spec_tpu.debate.core import RoundConfig, run_round
+from adversarial_spec_tpu.engine import tpu as tpu_mod
+from adversarial_spec_tpu.engine.dispatch import _ENGINE_CACHE
+from adversarial_spec_tpu.engine.tpu import TpuEngine
+from adversarial_spec_tpu.engine.types import ChatRequest, SamplingParams
+
+PARAMS = SamplingParams(max_new_tokens=8, greedy=True)
+
+
+def _req(model="tpu://random-tiny"):
+    return ChatRequest(model=model, system="s", user="u")
+
+
+class TestEngineFaults:
+    def test_oom_marked_transient(self, monkeypatch):
+        def oom(*a, **k):
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory on TPU")
+
+        monkeypatch.setattr(tpu_mod, "generate", oom)
+        comp = TpuEngine().chat([_req()], PARAMS)[0]
+        assert not comp.ok
+        assert comp.transient  # debate core will back off and retry
+
+    def test_programming_error_permanent(self, monkeypatch):
+        def bug(*a, **k):
+            raise TypeError("bad argument")
+
+        monkeypatch.setattr(tpu_mod, "generate", bug)
+        comp = TpuEngine().chat([_req()], PARAMS)[0]
+        assert not comp.ok
+        assert not comp.transient  # no point retrying a bug
+
+    def test_one_failing_group_does_not_kill_others(self, monkeypatch):
+        real_generate = tpu_mod.generate
+        calls = {"n": 0}
+
+        def flaky_for_mistral(params, cfg, prompts, **kw):
+            calls["n"] += 1
+            if cfg.rope_theta == 10000.0:  # the mistral-tiny config
+                raise RuntimeError("UNAVAILABLE: device lost")
+            return real_generate(params, cfg, prompts, **kw)
+
+        monkeypatch.setattr(tpu_mod, "generate", flaky_for_mistral)
+        comps = TpuEngine().chat(
+            [_req("tpu://random-tiny"), _req("tpu://random-mistral-tiny")],
+            PARAMS,
+        )
+        assert comps[0].ok
+        assert not comps[1].ok and comps[1].transient
+
+    def test_round_recovers_after_transient_engine_fault(self, monkeypatch):
+        """Full stack: first engine call OOMs, the debate core backs off
+        and retries, the retry succeeds, the round completes."""
+        real_generate = tpu_mod.generate
+        attempts = {"n": 0}
+
+        def oom_once(*a, **kw):
+            attempts["n"] += 1
+            if attempts["n"] == 1:
+                raise RuntimeError("RESOURCE_EXHAUSTED: hbm")
+            return real_generate(*a, **kw)
+
+        monkeypatch.setattr(tpu_mod, "generate", oom_once)
+        delays = []
+        monkeypatch.setattr(
+            RoundConfig, "sleep", staticmethod(delays.append)
+        )
+        _ENGINE_CACHE.pop("tpu", None)
+        cfg = RoundConfig(sampling=PARAMS)
+        result = run_round("# spec", ["tpu://random-tiny"], 1, cfg)
+        assert result.responses[0].ok
+        assert attempts["n"] == 2
+        assert delays == [1.0]  # one backoff before the successful retry
+
+    def test_load_failure_degrades_not_raises(self, monkeypatch):
+        def explode(self, spec, dtype, mesh):
+            raise RuntimeError("DEADLINE_EXCEEDED: checkpoint server")
+
+        monkeypatch.setattr(TpuEngine, "_materialize", explode)
+        comp = TpuEngine().chat([_req()], PARAMS)[0]
+        assert not comp.ok
+        assert comp.transient
