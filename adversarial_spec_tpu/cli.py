@@ -190,6 +190,12 @@ def create_parser() -> argparse.ArgumentParser:
         default="",
         help="Weight-only quantization for this model",
     )
+    r.add_argument(
+        "--kv",
+        choices=["dense", "paged"],
+        default="dense",
+        help="KV-cache layout for decode",
+    )
     return parser
 
 
@@ -599,6 +605,7 @@ def handle_registry(args: argparse.Namespace, rest: list[str]) -> int:
             dtype=args.dtype or "bfloat16",
             mesh={"tp": args.tp} if args.tp else {},
             quant=args.quant,
+            kv=args.kv,
         )
         model_registry.save_registry_entry(spec)
         print(f"registered tpu://{alias}")

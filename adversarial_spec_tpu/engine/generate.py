@@ -76,6 +76,33 @@ def pad_batch(
 PREFILL_CHUNK = 1024
 
 
+def _sample_step(
+    logits, key, finished, out_buf, step, eos_ids, *, greedy, top_k,
+    temperature, top_p,
+):
+    """Shared per-decode-step tail for BOTH cache layouts: sample, record
+    EOS (the EOS token itself is kept; finished rows emit 0 thereafter),
+    write the output slot. Any change here applies to dense and paged
+    decode alike."""
+    key, sub = jax.random.split(key)
+    nxt = sample_tokens(
+        logits,
+        sub,
+        greedy=greedy,
+        top_k=top_k,
+        temperature=temperature,
+        top_p=top_p,
+    )
+    is_eos = (nxt[:, None] == eos_ids[None, :]).any(axis=-1)
+    nxt = jnp.where(finished, 0, nxt)
+    out_buf = jax.lax.dynamic_update_slice(out_buf, nxt[:, None], (0, step))
+    return key, nxt, finished | is_eos, out_buf
+
+
+def _chunk_bound(start_step, chunk, stop_at, max_new):
+    return jnp.minimum(jnp.minimum(start_step + chunk, stop_at), max_new)
+
+
 @partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
 def prefill_chunk(
     params: Params,
@@ -160,10 +187,9 @@ def decode_chunk_steps(
 
     def cond(state):
         step, _, _, finished, _, _ = state
-        bound = jnp.minimum(
-            jnp.minimum(start_step + chunk, stop_at), max_new
-        )
-        return (step < bound) & ~finished.all()
+        return (
+            step < _chunk_bound(start_step, chunk, stop_at, max_new)
+        ) & ~finished.all()
 
     def body(state):
         step, cur, cache, finished, out_buf, key = state
@@ -184,21 +210,18 @@ def decode_chunk_steps(
             use_pallas_decode=use_pallas_decode,
             pallas_interpret=pallas_interpret,
         )
-        key, sub = jax.random.split(key)
-        nxt = sample_tokens(
+        key, nxt, finished, out_buf = _sample_step(
             logits[:, 0],
-            sub,
+            key,
+            finished,
+            out_buf,
+            step,
+            eos_ids,
             greedy=greedy,
             top_k=top_k,
             temperature=temperature,
             top_p=top_p,
         )
-        is_eos = (nxt[:, None] == eos_ids[None, :]).any(axis=-1)
-        nxt = jnp.where(finished, 0, nxt)
-        out_buf = jax.lax.dynamic_update_slice(
-            out_buf, nxt[:, None], (0, step)
-        )
-        finished = finished | is_eos
         return step + 1, nxt, cache, finished, out_buf, key
 
     step, cur, cache, finished, out_buf, key = jax.lax.while_loop(
@@ -207,6 +230,101 @@ def decode_chunk_steps(
         (start_step, cur_tokens, cache, finished, out_buf, key),
     )
     return cache, cur, finished, out_buf, step
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "cfg",
+        "prompt_len",
+        "chunk",
+        "greedy",
+        "top_k",
+        "use_pallas",
+        "pallas_interpret",
+    ),
+    donate_argnames=("pool", "out_buf"),
+)
+def paged_decode_chunk_steps(
+    params: Params,
+    cfg: ModelConfig,
+    pool: Cache,  # {"k","v": [L, n_pages, page_size, Hkv, D]}
+    page_table: jnp.ndarray,  # [B, Pmax]
+    cur_tokens: jnp.ndarray,  # [B]
+    pad_lens: jnp.ndarray,  # [B]
+    finished: jnp.ndarray,  # [B] bool
+    out_buf: jnp.ndarray,  # [B, max_new]
+    start_step: jnp.ndarray,
+    stop_at: jnp.ndarray,
+    eos_ids: jnp.ndarray,
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    top_p: jnp.ndarray,
+    *,
+    prompt_len: int,
+    chunk: int,
+    greedy: bool,
+    top_k: int,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
+):
+    """Paged-cache twin of ``decode_chunk_steps``: KV lives in the shared
+    page pool, each step's write target is looked up through the page
+    table, and attention reads via ops/pallas_paged (or its gather
+    reference path)."""
+    from adversarial_spec_tpu.models.transformer import forward_paged_decode
+
+    B = cur_tokens.shape[0]
+    page_size = pool["k"].shape[2]
+    max_new = out_buf.shape[1]
+
+    def cond(state):
+        step, _, _, finished, _, _ = state
+        return (
+            step < _chunk_bound(start_step, chunk, stop_at, max_new)
+        ) & ~finished.all()
+
+    def body(state):
+        step, cur, pool, finished, out_buf, key = state
+        q_pos = prompt_len + step - 1  # logical slot of `cur`'s KV
+        write_page = page_table[jnp.arange(B), q_pos // page_size]
+        write_off = jnp.full((B,), q_pos % page_size)
+        bounds = jnp.stack(
+            [pad_lens, jnp.full((B,), q_pos + 1)], axis=1
+        ).astype(jnp.int32)
+        positions = (q_pos - pad_lens)[:, None]
+        logits, pool = forward_paged_decode(
+            params,
+            cfg,
+            cur[:, None],
+            positions,
+            pool,
+            page_table,
+            write_page,
+            write_off,
+            bounds,
+            q_pos,
+            use_pallas=use_pallas,
+            pallas_interpret=pallas_interpret,
+        )
+        key, nxt, finished, out_buf = _sample_step(
+            logits[:, 0],
+            key,
+            finished,
+            out_buf,
+            step,
+            eos_ids,
+            greedy=greedy,
+            top_k=top_k,
+            temperature=temperature,
+            top_p=top_p,
+        )
+        return step + 1, nxt, pool, finished, out_buf, key
+
+    step, cur, pool, finished, out_buf, key = jax.lax.while_loop(
+        cond, body, (start_step, cur_tokens, pool, finished, out_buf, key)
+    )
+    return pool, cur, finished, out_buf, step
 
 
 @dataclass
@@ -236,6 +354,8 @@ def generate(
     mesh=None,
     use_pallas_decode: bool | None = None,
     share_prefix: bool = True,
+    paged: bool = False,
+    page_size: int = 128,
 ) -> GenerateResult:
     """End-to-end batched generation (host orchestration).
 
@@ -251,6 +371,12 @@ def generate(
     cache is tiled to B rows before decode — prefill FLOPs drop by B×,
     SURVEY §7 hard part (e)'s prefix-caching lever. Rows then diverge via
     per-row sampling. Applies off-mesh only (dp sharding wants real rows).
+
+    ``paged``: decode against the paged KV pool (engine/kvcache.py +
+    ops/pallas_paged.py) instead of the dense per-row cache — prompt KV is
+    scattered into pages after prefill and every decode step writes through
+    the page table. Single-device only (the paged kernel is not
+    GSPMD-partitionable); sharded meshes silently use the dense path.
     """
     if use_pallas_decode is None:
         # Auto: fused kernel on a real single-device TPU; jnp path for
@@ -292,6 +418,9 @@ def generate(
     eos = jnp.asarray(sorted(set(eos_ids)) or [-1], dtype=jnp.int32)
 
     deadline = time.monotonic() + timeout_s if timeout_s > 0 else None
+    # Paged decode is single-device (the kernel is not GSPMD-partitionable);
+    # resolve that now so the prefill cache can be sized to the prompt only.
+    paged = paged and (mesh is None or mesh.size == 1)
 
     # Shared-prefix: identical rows prefill once and tile. Qualifies off-
     # mesh and on single-device meshes (the TpuEngine always passes a
@@ -314,10 +443,12 @@ def generate(
         # Born sharded: batch over dp, heads over tp — never replicated
         # through one chip's HBM.
         cache_device = cache_sharding(mesh)
+    # Paged runs drop the dense cache after migrating prompt KV, so it
+    # only needs the prompt slots — not the decode region.
     cache = init_cache(
         cfg,
         prefill_tokens.shape[0],
-        total_len,
+        S if paged else total_len,
         dtype=params["embed"].dtype,
         device=cache_device,
     )
@@ -354,33 +485,93 @@ def generate(
     step = jnp.int32(1)
     timed_out = False
 
+    page_table = None
+    if paged:
+        from adversarial_spec_tpu.engine.kvcache import (
+            PageAllocator,
+            PagedCacheLayout,
+            init_page_pool,
+            write_tokens,
+        )
+
+        n_pages_per_row = -(-total_len // page_size)
+        allocator = PageAllocator(B * n_pages_per_row, page_size)
+        for b in range(B):
+            allocator.new_sequence(b)
+            allocator.extend(b, total_len)
+        table_np = allocator.table_array(list(range(B)), n_pages_per_row)
+        page_table = jnp.asarray(table_np)
+        layout = PagedCacheLayout(
+            n_pages=B * n_pages_per_row,
+            page_size=page_size,
+            n_layers=cfg.n_layers,
+            n_kv_heads=cfg.n_kv_heads,
+            head_dim=cfg.head_dim,
+        )
+        pool = init_page_pool(layout, dtype=cache["k"].dtype)
+        # Migrate prompt KV (slots [0, S)) from the dense prefill cache
+        # into pages (vectorized table lookup); pad-slot garbage lands too
+        # but stays masked by the per-row bounds start.
+        slots = np.tile(np.arange(S, dtype=np.int32)[None, :], (B, 1))
+        page_ids = table_np[np.arange(B)[:, None], slots // page_size]
+        offsets = slots % page_size
+        pool = write_tokens(
+            pool, cache["k"][:, :, :S], cache["v"][:, :, :S], page_ids, offsets
+        )
+        cache = None  # dense cache no longer needed
+        use_paged_kernel = jax.default_backend() == "tpu"
+
     t1 = time.monotonic()
     while int(step) < max_new_tokens and not bool(finished.all()):
         if deadline is not None and time.monotonic() >= deadline:
             timed_out = True
             break
         key, chunk_key = jax.random.split(key)
-        cache, cur, finished, out_buf, step = decode_chunk_steps(
-            params,
-            cfg,
-            cache,
-            cur,
-            pad_lens,
-            finished,
-            out_buf,
-            step,
-            jnp.int32(max_new_tokens),
-            eos,
-            chunk_key,
-            temp,
-            tp,
-            prompt_len=S,
-            chunk=DECODE_CHUNK,
-            greedy=greedy,
-            top_k=top_k,
-            use_pallas_decode=use_pallas_decode,
-            pallas_interpret=pallas_interpret,
-        )
+        if paged:
+            pool, cur, finished, out_buf, step = paged_decode_chunk_steps(
+                params,
+                cfg,
+                pool,
+                page_table,
+                cur,
+                pad_lens,
+                finished,
+                out_buf,
+                step,
+                jnp.int32(max_new_tokens),
+                eos,
+                chunk_key,
+                temp,
+                tp,
+                prompt_len=S,
+                chunk=DECODE_CHUNK,
+                greedy=greedy,
+                top_k=top_k,
+                use_pallas=use_paged_kernel,
+                pallas_interpret=pallas_interpret,
+            )
+        else:
+            cache, cur, finished, out_buf, step = decode_chunk_steps(
+                params,
+                cfg,
+                cache,
+                cur,
+                pad_lens,
+                finished,
+                out_buf,
+                step,
+                jnp.int32(max_new_tokens),
+                eos,
+                chunk_key,
+                temp,
+                tp,
+                prompt_len=S,
+                chunk=DECODE_CHUNK,
+                greedy=greedy,
+                top_k=top_k,
+                use_pallas_decode=use_pallas_decode,
+                pallas_interpret=pallas_interpret,
+            )
         step.block_until_ready()
     decode_time = time.monotonic() - t1
 

@@ -37,6 +37,7 @@ class ModelSpec:
     mesh: dict[str, int] = field(default_factory=dict)  # e.g. {"tp": 8}
     max_seq_len: int = 8192
     quant: str = ""  # "" = full precision, "int8" = weight-only int8
+    kv: str = "dense"  # "dense" | "paged" — KV-cache layout for decode
 
     def to_dict(self) -> dict:
         return asdict(self)

@@ -244,6 +244,7 @@ class TpuEngine:
                 seed=params.seed,
                 timeout_s=params.timeout_s,
                 mesh=lm.mesh,
+                paged=lm.spec.kv == "paged",
             )
         total_time = time.monotonic() - t0
 

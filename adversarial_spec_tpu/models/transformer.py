@@ -165,6 +165,58 @@ def attention(
     return out.reshape(B, S, Hq, D)
 
 
+def _project_qkv(lp, cfg: ModelConfig, h, B: int, S: int, cos, sin):
+    """Shared QKV projection + bias + head reshape + RoPE (dense & paged)."""
+    q = matmul(h, lp["wq"])
+    k = matmul(h, lp["wk"])
+    v = matmul(h, lp["wv"])
+    if cfg.qkv_bias:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    q = q.reshape(B, S, cfg.n_heads, cfg.head_dim)
+    k = k.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+    v = v.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    return q, k, v
+
+
+def _attn_out_and_ffn(x, attn_out, lp, cfg: ModelConfig, B: int, S: int):
+    """Shared post-attention projection, residuals, and FFN block."""
+    out = matmul(
+        attn_out.reshape(B, S, cfg.n_heads * cfg.head_dim), lp["wo"]
+    )
+    if cfg.post_norms:
+        out = rms_norm(
+            out, lp["post_attn_norm"], cfg.rms_eps, cfg.norm_scale_plus_one
+        )
+    x = x + out
+
+    h = rms_norm(x, lp["ffn_norm"], cfg.rms_eps, cfg.norm_scale_plus_one)
+    ff = _activation(matmul(h, lp["w_gate"]), cfg.activation) * matmul(
+        h, lp["w_up"]
+    )
+    ff = matmul(ff, lp["w_down"])
+    if cfg.post_norms:
+        ff = rms_norm(
+            ff, lp["post_ffn_norm"], cfg.rms_eps, cfg.norm_scale_plus_one
+        )
+    return x + ff
+
+
+def _layer_window_start(cfg: ModelConfig, layer_id, base_start, q_pos):
+    """Per-layer valid-window start: sliding window tightens it (on the
+    windowed layers only, for alternating-pattern families)."""
+    if cfg.sliding_window <= 0:
+        return base_start
+    win_start = jnp.maximum(base_start, q_pos - cfg.sliding_window + 1)
+    if cfg.sliding_window_pattern > 1:
+        use_window = (layer_id % cfg.sliding_window_pattern) == 0
+        return jnp.where(use_window, win_start, base_start)
+    return win_start
+
+
 def forward(
     params: Params,
     cfg: ModelConfig,
@@ -225,18 +277,7 @@ def forward(
     def layer_body(x, scanned):
         lp, layer_id, k_cache, v_cache = scanned
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps, cfg.norm_scale_plus_one)
-        q = matmul(h, lp["wq"])
-        k = matmul(h, lp["wk"])
-        v = matmul(h, lp["wv"])
-        if cfg.qkv_bias:
-            q = q + lp["bq"]
-            k = k + lp["bk"]
-            v = v + lp["bv"]
-        q = q.reshape(B, S, cfg.n_heads, cfg.head_dim)
-        k = k.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
-        v = v.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
+        q, k, v = _project_qkv(lp, cfg, h, B, S, cos, sin)
 
         k_cache = jax.lax.dynamic_update_slice(
             k_cache, k.astype(k_cache.dtype), (0, cache_index, 0, 0)
@@ -250,17 +291,9 @@ def forward(
                 decode_attention,
             )
 
-            if cfg.sliding_window > 0:
-                win_start = jnp.maximum(
-                    pallas_start, cache_index - cfg.sliding_window + 1
-                )
-                if cfg.sliding_window_pattern > 1:
-                    use_window = (layer_id % cfg.sliding_window_pattern) == 0
-                    start = jnp.where(use_window, win_start, pallas_start)
-                else:
-                    start = win_start
-            else:
-                start = pallas_start
+            start = _layer_window_start(
+                cfg, layer_id, pallas_start, cache_index
+            )
             bounds = jnp.stack([start, pallas_end], axis=1)
             out = decode_attention(
                 q[:, 0],
@@ -283,23 +316,7 @@ def forward(
             out = attention(
                 q, k_cache, v_cache, mask, attn_softcap=cfg.attn_softcap
             )
-        out = matmul(out.reshape(B, S, cfg.n_heads * cfg.head_dim), lp["wo"])
-        if cfg.post_norms:
-            out = rms_norm(
-                out, lp["post_attn_norm"], cfg.rms_eps, cfg.norm_scale_plus_one
-            )
-        x = x + out
-
-        h = rms_norm(x, lp["ffn_norm"], cfg.rms_eps, cfg.norm_scale_plus_one)
-        ff = _activation(matmul(h, lp["w_gate"]), cfg.activation) * matmul(
-            h, lp["w_up"]
-        )
-        ff = matmul(ff, lp["w_down"])
-        if cfg.post_norms:
-            ff = rms_norm(
-                ff, lp["post_ffn_norm"], cfg.rms_eps, cfg.norm_scale_plus_one
-            )
-        x = x + ff
+        x = _attn_out_and_ffn(x, out, lp, cfg, B, S)
         return x, (k_cache, v_cache)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -308,6 +325,13 @@ def forward(
         (params["layers"], layer_ids, cache["k"], cache["v"]),
     )
 
+    logits = _lm_head_logits(params, cfg, x, lm_head_last_only)
+    return logits, {"k": new_k, "v": new_v}
+
+
+def _lm_head_logits(
+    params: Params, cfg: ModelConfig, x, lm_head_last_only: bool
+):
     x = rms_norm(x, params["final_norm"], cfg.rms_eps, cfg.norm_scale_plus_one)
     if lm_head_last_only:
         # Prompt chunks only ever need the final position's logits; skip
@@ -326,6 +350,102 @@ def forward(
         )
     if cfg.logit_softcap > 0.0:
         logits = _softcap(logits, cfg.logit_softcap)
+    return logits
+
+
+def forward_paged_decode(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, 1] int32 — single decode step
+    positions: jnp.ndarray,  # [B, 1] rope positions
+    pool: Cache,  # {"k","v": [L, n_pages, page_size, Hkv, D]}
+    page_table: jnp.ndarray,  # [B, Pmax] int32, -1 = unmapped
+    write_page: jnp.ndarray,  # [B] physical page for this token's KV
+    write_off: jnp.ndarray,  # [B] slot within that page
+    bounds: jnp.ndarray,  # [B, 2] (start, end) valid logical-slot window
+    q_pos: jnp.ndarray,  # scalar: logical slot of this token
+    *,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
+) -> tuple[jnp.ndarray, Cache]:
+    """One decode step over the PAGED KV pool.
+
+    Same math as ``forward`` with S=1 (shared helpers), but K/V live in
+    pages shared across rows: the new token's K/V scatters to
+    (write_page[b], write_off[b]) and attention reads through the page
+    table — the fused Pallas kernel on real TPUs, a gather + masked jnp
+    reference path elsewhere (both against the same bounds semantics).
+    Returns (logits [B, 1, vocab], updated pool).
+    """
+    B = tokens.shape[0]
+    page_size = pool["k"].shape[2]
+    layer_ids = jnp.arange(cfg.n_layers)
+    cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+
+    x = params["embed"][tokens]
+    if cfg.scale_embeddings:
+        x = (x.astype(jnp.float32) * math.sqrt(cfg.dim)).astype(x.dtype)
+
+    def layer_body(x, scanned):
+        lp, layer_id, k_pages, v_pages = scanned
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_eps, cfg.norm_scale_plus_one)
+        q, k, v = _project_qkv(lp, cfg, h, B, 1, cos, sin)
+
+        k_pages = k_pages.at[write_page, write_off].set(
+            k[:, 0].astype(k_pages.dtype)
+        )
+        v_pages = v_pages.at[write_page, write_off].set(
+            v[:, 0].astype(v_pages.dtype)
+        )
+
+        start = _layer_window_start(cfg, layer_id, bounds[:, 0], q_pos)
+        layer_bounds = jnp.stack([start, bounds[:, 1]], axis=1)
+
+        if use_pallas:
+            from adversarial_spec_tpu.ops.pallas_paged import (
+                paged_decode_attention,
+            )
+
+            out = paged_decode_attention(
+                q[:, 0],
+                k_pages,
+                v_pages,
+                page_table,
+                layer_bounds,
+                attn_softcap=cfg.attn_softcap,
+                interpret=pallas_interpret,
+            )[:, None]
+        else:
+            # Gather reference path: page table → dense [B, T, Hkv, D].
+            safe_table = jnp.maximum(page_table, 0)
+            k_dense = k_pages[safe_table].reshape(
+                B, -1, cfg.n_kv_heads, cfg.head_dim
+            )
+            v_dense = v_pages[safe_table].reshape(
+                B, -1, cfg.n_kv_heads, cfg.head_dim
+            )
+            T = k_dense.shape[1]
+            slot = jnp.arange(T)[None, None, :]
+            mapped = jnp.repeat(
+                page_table >= 0, page_size, axis=1
+            )[:, None, :]
+            mask = (
+                mapped
+                & (slot >= start[:, None, None])
+                & (slot < layer_bounds[:, 1][:, None, None])
+            )
+            out = attention(
+                q, k_dense, v_dense, mask, attn_softcap=cfg.attn_softcap
+            )
+        x = _attn_out_and_ffn(x, out, lp, cfg, B, 1)
+        return x, (k_pages, v_pages)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_body,
+        x,
+        (params["layers"], layer_ids, pool["k"], pool["v"]),
+    )
+    logits = _lm_head_logits(params, cfg, x, lm_head_last_only=False)
     return logits, {"k": new_k, "v": new_v}
 
 

@@ -261,6 +261,45 @@ class TestGenerate:
         rows = {tuple(r) for r in out.tokens.tolist()}
         assert len(rows) > 1
 
+    @pytest.mark.parametrize("family", ["llama", "gemma2"])
+    def test_paged_decode_matches_dense(self, family):
+        """Paged-pool decode (gather reference path) must reproduce the
+        dense cache's greedy tokens exactly — including alternating
+        sliding-window layers (gemma2) whose per-layer bounds tighten."""
+        from dataclasses import replace
+
+        cfg = get_config(family, "tiny")
+        if cfg.sliding_window > 0:
+            cfg = replace(cfg, sliding_window=8)
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        prompts = [[1, 5, 9, 3] * 3, [2, 6, 4]]
+        kw = dict(max_new_tokens=10, eos_ids=[], greedy=True)
+        dense = generate(params, cfg, prompts, paged=False, **kw)
+        paged = generate(params, cfg, prompts, paged=True, page_size=16, **kw)
+        np.testing.assert_array_equal(dense.tokens, paged.tokens)
+
+    def test_paged_decode_with_eos(self, tiny_model):
+        params, cfg = tiny_model
+        probe = generate(
+            params, cfg, [[1, 2]], max_new_tokens=4, eos_ids=[], greedy=True
+        )
+        eos = int(probe.tokens[0, 0])
+        dense = generate(
+            params, cfg, [[1, 2]], max_new_tokens=24, eos_ids=[eos], greedy=True
+        )
+        paged = generate(
+            params,
+            cfg,
+            [[1, 2]],
+            max_new_tokens=24,
+            eos_ids=[eos],
+            greedy=True,
+            paged=True,
+            page_size=16,
+        )
+        np.testing.assert_array_equal(dense.tokens, paged.tokens)
+        np.testing.assert_array_equal(dense.n_generated, paged.n_generated)
+
     def test_timing_fields_populated(self, tiny_model):
         params, cfg = tiny_model
         out = generate(
