@@ -232,6 +232,26 @@ def _cli(argv: list[str]) -> int:
             return 1
         print(reply)
         return 0
+    if cmd == "notify":
+        # Send a message and (optionally) wait for a reply in one step —
+        # the round-notification primitive as a standalone command.
+        # First arg is the timeout if numeric; otherwise it's message text
+        # and no reply is awaited (mirrors `send`'s calling convention).
+        rest = argv[1:]
+        timeout_s = 0
+        if rest and rest[0].isdigit():
+            timeout_s = int(rest[0])
+            rest = rest[1:]
+        text = " ".join(rest) or sys.stdin.read()
+        last_id = get_last_update_id(config) if timeout_s > 0 else 0
+        send_long_message(config, text)
+        if timeout_s > 0:
+            reply = poll_for_reply(config, last_id, timeout_s)
+            if reply is None:
+                print("(no reply)", file=sys.stderr)
+                return 1
+            print(reply)
+        return 0
     print(f"unknown subcommand {cmd!r}", file=sys.stderr)
     return 2
 

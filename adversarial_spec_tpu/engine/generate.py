@@ -420,7 +420,15 @@ def generate(
     deadline = time.monotonic() + timeout_s if timeout_s > 0 else None
     # Paged decode is single-device (the kernel is not GSPMD-partitionable);
     # resolve that now so the prefill cache can be sized to the prompt only.
-    paged = paged and (mesh is None or mesh.size == 1)
+    if paged and mesh is not None and mesh.size > 1:
+        import sys
+
+        print(
+            f"warning: paged KV decode is single-device; falling back to "
+            f"the dense cache on this {mesh.size}-device mesh",
+            file=sys.stderr,
+        )
+        paged = False
 
     # Shared-prefix: identical rows prefill once and tile. Qualifies off-
     # mesh and on single-device meshes (the TpuEngine always passes a
@@ -519,7 +527,10 @@ def generate(
             pool, cache["k"][:, :, :S], cache["v"][:, :, :S], page_ids, offsets
         )
         cache = None  # dense cache no longer needed
-        use_paged_kernel = jax.default_backend() == "tpu"
+        # Same switch as the dense path: auto-resolved above (fused kernel
+        # on real single-device TPU), overridable by the caller — interpret
+        # mode makes the kernel testable on CPU too.
+        use_paged_kernel = use_pallas_decode
 
     t1 = time.monotonic()
     while int(step) < max_new_tokens and not bool(finished.all()):

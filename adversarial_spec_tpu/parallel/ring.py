@@ -14,12 +14,16 @@ riding neighbor ICI links.
 Causality is enforced at two granularities: whole blocks are skipped when
 the key block is entirely in the future (compute still runs — SPMD needs
 identical programs — but is masked), and the diagonal block applies the
-in-block triangular mask.
+in-block triangular mask. Per-row ``kv_start`` bounds additionally mask
+left-pad slots, so the same code serves padded batches.
+
+``ring_attention_local`` is the per-device body, reused by the
+sequence-parallel model prefill (parallel/sp.py) which runs its own
+shard_map; ``ring_attention`` wraps it for standalone global-array use.
 """
 
 from __future__ import annotations
 
-import functools
 import math
 
 import jax
@@ -33,11 +37,12 @@ def _block_attend(
     q: jnp.ndarray,  # [B, Sq, H, D] f32
     k: jnp.ndarray,  # [B, Sk, Hkv, D]
     v: jnp.ndarray,  # [B, Sk, Hkv, D]
-    mask: jnp.ndarray,  # [Sq, Sk] bool
+    mask: jnp.ndarray,  # [B, Sq, Sk] bool — True = attend
     m: jnp.ndarray,  # [B, H, Sq] running max
     l: jnp.ndarray,  # [B, H, Sq] running normalizer
     acc: jnp.ndarray,  # [B, Sq, H, D] running weighted values
     scale: float,
+    attn_softcap: float = 0.0,
 ):
     """One flash-attention accumulation step over a K/V block."""
     B, Sq, H, D = q.shape
@@ -48,8 +53,10 @@ def _block_attend(
         "bshgd,bthd->bhgst", qg, k, preferred_element_type=jnp.float32
     ) * scale  # [B, Hkv, g, Sq, Sk]
     s = s.reshape(B, H, Sq, k.shape[1])
+    if attn_softcap > 0.0:
+        s = jnp.tanh(s / attn_softcap) * attn_softcap
     neg = jnp.finfo(jnp.float32).min
-    s = jnp.where(mask[None, None, :, :], s, neg)
+    s = jnp.where(mask[:, None, :, :], s, neg)
 
     m_new = jnp.maximum(m, s.max(axis=-1))
     # Guard fully-masked rows: keep m finite so exp() stays 0, not NaN.
@@ -64,12 +71,73 @@ def _block_attend(
     return m_new, l_new, acc_new
 
 
+def ring_attention_local(
+    qb: jnp.ndarray,  # [B, S_loc, H, D] — this device's query block
+    kb: jnp.ndarray,  # [B, S_loc, Hkv, D] — this device's K block
+    vb: jnp.ndarray,
+    sp: int,
+    causal: bool = True,
+    kv_start: jnp.ndarray | None = None,  # [B] first valid global slot
+    attn_softcap: float = 0.0,
+    axis_name: str = SP,
+) -> jnp.ndarray:
+    """Per-device ring attention body (call inside shard_map over sp)."""
+    idx = jax.lax.axis_index(axis_name)
+    B, Sq, H, D = qb.shape
+    scale = 1.0 / math.sqrt(D)
+    m = jnp.full((B, H, Sq), -jnp.inf, jnp.float32)
+    l = jnp.zeros((B, H, Sq), jnp.float32)
+    acc = jnp.zeros((B, Sq, H, D), jnp.float32)
+    rows = jnp.arange(Sq)[:, None]
+    cols = jnp.arange(Sq)[None, :]
+
+    def step(h, carry):
+        m, l, acc, kb, vb = carry
+        # After h hops, we hold the block originally on device idx-h.
+        src = (idx - h) % sp
+        if causal:
+            diag = rows >= cols
+            full = jnp.ones((Sq, Sq), bool)
+            empty = jnp.zeros((Sq, Sq), bool)
+            block_mask = jnp.where(
+                src == idx, diag, jnp.where(src < idx, full, empty)
+            )
+        else:
+            block_mask = jnp.ones((Sq, Sq), bool)
+        mask = jnp.broadcast_to(block_mask[None], (B, Sq, Sq))
+        if kv_start is not None:
+            key_slot = src * Sq + cols  # [1, Sq] global slot of each key
+            mask = mask & (key_slot[None] >= kv_start[:, None, None])
+        m, l, acc = _block_attend(
+            qb.astype(jnp.float32),
+            kb,
+            vb,
+            mask,
+            m,
+            l,
+            acc,
+            scale,
+            attn_softcap=attn_softcap,
+        )
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        return m, l, acc, kb, vb
+
+    m, l, acc, _, _ = jax.lax.fori_loop(0, sp, step, (m, l, acc, kb, vb))
+    l_safe = jnp.maximum(l, 1e-30)
+    out = acc / l_safe.transpose(0, 2, 1)[..., None]
+    return out.astype(qb.dtype)
+
+
 def ring_attention(
     q: jnp.ndarray,  # [B, S, H, D] — S is the GLOBAL sequence length
     k: jnp.ndarray,  # [B, S, Hkv, D]
     v: jnp.ndarray,  # [B, S, Hkv, D]
     mesh: Mesh,
     causal: bool = True,
+    kv_start: jnp.ndarray | None = None,  # [B]
+    attn_softcap: float = 0.0,
 ) -> jnp.ndarray:
     """Causal attention with sequence sharded over the mesh's ``sp`` axis.
 
@@ -80,52 +148,37 @@ def ring_attention(
     S = q.shape[1]
     if S % sp != 0:
         raise ValueError(f"sequence {S} not divisible by sp={sp}")
-    scale = 1.0 / math.sqrt(q.shape[-1])
-    block = S // sp
-
-    def local(qb, kb, vb):
-        # qb: [B, block, H, D] — this device's query block.
-        idx = jax.lax.axis_index(SP)
-        B, Sq, H, D = qb.shape
-        m = jnp.full((B, H, Sq), -jnp.inf, jnp.float32)
-        l = jnp.zeros((B, H, Sq), jnp.float32)
-        acc = jnp.zeros((B, Sq, H, D), jnp.float32)
-        rows = jnp.arange(Sq)[:, None]
-        cols = jnp.arange(Sq)[None, :]
-
-        def step(h, carry):
-            m, l, acc, kb, vb = carry
-            # After h hops, we hold the block originally on device idx-h.
-            src = (idx - h) % sp
-            if causal:
-                diag = rows >= cols
-                full = jnp.ones((Sq, Sq), bool)
-                empty = jnp.zeros((Sq, Sq), bool)
-                mask = jnp.where(
-                    src == idx, diag, jnp.where(src < idx, full, empty)
-                )
-            else:
-                mask = jnp.ones((Sq, Sq), bool)
-            m, l, acc = _block_attend(
-                qb.astype(jnp.float32), kb, vb, mask, m, l, acc, scale
-            )
-            perm = [(i, (i + 1) % sp) for i in range(sp)]
-            kb = jax.lax.ppermute(kb, SP, perm)
-            vb = jax.lax.ppermute(vb, SP, perm)
-            return m, l, acc, kb, vb
-
-        m, l, acc, _, _ = jax.lax.fori_loop(
-            0, sp, step, (m, l, acc, kb, vb)
-        )
-        l_safe = jnp.maximum(l, 1e-30)
-        out = acc / l_safe.transpose(0, 2, 1)[..., None]
-        return out.astype(q.dtype)
 
     spec = P(None, SP, None, None)
+    if kv_start is None:
+
+        def local(qb, kb, vb):
+            return ring_attention_local(
+                qb, kb, vb, sp, causal=causal, attn_softcap=attn_softcap
+            )
+
+        in_specs = (spec, spec, spec)
+        args = (q, k, v)
+    else:
+
+        def local(qb, kb, vb, ks):
+            return ring_attention_local(
+                qb,
+                kb,
+                vb,
+                sp,
+                causal=causal,
+                kv_start=ks,
+                attn_softcap=attn_softcap,
+            )
+
+        in_specs = (spec, spec, spec, P(None))
+        args = (q, k, v, kv_start)
+
     return jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=in_specs,
         out_specs=spec,
         check_vma=False,
-    )(q, k, v)
+    )(*args)

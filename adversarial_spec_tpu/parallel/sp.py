@@ -1,0 +1,139 @@
+"""Sequence-parallel (long-context) prefill: the whole transformer forward
+with the sequence axis sharded over the ``sp`` mesh axis.
+
+BASELINE config 5 is a 16k-context PRD; at that length a single chip's
+prefill is attention-memory-bound. Here the prompt is split into ``sp``
+contiguous blocks (one per device): embeddings, QKV projections, and FFNs
+run on local blocks only, and attention runs as a ring
+(parallel/ring.py::ring_attention_local — ppermute of K/V blocks around
+the ICI ring with online-softmax accumulation). Activation and attention
+memory are O(S/sp) per device; the only cross-device traffic is the K/V
+ring (plus whatever collectives GSPMD inserts for tp-sharded weights).
+
+The resulting KV cache comes back sequence-sharded; the caller reshards
+it to the decode layout (batch over dp) — decode is token-at-a-time and
+has no sequence axis worth sharding.
+
+Constraints (v1): global attention only (no sliding window — Llama-style
+families; windowed families raise), and the padded length must divide sp.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from adversarial_spec_tpu.models.config import ModelConfig
+from adversarial_spec_tpu.models.transformer import (
+    _attn_out_and_ffn,
+    _lm_head_logits,
+    _project_qkv,
+    rms_norm,
+)
+from adversarial_spec_tpu.ops.rope import rope_angles
+from adversarial_spec_tpu.parallel.mesh import SP
+from adversarial_spec_tpu.parallel.ring import ring_attention_local
+
+
+@partial(jax.jit, static_argnames=("cfg", "mesh"))
+def sp_prefill(
+    params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,  # [B, S] left-padded, S % sp == 0
+    pad_lens: jnp.ndarray,  # [B]
+    mesh: Mesh,
+):
+    """Sequence-parallel prefill over the full prompt.
+
+    Returns (last_logits [B, vocab] f32, cache {"k","v": [L, B, S, Hkv, D]}
+    sequence-sharded over sp).
+    """
+    if cfg.sliding_window > 0:
+        raise NotImplementedError(
+            "sequence-parallel prefill supports global attention only; "
+            f"family with sliding_window={cfg.sliding_window} must prefill "
+            "chunked on one device"
+        )
+    sp = mesh.shape[SP]
+    B, S = tokens.shape
+    if S % sp != 0:
+        raise ValueError(f"padded length {S} not divisible by sp={sp}")
+
+    def local(tokens_l, pad_lens_rep, params_rep):
+        # tokens_l: [B, S/sp] — this device's contiguous block.
+        idx = jax.lax.axis_index(SP)
+        S_loc = tokens_l.shape[1]
+        base = idx * S_loc
+        positions = jnp.maximum(
+            base + jnp.arange(S_loc, dtype=jnp.int32)[None, :]
+            - pad_lens_rep[:, None],
+            0,
+        )
+        cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+
+        x = params_rep["embed"][tokens_l]
+        if cfg.scale_embeddings:
+            x = (x.astype(jnp.float32) * math.sqrt(cfg.dim)).astype(x.dtype)
+
+        def layer_body(x, lp):
+            h = rms_norm(
+                x, lp["attn_norm"], cfg.rms_eps, cfg.norm_scale_plus_one
+            )
+            q, k, v = _project_qkv(lp, cfg, h, B, S_loc, cos, sin)
+            out = ring_attention_local(
+                q,
+                k.astype(jnp.float32),
+                v.astype(jnp.float32),
+                sp,
+                causal=True,
+                kv_start=pad_lens_rep,
+                attn_softcap=cfg.attn_softcap,
+            )
+            x = _attn_out_and_ffn(x, out, lp, cfg, B, S_loc)
+            return x, (k, v)
+
+        x, (k_all, v_all) = jax.lax.scan(
+            layer_body, x, params_rep["layers"]
+        )
+
+        # Last-position logits exist only on the last device; other
+        # devices compute on their block and the caller's psum keeps SPMD
+        # shapes uniform (their contribution is zeroed).
+        logits_local = _lm_head_logits(
+            params_rep, cfg, x, lm_head_last_only=True
+        )[:, 0]
+        logits_local = jnp.where(idx == sp - 1, logits_local, 0.0)
+        logits = jax.lax.psum(logits_local, SP)
+        return logits, k_all, v_all
+
+    seq_spec = P(None, SP)
+    cache_spec = P(None, None, SP, None, None)  # [L, B, S(sp), Hkv, D]
+    logits, k_all, v_all = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(seq_spec, P(None), P()),
+        out_specs=(P(None, None), cache_spec, cache_spec),
+        check_vma=False,
+    )(tokens, pad_lens, params)
+    return logits, {"k": k_all, "v": v_all}
+
+
+def reshard_cache_for_decode(cache, mesh: Mesh, total_len: int):
+    """Sequence-sharded prefill cache → decode layout: gather the sequence
+    axis, pad to ``total_len`` slots, shard batch over dp / heads over tp."""
+    from adversarial_spec_tpu.parallel.sharding import cache_sharding
+
+    S = cache["k"].shape[2]
+    out = {}
+    for name, arr in cache.items():
+        arr = jax.device_put(arr, cache_sharding(mesh))  # gathers sp
+        if total_len > S:
+            pad = [(0, 0)] * arr.ndim
+            pad[2] = (0, total_len - S)
+            arr = jnp.pad(arr, pad)
+        out[name] = arr
+    return out

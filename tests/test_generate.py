@@ -278,6 +278,19 @@ class TestGenerate:
         paged = generate(params, cfg, prompts, paged=True, page_size=16, **kw)
         np.testing.assert_array_equal(dense.tokens, paged.tokens)
 
+    def test_paged_kernel_in_loop_matches_gather(self, tiny_model):
+        """Force the paged Pallas kernel (interpret mode on CPU) inside
+        the decode loop — must match the gather reference path."""
+        params, cfg = tiny_model
+        prompts = [[1, 5, 9, 3], [2, 6]]
+        kw = dict(
+            max_new_tokens=4, eos_ids=[], greedy=True, paged=True,
+            page_size=16,
+        )
+        gather = generate(params, cfg, prompts, use_pallas_decode=False, **kw)
+        kernel = generate(params, cfg, prompts, use_pallas_decode=True, **kw)
+        np.testing.assert_array_equal(gather.tokens, kernel.tokens)
+
     def test_paged_decode_with_eos(self, tiny_model):
         params, cfg = tiny_model
         probe = generate(

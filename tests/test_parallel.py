@@ -133,6 +133,71 @@ class TestShardedGenerate:
         np.testing.assert_array_equal(ref.tokens, out.tokens)
 
 
+class TestSequenceParallelPrefill:
+    def test_sp_prefill_matches_dense(self):
+        """Full-model sequence-parallel prefill (ring attention inside the
+        layer scan) must reproduce the dense single-device prefill: same
+        last-position logits, same KV cache contents."""
+        from adversarial_spec_tpu.engine.generate import prefill_chunk
+        from adversarial_spec_tpu.parallel.sp import (
+            reshard_cache_for_decode,
+            sp_prefill,
+        )
+
+        cfg = get_config("llama", "tiny")
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        mesh = make_mesh({"sp": 4})
+        B, S = 2, 32
+        tokens = jax.random.randint(
+            jax.random.key(5), (B, S), 0, cfg.vocab_size
+        )
+        pad_lens = jnp.array([3, 0], jnp.int32)
+        # Left-pad semantics: zero out the pad slots.
+        tokens = jnp.where(
+            jnp.arange(S)[None, :] < pad_lens[:, None], 0, tokens
+        )
+
+        with mesh:
+            logits_sp, cache_sp = sp_prefill(params, cfg, tokens, pad_lens, mesh)
+
+        dense_cache = T.init_cache(cfg, B, S, dtype=jnp.float32)
+        dense_cache, last_logits = prefill_chunk(
+            params, cfg, tokens, pad_lens, dense_cache, jnp.int32(0)
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits_sp),
+            np.asarray(last_logits),
+            rtol=2e-4,
+            atol=2e-4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(cache_sp["k"]),
+            np.asarray(dense_cache["k"]),
+            rtol=2e-4,
+            atol=2e-4,
+        )
+
+        with mesh:
+            resharded = reshard_cache_for_decode(cache_sp, mesh, S + 8)
+        assert resharded["k"].shape[2] == S + 8
+        np.testing.assert_allclose(
+            np.asarray(resharded["k"][:, :, :S]),
+            np.asarray(dense_cache["k"]),
+            rtol=2e-4,
+            atol=2e-4,
+        )
+
+    def test_sp_prefill_rejects_sliding_window(self):
+        from adversarial_spec_tpu.parallel.sp import sp_prefill
+
+        cfg = get_config("mistral", "tiny")
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        mesh = make_mesh({"sp": 4})
+        tokens = jnp.zeros((1, 32), jnp.int32)
+        with pytest.raises(NotImplementedError, match="sliding_window"):
+            sp_prefill(params, cfg, tokens, jnp.zeros((1,), jnp.int32), mesh)
+
+
 class TestRingAttention:
     def _dense_ref(self, q, k, v, causal=True):
         B, S, H, D = q.shape

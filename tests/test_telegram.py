@@ -171,6 +171,55 @@ class TestPolling:
             assert telegram.get_last_update_id(CFG) == 0
 
 
+class TestCliSubcommands:
+    def test_send(self, monkeypatch, capsys):
+        monkeypatch.setenv("TELEGRAM_BOT_TOKEN", "tok")
+        monkeypatch.setenv("TELEGRAM_CHAT_ID", "42")
+        sent = []
+        monkeypatch.setattr(
+            telegram, "send_long_message", lambda cfg, text: sent.append(text)
+        )
+        assert telegram._cli(["send", "hello", "world"]) == 0
+        assert sent == ["hello world"]
+
+    def test_notify_with_reply(self, monkeypatch, capsys):
+        monkeypatch.setenv("TELEGRAM_BOT_TOKEN", "tok")
+        monkeypatch.setenv("TELEGRAM_CHAT_ID", "42")
+        monkeypatch.setattr(telegram, "get_last_update_id", lambda cfg: 5)
+        monkeypatch.setattr(
+            telegram, "send_long_message", lambda cfg, text: 1
+        )
+        monkeypatch.setattr(
+            telegram,
+            "poll_for_reply",
+            lambda cfg, after, timeout_s: "go ahead",
+        )
+        assert telegram._cli(["notify", "30", "round done"]) == 0
+        assert "go ahead" in capsys.readouterr().out
+
+    def test_notify_no_reply_exit_1(self, monkeypatch, capsys):
+        monkeypatch.setenv("TELEGRAM_BOT_TOKEN", "tok")
+        monkeypatch.setenv("TELEGRAM_CHAT_ID", "42")
+        monkeypatch.setattr(telegram, "get_last_update_id", lambda cfg: 0)
+        monkeypatch.setattr(
+            telegram, "send_long_message", lambda cfg, text: 1
+        )
+        monkeypatch.setattr(
+            telegram, "poll_for_reply", lambda cfg, after, timeout_s: None
+        )
+        assert telegram._cli(["notify", "5", "msg"]) == 1
+
+    def test_unconfigured_exit_2(self, monkeypatch, capsys):
+        monkeypatch.delenv("TELEGRAM_BOT_TOKEN", raising=False)
+        monkeypatch.delenv("TELEGRAM_CHAT_ID", raising=False)
+        assert telegram._cli(["send", "x"]) == 2
+
+    def test_unknown_subcommand_exit_2(self, monkeypatch, capsys):
+        monkeypatch.setenv("TELEGRAM_BOT_TOKEN", "tok")
+        monkeypatch.setenv("TELEGRAM_CHAT_ID", "42")
+        assert telegram._cli(["frobnicate"]) == 2
+
+
 class TestRoundSummary:
     def test_format(self):
         result = RoundResult(
