@@ -83,7 +83,8 @@ def _sample_step(
     """Shared per-decode-step tail for BOTH cache layouts: sample, record
     EOS (the EOS token itself is kept; finished rows emit 0 thereafter),
     write the output slot. Any change here applies to dense and paged
-    decode alike."""
+    decode alike — and must be mirrored in the vectorized emission logic
+    of engine/speculative.py (same EOS contract, γ+1 tokens at a time)."""
     key, sub = jax.random.split(key)
     nxt = sample_tokens(
         logits,
@@ -356,6 +357,7 @@ def generate(
     share_prefix: bool = True,
     paged: bool = False,
     page_size: int = 128,
+    speculative: bool | None = None,
 ) -> GenerateResult:
     """End-to-end batched generation (host orchestration).
 
@@ -377,6 +379,12 @@ def generate(
     scattered into pages after prefill and every decode step writes through
     the page table. Single-device only (the paged kernel is not
     GSPMD-partitionable); sharded meshes silently use the dense path.
+
+    ``speculative``: prompt-lookup speculative decoding
+    (engine/speculative.py) — greedy, single-row, dense-cache runs draft
+    tokens from n-gram matches in the prompt and verify several per
+    forward; bit-identical outputs, multiple tokens per step on
+    revision-style outputs. None = auto (on when eligible).
     """
     if use_pallas_decode is None:
         # Auto: fused kernel on a real single-device TPU; jnp path for
@@ -451,26 +459,53 @@ def generate(
         # Born sharded: batch over dp, heads over tp — never replicated
         # through one chip's HBM.
         cache_device = cache_sharding(mesh)
-    # Paged runs drop the dense cache after migrating prompt KV, so it
-    # only needs the prompt slots — not the decode region.
-    cache = init_cache(
-        cfg,
-        prefill_tokens.shape[0],
-        S if paged else total_len,
-        dtype=params["embed"].dtype,
-        device=cache_device,
+
+    sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+    use_sp_prefill = (
+        sp > 1 and cfg.sliding_window == 0 and S % sp == 0
     )
-    chunk_len = min(S, PREFILL_CHUNK)
-    last_logits = None
-    for ci in range(0, S, chunk_len):
-        cache, last_logits = prefill_chunk(
-            params,
-            cfg,
-            prefill_tokens[:, ci : ci + chunk_len],
-            prefill_pads,
-            cache,
-            jnp.int32(ci),
+    if use_sp_prefill:
+        # Long-context path: sequence-parallel prefill (ring attention
+        # over the sp axis — parallel/sp.py), then reshard the
+        # sequence-sharded cache into the decode layout.
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from adversarial_spec_tpu.parallel.mesh import SP as SP_AXIS
+        from adversarial_spec_tpu.parallel.sp import (
+            reshard_cache_for_decode,
+            sp_prefill,
         )
+
+        # Tokens enter sequence-sharded so shard_map needs no reshard.
+        sp_tokens = jax.device_put(
+            prefill_tokens, NamedSharding(mesh, P(None, SP_AXIS))
+        )
+        last_logits, cache = sp_prefill(
+            params, cfg, sp_tokens, prefill_pads, mesh
+        )
+        # (paged cannot reach here: it is force-disabled on multi-device
+        # meshes above, and sp > 1 implies multi-device.)
+        cache = reshard_cache_for_decode(cache, mesh, total_len)
+    else:
+        # Paged runs drop the dense cache after migrating prompt KV, so
+        # it only needs the prompt slots — not the decode region.
+        cache = init_cache(
+            cfg,
+            prefill_tokens.shape[0],
+            S if paged else total_len,
+            dtype=params["embed"].dtype,
+            device=cache_device,
+        )
+        chunk_len = min(S, PREFILL_CHUNK)
+        last_logits = None
+        for ci in range(0, S, chunk_len):
+            cache, last_logits = prefill_chunk(
+                params,
+                cfg,
+                prefill_tokens[:, ci : ci + chunk_len],
+                prefill_pads,
+                cache,
+                jnp.int32(ci),
+            )
     if shared:
         cache = jax.tree.map(lambda x: jnp.repeat(x, B, axis=1), cache)
         last_logits = jnp.repeat(last_logits, B, axis=0)
@@ -532,13 +567,56 @@ def generate(
         # mode makes the kernel testable on CPU too.
         use_paged_kernel = use_pallas_decode
 
+    # Speculative eligibility: greedy, one row, dense cache, one device.
+    if speculative is None:
+        speculative = True
+    use_spec = (
+        speculative
+        and B == 1
+        and greedy
+        and not paged
+        and (mesh is None or mesh.size == 1)
+    )
+    if use_spec:
+        from adversarial_spec_tpu.engine.speculative import (
+            GAMMA,
+            speculative_decode_steps,
+        )
+
+        prev_tok = tokens[0, -1]
+        # Keep the whole call on ONE attention implementation: the
+        # verification forward runs the jnp path (S=γ+1 — the fused
+        # Pallas kernel is single-query), so the single-token tail must
+        # too, or near-tie argmaxes could diverge mid-sequence.
+        use_pallas_decode = False
+
     t1 = time.monotonic()
     while int(step) < max_new_tokens and not bool(finished.all()):
         if deadline is not None and time.monotonic() >= deadline:
             timed_out = True
             break
         key, chunk_key = jax.random.split(key)
-        if paged:
+        if use_spec and int(step) + GAMMA + 1 <= max_new_tokens:
+            cache, prev_tok, cur_scalar, finished, out_buf, step = (
+                speculative_decode_steps(
+                    params,
+                    cfg,
+                    cache,
+                    tokens,
+                    prev_tok,
+                    cur[0],
+                    pad_lens,
+                    finished,
+                    out_buf,
+                    step,
+                    jnp.int32(max_new_tokens),
+                    eos,
+                    prompt_len=S,
+                    chunk=DECODE_CHUNK,
+                )
+            )
+            cur = cur_scalar[None]
+        elif paged:
             pool, cur, finished, out_buf, step = paged_decode_chunk_steps(
                 params,
                 cfg,

@@ -1,0 +1,157 @@
+"""Prompt-lookup speculative decoding (greedy, single-row).
+
+The debate workload's dominant output is a ``[SPEC]...[/SPEC]`` revision —
+a near-copy of the input document with edits. That makes *prompt-lookup*
+drafting (LLMA / prompt-lookup decoding: match the last n-gram of the
+generated text against the prompt and draft the tokens that followed it
+there) exceptionally effective: long runs of the revision are verbatim
+prompt spans, so most drafts verify and the model emits several tokens per
+forward pass instead of one. No draft model, no extra weights — the draft
+source is the prompt itself.
+
+One step: draft γ tokens from the best (most recent) n-gram match; run ONE
+verification forward over [cur, d_0..d_{γ-1}] (γ+1 positions, the same
+KV-cached forward prefill chunks use); accept the longest prefix of drafts
+that equals the greedy argmax chain; emit the accepted tokens plus the
+model's own next token (always ≥1 token of progress, bit-identical to
+plain greedy decode by construction).
+
+Cache discipline: the verification forward writes γ+1 KV slots; rejected
+drafts leave stale KV above slot cache_index+n_acc, but the next step's
+write region starts exactly there (new cache_index = old + n_emit) and
+layer writes land before attention, so stale slots are never read.
+
+Scope (v1): greedy sampling, one row (B=1 — BASELINE config 2's
+single-opponent critique), dense KV cache, jnp attention (generate()
+forces the tail decode off the Pallas kernel so one attention
+implementation governs the whole call — near-tie argmaxes must not
+diverge between verify and tail). Exact-output parity with plain greedy
+decode on the same attention path is the correctness contract (tested).
+
+EOS contract (mirror of generate._sample_step — change BOTH together):
+the EOS token itself is kept in the output; slots after it emit 0.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from adversarial_spec_tpu.models.config import ModelConfig
+from adversarial_spec_tpu.models.transformer import Cache, Params, forward
+
+GAMMA = 8  # draft length per step
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "prompt_len", "chunk", "gamma"),
+    donate_argnames=("cache", "out_buf"),
+)
+def speculative_decode_steps(
+    params: Params,
+    cfg: ModelConfig,
+    cache: Cache,
+    prompt_tokens: jnp.ndarray,  # [1, S] the left-padded prompt (draft source)
+    prev_token: jnp.ndarray,  # [] token before cur (n-gram context)
+    cur_token: jnp.ndarray,  # [] last emitted token
+    pad_lens: jnp.ndarray,  # [1]
+    finished: jnp.ndarray,  # [1] bool
+    out_buf: jnp.ndarray,  # [1, max_new]
+    start_step: jnp.ndarray,  # scalar
+    stop_at: jnp.ndarray,  # scalar
+    eos_ids: jnp.ndarray,  # [E]
+    *,
+    prompt_len: int,
+    chunk: int,
+    gamma: int = GAMMA,
+):
+    """Run speculative greedy steps while ≥ γ+1 output slots remain.
+
+    Returns (cache, prev, cur, finished, out_buf, step) — the caller
+    finishes any tail with the plain single-token loop.
+    """
+    S = prompt_tokens.shape[1]
+    T = cache["k"].shape[2]
+    max_new = out_buf.shape[1]
+    pt = prompt_tokens[0]
+    kv_base = jnp.arange(T)[None, :] >= pad_lens[:, None]
+    draft_span = gamma + 1
+
+    def cond(state):
+        step, finished = state[0], state[5]
+        # The full span must fit the output budget; the chunk bound only
+        # paces how much work one host call performs.
+        fits = step + draft_span <= jnp.minimum(stop_at, max_new)
+        return fits & (step < start_step + chunk) & ~finished.all()
+
+    def body(state):
+        step, prev, cur, cache, out_buf, finished, key_unused = state
+
+        # --- Draft: most recent prompt position following [prev, cur]. ---
+        match = (pt[:-1] == prev) & (pt[1:] == cur)  # [S-1]
+        pos = jnp.arange(S - 1)
+        best = jnp.max(jnp.where(match, pos, -1))
+        has_match = best >= 0
+        d_start = jnp.clip(best + 2, 0, S - gamma)
+        draft = jax.lax.dynamic_slice(pt, (d_start,), (gamma,))
+        draft = jnp.where(has_match, draft, jnp.zeros_like(draft))
+
+        # --- Verify: one forward over [cur, draft]. ---
+        toks = jnp.concatenate([cur[None], draft])[None]  # [1, γ+1]
+        cache_index = prompt_len + step - 1
+        positions = (
+            cache_index
+            + jnp.arange(draft_span, dtype=jnp.int32)[None, :]
+            - pad_lens[:, None]
+        )
+        logits, cache = forward(
+            params, cfg, toks, positions, cache, cache_index, kv_base
+        )
+        greedy_chain = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)
+
+        # --- Accept the longest verified prefix, emit + bonus token. ---
+        matches = draft == greedy_chain[:-1]  # [γ]
+        n_acc = jnp.sum(jnp.cumprod(matches.astype(jnp.int32)))
+        emitted = jnp.concatenate([draft, jnp.zeros((1,), draft.dtype)])
+        emitted = emitted.at[n_acc].set(greedy_chain[n_acc])
+
+        is_eos = (emitted[:, None] == eos_ids[None, :]).any(axis=-1)
+        j = jnp.arange(draft_span)
+        eos_hits = is_eos & (j <= n_acc)
+        any_eos = eos_hits.any()
+        first_eos = jnp.argmax(eos_hits)
+        n_emit = jnp.where(any_eos, first_eos + 1, n_acc + 1)
+        emitted = jnp.where(j < n_emit, emitted, 0)
+
+        out_buf = jax.lax.dynamic_update_slice(
+            out_buf, emitted[None], (0, step)
+        )
+        finished = finished | any_eos
+        new_cur = emitted[n_emit - 1]
+        new_prev = jnp.where(n_emit >= 2, emitted[n_emit - 2], cur)
+        return (
+            step + n_emit,
+            new_prev,
+            new_cur,
+            cache,
+            out_buf,
+            finished,
+            key_unused,
+        )
+
+    state = (
+        start_step,
+        prev_token,
+        cur_token,
+        cache,
+        out_buf,
+        finished,
+        jnp.int32(0),
+    )
+    step, prev, cur, cache, out_buf, finished, _ = jax.lax.while_loop(
+        cond, body, state
+    )
+    return cache, prev, cur, finished, out_buf, step

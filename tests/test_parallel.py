@@ -187,6 +187,20 @@ class TestSequenceParallelPrefill:
             atol=2e-4,
         )
 
+    def test_generate_end_to_end_on_sp_mesh(self):
+        """generate() on an sp>1 mesh routes prefill through the
+        sequence-parallel path and must reproduce single-device tokens."""
+        cfg = get_config("llama", "tiny")
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        prompts = [[1, 5, 9, 3, 7, 2], [4, 4, 8]]
+        kw = dict(max_new_tokens=6, eos_ids=[], greedy=True)
+        ref = generate(params, cfg, prompts, **kw)
+        mesh = make_mesh({"sp": 4, "dp": 2})
+        sharded = shard_params(mesh, params)
+        with mesh:
+            out = generate(sharded, cfg, prompts, mesh=mesh, **kw)
+        np.testing.assert_array_equal(ref.tokens, out.tokens)
+
     def test_sp_prefill_rejects_sliding_window(self):
         from adversarial_spec_tpu.parallel.sp import sp_prefill
 

@@ -1,0 +1,169 @@
+"""Prompt-lookup speculative decoding tests.
+
+Correctness contract: speculative greedy decode is BIT-IDENTICAL to plain
+greedy decode (acceptance only reorders how many tokens emerge per
+forward, never which tokens)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from adversarial_spec_tpu.engine import speculative as spec_mod
+from adversarial_spec_tpu.engine.generate import generate
+from adversarial_spec_tpu.models import transformer as T
+from adversarial_spec_tpu.models.config import get_config
+
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    cfg = get_config("llama", "tiny")
+    params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+    return params, cfg
+
+
+class TestSpeculativeParity:
+    def test_matches_plain_greedy(self, tiny_model):
+        params, cfg = tiny_model
+        prompt = [((i * 13) % 500) + 3 for i in range(40)]
+        kw = dict(max_new_tokens=24, eos_ids=[], greedy=True)
+        plain = generate(params, cfg, [prompt], speculative=False, **kw)
+        spec = generate(params, cfg, [prompt], speculative=True, **kw)
+        np.testing.assert_array_equal(plain.tokens, spec.tokens)
+        np.testing.assert_array_equal(plain.n_generated, spec.n_generated)
+
+    def test_matches_with_repetitive_prompt(self, tiny_model):
+        """Repetitive prompts maximize n-gram matches (acceptance both
+        succeeds and fails along the way) — parity must still hold."""
+        params, cfg = tiny_model
+        prompt = [5, 9, 7, 5, 9, 7, 5, 9, 7, 5, 9, 7, 5, 9]
+        kw = dict(max_new_tokens=20, eos_ids=[], greedy=True)
+        plain = generate(params, cfg, [prompt], speculative=False, **kw)
+        spec = generate(params, cfg, [prompt], speculative=True, **kw)
+        np.testing.assert_array_equal(plain.tokens, spec.tokens)
+
+    def test_eos_parity(self, tiny_model):
+        params, cfg = tiny_model
+        probe = generate(
+            params, cfg, [[1, 2]], max_new_tokens=4, eos_ids=[], greedy=True
+        )
+        eos = int(probe.tokens[0, 1])
+        kw = dict(max_new_tokens=30, eos_ids=[eos], greedy=True)
+        plain = generate(params, cfg, [[1, 2]], speculative=False, **kw)
+        spec = generate(params, cfg, [[1, 2]], speculative=True, **kw)
+        np.testing.assert_array_equal(plain.tokens, spec.tokens)
+        np.testing.assert_array_equal(plain.n_generated, spec.n_generated)
+
+    def test_disabled_for_batches_and_sampling(self, tiny_model):
+        """Multi-row and temperature>0 silently use the plain path (no
+        crash, valid output shapes)."""
+        params, cfg = tiny_model
+        multi = generate(
+            params,
+            cfg,
+            [[1, 2], [3, 4]],
+            max_new_tokens=6,
+            eos_ids=[],
+            greedy=True,
+            speculative=True,
+        )
+        assert multi.tokens.shape == (2, 6)
+        sampled = generate(
+            params,
+            cfg,
+            [[1, 2]],
+            max_new_tokens=6,
+            eos_ids=[],
+            temperature=1.0,
+            seed=3,
+            speculative=True,
+        )
+        assert sampled.tokens.shape == (1, 6)
+
+
+class TestAcceptanceArithmetic:
+    def test_full_acceptance_advances_gamma_plus_one(self, monkeypatch):
+        """With a forward whose greedy chain always equals the draft, each
+        speculative step must emit γ+1 tokens (all drafts + bonus)."""
+        cfg = get_config("llama", "tiny")
+        V = cfg.vocab_size
+
+        def fake_forward(params, cfg_, toks, positions, cache, ci, kv, **kw):
+            # argmax(logits[i]) == toks[i+1] for i < span-1 (accept all);
+            # last position predicts token 7 (the bonus).
+            span = toks.shape[1]
+            nxt = jnp.concatenate(
+                [toks[0, 1:], jnp.array([7], toks.dtype)]
+            )
+            logits = jax.nn.one_hot(nxt, V, dtype=jnp.float32)[None] * 10.0
+            return logits, cache
+
+        monkeypatch.setattr(spec_mod, "forward", fake_forward)
+
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        S, max_new, gamma = 16, 32, spec_mod.GAMMA
+        prompt = jnp.arange(3, 3 + S, dtype=jnp.int32)[None]
+        cache = T.init_cache(cfg, 1, S + max_new, dtype=jnp.float32)
+        out_buf = jnp.zeros((1, max_new), jnp.int32)
+
+        cache, prev, cur, finished, out_buf, step = (
+            spec_mod.speculative_decode_steps(
+                params,
+                cfg,
+                cache,
+                prompt,
+                prompt[0, -2],
+                prompt[0, -1],
+                jnp.zeros((1,), jnp.int32),
+                jnp.zeros((1,), bool),
+                out_buf,
+                jnp.int32(1),
+                jnp.int32(max_new),
+                jnp.asarray([-1], jnp.int32),
+                prompt_len=S,
+                chunk=64,
+            )
+        )
+        # [prev, cur] = last two prompt tokens match at the prompt's end;
+        # clamped draft comes from the prompt tail and fully verifies, so
+        # every iteration advances by γ+1.
+        n_steps = int(step) - 1
+        assert n_steps % (gamma + 1) == 0
+        assert n_steps >= gamma + 1
+
+    def test_zero_acceptance_advances_one(self, monkeypatch):
+        """A forward that contradicts every draft must still emit exactly
+        one (correct) token per step — guaranteed progress."""
+        cfg = get_config("llama", "tiny")
+        V = cfg.vocab_size
+
+        def fake_forward(params, cfg_, toks, positions, cache, ci, kv, **kw):
+            span = toks.shape[1]
+            # Predict token (draft + 1) everywhere: never matches drafts.
+            nxt = (toks[0] + 1) % V
+            logits = jax.nn.one_hot(nxt, V, dtype=jnp.float32)[None] * 10.0
+            return logits, cache
+
+        monkeypatch.setattr(spec_mod, "forward", fake_forward)
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        S, max_new = 16, 16
+        prompt = jnp.arange(3, 3 + S, dtype=jnp.int32)[None]
+        cache = T.init_cache(cfg, 1, S + max_new, dtype=jnp.float32)
+        out_buf = jnp.zeros((1, max_new), jnp.int32)
+        _, _, _, _, out_buf, step = spec_mod.speculative_decode_steps(
+            params,
+            cfg,
+            cache,
+            prompt,
+            prompt[0, -2],
+            prompt[0, -1],
+            jnp.zeros((1,), jnp.int32),
+            jnp.zeros((1,), bool),
+            out_buf,
+            jnp.int32(1),
+            jnp.int32(max_new),
+            jnp.asarray([-1], jnp.int32),
+            prompt_len=S,
+            chunk=3,  # 3 single-token steps fit the chunk bound
+        )
+        assert int(step) == 4  # start 1 + chunk bound 3 → exactly 3 steps
