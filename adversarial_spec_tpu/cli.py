@@ -619,6 +619,30 @@ def handle_registry(args: argparse.Namespace, rest: list[str]) -> int:
             return EXIT_OK
         _err(f"error: no registry entry named {rest[1]}")
         return EXIT_VALIDATION
+    if sub == "alias":
+        # Friendly-name aliasing (parity: reference bedrock `alias`
+        # subcommand, providers.py:489-656). Snapshot semantics: the new
+        # alias is an independent COPY of the existing entry's
+        # configuration at this moment — later edits to the source do not
+        # follow.
+        if len(rest) < 3:
+            _err("usage: debate registry alias <new-alias> <existing-alias>")
+            return EXIT_VALIDATION
+        new_alias, existing = rest[1], rest[2]
+        reg = model_registry.load_registry()
+        if existing not in reg:
+            _err(f"error: no registry entry named {existing}")
+            return EXIT_VALIDATION
+        import dataclasses
+
+        model_registry.save_registry_entry(
+            dataclasses.replace(reg[existing], alias=new_alias)
+        )
+        print(
+            f"registered tpu://{new_alias} as a copy of {existing}'s "
+            "current configuration"
+        )
+        return EXIT_OK
     _err(f"error: unknown registry subcommand {sub!r}")
     return EXIT_VALIDATION
 

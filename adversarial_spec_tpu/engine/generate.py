@@ -386,6 +386,7 @@ def generate(
     forward; bit-identical outputs, multiple tokens per step on
     revision-style outputs. None = auto (on when eligible).
     """
+    explicit_pallas = use_pallas_decode is True
     if use_pallas_decode is None:
         # Auto: fused kernel on a real single-device TPU; jnp path for
         # GSPMD-sharded meshes (the kernel isn't partitionable) and CPU.
@@ -567,19 +568,24 @@ def generate(
         # mode makes the kernel testable on CPU too.
         use_paged_kernel = use_pallas_decode
 
-    # Speculative eligibility: greedy, one row, dense cache, one device.
+    # Speculative eligibility: greedy, one row, dense cache, one device,
+    # enough output budget for at least one γ+1 span — and an explicit
+    # use_pallas_decode=True wins over auto-speculation (speculation
+    # forces the jnp attention path; see below).
+    from adversarial_spec_tpu.engine.speculative import GAMMA
+
     if speculative is None:
-        speculative = True
+        speculative = not explicit_pallas
     use_spec = (
         speculative
         and B == 1
         and greedy
         and not paged
         and (mesh is None or mesh.size == 1)
+        and max_new_tokens > GAMMA + 1
     )
     if use_spec:
         from adversarial_spec_tpu.engine.speculative import (
-            GAMMA,
             speculative_decode_steps,
         )
 
@@ -597,7 +603,8 @@ def generate(
             break
         key, chunk_key = jax.random.split(key)
         if use_spec and int(step) + GAMMA + 1 <= max_new_tokens:
-            cache, prev_tok, cur_scalar, finished, out_buf, step = (
+            step_before = int(step)
+            cache, prev_tok, cur_scalar, finished, out_buf, step, n_iters = (
                 speculative_decode_steps(
                     params,
                     cfg,
@@ -616,6 +623,12 @@ def generate(
                 )
             )
             cur = cur_scalar[None]
+            # Adaptive off-switch: each verification forward is γ+1 wide;
+            # if it averages barely more than one emitted token, drafts
+            # aren't matching and plain decode is cheaper.
+            iters = max(int(n_iters), 1)
+            if (int(step) - step_before) / iters < 1.5:
+                use_spec = False
         elif paged:
             pool, cur, finished, out_buf, step = paged_decode_chunk_steps(
                 params,

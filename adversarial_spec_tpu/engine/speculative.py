@@ -70,8 +70,11 @@ def speculative_decode_steps(
 ):
     """Run speculative greedy steps while ≥ γ+1 output slots remain.
 
-    Returns (cache, prev, cur, finished, out_buf, step) — the caller
-    finishes any tail with the plain single-token loop.
+    Returns (cache, prev, cur, finished, out_buf, step, n_iters) — the
+    caller finishes any tail with the plain single-token loop, and can use
+    step-progress / n_iters (mean tokens emitted per verification forward)
+    to turn speculation OFF when drafts aren't matching (each rejected
+    round costs a γ+1-wide forward to emit one token).
     """
     S = prompt_tokens.shape[1]
     T = cache["k"].shape[2]
@@ -88,7 +91,7 @@ def speculative_decode_steps(
         return fits & (step < start_step + chunk) & ~finished.all()
 
     def body(state):
-        step, prev, cur, cache, out_buf, finished, key_unused = state
+        step, prev, cur, cache, out_buf, finished, n_iters = state
 
         # --- Draft: most recent prompt position following [prev, cur]. ---
         match = (pt[:-1] == prev) & (pt[1:] == cur)  # [S-1]
@@ -139,7 +142,7 @@ def speculative_decode_steps(
             cache,
             out_buf,
             finished,
-            key_unused,
+            n_iters + 1,
         )
 
     state = (
@@ -151,7 +154,7 @@ def speculative_decode_steps(
         finished,
         jnp.int32(0),
     )
-    step, prev, cur, cache, out_buf, finished, _ = jax.lax.while_loop(
+    step, prev, cur, cache, out_buf, finished, n_iters = jax.lax.while_loop(
         cond, body, state
     )
-    return cache, prev, cur, finished, out_buf, step
+    return cache, prev, cur, finished, out_buf, step, n_iters

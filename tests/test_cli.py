@@ -375,6 +375,36 @@ class TestRegistry:
         )
         assert "mymodel" not in json.loads(out)
 
+    def test_alias_subcommand(self, monkeypatch, capsys):
+        run_cli(
+            ["registry", "add-model", "base", "--family", "gemma2",
+             "--size", "9b"],
+            monkeypatch=monkeypatch,
+            capsys=capsys,
+        )
+        code, out, _ = run_cli(
+            ["registry", "alias", "judge", "base"],
+            monkeypatch=monkeypatch,
+            capsys=capsys,
+        )
+        assert code == 0
+        code, out, _ = run_cli(
+            ["registry", "list-models", "--json"],
+            monkeypatch=monkeypatch,
+            capsys=capsys,
+        )
+        data = json.loads(out)
+        assert data["judge"]["family"] == "gemma2"
+        assert data["judge"]["size"] == "9b"
+
+    def test_alias_of_missing_exits_2(self, monkeypatch, capsys):
+        code, _, err = run_cli(
+            ["registry", "alias", "x", "ghost"],
+            monkeypatch=monkeypatch,
+            capsys=capsys,
+        )
+        assert code == 2
+
     def test_remove_missing_exits_2(self, monkeypatch, capsys):
         code, _, _ = run_cli(
             ["registry", "remove-model", "ghost"],

@@ -106,7 +106,7 @@ class TestAcceptanceArithmetic:
         cache = T.init_cache(cfg, 1, S + max_new, dtype=jnp.float32)
         out_buf = jnp.zeros((1, max_new), jnp.int32)
 
-        cache, prev, cur, finished, out_buf, step = (
+        cache, prev, cur, finished, out_buf, step, n_iters = (
             spec_mod.speculative_decode_steps(
                 params,
                 cfg,
@@ -130,6 +130,8 @@ class TestAcceptanceArithmetic:
         n_steps = int(step) - 1
         assert n_steps % (gamma + 1) == 0
         assert n_steps >= gamma + 1
+        # Every verification forward emitted the full span.
+        assert n_steps == int(n_iters) * (gamma + 1)
 
     def test_zero_acceptance_advances_one(self, monkeypatch):
         """A forward that contradicts every draft must still emit exactly
@@ -150,7 +152,7 @@ class TestAcceptanceArithmetic:
         prompt = jnp.arange(3, 3 + S, dtype=jnp.int32)[None]
         cache = T.init_cache(cfg, 1, S + max_new, dtype=jnp.float32)
         out_buf = jnp.zeros((1, max_new), jnp.int32)
-        _, _, _, _, out_buf, step = spec_mod.speculative_decode_steps(
+        _, _, _, _, out_buf, step, n_iters = spec_mod.speculative_decode_steps(
             params,
             cfg,
             cache,
@@ -167,3 +169,4 @@ class TestAcceptanceArithmetic:
             chunk=3,  # 3 single-token steps fit the chunk bound
         )
         assert int(step) == 4  # start 1 + chunk bound 3 → exactly 3 steps
+        assert int(n_iters) == 3  # one wide forward per single emitted token
