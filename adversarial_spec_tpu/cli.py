@@ -633,6 +633,14 @@ def handle_registry(args: argparse.Namespace, rest: list[str]) -> int:
         if existing not in reg:
             _err(f"error: no registry entry named {existing}")
             return EXIT_VALIDATION
+        if new_alias in reg:
+            # Guard against swapped arguments silently destroying an
+            # existing model's configuration.
+            _err(
+                f"error: {new_alias} already exists; remove it first with "
+                f"'registry remove-model {new_alias}'"
+            )
+            return EXIT_VALIDATION
         import dataclasses
 
         model_registry.save_registry_entry(

@@ -1,0 +1,393 @@
+"""Continuous batching scheduler over the paged KV pool.
+
+SURVEY §7 step 3's full form ("continuous batching across opponents
+sharing weights"): a slot-based scheduler that keeps one decode batch hot
+while sequences of different lengths join and leave it —
+
+- ``max_batch`` slots decode together as rows of one jitted program;
+- a finished row's pages free immediately and a queued request is admitted
+  into the empty slot at the next chunk boundary (its prompt prefills into
+  its own pages while the others wait one admission pause);
+- per-row lengths/budgets/EOS are tracked as device arrays, so rows at
+  different positions coexist in the same while_loop (per-row ``q_pos``
+  drives page writes, RoPE positions, and window bounds).
+
+Inactive-slot safety: physical page 0 is a reserved TRASH page no
+sequence owns. Allocator ids are shifted +1, the -1 "unmapped" sentinel
+maps to 0, and inactive rows write their (masked, discarded) KV there —
+a dead slot can never scribble into pages re-allocated to a newcomer.
+Trash/unmapped pages are never read: every row's valid window
+[pad, cur_len) ends before any unmapped logical slot.
+
+The round-synchronous debate path (engine/tpu.py) doesn't need this; it
+serves multi-session workloads (several debates sharing one model) and is
+exercised directly in tests/test_scheduler.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from adversarial_spec_tpu.engine.generate import (
+    bucket_length,
+    pad_batch,
+    prefill_chunk,
+)
+from adversarial_spec_tpu.engine.kvcache import (
+    OutOfPages,
+    PageAllocator,
+    PagedCacheLayout,
+    init_page_pool,
+    write_tokens,
+)
+from adversarial_spec_tpu.engine.sampling import sample_tokens
+from adversarial_spec_tpu.models.config import ModelConfig
+from adversarial_spec_tpu.models.transformer import (
+    forward_paged_decode,
+    init_cache,
+)
+
+TRASH_PAGE = 0
+
+
+@dataclass
+class SchedRequest:
+    req_id: int
+    prompt_ids: list[int]
+    max_new_tokens: int
+
+
+@dataclass
+class SchedResult:
+    req_id: int
+    tokens: np.ndarray  # generated ids (0 past the row's end)
+    n_generated: int
+
+
+@partial(
+    jax.jit,
+    static_argnames=("cfg", "chunk", "greedy", "top_k"),
+    donate_argnames=("pool", "out_buf"),
+)
+def scheduler_decode_chunk(
+    params,
+    cfg: ModelConfig,
+    pool,
+    page_table: jnp.ndarray,  # [B, Pmax] physical ids (0 = trash/unmapped)
+    cur_tok: jnp.ndarray,  # [B]
+    cur_len: jnp.ndarray,  # [B] prompt+emitted tokens so far
+    pad_lens: jnp.ndarray,  # [B]
+    n_emitted: jnp.ndarray,  # [B]
+    max_new: jnp.ndarray,  # [B] per-row budget
+    active: jnp.ndarray,  # [B] bool
+    out_buf: jnp.ndarray,  # [B, cap]
+    eos_ids: jnp.ndarray,
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    top_p: jnp.ndarray,
+    *,
+    chunk: int,
+    greedy: bool,
+    top_k: int,
+):
+    """Up to ``chunk`` decode steps over whatever rows are active."""
+    B = cur_tok.shape[0]
+    page_size = pool["k"].shape[2]
+    cap = out_buf.shape[1]
+    rows = jnp.arange(B)
+
+    def cond(state):
+        i, active = state[0], state[6]
+        return (i < chunk) & active.any()
+
+    def body(state):
+        i, cur, cur_len, n_emitted, pool, out_buf, active, key = state
+        q_pos = cur_len - 1  # [B] logical slot of cur's KV
+        write_page = jnp.where(
+            active,
+            page_table[rows, q_pos // page_size],
+            TRASH_PAGE,
+        )
+        write_off = q_pos % page_size
+        bounds = jnp.stack([pad_lens, q_pos + 1], axis=1).astype(jnp.int32)
+        positions = (q_pos - pad_lens)[:, None]
+        logits, pool = forward_paged_decode(
+            params,
+            cfg,
+            cur[:, None],
+            positions,
+            pool,
+            page_table,
+            write_page,
+            write_off,
+            bounds,
+            q_pos,
+        )
+        key, sub = jax.random.split(key)
+        nxt = sample_tokens(
+            logits[:, 0],
+            sub,
+            greedy=greedy,
+            top_k=top_k,
+            temperature=temperature,
+            top_p=top_p,
+        )
+        is_eos = (nxt[:, None] == eos_ids[None, :]).any(axis=-1)
+        nxt = jnp.where(active, nxt, 0)
+        write_pos = jnp.minimum(n_emitted, cap - 1)
+        out_buf = out_buf.at[rows, write_pos].set(
+            jnp.where(active, nxt, out_buf[rows, write_pos])
+        )
+        n_emitted = n_emitted + active.astype(jnp.int32)
+        cur_len = cur_len + active.astype(jnp.int32)
+        done = (is_eos | (n_emitted >= max_new)) & active
+        active = active & ~done
+        return i + 1, nxt, cur_len, n_emitted, pool, out_buf, active, key
+
+    state = (
+        jnp.int32(0),
+        cur_tok,
+        cur_len,
+        n_emitted,
+        pool,
+        out_buf,
+        active,
+        key,
+    )
+    _, cur, cur_len, n_emitted, pool, out_buf, active, _ = jax.lax.while_loop(
+        cond, body, state
+    )
+    return pool, cur, cur_len, n_emitted, out_buf, active
+
+
+class ContinuousBatcher:
+    """Admits requests into decode slots over one shared model + pool."""
+
+    def __init__(
+        self,
+        params,
+        cfg: ModelConfig,
+        *,
+        max_batch: int = 4,
+        page_size: int = 64,
+        capacity_tokens: int = 16384,
+        max_new_cap: int = 1024,
+        eos_ids: list[int] | None = None,
+        greedy: bool = True,
+        temperature: float = 0.7,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        seed: int = 0,
+        chunk: int = 32,
+    ):
+        self.params = params
+        self.cfg = cfg
+        self.B = max_batch
+        self.page_size = page_size
+        self.chunk = chunk
+        self.greedy = greedy
+        self.top_k = top_k
+        self._temp = jnp.float32(temperature)
+        self._top_p = jnp.float32(top_p)
+        self._eos = jnp.asarray(
+            sorted(set(eos_ids or [])) or [-1], jnp.int32
+        )
+        self._eos_np = np.asarray(sorted(set(eos_ids or [])) or [-1])
+        self._key = jax.random.key(seed)
+
+        n_pages = -(-capacity_tokens // page_size)
+        # Physical page 0 is the trash page; allocator ids shift +1.
+        self.allocator = PageAllocator(n_pages, page_size)
+        layout = PagedCacheLayout(
+            n_pages=n_pages + 1,
+            page_size=page_size,
+            n_layers=cfg.n_layers,
+            n_kv_heads=cfg.n_kv_heads,
+            head_dim=cfg.head_dim,
+        )
+        self.pool = init_page_pool(layout, dtype=jnp.float32)
+        self.max_pages_per_seq = -(-(cfg.max_seq_len) // page_size)
+
+        B, cap = self.B, max_new_cap
+        self.cap = cap
+        self.page_table = jnp.zeros((B, self.max_pages_per_seq), jnp.int32)
+        self.cur_tok = jnp.zeros((B,), jnp.int32)
+        self.cur_len = jnp.ones((B,), jnp.int32)  # ≥1 so q_pos ≥ 0
+        self.pad_lens = jnp.zeros((B,), jnp.int32)
+        self.n_emitted = jnp.zeros((B,), jnp.int32)
+        self.max_new = jnp.zeros((B,), jnp.int32)
+        self.active = jnp.zeros((B,), bool)
+        self.out_buf = jnp.zeros((B, cap), jnp.int32)
+
+        self._slot_req: list[SchedRequest | None] = [None] * B
+        self._slot_seq: list[int | None] = [None] * B
+        self._seq_counter = 0
+        self.capacity_tokens = n_pages * page_size
+        self.queue: list[SchedRequest] = []
+        self.results: list[SchedResult] = []
+
+    # -- admission ---------------------------------------------------------
+
+    def submit(self, req: SchedRequest) -> None:
+        """Reject infeasible requests up front with actionable errors —
+        anything accepted here is guaranteed schedulable once enough
+        resident sequences finish."""
+        if req.max_new_tokens > self.cap:
+            raise ValueError(
+                f"max_new_tokens {req.max_new_tokens} exceeds scheduler "
+                f"cap {self.cap}"
+            )
+        total = bucket_length(len(req.prompt_ids)) + req.max_new_tokens
+        if total > self.cfg.max_seq_len:
+            raise ValueError(
+                f"prompt (bucketed) + budget = {total} tokens exceeds the "
+                f"model context {self.cfg.max_seq_len}"
+            )
+        if total > self.capacity_tokens:
+            raise ValueError(
+                f"request needs {total} tokens but the pool holds only "
+                f"{self.capacity_tokens}; raise capacity_tokens"
+            )
+        self.queue.append(req)
+
+    def _admit_one(self, slot: int, req: SchedRequest) -> bool:
+        """Admit into ``slot``; False if the pool is momentarily full (the
+        request stays queued and retries after residents free pages)."""
+        tokens_np, pads_np = pad_batch([req.prompt_ids], pad_id=0)
+        S = tokens_np.shape[1]
+        total = S + req.max_new_tokens
+        seq_id = self._seq_counter
+        self.allocator.new_sequence(seq_id)
+        try:
+            self.allocator.extend(seq_id, total)
+        except OutOfPages:
+            self.allocator.free_sequence(seq_id)
+            return False
+        self._seq_counter += 1
+
+        # Prefill the prompt into a throwaway dense cache, then scatter
+        # into this sequence's pages (+1 shift: page 0 is trash).
+        cache = init_cache(self.cfg, 1, S, dtype=jnp.float32)
+        tokens = jnp.asarray(tokens_np)
+        pads = jnp.asarray(pads_np)
+        chunk_len = min(S, 512)
+        for ci in range(0, S, chunk_len):
+            cache, last_logits = prefill_chunk(
+                self.params,
+                self.cfg,
+                tokens[:, ci : ci + chunk_len],
+                pads,
+                cache,
+                jnp.int32(ci),
+            )
+        table = np.asarray(self.allocator.table(seq_id), np.int32) + 1
+        slots = np.arange(S, dtype=np.int32)[None, :]
+        page_ids = table[slots // self.page_size]
+        offsets = slots % self.page_size
+        self.pool = write_tokens(
+            self.pool, cache["k"], cache["v"], page_ids, offsets
+        )
+
+        self._key, sub = jax.random.split(self._key)
+        first = sample_tokens(
+            last_logits,
+            sub,
+            greedy=self.greedy,
+            top_k=self.top_k,
+            temperature=self._temp,
+            top_p=self._top_p,
+        )[0]
+
+        row_table = np.zeros((self.max_pages_per_seq,), np.int32)
+        row_table[: len(table)] = table
+        self.page_table = self.page_table.at[slot].set(jnp.asarray(row_table))
+        self.cur_tok = self.cur_tok.at[slot].set(first)
+        self.cur_len = self.cur_len.at[slot].set(S + 1)
+        self.pad_lens = self.pad_lens.at[slot].set(int(pads_np[0]))
+        self.out_buf = self.out_buf.at[slot].set(0)
+        self.out_buf = self.out_buf.at[slot, 0].set(first)
+        first_is_eos = bool(np.isin(np.asarray(first), self._eos_np))
+        self.n_emitted = self.n_emitted.at[slot].set(1)
+        self.max_new = self.max_new.at[slot].set(req.max_new_tokens)
+        self.active = self.active.at[slot].set(
+            (req.max_new_tokens > 1) and not first_is_eos
+        )
+        self._slot_req[slot] = req
+        self._slot_seq[slot] = seq_id
+        if not self.active[slot]:
+            self._finish_slot(slot)
+        return True
+
+    def _admit(self) -> None:
+        active_np = np.asarray(self.active)
+        for slot in range(self.B):
+            if not self.queue:
+                return
+            if self._slot_req[slot] is None and not active_np[slot]:
+                if not self._admit_one(slot, self.queue[0]):
+                    # Pool full right now: keep the request queued (FIFO)
+                    # and stop admitting until residents free pages.
+                    return
+                self.queue.pop(0)
+                active_np = np.asarray(self.active)
+
+    # -- completion --------------------------------------------------------
+
+    def _finish_slot(self, slot: int) -> None:
+        req = self._slot_req[slot]
+        n = int(self.n_emitted[slot])
+        row = np.asarray(self.out_buf[slot, :n])
+        self.results.append(
+            SchedResult(req_id=req.req_id, tokens=row, n_generated=n)
+        )
+        self.allocator.free_sequence(self._slot_seq[slot])
+        self._slot_req[slot] = None
+
+    def _collect(self) -> None:
+        active_np = np.asarray(self.active)
+        for slot in range(self.B):
+            if self._slot_req[slot] is not None and not active_np[slot]:
+                self._finish_slot(slot)
+
+    # -- main loop ---------------------------------------------------------
+
+    def run_all(self) -> list[SchedResult]:
+        """Drain the queue: admit, decode a chunk, collect, repeat."""
+        while self.queue or any(r is not None for r in self._slot_req):
+            self._admit()
+            if bool(self.active.any()):
+                self._key, sub = jax.random.split(self._key)
+                (
+                    self.pool,
+                    self.cur_tok,
+                    self.cur_len,
+                    self.n_emitted,
+                    self.out_buf,
+                    self.active,
+                ) = scheduler_decode_chunk(
+                    self.params,
+                    self.cfg,
+                    self.pool,
+                    self.page_table,
+                    self.cur_tok,
+                    self.cur_len,
+                    self.pad_lens,
+                    self.n_emitted,
+                    self.max_new,
+                    self.active,
+                    self.out_buf,
+                    self._eos,
+                    sub,
+                    self._temp,
+                    self._top_p,
+                    chunk=self.chunk,
+                    greedy=self.greedy,
+                    top_k=self.top_k,
+                )
+            self._collect()
+        return sorted(self.results, key=lambda r: r.req_id)

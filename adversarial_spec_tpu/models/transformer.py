@@ -363,7 +363,7 @@ def forward_paged_decode(
     write_page: jnp.ndarray,  # [B] physical page for this token's KV
     write_off: jnp.ndarray,  # [B] slot within that page
     bounds: jnp.ndarray,  # [B, 2] (start, end) valid logical-slot window
-    q_pos: jnp.ndarray,  # scalar: logical slot of this token
+    q_pos: jnp.ndarray,  # scalar or [B]: logical slot of this token
     *,
     use_pallas: bool = False,
     pallas_interpret: bool = False,
