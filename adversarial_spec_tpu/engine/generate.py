@@ -233,101 +233,6 @@ def decode_chunk_steps(
     return cache, cur, finished, out_buf, step
 
 
-@partial(
-    jax.jit,
-    static_argnames=(
-        "cfg",
-        "prompt_len",
-        "chunk",
-        "greedy",
-        "top_k",
-        "use_pallas",
-        "pallas_interpret",
-    ),
-    donate_argnames=("pool", "out_buf"),
-)
-def paged_decode_chunk_steps(
-    params: Params,
-    cfg: ModelConfig,
-    pool: Cache,  # {"k","v": [L, n_pages, page_size, Hkv, D]}
-    page_table: jnp.ndarray,  # [B, Pmax]
-    cur_tokens: jnp.ndarray,  # [B]
-    pad_lens: jnp.ndarray,  # [B]
-    finished: jnp.ndarray,  # [B] bool
-    out_buf: jnp.ndarray,  # [B, max_new]
-    start_step: jnp.ndarray,
-    stop_at: jnp.ndarray,
-    eos_ids: jnp.ndarray,
-    key: jax.Array,
-    temperature: jnp.ndarray,
-    top_p: jnp.ndarray,
-    *,
-    prompt_len: int,
-    chunk: int,
-    greedy: bool,
-    top_k: int,
-    use_pallas: bool = False,
-    pallas_interpret: bool = False,
-):
-    """Paged-cache twin of ``decode_chunk_steps``: KV lives in the shared
-    page pool, each step's write target is looked up through the page
-    table, and attention reads via ops/pallas_paged (or its gather
-    reference path)."""
-    from adversarial_spec_tpu.models.transformer import forward_paged_decode
-
-    B = cur_tokens.shape[0]
-    page_size = pool["k"].shape[2]
-    max_new = out_buf.shape[1]
-
-    def cond(state):
-        step, _, _, finished, _, _ = state
-        return (
-            step < _chunk_bound(start_step, chunk, stop_at, max_new)
-        ) & ~finished.all()
-
-    def body(state):
-        step, cur, pool, finished, out_buf, key = state
-        q_pos = prompt_len + step - 1  # logical slot of `cur`'s KV
-        write_page = page_table[jnp.arange(B), q_pos // page_size]
-        write_off = jnp.full((B,), q_pos % page_size)
-        bounds = jnp.stack(
-            [pad_lens, jnp.full((B,), q_pos + 1)], axis=1
-        ).astype(jnp.int32)
-        positions = (q_pos - pad_lens)[:, None]
-        logits, pool = forward_paged_decode(
-            params,
-            cfg,
-            cur[:, None],
-            positions,
-            pool,
-            page_table,
-            write_page,
-            write_off,
-            bounds,
-            q_pos,
-            use_pallas=use_pallas,
-            pallas_interpret=pallas_interpret,
-        )
-        key, nxt, finished, out_buf = _sample_step(
-            logits[:, 0],
-            key,
-            finished,
-            out_buf,
-            step,
-            eos_ids,
-            greedy=greedy,
-            top_k=top_k,
-            temperature=temperature,
-            top_p=top_p,
-        )
-        return step + 1, nxt, pool, finished, out_buf, key
-
-    step, cur, pool, finished, out_buf, key = jax.lax.while_loop(
-        cond, body, (start_step, cur_tokens, pool, finished, out_buf, key)
-    )
-    return pool, cur, finished, out_buf, step
-
-
 @dataclass
 class GenerateResult:
     tokens: np.ndarray  # [B, <=max_new] generated ids (0 past each row's end)
@@ -567,6 +472,13 @@ def generate(
         # on real single-device TPU), overridable by the caller — interpret
         # mode makes the kernel testable on CPU too.
         use_paged_kernel = use_pallas_decode
+        # Per-row decode state for the shared paged loop
+        # (engine/scheduler.py::scheduler_decode_chunk — one loop serves
+        # both this round-synchronous path and the continuous batcher).
+        paged_cur_len = jnp.full((B,), S + 1, jnp.int32)
+        paged_n_emitted = jnp.ones((B,), jnp.int32)
+        paged_max_new = jnp.full((B,), max_new_tokens, jnp.int32)
+        paged_active = ~finished
 
     # Speculative eligibility: greedy, one row, dense cache, one device,
     # enough output budget for at least one γ+1 span — and an explicit
@@ -630,28 +542,41 @@ def generate(
             if (int(step) - step_before) / iters < 1.5:
                 use_spec = False
         elif paged:
-            pool, cur, finished, out_buf, step = paged_decode_chunk_steps(
+            from adversarial_spec_tpu.engine.scheduler import (
+                scheduler_decode_chunk,
+            )
+
+            (
+                pool,
+                cur,
+                paged_cur_len,
+                paged_n_emitted,
+                out_buf,
+                paged_active,
+            ) = scheduler_decode_chunk(
                 params,
                 cfg,
                 pool,
                 page_table,
                 cur,
+                paged_cur_len,
                 pad_lens,
-                finished,
+                paged_n_emitted,
+                paged_max_new,
+                paged_active,
                 out_buf,
-                step,
-                jnp.int32(max_new_tokens),
                 eos,
                 chunk_key,
                 temp,
                 tp,
-                prompt_len=S,
                 chunk=DECODE_CHUNK,
                 greedy=greedy,
                 top_k=top_k,
                 use_pallas=use_paged_kernel,
                 pallas_interpret=pallas_interpret,
             )
+            step = jnp.max(paged_n_emitted)
+            finished = ~paged_active
         else:
             cache, cur, finished, out_buf, step = decode_chunk_steps(
                 params,

@@ -71,7 +71,14 @@ class SchedResult:
 
 @partial(
     jax.jit,
-    static_argnames=("cfg", "chunk", "greedy", "top_k"),
+    static_argnames=(
+        "cfg",
+        "chunk",
+        "greedy",
+        "top_k",
+        "use_pallas",
+        "pallas_interpret",
+    ),
     donate_argnames=("pool", "out_buf"),
 )
 def scheduler_decode_chunk(
@@ -94,8 +101,15 @@ def scheduler_decode_chunk(
     chunk: int,
     greedy: bool,
     top_k: int,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
 ):
-    """Up to ``chunk`` decode steps over whatever rows are active."""
+    """Up to ``chunk`` decode steps over whatever rows are active.
+
+    This is THE paged decode loop — generate()'s round-synchronous paged
+    path calls it too (with uniform initial state), so the per-step
+    write-page lookup, bounds, and sampling glue exist exactly once.
+    """
     B = cur_tok.shape[0]
     page_size = pool["k"].shape[2]
     cap = out_buf.shape[1]
@@ -127,6 +141,8 @@ def scheduler_decode_chunk(
             write_off,
             bounds,
             q_pos,
+            use_pallas=use_pallas,
+            pallas_interpret=pallas_interpret,
         )
         key, sub = jax.random.split(key)
         nxt = sample_tokens(
@@ -210,8 +226,12 @@ class ContinuousBatcher:
             n_kv_heads=cfg.n_kv_heads,
             head_dim=cfg.head_dim,
         )
-        self.pool = init_page_pool(layout, dtype=jnp.float32)
+        self._dtype = jax.tree.leaves(params)[0].dtype
+        self.pool = init_page_pool(layout, dtype=self._dtype)
         self.max_pages_per_seq = -(-(cfg.max_seq_len) // page_size)
+        # Fused paged kernel on real TPUs; gather path elsewhere.
+        self._use_pallas = jax.default_backend() == "tpu"
+        self._pallas_interpret = jax.default_backend() == "cpu"
 
         B, cap = self.B, max_new_cap
         self.cap = cap
@@ -237,6 +257,8 @@ class ContinuousBatcher:
         """Reject infeasible requests up front with actionable errors —
         anything accepted here is guaranteed schedulable once enough
         resident sequences finish."""
+        if req.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
         if req.max_new_tokens > self.cap:
             raise ValueError(
                 f"max_new_tokens {req.max_new_tokens} exceeds scheduler "
@@ -272,7 +294,7 @@ class ContinuousBatcher:
 
         # Prefill the prompt into a throwaway dense cache, then scatter
         # into this sequence's pages (+1 shift: page 0 is trash).
-        cache = init_cache(self.cfg, 1, S, dtype=jnp.float32)
+        cache = init_cache(self.cfg, 1, S, dtype=self._dtype)
         tokens = jnp.asarray(tokens_np)
         pads = jnp.asarray(pads_np)
         chunk_len = min(S, 512)
@@ -388,6 +410,8 @@ class ContinuousBatcher:
                     chunk=self.chunk,
                     greedy=self.greedy,
                     top_k=self.top_k,
+                    use_pallas=self._use_pallas,
+                    pallas_interpret=self._pallas_interpret,
                 )
             self._collect()
         return sorted(self.results, key=lambda r: r.req_id)

@@ -45,10 +45,20 @@ class ModelConfig:
     qkv_bias: bool = False  # qwen-2
     max_seq_len: int = 8192
     norm_scale_plus_one: bool = False  # gemma RMSNorm uses (1 + weight)
+    # Gemma-2 "query_pre_attn_scalar": attention scale is 1/sqrt(this)
+    # instead of 1/sqrt(head_dim). 0 = use head_dim (all other families;
+    # gemma-2-9b's value equals its head_dim, 27b's does NOT: 4608/32=144).
+    query_pre_attn_scalar: float = 0.0
 
     @property
     def q_per_kv(self) -> int:
         return self.n_heads // self.n_kv_heads
+
+    @property
+    def attn_scale(self) -> float:
+        import math
+
+        return 1.0 / math.sqrt(self.query_pre_attn_scalar or self.head_dim)
 
 
 def _llama(dim, n_layers, n_heads, n_kv_heads, ffn_dim, vocab=128256, **kw):
@@ -159,6 +169,37 @@ CONFIGS: dict[tuple[str, str], ModelConfig] = {
         ffn_dim=18944,
         rope_theta=1000000.0,
         qkv_bias=True,
+    ),
+    ("qwen2", "72b"): ModelConfig(
+        vocab_size=152064,
+        dim=8192,
+        n_layers=80,
+        n_heads=64,
+        n_kv_heads=8,
+        head_dim=128,
+        ffn_dim=29568,
+        rope_theta=1000000.0,
+        qkv_bias=True,
+    ),
+    ("gemma2", "27b"): ModelConfig(
+        vocab_size=256000,
+        dim=4608,
+        n_layers=46,
+        n_heads=32,
+        n_kv_heads=16,
+        head_dim=128,
+        ffn_dim=36864,
+        rope_theta=10000.0,
+        activation="gelu",
+        tied_embeddings=True,
+        scale_embeddings=True,
+        post_norms=True,
+        logit_softcap=30.0,
+        attn_softcap=50.0,
+        sliding_window=4096,
+        sliding_window_pattern=2,
+        norm_scale_plus_one=True,
+        query_pre_attn_scalar=144.0,  # dim / n_heads, NOT head_dim
     ),
 }
 

@@ -301,6 +301,7 @@ def forward(
                 v_cache,
                 bounds,
                 attn_softcap=cfg.attn_softcap,
+                scale=cfg.attn_scale,
                 interpret=pallas_interpret,
             )[:, None]
         else:
@@ -314,7 +315,12 @@ def forward(
                 mask = base_mask
 
             out = attention(
-                q, k_cache, v_cache, mask, attn_softcap=cfg.attn_softcap
+                q,
+                k_cache,
+                v_cache,
+                mask,
+                attn_softcap=cfg.attn_softcap,
+                scale=cfg.attn_scale,
             )
         x = _attn_out_and_ffn(x, out, lp, cfg, B, S)
         return x, (k_cache, v_cache)
@@ -413,6 +419,7 @@ def forward_paged_decode(
                 page_table,
                 layer_bounds,
                 attn_softcap=cfg.attn_softcap,
+                scale=cfg.attn_scale,
                 interpret=pallas_interpret,
             )[:, None]
         else:
@@ -435,7 +442,12 @@ def forward_paged_decode(
                 & (slot < layer_bounds[:, 1][:, None, None])
             )
             out = attention(
-                q, k_dense, v_dense, mask, attn_softcap=cfg.attn_softcap
+                q,
+                k_dense,
+                v_dense,
+                mask,
+                attn_softcap=cfg.attn_softcap,
+                scale=cfg.attn_scale,
             )
         x = _attn_out_and_ffn(x, out, lp, cfg, B, 1)
         return x, (k_pages, v_pages)

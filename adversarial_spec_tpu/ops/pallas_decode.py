@@ -101,7 +101,7 @@ def _decode_attn_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("attn_softcap", "interpret")
+    jax.jit, static_argnames=("attn_softcap", "scale", "interpret")
 )
 def decode_attention(
     q: jnp.ndarray,  # [B, Hq, D] one query token per row
@@ -109,6 +109,7 @@ def decode_attention(
     v_cache: jnp.ndarray,  # [B, T, Hkv, D]
     bounds: jnp.ndarray,  # [B, 2] int32 (start, end) valid slot window
     attn_softcap: float = 0.0,
+    scale: float | None = None,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Fused decode attention. Returns [B, Hq, D] in q.dtype."""
@@ -116,7 +117,7 @@ def decode_attention(
     T, Hkv = k_cache.shape[1], k_cache.shape[2]
     g = Hq // Hkv
     G8 = max(_SUBLANE, g)
-    scale = 1.0 / math.sqrt(D)
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
     # Largest tileable block that divides the (static) cache length.
     block_t = next(
         (b for b in (BLOCK_T, 128, 64, 32, 16, 8) if T % b == 0), T

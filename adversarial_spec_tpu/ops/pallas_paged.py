@@ -98,7 +98,7 @@ def _paged_attn_kernel(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("attn_softcap", "interpret")
+    jax.jit, static_argnames=("attn_softcap", "scale", "interpret")
 )
 def paged_decode_attention(
     q: jnp.ndarray,  # [B, Hq, D]
@@ -107,6 +107,7 @@ def paged_decode_attention(
     page_table: jnp.ndarray,  # [B, P] int32, -1 = unmapped
     bounds: jnp.ndarray,  # [B, 2] int32 (start, end) token window
     attn_softcap: float = 0.0,
+    scale: float | None = None,
     interpret: bool = False,
 ) -> jnp.ndarray:
     """Fused paged decode attention. Returns [B, Hq, D]."""
@@ -115,7 +116,7 @@ def paged_decode_attention(
     P = page_table.shape[1]
     g = Hq // Hkv
     G8 = max(_SUBLANE, g)
-    scale = 1.0 / math.sqrt(D)
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
 
     qg = q.reshape(B, Hkv, g, D)
     if G8 != g:

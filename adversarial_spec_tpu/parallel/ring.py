@@ -79,12 +79,13 @@ def ring_attention_local(
     causal: bool = True,
     kv_start: jnp.ndarray | None = None,  # [B] first valid global slot
     attn_softcap: float = 0.0,
+    scale: float | None = None,
     axis_name: str = SP,
 ) -> jnp.ndarray:
     """Per-device ring attention body (call inside shard_map over sp)."""
     idx = jax.lax.axis_index(axis_name)
     B, Sq, H, D = qb.shape
-    scale = 1.0 / math.sqrt(D)
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
     m = jnp.full((B, H, Sq), -jnp.inf, jnp.float32)
     l = jnp.zeros((B, H, Sq), jnp.float32)
     acc = jnp.zeros((B, Sq, H, D), jnp.float32)

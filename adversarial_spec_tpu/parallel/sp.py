@@ -92,6 +92,7 @@ def sp_prefill(
                 causal=True,
                 kv_start=pad_lens_rep,
                 attn_softcap=cfg.attn_softcap,
+                scale=cfg.attn_scale,
             )
             x = _attn_out_and_ffn(x, out, lp, cfg, B, S_loc)
             return x, (k, v)

@@ -1,0 +1,143 @@
+"""End-to-end debate-loop integration tests, driven the way the L5 agent
+drives it: repeated `critique` CLI invocations with sessions, feeding each
+round's revised spec forward until all models agree (BASELINE configs 1
+and 4's loop shape, on the mock engine)."""
+
+import io
+import json
+
+from adversarial_spec_tpu import cli
+from adversarial_spec_tpu.debate.session import SessionState
+from adversarial_spec_tpu.debate import session as session_mod
+
+SPEC = """# Notification Service
+
+Sends notifications to users over email and push.
+
+## Scope
+Initial version targets transactional messages only.
+"""
+
+
+def _round(monkeypatch, capsys, argv, stdin=None):
+    if stdin is not None:
+        monkeypatch.setattr("sys.stdin", io.StringIO(stdin))
+    code = cli.main(argv)
+    out, err = capsys.readouterr()
+    assert code == 0, err
+    return json.loads(out)
+
+
+class TestFullDebateLoop:
+    def test_converges_with_sessions_and_resume(self, monkeypatch, capsys):
+        """Multi-round loop: 4 opponents with different agreement
+        thresholds converge by round 3; every round resumes the session
+        and carries the revised spec forward; checkpoints accumulate."""
+        models = (
+            "mock://agree,"
+            "mock://critic?agree_after=2,"
+            "mock://critic?agree_after=3,"
+            # Transient failure on its first call, then a critic that
+            # agrees from round 2 on.
+            "mock://flaky?fail=1&agree_after=2"
+        )
+        data = _round(
+            monkeypatch,
+            capsys,
+            [
+                "critique",
+                "--models",
+                models,
+                "--doc-type",
+                "tech",
+                "--session",
+                "e2e",
+                "--json",
+            ],
+            stdin=SPEC,
+        )
+        assert data["round"] == 1
+        assert data["all_agreed"] is False
+
+        rounds = [data]
+        for _ in range(6):
+            data = _round(
+                monkeypatch, capsys, ["critique", "--resume", "e2e", "--json"]
+            )
+            rounds.append(data)
+            if data["all_agreed"]:
+                break
+        assert data["all_agreed"] is True
+        assert data["round"] == 3  # agree_after=3 is the last holdout
+
+        # Spec evolved across rounds (revision notes accumulated).
+        final_state = SessionState.load("e2e")
+        assert "Revision note" in final_state.spec
+        assert final_state.round == 4
+        assert len(final_state.history) == 3
+
+        # Per-round checkpoints exist for rollback.
+        ckpts = sorted(
+            p.name for p in session_mod.CHECKPOINTS_DIR.glob("e2e-round-*.md")
+        )
+        assert ckpts == ["e2e-round-1.md", "e2e-round-2.md", "e2e-round-3.md"]
+
+    def test_press_round_after_quick_consensus(self, monkeypatch, capsys):
+        """The L5 protocol's press rule: round-1 unanimous agreement is
+        re-challenged with --press; the mock pool agrees again and the
+        press prompt reached the models."""
+        data = _round(
+            monkeypatch,
+            capsys,
+            ["critique", "--models", "mock://agree,mock://agree", "--json"],
+            stdin=SPEC,
+        )
+        assert data["all_agreed"] is True and data["round"] == 1
+
+        pressed = _round(
+            monkeypatch,
+            capsys,
+            [
+                "critique",
+                "--models",
+                "mock://agree,mock://agree",
+                "--press",
+                "--round",
+                "1",
+                "--json",
+            ],
+            stdin=SPEC,
+        )
+        assert pressed["all_agreed"] is True
+
+    def test_cost_accumulates_across_rounds(self, monkeypatch, capsys):
+        total = 0.0
+        for r in (1, 2):
+            data = _round(
+                monkeypatch,
+                capsys,
+                [
+                    "critique",
+                    "--models",
+                    "mock://critic",
+                    "--round",
+                    str(r),
+                    "--json",
+                ],
+                stdin=SPEC,
+            )
+            assert data["cost"]["total_cost_usd"] > 0
+            total += data["cost"]["total_cost_usd"]
+        assert total > 0
+
+    def test_final_flow_export_tasks(self, monkeypatch, capsys):
+        """Post-convergence: the final spec exports to structured tasks."""
+        data = _round(
+            monkeypatch,
+            capsys,
+            ["export-tasks", "--models", "mock://tasks", "--json"],
+            stdin=SPEC,
+        )
+        assert len(data) == 3
+        titles = [t["title"] for t in data]
+        assert "Define data model" in titles

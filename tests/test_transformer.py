@@ -179,6 +179,34 @@ def test_hf_parity_tiny(family, hf_name, tmp_path):
     )
 
 
+def test_attn_scale_override():
+    """Gemma-2-27B scales queries by 1/sqrt(dim/n_heads)=1/sqrt(144), not
+    1/sqrt(head_dim)=1/sqrt(128); other configs use head_dim."""
+    import math
+
+    c27 = get_config("gemma2", "27b")
+    assert c27.query_pre_attn_scalar == 144.0
+    assert abs(c27.attn_scale - 1 / math.sqrt(144)) < 1e-12
+    c9 = get_config("gemma2", "9b")
+    assert abs(c9.attn_scale - 1 / math.sqrt(c9.head_dim)) < 1e-12
+    cl = get_config("llama", "8b")
+    assert abs(cl.attn_scale - 1 / math.sqrt(cl.head_dim)) < 1e-12
+
+
+def test_scale_changes_logits():
+    """The configured attention scale must actually reach the kernels:
+    same weights, different query_pre_attn_scalar → different logits."""
+    from dataclasses import replace
+
+    cfg = get_config("llama", "tiny")
+    params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+    ids = jnp.array([[1, 7, 42, 9]], jnp.int32)
+    a, _ = _full_forward(params, cfg, ids, 4)
+    cfg2 = replace(cfg, query_pre_attn_scalar=float(cfg.head_dim) * 4)
+    b, _ = _full_forward(params, cfg2, ids, 4)
+    assert not np.allclose(np.asarray(a), np.asarray(b))
+
+
 def test_count_params():
     cfg = get_config("llama", "tiny")
     params = T.init_params(jax.random.key(0), cfg)
