@@ -78,7 +78,7 @@ PREFILL_CHUNK = 1024
 
 def _sample_step(
     logits, key, finished, out_buf, step, eos_ids, *, greedy, top_k,
-    temperature, top_p,
+    temperature, top_p, use_top_p=True,
 ):
     """Shared per-decode-step tail for BOTH cache layouts: sample, record
     EOS (the EOS token itself is kept; finished rows emit 0 thereafter),
@@ -93,6 +93,7 @@ def _sample_step(
         top_k=top_k,
         temperature=temperature,
         top_p=top_p,
+        use_top_p=use_top_p,
     )
     is_eos = (nxt[:, None] == eos_ids[None, :]).any(axis=-1)
     nxt = jnp.where(finished, 0, nxt)
@@ -149,6 +150,7 @@ def prefill_chunk(
         "chunk",
         "greedy",
         "top_k",
+        "use_top_p",
         "use_pallas_decode",
         "pallas_interpret",
     ),
@@ -173,6 +175,7 @@ def decode_chunk_steps(
     chunk: int,
     greedy: bool,
     top_k: int,
+    use_top_p: bool = True,
     use_pallas_decode: bool = False,
     pallas_interpret: bool = False,
 ) -> tuple[Cache, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
@@ -222,6 +225,7 @@ def decode_chunk_steps(
             top_k=top_k,
             temperature=temperature,
             top_p=top_p,
+            use_top_p=use_top_p,
         )
         return step + 1, nxt, cache, finished, out_buf, key
 
@@ -329,6 +333,7 @@ def generate(
     key, prefill_key = jax.random.split(key)
     temp = jnp.float32(temperature)
     tp = jnp.float32(top_p)
+    use_top_p = float(top_p) < 1.0  # static: skip the no-op vocab sort
     eos = jnp.asarray(sorted(set(eos_ids)) or [-1], dtype=jnp.int32)
 
     deadline = time.monotonic() + timeout_s if timeout_s > 0 else None
@@ -422,6 +427,7 @@ def generate(
         top_k=top_k,
         temperature=temp,
         top_p=tp,
+        use_top_p=use_top_p,
     )
     first.block_until_ready()
     prefill_time = time.monotonic() - t0
@@ -572,6 +578,7 @@ def generate(
                 chunk=DECODE_CHUNK,
                 greedy=greedy,
                 top_k=top_k,
+                use_top_p=use_top_p,
                 use_pallas=use_paged_kernel,
                 pallas_interpret=pallas_interpret,
             )
@@ -596,6 +603,7 @@ def generate(
                 chunk=DECODE_CHUNK,
                 greedy=greedy,
                 top_k=top_k,
+                use_top_p=use_top_p,
                 use_pallas_decode=use_pallas_decode,
                 pallas_interpret=pallas_interpret,
             )

@@ -19,8 +19,14 @@ def sample_tokens(
     top_k: int,
     temperature: jnp.ndarray,  # scalar f32
     top_p: jnp.ndarray,  # scalar f32
+    use_top_p: bool = True,
 ) -> jnp.ndarray:
-    """Sample one token per row. Returns [B] int32."""
+    """Sample one token per row. Returns [B] int32.
+
+    ``use_top_p`` is a static switch: callers that know (at trace time)
+    top_p >= 1 skip the full-vocab sort/cumsum entirely — it would be a
+    semantic no-op that still costs a vocab-sized sort per decode step.
+    """
     if greedy:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
@@ -32,18 +38,20 @@ def sample_tokens(
         kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
         scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
 
-    # Top-p (nucleus): drop tokens outside the smallest prefix of the
-    # probability-sorted vocab whose mass exceeds top_p. top_p >= 1 is a
-    # no-op via the mask.
-    sorted_logits = jnp.sort(scaled, axis=-1)[..., ::-1]
-    sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
-    cumulative = jnp.cumsum(sorted_probs, axis=-1)
-    # Keep the first token whose cumulative crosses top_p, drop the rest.
-    cutoff_mask = cumulative - sorted_probs > top_p
-    cutoff_logit = jnp.min(
-        jnp.where(cutoff_mask, jnp.inf, sorted_logits), axis=-1, keepdims=True
-    )
-    scaled = jnp.where(scaled < cutoff_logit, -jnp.inf, scaled)
+    if use_top_p:
+        # Top-p (nucleus): drop tokens outside the smallest prefix of the
+        # probability-sorted vocab whose mass exceeds top_p.
+        sorted_logits = jnp.sort(scaled, axis=-1)[..., ::-1]
+        sorted_probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cumulative = jnp.cumsum(sorted_probs, axis=-1)
+        # Keep the first token whose cumulative crosses top_p.
+        cutoff_mask = cumulative - sorted_probs > top_p
+        cutoff_logit = jnp.min(
+            jnp.where(cutoff_mask, jnp.inf, sorted_logits),
+            axis=-1,
+            keepdims=True,
+        )
+        scaled = jnp.where(scaled < cutoff_logit, -jnp.inf, scaled)
 
     sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
     argmax = jnp.argmax(logits, axis=-1).astype(jnp.int32)

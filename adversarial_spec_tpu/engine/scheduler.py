@@ -26,7 +26,7 @@ exercised directly in tests/test_scheduler.py.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from functools import partial
 
 import jax
@@ -76,6 +76,7 @@ class SchedResult:
         "chunk",
         "greedy",
         "top_k",
+        "use_top_p",
         "use_pallas",
         "pallas_interpret",
     ),
@@ -101,6 +102,7 @@ def scheduler_decode_chunk(
     chunk: int,
     greedy: bool,
     top_k: int,
+    use_top_p: bool = True,
     use_pallas: bool = False,
     pallas_interpret: bool = False,
 ):
@@ -152,6 +154,7 @@ def scheduler_decode_chunk(
             top_k=top_k,
             temperature=temperature,
             top_p=top_p,
+            use_top_p=use_top_p,
         )
         is_eos = (nxt[:, None] == eos_ids[None, :]).any(axis=-1)
         nxt = jnp.where(active, nxt, 0)
@@ -214,6 +217,7 @@ class ContinuousBatcher:
             sorted(set(eos_ids or [])) or [-1], jnp.int32
         )
         self._eos_np = np.asarray(sorted(set(eos_ids or [])) or [-1])
+        self._use_top_p = float(top_p) < 1.0
         self._key = jax.random.key(seed)
 
         n_pages = -(-capacity_tokens // page_size)
@@ -323,6 +327,7 @@ class ContinuousBatcher:
             top_k=self.top_k,
             temperature=self._temp,
             top_p=self._top_p,
+            use_top_p=self._use_top_p,
         )[0]
 
         row_table = np.zeros((self.max_pages_per_seq,), np.int32)
@@ -410,6 +415,7 @@ class ContinuousBatcher:
                     chunk=self.chunk,
                     greedy=self.greedy,
                     top_k=self.top_k,
+                    use_top_p=self._use_top_p,
                     use_pallas=self._use_pallas,
                     pallas_interpret=self._pallas_interpret,
                 )

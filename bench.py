@@ -2,8 +2,10 @@
 
 Measures the north-star metric (BASELINE.json): decode throughput of one
 debate round's opponent pool run as a single batched generate — 4 opponents
-(batch rows) sharing one model, greedy decode, synthetic weights (zero
-egress). Baseline target: 1500 critique tokens/sec/chip.
+(batch rows) critiquing the SAME spec prompt on one model (shared-prefix
+prefill fires), temperature-0.7 sampling with a fixed seed so rows diverge
+the way a real round does, synthetic weights (zero egress). Baseline
+target: 1500 critique tokens/sec/chip.
 
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": "tok/s/chip", "vs_baseline": N/1500}
@@ -63,11 +65,12 @@ def _run_bench(platform: str) -> dict:
         dtype=jnp.bfloat16 if platform != "cpu" else jnp.float32,
     )
 
+    # The real debate-round shape: every opponent critiques the SAME spec
+    # prompt (shared-prefix prefill fires on one chip), and temperature
+    # sampling diverges the rows — exactly what a critique round does.
     rng = __import__("random").Random(0)
-    prompts = [
-        [rng.randrange(3, cfg.vocab_size) for _ in range(PROMPT_TOKENS)]
-        for _ in range(N_OPPONENTS)
-    ]
+    prompt = [rng.randrange(3, cfg.vocab_size) for _ in range(PROMPT_TOKENS)]
+    prompts = [list(prompt) for _ in range(N_OPPONENTS)]
 
     # Multi-chip: shard the round over a dp×tp mesh so every chip
     # participates before dividing by chip count; single chip (the usual
@@ -89,7 +92,8 @@ def _run_bench(platform: str) -> dict:
     kw = dict(
         max_new_tokens=DECODE_TOKENS,
         eos_ids=[],  # synthetic model: measure the full decode length
-        greedy=True,
+        temperature=0.7,
+        seed=0,
         mesh=mesh,
     )
     # Warmup: compile prefill + decode chunk.
