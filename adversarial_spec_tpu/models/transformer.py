@@ -182,11 +182,22 @@ def _project_qkv(lp, cfg: ModelConfig, h, B: int, S: int, cos, sin):
     return q, k, v
 
 
-def _attn_out_and_ffn(x, attn_out, lp, cfg: ModelConfig, B: int, S: int):
-    """Shared post-attention projection, residuals, and FFN block."""
+def _attn_out_and_ffn(
+    x, attn_out, lp, cfg: ModelConfig, B: int, S: int, psum_axis=None
+):
+    """Shared post-attention projection, residuals, and FFN block.
+
+    ``psum_axis``: when running inside a manual-collective region
+    (shard_map) with Megatron-style TP, the row-parallel matmuls (wo,
+    w_down) produce partial sums that must all-reduce over the tp axis —
+    BEFORE any post-norm reads them (norms of partial sums are wrong).
+    Under GSPMD (jit) leave it None; the compiler inserts the psums.
+    """
     out = matmul(
         attn_out.reshape(B, S, cfg.n_heads * cfg.head_dim), lp["wo"]
     )
+    if psum_axis is not None:
+        out = jax.lax.psum(out, psum_axis)
     if cfg.post_norms:
         out = rms_norm(
             out, lp["post_attn_norm"], cfg.rms_eps, cfg.norm_scale_plus_one
@@ -198,6 +209,8 @@ def _attn_out_and_ffn(x, attn_out, lp, cfg: ModelConfig, B: int, S: int):
         h, lp["w_up"]
     )
     ff = matmul(ff, lp["w_down"])
+    if psum_axis is not None:
+        ff = jax.lax.psum(ff, psum_axis)
     if cfg.post_norms:
         ff = rms_norm(
             ff, lp["post_ffn_norm"], cfg.rms_eps, cfg.norm_scale_plus_one

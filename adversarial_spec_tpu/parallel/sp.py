@@ -1,26 +1,36 @@
 """Sequence-parallel (long-context) prefill: the whole transformer forward
-with the sequence axis sharded over the ``sp`` mesh axis.
+with the sequence axis sharded over ``sp`` — composable with tensor
+parallelism over ``tp``.
 
-BASELINE config 5 is a 16k-context PRD; at that length a single chip's
-prefill is attention-memory-bound. Here the prompt is split into ``sp``
-contiguous blocks (one per device): embeddings, QKV projections, and FFNs
-run on local blocks only, and attention runs as a ring
-(parallel/ring.py::ring_attention_local — ppermute of K/V blocks around
-the ICI ring with online-softmax accumulation). Activation and attention
-memory are O(S/sp) per device; the only cross-device traffic is the K/V
-ring (plus whatever collectives GSPMD inserts for tp-sharded weights).
+BASELINE config 5 is a 16k-context PRD against a TP=8 70B judge; at that
+shape prefill needs BOTH axes at once. Inside one shard_map over the full
+mesh:
 
-The resulting KV cache comes back sequence-sharded; the caller reshards
-it to the decode layout (batch over dp) — decode is token-at-a-time and
-has no sequence axis worth sharding.
+- the prompt is split into ``sp`` contiguous blocks (embeddings, QKV
+  projections, FFNs run on local blocks; attention is a K/V ring over the
+  sp axis — parallel/ring.py);
+- weights enter tp-sharded per the Megatron rules (parallel/sharding.py):
+  this is a manual-collective region, so the body works on a "shard view"
+  of the config (heads/FFN columns divided by tp) and the row-parallel
+  matmuls all-reduce explicitly (``psum_axis`` in the shared layer tail);
+- last-position logits are vocab-sharded under tp (column-parallel
+  lm_head) and all-gather only at the very end.
 
-Constraints (v1): global attention only (no sliding window — Llama-style
-families; windowed families raise), and the padded length must divide sp.
+Activation and attention memory are O(S/sp) per device; K/V ring traffic
+rides sp-neighbor ICI links and the TP all-reduces ride the tp axis.
+
+The resulting KV cache comes back sequence-sharded (heads tp-sharded);
+the caller reshards to the decode layout (batch over dp, heads over tp).
+
+Constraints (v1): global attention only (no sliding window — windowed
+families prefill chunked on one device), and the padded length must
+divide sp; n_heads/n_kv_heads/ffn_dim/vocab must divide tp.
 """
 
 from __future__ import annotations
 
 import math
+from dataclasses import replace
 from functools import partial
 
 import jax
@@ -35,8 +45,17 @@ from adversarial_spec_tpu.models.transformer import (
     rms_norm,
 )
 from adversarial_spec_tpu.ops.rope import rope_angles
-from adversarial_spec_tpu.parallel.mesh import SP
+from adversarial_spec_tpu.parallel.mesh import SP, TP
 from adversarial_spec_tpu.parallel.ring import ring_attention_local
+from adversarial_spec_tpu.parallel.sharding import param_sharding_rules
+
+
+def _param_in_specs(params):
+    """Per-leaf PartitionSpecs for shard_map: the tp placements from the
+    Megatron rules (sp/dp never appear on weights)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: param_sharding_rules(path), params
+    )
 
 
 @partial(jax.jit, static_argnames=("cfg", "mesh"))
@@ -47,10 +66,10 @@ def sp_prefill(
     pad_lens: jnp.ndarray,  # [B]
     mesh: Mesh,
 ):
-    """Sequence-parallel prefill over the full prompt.
+    """Sequence-parallel (× tensor-parallel) prefill over the full prompt.
 
     Returns (last_logits [B, vocab] f32, cache {"k","v": [L, B, S, Hkv, D]}
-    sequence-sharded over sp).
+    sequence-sharded over sp and head-sharded over tp).
     """
     if cfg.sliding_window > 0:
         raise NotImplementedError(
@@ -59,12 +78,38 @@ def sp_prefill(
             "chunked on one device"
         )
     sp = mesh.shape[SP]
+    tp = mesh.shape[TP]
     B, S = tokens.shape
     if S % sp != 0:
         raise ValueError(f"padded length {S} not divisible by sp={sp}")
+    if tp > 1 and (
+        cfg.n_heads % tp
+        or cfg.n_kv_heads % tp
+        or cfg.ffn_dim % tp
+        or cfg.vocab_size % tp
+    ):
+        raise ValueError(
+            f"tp={tp} must divide n_heads={cfg.n_heads}, "
+            f"n_kv_heads={cfg.n_kv_heads}, ffn_dim={cfg.ffn_dim}, "
+            f"vocab={cfg.vocab_size}"
+        )
 
-    def local(tokens_l, pad_lens_rep, params_rep):
-        # tokens_l: [B, S/sp] — this device's contiguous block.
+    # The body sees LOCAL shards: express the per-device shapes as a
+    # shard-view config (full head_dim/dim; heads and FFN columns split).
+    local_cfg = (
+        replace(
+            cfg,
+            n_heads=cfg.n_heads // tp,
+            n_kv_heads=cfg.n_kv_heads // tp,
+            ffn_dim=cfg.ffn_dim // tp,
+        )
+        if tp > 1
+        else cfg
+    )
+    psum_axis = TP if tp > 1 else None
+
+    def local(tokens_l, pad_lens_rep, params_l):
+        # tokens_l: [B, S/sp]; params_l: tp-local weight shards.
         idx = jax.lax.axis_index(SP)
         S_loc = tokens_l.shape[1]
         base = idx * S_loc
@@ -75,7 +120,7 @@ def sp_prefill(
         )
         cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
 
-        x = params_rep["embed"][tokens_l]
+        x = params_l["embed"][tokens_l]  # embed is tp-replicated
         if cfg.scale_embeddings:
             x = (x.astype(jnp.float32) * math.sqrt(cfg.dim)).astype(x.dtype)
 
@@ -83,7 +128,7 @@ def sp_prefill(
             h = rms_norm(
                 x, lp["attn_norm"], cfg.rms_eps, cfg.norm_scale_plus_one
             )
-            q, k, v = _project_qkv(lp, cfg, h, B, S_loc, cos, sin)
+            q, k, v = _project_qkv(lp, local_cfg, h, B, S_loc, cos, sin)
             out = ring_attention_local(
                 q,
                 k.astype(jnp.float32),
@@ -94,29 +139,36 @@ def sp_prefill(
                 attn_softcap=cfg.attn_softcap,
                 scale=cfg.attn_scale,
             )
-            x = _attn_out_and_ffn(x, out, lp, cfg, B, S_loc)
+            x = _attn_out_and_ffn(
+                x, out, lp, local_cfg, B, S_loc, psum_axis=psum_axis
+            )
             return x, (k, v)
 
-        x, (k_all, v_all) = jax.lax.scan(
-            layer_body, x, params_rep["layers"]
-        )
+        x, (k_all, v_all) = jax.lax.scan(layer_body, x, params_l["layers"])
 
-        # Last-position logits exist only on the last device; other
-        # devices compute on their block and the caller's psum keeps SPMD
-        # shapes uniform (their contribution is zeroed).
+        # Last-position logits: the shared lm-head tail (final norm +
+        # tied/untied projection + softcap — one source of truth with the
+        # dense path), computed on every sp block for SPMD uniformity,
+        # zeroed except on the last block, psum'd over sp. Under tp the
+        # lm_head is column-parallel; softcap is elementwise so it
+        # commutes with the vocab all-gather.
         logits_local = _lm_head_logits(
-            params_rep, cfg, x, lm_head_last_only=True
+            params_l, cfg, x, lm_head_last_only=True
         )[:, 0]
+        if tp > 1 and not cfg.tied_embeddings:
+            logits_local = jax.lax.all_gather(
+                logits_local, TP, axis=1, tiled=True
+            )
         logits_local = jnp.where(idx == sp - 1, logits_local, 0.0)
         logits = jax.lax.psum(logits_local, SP)
         return logits, k_all, v_all
 
     seq_spec = P(None, SP)
-    cache_spec = P(None, None, SP, None, None)  # [L, B, S(sp), Hkv, D]
+    cache_spec = P(None, None, SP, TP, None)  # [L, B, S(sp), Hkv(tp), D]
     logits, k_all, v_all = jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(seq_spec, P(None), P()),
+        in_specs=(seq_spec, P(None), _param_in_specs(params)),
         out_specs=(P(None, None), cache_spec, cache_spec),
         check_vma=False,
     )(tokens, pad_lens, params)

@@ -201,6 +201,66 @@ class TestSequenceParallelPrefill:
             out = generate(sharded, cfg, prompts, mesh=mesh, **kw)
         np.testing.assert_array_equal(ref.tokens, out.tokens)
 
+    def test_sp_times_tp_matches_dense(self):
+        """tp×sp composition (the config-5 shape: TP judge + long
+        context): manual-collective TP inside the sp shard_map must
+        reproduce dense single-device prefill exactly."""
+        from adversarial_spec_tpu.engine.generate import prefill_chunk
+        from adversarial_spec_tpu.parallel.sp import sp_prefill
+
+        cfg = get_config("llama", "tiny")  # 4 heads, 2 kv heads
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        mesh = make_mesh({"sp": 4, "tp": 2, "dp": 1})
+        sharded = shard_params(mesh, params)
+        B, S = 2, 32
+        tokens = jax.random.randint(
+            jax.random.key(7), (B, S), 0, cfg.vocab_size
+        )
+        pad_lens = jnp.array([5, 0], jnp.int32)
+        tokens = jnp.where(
+            jnp.arange(S)[None, :] < pad_lens[:, None], 0, tokens
+        )
+        with mesh:
+            logits_sp, cache_sp = sp_prefill(
+                sharded, cfg, tokens, pad_lens, mesh
+            )
+        dense_cache = T.init_cache(cfg, B, S, dtype=jnp.float32)
+        dense_cache, ref_logits = prefill_chunk(
+            params, cfg, tokens, pad_lens, dense_cache, jnp.int32(0)
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits_sp), np.asarray(ref_logits), rtol=2e-4, atol=2e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(cache_sp["k"]),
+            np.asarray(dense_cache["k"]),
+            rtol=2e-4,
+            atol=2e-4,
+        )
+
+    def test_generate_on_sp_tp_dp_mesh(self):
+        """All three axes at once through the public generate()."""
+        cfg = get_config("llama", "tiny")
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        prompts = [[1, 5, 9, 3], [2, 6, 4, 8]]
+        kw = dict(max_new_tokens=4, eos_ids=[], greedy=True)
+        ref = generate(params, cfg, prompts, **kw)
+        mesh = make_mesh({"sp": 2, "tp": 2, "dp": 2})
+        sharded = shard_params(mesh, params)
+        with mesh:
+            out = generate(sharded, cfg, prompts, mesh=mesh, **kw)
+        np.testing.assert_array_equal(ref.tokens, out.tokens)
+
+    def test_sp_tp_indivisible_heads_raises(self):
+        from adversarial_spec_tpu.parallel.sp import sp_prefill
+
+        cfg = get_config("llama", "tiny")  # 2 kv heads
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        mesh = make_mesh({"sp": 2, "tp": 4})
+        tokens = jnp.zeros((1, 32), jnp.int32)
+        with pytest.raises(ValueError, match="must divide"):
+            sp_prefill(params, cfg, tokens, jnp.zeros((1,), jnp.int32), mesh)
+
     def test_sp_prefill_rejects_sliding_window(self):
         from adversarial_spec_tpu.parallel.sp import sp_prefill
 
