@@ -10,7 +10,7 @@ from adversarial_spec_tpu.debate.core import (
 )
 from adversarial_spec_tpu.debate.prompts import PRESS_PROMPT_TEMPLATE
 from adversarial_spec_tpu.engine.mock import MockEngine
-from adversarial_spec_tpu.engine.types import ChatRequest, SamplingParams
+from adversarial_spec_tpu.engine.types import SamplingParams
 
 import pytest
 

@@ -8,8 +8,6 @@ but the faults injected are the TPU engine's real failure modes
 generate seam inside the real TpuEngine.
 """
 
-import pytest
-
 from adversarial_spec_tpu.debate.core import RoundConfig, run_round
 from adversarial_spec_tpu.engine import tpu as tpu_mod
 from adversarial_spec_tpu.engine.dispatch import _ENGINE_CACHE
