@@ -196,6 +196,12 @@ def create_parser() -> argparse.ArgumentParser:
         default="dense",
         help="KV-cache layout for decode",
     )
+    r.add_argument(
+        "--kv-dtype",
+        choices=["", "int8"],
+        default="",
+        help="KV-cache storage dtype (int8 halves cache HBM)",
+    )
     return parser
 
 
@@ -595,6 +601,12 @@ def handle_registry(args: argparse.Namespace, rest: list[str]) -> int:
         if len(rest) < 2:
             _err("usage: debate registry add-model <alias> --checkpoint DIR")
             return EXIT_VALIDATION
+        if args.kv == "paged" and args.kv_dtype == "int8":
+            _err(
+                "error: --kv paged does not support --kv-dtype int8 yet "
+                "(int8 KV applies to the dense cache)"
+            )
+            return EXIT_VALIDATION
         alias = rest[1]
         spec = model_registry.ModelSpec(
             alias=alias,
@@ -606,6 +618,7 @@ def handle_registry(args: argparse.Namespace, rest: list[str]) -> int:
             mesh={"tp": args.tp} if args.tp else {},
             quant=args.quant,
             kv=args.kv,
+            kv_dtype=args.kv_dtype,
         )
         model_registry.save_registry_entry(spec)
         print(f"registered tpu://{alias}")

@@ -267,6 +267,7 @@ def generate(
     paged: bool = False,
     page_size: int = 128,
     speculative: bool | None = None,
+    kv_dtype: str = "",
 ) -> GenerateResult:
     """End-to-end batched generation (host orchestration).
 
@@ -294,8 +295,30 @@ def generate(
     tokens from n-gram matches in the prompt and verify several per
     forward; bit-identical outputs, multiple tokens per step on
     revision-style outputs. None = auto (on when eligible).
+
+    ``kv_dtype="int8"``: store the dense KV cache int8 with per-token-head
+    scales — half the cache HBM (and half the bytes read per decoded
+    token on the jnp attention path). Dense single-device path only:
+    forces the jnp attention implementation (the fused kernels read raw
+    K/V; int8 kernel tiles are round-2 work) and is ignored for paged
+    and sp-prefill runs.
     """
+    if kv_dtype == "int8" and (paged or (mesh is not None and mesh.size > 1)):
+        import sys as _sys
+
+        print(
+            "warning: kv_dtype=int8 applies to the dense single-device "
+            "cache only; using full-precision KV here",
+            file=_sys.stderr,
+        )
+        kv_dtype = ""
+    # An explicit use_pallas_decode=True records caller intent (it gates
+    # auto-speculation) BEFORE the int8-KV override clears the flag.
     explicit_pallas = use_pallas_decode is True
+    if kv_dtype == "int8":
+        # The fused kernels read raw-dtype K/V tiles; int8 cache decodes
+        # through the (dequant-fused) jnp attention path.
+        use_pallas_decode = False
     if use_pallas_decode is None:
         # Auto: fused kernel on a real single-device TPU; jnp path for
         # GSPMD-sharded meshes (the kernel isn't partitionable) and CPU.
@@ -405,6 +428,7 @@ def generate(
             S if paged else total_len,
             dtype=params["embed"].dtype,
             device=cache_device,
+            kv_dtype=kv_dtype,
         )
         chunk_len = min(S, PREFILL_CHUNK)
         last_logits = None

@@ -38,6 +38,7 @@ class ModelSpec:
     max_seq_len: int = 8192
     quant: str = ""  # "" = full precision, "int8" = weight-only int8
     kv: str = "dense"  # "dense" | "paged" — KV-cache layout for decode
+    kv_dtype: str = ""  # "" = model dtype, "int8" = quantized KV cache
 
     def to_dict(self) -> dict:
         return asdict(self)

@@ -94,6 +94,18 @@ class TpuEngine:
         self._evict_to(MAX_RESIDENT_MODELS - 1)
         maybe_initialize_distributed()
         mesh = make_mesh(spec.mesh)
+        if spec.kv_dtype == "int8" and (spec.kv == "paged" or mesh.size > 1):
+            # Resolve the incompatibility ONCE at load, not with a stderr
+            # warning on every debate turn.
+            import dataclasses
+            import sys
+
+            print(
+                f"warning: tpu://{alias}: kv_dtype=int8 applies to the "
+                "dense single-device cache only; serving full-precision KV",
+                file=sys.stderr,
+            )
+            spec = dataclasses.replace(spec, kv_dtype="")
         params, cfg = self._materialize(spec, dtype, mesh)
         tokenizer = load_tokenizer(spec.tokenizer)
         lm = LoadedModel(
@@ -245,6 +257,7 @@ class TpuEngine:
                 timeout_s=params.timeout_s,
                 mesh=lm.mesh,
                 paged=lm.spec.kv == "paged",
+                kv_dtype=lm.spec.kv_dtype,
             )
         total_time = time.monotonic() - t0
 

@@ -102,15 +102,40 @@ def init_cache(
     max_seq: int,
     dtype: jnp.dtype = jnp.bfloat16,
     device=None,
+    kv_dtype: str = "",
 ) -> Cache:
     """``device`` may be a Sharding so the cache is born sharded (never
-    materialized replicated on one chip)."""
+    materialized replicated on one chip).
+
+    ``kv_dtype="int8"``: store K/V int8 with per-(token, head) symmetric
+    scales (keys "ks"/"vs") — half the HBM bytes read per decoded token
+    (decode is KV-bandwidth-bound at long contexts); dequant fuses into
+    the attention matmuls. Presence of "ks" marks a quantized cache.
+    """
     shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
     kw = {"device": device} if device is not None else {}
+    if kv_dtype == "int8":
+        sshape = shape[:-1] + (1,)
+        return {
+            "k": jnp.zeros(shape, jnp.int8, **kw),
+            "v": jnp.zeros(shape, jnp.int8, **kw),
+            "ks": jnp.zeros(sshape, jnp.float32, **kw),
+            "vs": jnp.zeros(sshape, jnp.float32, **kw),
+        }
     return {
         "k": jnp.zeros(shape, dtype, **kw),
         "v": jnp.zeros(shape, dtype, **kw),
     }
+
+
+def _quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-(token, head) symmetric int8 over the feature axis."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
 
 
 def rms_norm(
@@ -287,17 +312,40 @@ def forward(
         )
         pallas_end = jnp.full((B,), 0, jnp.int32) + cache_index + 1
 
+    quant_kv = "ks" in cache  # int8 K/V with per-(token, head) scales
+
+    def _write_and_read_kv(cache_l: Cache, k, v, x_dtype):
+        """Store this chunk's K/V into the layer's cache slice and return
+        (updated slice, attention-readable K, V). One site owns both the
+        plain and int8 layouts."""
+        upd = lambda buf, val: jax.lax.dynamic_update_slice(  # noqa: E731
+            buf, val, (0, cache_index, 0, 0)
+        )
+        if quant_kv:
+            kq, ks = _quantize_kv(k)
+            vq, vs = _quantize_kv(v)
+            out = {
+                "k": upd(cache_l["k"], kq),
+                "v": upd(cache_l["v"], vq),
+                "ks": upd(cache_l["ks"], ks),
+                "vs": upd(cache_l["vs"], vs),
+            }
+            # Dequant feeds the attention matmuls directly; XLA fuses the
+            # elementwise producer into the dot's operand read.
+            k_read = (out["k"].astype(jnp.float32) * out["ks"]).astype(x_dtype)
+            v_read = (out["v"].astype(jnp.float32) * out["vs"]).astype(x_dtype)
+            return out, k_read, v_read
+        out = {
+            "k": upd(cache_l["k"], k.astype(cache_l["k"].dtype)),
+            "v": upd(cache_l["v"], v.astype(cache_l["v"].dtype)),
+        }
+        return out, out["k"], out["v"]
+
     def layer_body(x, scanned):
-        lp, layer_id, k_cache, v_cache = scanned
+        lp, layer_id, cache_l = scanned
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps, cfg.norm_scale_plus_one)
         q, k, v = _project_qkv(lp, cfg, h, B, S, cos, sin)
-
-        k_cache = jax.lax.dynamic_update_slice(
-            k_cache, k.astype(k_cache.dtype), (0, cache_index, 0, 0)
-        )
-        v_cache = jax.lax.dynamic_update_slice(
-            v_cache, v.astype(v_cache.dtype), (0, cache_index, 0, 0)
-        )
+        cache_l, k_read, v_read = _write_and_read_kv(cache_l, k, v, x.dtype)
 
         if pallas_decode:
             from adversarial_spec_tpu.ops.pallas_decode import (
@@ -310,8 +358,8 @@ def forward(
             bounds = jnp.stack([start, pallas_end], axis=1)
             out = decode_attention(
                 q[:, 0],
-                k_cache,
-                v_cache,
+                k_read,
+                v_read,
                 bounds,
                 attn_softcap=cfg.attn_softcap,
                 scale=cfg.attn_scale,
@@ -329,23 +377,23 @@ def forward(
 
             out = attention(
                 q,
-                k_cache,
-                v_cache,
+                k_read,
+                v_read,
                 mask,
                 attn_softcap=cfg.attn_softcap,
                 scale=cfg.attn_scale,
             )
         x = _attn_out_and_ffn(x, out, lp, cfg, B, S)
-        return x, (k_cache, v_cache)
+        return x, cache_l
 
-    x, (new_k, new_v) = jax.lax.scan(
-        layer_body,
-        x,
-        (params["layers"], layer_ids, cache["k"], cache["v"]),
+    # The cache dict scans as a pytree: every leaf carries a leading
+    # n_layers axis, so one scan serves both cache layouts.
+    x, new_cache = jax.lax.scan(
+        layer_body, x, (params["layers"], layer_ids, cache)
     )
 
     logits = _lm_head_logits(params, cfg, x, lm_head_last_only)
-    return logits, {"k": new_k, "v": new_v}
+    return logits, new_cache
 
 
 def _lm_head_logits(

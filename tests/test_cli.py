@@ -405,6 +405,23 @@ class TestRegistry:
         )
         assert code == 2
 
+    def test_paged_int8_kv_combo_rejected(self, monkeypatch, capsys):
+        code, _, err = run_cli(
+            [
+                "registry",
+                "add-model",
+                "bad",
+                "--kv",
+                "paged",
+                "--kv-dtype",
+                "int8",
+            ],
+            monkeypatch=monkeypatch,
+            capsys=capsys,
+        )
+        assert code == 2
+        assert "does not support" in err
+
     def test_remove_missing_exits_2(self, monkeypatch, capsys):
         code, _, _ = run_cli(
             ["registry", "remove-model", "ghost"],
