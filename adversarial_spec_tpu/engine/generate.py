@@ -395,9 +395,7 @@ def generate(
         cache_device = cache_sharding(mesh)
 
     sp = mesh.shape.get("sp", 1) if mesh is not None else 1
-    use_sp_prefill = (
-        sp > 1 and cfg.sliding_window == 0 and S % sp == 0
-    )
+    use_sp_prefill = sp > 1 and S % sp == 0
     if use_sp_prefill:
         # Long-context path: sequence-parallel prefill (ring attention
         # over the sp axis — parallel/sp.py), then reshard the

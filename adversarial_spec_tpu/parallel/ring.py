@@ -80,9 +80,15 @@ def ring_attention_local(
     kv_start: jnp.ndarray | None = None,  # [B] first valid global slot
     attn_softcap: float = 0.0,
     scale: float | None = None,
+    window: jnp.ndarray | int = 0,  # sliding window in slots; 0 = global
     axis_name: str = SP,
 ) -> jnp.ndarray:
-    """Per-device ring attention body (call inside shard_map over sp)."""
+    """Per-device ring attention body (call inside shard_map over sp).
+
+    ``window`` may be a traced scalar (per-layer alternation inside a
+    scan): key slots below q_slot - window + 1 are masked. The ring still
+    makes all sp hops (SPMD uniformity); distant blocks contribute zeros.
+    """
     idx = jax.lax.axis_index(axis_name)
     B, Sq, H, D = qb.shape
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
@@ -106,9 +112,14 @@ def ring_attention_local(
         else:
             block_mask = jnp.ones((Sq, Sq), bool)
         mask = jnp.broadcast_to(block_mask[None], (B, Sq, Sq))
+        key_slot = src * Sq + cols  # [Sq(q), Sq(k)]-broadcastable key slots
         if kv_start is not None:
-            key_slot = src * Sq + cols  # [1, Sq] global slot of each key
             mask = mask & (key_slot[None] >= kv_start[:, None, None])
+        # Sliding window (traced-scalar friendly): q at global slot
+        # idx*Sq+row sees keys in (q_slot - window, q_slot].
+        q_slot = idx * Sq + rows
+        win_mask = (window <= 0) | (key_slot > q_slot - window)
+        mask = mask & win_mask[None]
         m, l, acc = _block_attend(
             qb.astype(jnp.float32),
             kb,

@@ -22,9 +22,10 @@ rides sp-neighbor ICI links and the TP all-reduces ride the tp axis.
 The resulting KV cache comes back sequence-sharded (heads tp-sharded);
 the caller reshards to the decode layout (batch over dp, heads over tp).
 
-Constraints (v1): global attention only (no sliding window — windowed
-families prefill chunked on one device), and the padded length must
-divide sp; n_heads/n_kv_heads/ffn_dim/vocab must divide tp.
+Sliding-window families are supported: each layer's window (including
+gemma-2's alternating pattern) is applied as a mask inside the ring.
+Constraints (v1): the padded length must divide sp;
+n_heads/n_kv_heads/ffn_dim/vocab must divide tp.
 """
 
 from __future__ import annotations
@@ -70,13 +71,12 @@ def sp_prefill(
 
     Returns (last_logits [B, vocab] f32, cache {"k","v": [L, B, S, Hkv, D]}
     sequence-sharded over sp and head-sharded over tp).
+
+    Sliding-window families work too: the per-layer window (including
+    gemma-2's alternating pattern) is applied as a mask inside the ring —
+    every hop still runs (SPMD uniformity), distant blocks contribute
+    zeros.
     """
-    if cfg.sliding_window > 0:
-        raise NotImplementedError(
-            "sequence-parallel prefill supports global attention only; "
-            f"family with sliding_window={cfg.sliding_window} must prefill "
-            "chunked on one device"
-        )
     sp = mesh.shape[SP]
     tp = mesh.shape[TP]
     B, S = tokens.shape
@@ -124,11 +124,23 @@ def sp_prefill(
         if cfg.scale_embeddings:
             x = (x.astype(jnp.float32) * math.sqrt(cfg.dim)).astype(x.dtype)
 
-        def layer_body(x, lp):
+        layer_ids = jnp.arange(cfg.n_layers)
+
+        def layer_body(x, scanned):
+            lp, layer_id = scanned
             h = rms_norm(
                 x, lp["attn_norm"], cfg.rms_eps, cfg.norm_scale_plus_one
             )
             q, k, v = _project_qkv(lp, local_cfg, h, B, S_loc, cos, sin)
+            if cfg.sliding_window > 0 and cfg.sliding_window_pattern > 1:
+                # Gemma-2: alternate windowed / global layers.
+                window = jnp.where(
+                    layer_id % cfg.sliding_window_pattern == 0,
+                    cfg.sliding_window,
+                    0,
+                )
+            else:
+                window = cfg.sliding_window
             out = ring_attention_local(
                 q,
                 k.astype(jnp.float32),
@@ -138,13 +150,16 @@ def sp_prefill(
                 kv_start=pad_lens_rep,
                 attn_softcap=cfg.attn_softcap,
                 scale=cfg.attn_scale,
+                window=window,
             )
             x = _attn_out_and_ffn(
                 x, out, lp, local_cfg, B, S_loc, psum_axis=psum_axis
             )
             return x, (k, v)
 
-        x, (k_all, v_all) = jax.lax.scan(layer_body, x, params_l["layers"])
+        x, (k_all, v_all) = jax.lax.scan(
+            layer_body, x, (params_l["layers"], layer_ids)
+        )
 
         # Last-position logits: the shared lm-head tail (final norm +
         # tied/untied projection + softcap — one source of truth with the

@@ -176,6 +176,12 @@ class TestSequenceParallelPrefill:
             rtol=2e-4,
             atol=2e-4,
         )
+        np.testing.assert_allclose(
+            np.asarray(cache_sp["v"]),
+            np.asarray(dense_cache["v"]),
+            rtol=2e-4,
+            atol=2e-4,
+        )
 
         with mesh:
             resharded = reshard_cache_for_decode(cache_sp, mesh, S + 8)
@@ -261,15 +267,51 @@ class TestSequenceParallelPrefill:
         with pytest.raises(ValueError, match="must divide"):
             sp_prefill(params, cfg, tokens, jnp.zeros((1,), jnp.int32), mesh)
 
-    def test_sp_prefill_rejects_sliding_window(self):
+    @pytest.mark.parametrize("family", ["mistral", "gemma2"])
+    def test_sp_prefill_windowed_families(self, family):
+        """Sliding windows (incl. gemma-2's alternating layers) inside the
+        ring must reproduce dense prefill exactly. Window shrunk to 8 so
+        it genuinely truncates across block boundaries (blocks of 8 at
+        sp=4, S=32)."""
+        from dataclasses import replace as dc_replace
+
+        from adversarial_spec_tpu.engine.generate import prefill_chunk
         from adversarial_spec_tpu.parallel.sp import sp_prefill
 
-        cfg = get_config("mistral", "tiny")
+        cfg = dc_replace(get_config(family, "tiny"), sliding_window=8)
         params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
         mesh = make_mesh({"sp": 4})
-        tokens = jnp.zeros((1, 32), jnp.int32)
-        with pytest.raises(NotImplementedError, match="sliding_window"):
-            sp_prefill(params, cfg, tokens, jnp.zeros((1,), jnp.int32), mesh)
+        B, S = 2, 32
+        tokens = jax.random.randint(
+            jax.random.key(9), (B, S), 0, cfg.vocab_size
+        )
+        pad_lens = jnp.array([3, 0], jnp.int32)
+        tokens = jnp.where(
+            jnp.arange(S)[None, :] < pad_lens[:, None], 0, tokens
+        )
+        with mesh:
+            logits_sp, cache_sp = sp_prefill(
+                params, cfg, tokens, pad_lens, mesh
+            )
+        dense_cache = T.init_cache(cfg, B, S, dtype=jnp.float32)
+        dense_cache, ref_logits = prefill_chunk(
+            params, cfg, tokens, pad_lens, dense_cache, jnp.int32(0)
+        )
+        np.testing.assert_allclose(
+            np.asarray(logits_sp), np.asarray(ref_logits), rtol=3e-4, atol=3e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(cache_sp["k"]),
+            np.asarray(dense_cache["k"]),
+            rtol=3e-4,
+            atol=3e-4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(cache_sp["v"]),
+            np.asarray(dense_cache["v"]),
+            rtol=3e-4,
+            atol=3e-4,
+        )
 
 
 class TestRingAttention:
