@@ -439,8 +439,14 @@ def generate(
                 cache,
                 jnp.int32(ci),
             )
+    # Paged + identical prompts: rows can SHARE physical prompt pages
+    # (never written after migration — decode slots start at S, which is
+    # page-aligned when page_size divides the pow2 bucket), so skip the
+    # B-way cache tile entirely; only logits tile.
+    share_prompt_pages = shared and paged and S % page_size == 0
     if shared:
-        cache = jax.tree.map(lambda x: jnp.repeat(x, B, axis=1), cache)
+        if not share_prompt_pages:
+            cache = jax.tree.map(lambda x: jnp.repeat(x, B, axis=1), cache)
         last_logits = jnp.repeat(last_logits, B, axis=0)
     first = sample_tokens(
         last_logits,
@@ -471,15 +477,49 @@ def generate(
             write_tokens,
         )
 
+        # Physical page 0 is the TRASH page (scheduler_decode_chunk
+        # redirects inactive rows' writes there), so allocator ids shift
+        # +1 — the scheduler's convention, which this path shares. Without
+        # the reservation, an early-EOS row's redirected writes would
+        # corrupt whichever row's KV occupied physical page 0.
         n_pages_per_row = -(-total_len // page_size)
-        allocator = PageAllocator(B * n_pages_per_row, page_size)
-        for b in range(B):
-            allocator.new_sequence(b)
-            allocator.extend(b, total_len)
-        table_np = allocator.table_array(list(range(B)), n_pages_per_row)
+        if share_prompt_pages:
+            # One physical copy of the prompt pages, shared by all rows;
+            # only the decode region is per-row.
+            prompt_pages = S // page_size
+            decode_pages = n_pages_per_row - prompt_pages
+            allocator = PageAllocator(
+                prompt_pages + B * decode_pages, page_size
+            )
+            allocator.new_sequence("prompt")
+            allocator.extend("prompt", S)
+            shared_table = np.asarray(allocator.table("prompt"), np.int32)
+            rows_tables = []
+            for b in range(B):
+                allocator.new_sequence(b)
+                allocator.extend(b, total_len - S)
+                rows_tables.append(
+                    np.concatenate(
+                        [
+                            shared_table,
+                            np.asarray(allocator.table(b), np.int32),
+                        ]
+                    )
+                )
+            table_np = np.stack(rows_tables) + 1
+            n_phys_pages = prompt_pages + B * decode_pages
+        else:
+            allocator = PageAllocator(B * n_pages_per_row, page_size)
+            for b in range(B):
+                allocator.new_sequence(b)
+                allocator.extend(b, total_len)
+            table_np = (
+                allocator.table_array(list(range(B)), n_pages_per_row) + 1
+            )
+            n_phys_pages = B * n_pages_per_row
         page_table = jnp.asarray(table_np)
         layout = PagedCacheLayout(
-            n_pages=B * n_pages_per_row,
+            n_pages=n_phys_pages + 1,  # +1: trash page 0
             page_size=page_size,
             n_layers=cfg.n_layers,
             n_kv_heads=cfg.n_kv_heads,
@@ -488,9 +528,11 @@ def generate(
         pool = init_page_pool(layout, dtype=cache["k"].dtype)
         # Migrate prompt KV (slots [0, S)) from the dense prefill cache
         # into pages (vectorized table lookup); pad-slot garbage lands too
-        # but stays masked by the per-row bounds start.
-        slots = np.tile(np.arange(S, dtype=np.int32)[None, :], (B, 1))
-        page_ids = table_np[np.arange(B)[:, None], slots // page_size]
+        # but stays masked by the per-row bounds start. With shared prompt
+        # pages the (untiled, single-row) cache scatters ONCE.
+        B_mig = cache["k"].shape[1]
+        slots = np.tile(np.arange(S, dtype=np.int32)[None, :], (B_mig, 1))
+        page_ids = table_np[np.arange(B_mig)[:, None], slots // page_size]
         offsets = slots % page_size
         pool = write_tokens(
             pool, cache["k"][:, :, :S], cache["v"][:, :, :S], page_ids, offsets

@@ -291,6 +291,61 @@ class TestGenerate:
         kernel = generate(params, cfg, prompts, use_pallas_decode=True, **kw)
         np.testing.assert_array_equal(gather.tokens, kernel.tokens)
 
+    def test_paged_early_eos_row_does_not_corrupt_others(self, tiny_model):
+        """Regression: inactive rows' KV writes redirect to the reserved
+        trash page. Before the +1 table shift, physical page 0 belonged to
+        row 0's prompt and an early-EOS row would scribble over it — the
+        surviving row's tokens must match dense decode exactly."""
+        params, cfg = tiny_model
+        # Row 0's prompt fills the whole 128 bucket (pad_len = 0), so its
+        # REAL slot 0 lives in physical page 0 under the unshifted layout;
+        # row 1 dies after its first token (its greedy first token is the
+        # EOS) and its trash-page writes land exactly there. Row 0 keeps
+        # decoding and must stay uncorrupted.
+        probe = generate(
+            params, cfg, [[1, 2]], max_new_tokens=2, eos_ids=[], greedy=True
+        )
+        eos = int(probe.tokens[0, 0])
+        long_prompt = [((i * 11) % 500) + 3 for i in range(128)]
+        prompts = [long_prompt, [1, 2]]
+        kw = dict(max_new_tokens=24, eos_ids=[eos], greedy=True)
+        dense = generate(params, cfg, prompts, paged=False, **kw)
+        paged = generate(params, cfg, prompts, paged=True, page_size=16, **kw)
+        np.testing.assert_array_equal(dense.tokens, paged.tokens)
+        np.testing.assert_array_equal(dense.n_generated, paged.n_generated)
+
+    def test_paged_shared_prompt_pages(self, tiny_model, monkeypatch):
+        """Identical opponent prompts share ONE physical copy of the
+        prompt pages (pool sized prompt+B*decode, not B*total), and the
+        outputs still match the dense unshared reference."""
+        from adversarial_spec_tpu.engine import kvcache as kv_mod
+
+        pool_sizes = []
+        real_init = kv_mod.init_page_pool
+
+        def spy(layout, **kw):
+            pool_sizes.append(layout.n_pages)
+            return real_init(layout, **kw)
+
+        # generate() imports init_page_pool inside the function, so patch
+        # the source module.
+        monkeypatch.setattr(kv_mod, "init_page_pool", spy)
+
+        params, cfg = tiny_model
+        B, page = 3, 16
+        prompt = [1, 5, 9, 3, 7, 2]  # buckets to 128 → 8 prompt pages
+        kw = dict(max_new_tokens=16, eos_ids=[], greedy=True)
+        ref = generate(
+            params, cfg, [prompt] * B, paged=False, share_prefix=False, **kw
+        )
+        out = generate(
+            params, cfg, [prompt] * B, paged=True, page_size=page, **kw
+        )
+        np.testing.assert_array_equal(ref.tokens, out.tokens)
+        # 128/16=8 shared prompt pages + 3 rows × ceil(64/16)=4 decode
+        # pages + 1 trash page = 21, versus 3×12+1=37 unshared.
+        assert pool_sizes == [8 + 3 * 4 + 1]
+
     def test_paged_decode_with_eos(self, tiny_model):
         params, cfg = tiny_model
         probe = generate(
