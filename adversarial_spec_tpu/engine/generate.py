@@ -494,19 +494,19 @@ def generate(
             allocator.new_sequence("prompt")
             allocator.extend("prompt", S)
             shared_table = np.asarray(allocator.table("prompt"), np.int32)
-            rows_tables = []
             for b in range(B):
                 allocator.new_sequence(b)
                 allocator.extend(b, total_len - S)
-                rows_tables.append(
-                    np.concatenate(
-                        [
-                            shared_table,
-                            np.asarray(allocator.table(b), np.int32),
-                        ]
-                    )
+            table_np = (
+                np.concatenate(
+                    [
+                        np.broadcast_to(shared_table, (B, prompt_pages)),
+                        allocator.table_array(list(range(B)), decode_pages),
+                    ],
+                    axis=1,
                 )
-            table_np = np.stack(rows_tables) + 1
+                + 1
+            )
             n_phys_pages = prompt_pages + B * decode_pages
         else:
             allocator = PageAllocator(B * n_pages_per_row, page_size)
