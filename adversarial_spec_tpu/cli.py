@@ -567,6 +567,9 @@ def handle_providers(args: argparse.Namespace) -> int:
 
 def _device_info() -> dict:
     try:
+        from adversarial_spec_tpu.utils.jaxenv import configure_jax
+
+        configure_jax()
         import jax
 
         devs = jax.devices()

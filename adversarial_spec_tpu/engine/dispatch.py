@@ -32,6 +32,9 @@ def get_engine(model: str) -> Engine:
             _ENGINE_CACHE[key] = MockEngine()
         else:
             # Deferred import: pulls in jax; mock-only flows never pay it.
+            from adversarial_spec_tpu.utils.jaxenv import configure_jax
+
+            configure_jax()
             try:
                 from adversarial_spec_tpu.engine.tpu import TpuEngine
             except ImportError as e:

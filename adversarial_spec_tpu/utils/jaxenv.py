@@ -1,0 +1,54 @@
+"""One-time jax process configuration (platform mirroring + compile cache).
+
+Called lazily from the first jax-touching entry point (engine dispatch,
+device introspection) so mock-only CLI flows never pay the jax import.
+
+1. Mirror JAX_PLATFORMS into jax.config before first backend use: some
+   environments bootstrap jax at interpreter start (sitecustomize PJRT
+   plugins) in a way that snapshots their own platform choice; the user's
+   env var is then silently ignored and a CPU-only run can block on an
+   unreachable accelerator.
+2. Enable the persistent compilation cache. The L5 debate protocol invokes
+   the CLI once per round as a fresh process; without the cache every
+   round re-pays the full XLA compile of prefill + decode (tens of
+   seconds on TPU). The cache keys on program + topology, so round 2+ and
+   every later debate reuse round 1's compiles.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+_configured = False
+
+
+def configure_jax() -> None:
+    global _configured
+    if _configured:
+        return
+    _configured = True
+    try:
+        import jax
+    except Exception:
+        return  # jax missing/odd build: callers surface real errors
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception:
+            pass
+
+    cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR") or str(
+        Path.home() / ".cache" / "adversarial-spec-tpu" / "xla-cache"
+    )
+    for option, value in (
+        ("jax_compilation_cache_dir", cache_dir),
+        ("jax_persistent_cache_min_compile_time_secs", 1.0),
+        ("jax_persistent_cache_min_entry_size_bytes", 0),
+    ):
+        try:
+            jax.config.update(option, value)
+        except Exception:
+            pass  # option renamed/absent in this jax version

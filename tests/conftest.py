@@ -39,6 +39,12 @@ def _force_cpu_only_backends() -> None:
     # Restricting jax_platforms is sufficient to keep the remote backend
     # uninitialized (its client is only dialed at init).
     jax.config.update("jax_platforms", "cpu")
+    # Pin the env var too: utils/jaxenv.configure_jax (invoked lazily at
+    # first tpu-engine use) mirrors JAX_PLATFORMS into jax.config, and the
+    # surrounding environment may preset it to an accelerator value —
+    # without this pin that mirror would override the CPU-only test
+    # contract mid-suite.
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
 
 _force_cpu_only_backends()
