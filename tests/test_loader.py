@@ -1,0 +1,128 @@
+"""Checkpoint loader tests: sharded safetensors with an index file, error
+messages, and the bounded-host-RAM stacking path."""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from adversarial_spec_tpu.engine.loader import (
+    _open_safetensors,
+    load_hf_checkpoint,
+    materialize_params,
+)
+from adversarial_spec_tpu.models.config import get_config
+
+
+def _write_sharded_checkpoint(tmp_path, cfg):
+    """Write a tiny llama checkpoint SPLIT across two safetensors shards
+    with a model.safetensors.index.json — the multi-file layout real 8B/70B
+    checkpoints use."""
+    from safetensors.numpy import save_file
+
+    rng = np.random.default_rng(0)
+    D, F = cfg.dim, cfg.ffn_dim
+    QD = cfg.n_heads * cfg.head_dim
+    KD = cfg.n_kv_heads * cfg.head_dim
+
+    tensors = {}
+    tensors["model.embed_tokens.weight"] = rng.standard_normal(
+        (cfg.vocab_size, D), dtype=np.float32
+    )
+    tensors["model.norm.weight"] = np.ones((D,), np.float32)
+    tensors["lm_head.weight"] = rng.standard_normal(
+        (cfg.vocab_size, D), dtype=np.float32
+    )
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        tensors[p + "input_layernorm.weight"] = np.ones((D,), np.float32)
+        tensors[p + "post_attention_layernorm.weight"] = np.ones(
+            (D,), np.float32
+        )
+        tensors[p + "self_attn.q_proj.weight"] = rng.standard_normal(
+            (QD, D), dtype=np.float32
+        )
+        tensors[p + "self_attn.k_proj.weight"] = rng.standard_normal(
+            (KD, D), dtype=np.float32
+        )
+        tensors[p + "self_attn.v_proj.weight"] = rng.standard_normal(
+            (KD, D), dtype=np.float32
+        )
+        tensors[p + "self_attn.o_proj.weight"] = rng.standard_normal(
+            (D, QD), dtype=np.float32
+        )
+        tensors[p + "mlp.gate_proj.weight"] = rng.standard_normal(
+            (F, D), dtype=np.float32
+        )
+        tensors[p + "mlp.up_proj.weight"] = rng.standard_normal(
+            (F, D), dtype=np.float32
+        )
+        tensors[p + "mlp.down_proj.weight"] = rng.standard_normal(
+            (D, F), dtype=np.float32
+        )
+
+    names = sorted(tensors)
+    half = len(names) // 2
+    shards = {
+        "model-00001-of-00002.safetensors": {n: tensors[n] for n in names[:half]},
+        "model-00002-of-00002.safetensors": {n: tensors[n] for n in names[half:]},
+    }
+    weight_map = {}
+    for fname, shard in shards.items():
+        save_file(shard, str(tmp_path / fname))
+        for n in shard:
+            weight_map[n] = fname
+    (tmp_path / "model.safetensors.index.json").write_text(
+        json.dumps({"weight_map": weight_map})
+    )
+    return tensors
+
+
+class TestShardedCheckpoint:
+    def test_index_json_resolves_all_shards(self, tmp_path):
+        cfg = get_config("llama", "tiny")
+        tensors = _write_sharded_checkpoint(tmp_path, cfg)
+        files = _open_safetensors(tmp_path)
+        assert set(files) == set(tensors)
+        assert len({f.name for f in files.values()}) == 2
+
+    def test_load_across_shards_matches_source(self, tmp_path):
+        cfg = get_config("llama", "tiny")
+        tensors = _write_sharded_checkpoint(tmp_path, cfg)
+        params = load_hf_checkpoint(tmp_path, cfg, "llama", dtype=jnp.float32)
+        # Layer-stacked wq[0] equals the transposed per-layer source.
+        np.testing.assert_allclose(
+            np.asarray(params["layers"]["wq"][0]),
+            tensors["model.layers.0.self_attn.q_proj.weight"].T,
+            rtol=1e-6,
+        )
+        np.testing.assert_allclose(
+            np.asarray(params["lm_head"]),
+            tensors["lm_head.weight"].T,
+            rtol=1e-6,
+        )
+
+    def test_missing_tensor_actionable_error(self, tmp_path):
+        """An index that omits tensors names the missing tensor."""
+        cfg = get_config("llama", "tiny")
+        _write_sharded_checkpoint(tmp_path, cfg)
+        (tmp_path / "model.safetensors.index.json").write_text(
+            json.dumps({"weight_map": {}})
+        )
+        with pytest.raises(KeyError, match="missing from checkpoint"):
+            load_hf_checkpoint(tmp_path, cfg, "llama")
+
+    def test_empty_dir_actionable_error(self, tmp_path):
+        cfg = get_config("llama", "tiny")
+        with pytest.raises(FileNotFoundError, match="no \\*.safetensors"):
+            load_hf_checkpoint(tmp_path, cfg, "llama")
+
+    def test_materialize_random_is_deterministic(self):
+        a, cfg_a = materialize_params("random", "llama", "tiny", seed=3)
+        b, _ = materialize_params("random", "llama", "tiny", seed=3)
+        np.testing.assert_array_equal(
+            np.asarray(a["embed"]), np.asarray(b["embed"])
+        )
+        c, _ = materialize_params("random", "llama", "tiny", seed=4)
+        assert not np.array_equal(np.asarray(a["embed"]), np.asarray(c["embed"]))
