@@ -1,0 +1,121 @@
+"""CLI ↔ Telegram integration paths (notify, send-final, discovery) with
+the telegram transport faked at the urlopen/module seam."""
+
+import io
+import json
+
+from adversarial_spec_tpu import cli
+from adversarial_spec_tpu.debate import telegram
+
+SPEC = "# Spec\nBody."
+
+
+class TestNotifyFlow:
+    def test_notify_unconfigured_warns_and_continues(
+        self, monkeypatch, capsys
+    ):
+        monkeypatch.delenv("TELEGRAM_BOT_TOKEN", raising=False)
+        monkeypatch.delenv("TELEGRAM_CHAT_ID", raising=False)
+        monkeypatch.setattr("sys.stdin", io.StringIO(SPEC))
+        code = cli.main(
+            ["critique", "--models", "mock://agree", "--notify", "--json"]
+        )
+        out, err = capsys.readouterr()
+        assert code == 0
+        assert "Telegram not configured" in err
+        assert json.loads(out)["all_agreed"] is True
+
+    def test_notify_feedback_lands_in_output(self, monkeypatch, capsys):
+        monkeypatch.setenv("TELEGRAM_BOT_TOKEN", "tok")
+        monkeypatch.setenv("TELEGRAM_CHAT_ID", "42")
+        sent = []
+        monkeypatch.setattr(
+            telegram, "send_long_message", lambda cfg, text: sent.append(text)
+        )
+        monkeypatch.setattr(telegram, "send_message", lambda cfg, text: None)
+        monkeypatch.setattr(telegram, "get_last_update_id", lambda cfg: 0)
+        monkeypatch.setattr(
+            telegram,
+            "poll_for_reply",
+            lambda cfg, after, timeout: "tighten the SLO section",
+        )
+        monkeypatch.setattr("sys.stdin", io.StringIO(SPEC))
+        code = cli.main(
+            [
+                "critique",
+                "--models",
+                "mock://critic",
+                "--notify",
+                "--feedback-timeout",
+                "30",
+                "--json",
+            ]
+        )
+        out, _ = capsys.readouterr()
+        assert code == 0
+        data = json.loads(out)
+        assert data["user_feedback"] == "tighten the SLO section"
+        assert any("Debate round 1" in s for s in sent)
+
+    def test_notify_failure_never_kills_round(self, monkeypatch, capsys):
+        monkeypatch.setenv("TELEGRAM_BOT_TOKEN", "tok")
+        monkeypatch.setenv("TELEGRAM_CHAT_ID", "42")
+
+        def boom(*a, **k):
+            raise RuntimeError("network down")
+
+        monkeypatch.setattr(telegram, "notify_round", boom)
+        monkeypatch.setattr("sys.stdin", io.StringIO(SPEC))
+        code = cli.main(
+            ["critique", "--models", "mock://agree", "--notify", "--json"]
+        )
+        out, err = capsys.readouterr()
+        assert code == 0
+        assert "Telegram notify failed" in err
+
+
+class TestSendFinal:
+    def test_send_final_chunks_document(self, monkeypatch, capsys):
+        monkeypatch.setenv("TELEGRAM_BOT_TOKEN", "tok")
+        monkeypatch.setenv("TELEGRAM_CHAT_ID", "42")
+        sent = []
+        monkeypatch.setattr(
+            telegram,
+            "send_long_message",
+            lambda cfg, text, **k: sent.append(text) or 1,
+        )
+        monkeypatch.setattr("sys.stdin", io.StringIO("# Final doc"))
+        code = cli.main(["send-final"])
+        out, _ = capsys.readouterr()
+        assert code == 0
+        assert "Final document sent." in out
+        assert sent and "FINAL DOCUMENT" in sent[0]
+
+
+class TestDiscovery:
+    def test_discover_chat_id_most_recent(self, monkeypatch):
+        monkeypatch.setattr(
+            telegram,
+            "api_call",
+            lambda token, method, params=None: [
+                {"update_id": 1, "message": {"chat": {"id": 11}}},
+                {"update_id": 2, "message": {"chat": {"id": 22}}},
+            ],
+        )
+        assert telegram.discover_chat_id("tok") == "22"
+
+    def test_discover_none_when_no_messages(self, monkeypatch):
+        monkeypatch.setattr(
+            telegram, "api_call", lambda token, method, params=None: []
+        )
+        assert telegram.discover_chat_id("tok") is None
+
+    def test_setup_subcommand(self, monkeypatch, capsys):
+        monkeypatch.setenv("TELEGRAM_BOT_TOKEN", "tok")
+        monkeypatch.setattr(telegram, "discover_chat_id", lambda tok: "777")
+        assert telegram._cli(["setup"]) == 0
+        assert "TELEGRAM_CHAT_ID=777" in capsys.readouterr().out
+
+    def test_setup_without_token_exit_2(self, monkeypatch, capsys):
+        monkeypatch.delenv("TELEGRAM_BOT_TOKEN", raising=False)
+        assert telegram._cli(["setup"]) == 2
