@@ -1,0 +1,94 @@
+"""Fuzz tests: the tag parsers and message splitter consume ADVERSARIAL
+model output by definition — no input may crash them, and the splitter's
+invariants must hold for arbitrary text."""
+
+import random
+import string
+
+from adversarial_spec_tpu.debate.parsing import (
+    detect_agreement,
+    extract_spec,
+    extract_tasks,
+    get_critique_summary,
+    has_malformed_spec,
+)
+from adversarial_spec_tpu.debate.telegram import split_message
+
+_ALPHABET = (
+    string.ascii_letters
+    + string.digits
+    + " \n\t:[]/\\{}()<>|#*-_.,;\"'"
+)
+_FRAGMENTS = [
+    "[AGREE]",
+    "[SPEC]",
+    "[/SPEC]",
+    "[TASK]",
+    "[/TASK]",
+    "title:",
+    "priority:",
+    "dependencies:",
+    "estimate:",
+    "\n\n",
+    "✓✗…",
+]
+
+
+def _random_soup(rng: random.Random, n: int) -> str:
+    parts = []
+    for _ in range(n):
+        if rng.random() < 0.3:
+            parts.append(rng.choice(_FRAGMENTS))
+        else:
+            parts.append(
+                "".join(rng.choice(_ALPHABET) for _ in range(rng.randrange(1, 30)))
+            )
+    return "".join(parts)
+
+
+class TestParserFuzz:
+    def test_parsers_never_crash(self):
+        rng = random.Random(0)
+        for i in range(300):
+            soup = _random_soup(rng, rng.randrange(0, 40))
+            detect_agreement(soup)
+            spec = extract_spec(soup)
+            assert spec is None or isinstance(spec, str)
+            has_malformed_spec(soup)
+            for task in extract_tasks(soup):
+                d = task.to_dict()
+                assert d["priority"] in {"critical", "high", "medium", "low"}
+            summary = get_critique_summary(soup)
+            assert len(summary) <= 200
+
+    def test_extract_spec_inverse_property(self):
+        """Any payload wrapped in clean tags round-trips (after strip)."""
+        rng = random.Random(1)
+        for _ in range(100):
+            payload = _random_soup(rng, rng.randrange(0, 10))
+            # Avoid payloads that smuggle a closing tag at the very end
+            # changing the widest-span semantics deliberately kept.
+            wrapped = f"prefix [SPEC]{payload}[/SPEC]"
+            got = extract_spec(wrapped)
+            if "[/SPEC]" not in payload:
+                assert got == payload.strip()
+
+
+class TestSplitterFuzz:
+    def test_invariants_hold_for_arbitrary_text(self):
+        rng = random.Random(2)
+        for _ in range(100):
+            text = _random_soup(rng, rng.randrange(0, 60))
+            limit = rng.choice([50, 100, 4096])
+            chunks = split_message(text, limit=limit)
+            # Every chunk within the limit.
+            assert all(len(c) <= limit for c in chunks)
+            # No content invented: concatenation loses only the boundary
+            # whitespace the splitter strips.
+            joined = "".join(chunks)
+            assert len(joined) <= len(text)
+            assert joined.replace("\n", "").replace(" ", "") == text.replace(
+                "\n", ""
+            ).replace(" ", "")
+            # Empty input → no chunks; non-empty → at least one.
+            assert (chunks == []) == (text == "")
