@@ -36,9 +36,12 @@ def detect_agreement(response: str) -> bool:
 def extract_spec(response: str) -> str | None:
     """Pull the revised spec out of [SPEC]...[/SPEC], or None.
 
-    Parity: reference scripts/models.py:154-160. First open tag, last close
-    tag — models sometimes nest examples containing the literal tags; taking
-    the widest span preserves them.
+    Deliberate departure from the reference (scripts/models.py:154-160,
+    which takes the FIRST close tag): we take first open tag → LAST close
+    tag. Models sometimes nest examples containing literal [/SPEC] tags;
+    the widest span preserves them, where the reference would truncate the
+    spec at the embedded tag. Outputs diverge only on multi-close-tag
+    responses (pinned in tests/test_parsing.py).
     """
     start = response.find(SPEC_OPEN)
     if start == -1:

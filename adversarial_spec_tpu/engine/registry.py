@@ -35,7 +35,9 @@ class ModelSpec:
     size: str = "tiny"  # named config within the family (tiny/1b/8b/70b)
     dtype: str = "bfloat16"
     mesh: dict[str, int] = field(default_factory=dict)  # e.g. {"tp": 8}
-    max_seq_len: int = 8192
+    # 0 = keep the model config's native context length (e.g. 131072 for
+    # llama-3.2 1b/3b); nonzero overrides it.
+    max_seq_len: int = 0
     quant: str = ""  # "" = full precision, "int8" = weight-only int8
     kv: str = "dense"  # "dense" | "paged" — KV-cache layout for decode
     kv_dtype: str = ""  # "" = model dtype, "int8" = quantized KV cache

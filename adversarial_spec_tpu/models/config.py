@@ -43,6 +43,11 @@ class ModelConfig:
     sliding_window: int = 0
     sliding_window_pattern: int = 1
     qkv_bias: bool = False  # qwen-2
+    # Llama-3.1/3.2 rope scaling (HF rope_type="llama3"): 0 = unscaled.
+    rope_scaling_factor: float = 0.0
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max: int = 8192
     max_seq_len: int = 8192
     norm_scale_plus_one: bool = False  # gemma RMSNorm uses (1 + weight)
     # Gemma-2 "query_pre_attn_scalar": attention scale is 1/sqrt(this)
@@ -53,6 +58,18 @@ class ModelConfig:
     @property
     def q_per_kv(self) -> int:
         return self.n_heads // self.n_kv_heads
+
+    @property
+    def rope_scaling(self) -> tuple[float, float, float, float] | None:
+        """(factor, low, high, original_max) for ops/rope.py, or None."""
+        if not self.rope_scaling_factor:
+            return None
+        return (
+            self.rope_scaling_factor,
+            self.rope_low_freq_factor,
+            self.rope_high_freq_factor,
+            float(self.rope_original_max),
+        )
 
     @property
     def attn_scale(self) -> float:
@@ -78,13 +95,27 @@ def _llama(dim, n_layers, n_heads, n_kv_heads, ffn_dim, vocab=128256, **kw):
 # Named (family, size) → config. "tiny" sizes are for tests/CI: real family
 # semantics, toy widths (lane-aligned: dim multiple of 128 where possible).
 CONFIGS: dict[tuple[str, str], ModelConfig] = {
-    # Llama-3 family (HF meta-llama/Meta-Llama-3-8B etc.).
+    # Llama-3 family (HF meta-llama/Meta-Llama-3-8B etc.). 1b/3b are
+    # Llama-3.2 (tied embeddings, rope scaling factor 32, 128k context);
+    # 8b/70b are base Llama-3 (unscaled rope, 8k).
     ("llama", "tiny"): _llama(256, 2, 4, 2, 512, vocab=512),
-    ("llama", "1b"): _llama(2048, 16, 32, 8, 8192),
-    ("llama", "3b"): _llama(3072, 28, 24, 8, 8192),
+    ("llama", "1b"): _llama(
+        2048, 16, 32, 8, 8192,
+        tied_embeddings=True, rope_scaling_factor=32.0,
+        max_seq_len=131072,
+    ),
+    ("llama", "3b"): _llama(
+        3072, 28, 24, 8, 8192,
+        tied_embeddings=True, rope_scaling_factor=32.0,
+        max_seq_len=131072,
+    ),
     ("llama", "8b"): _llama(4096, 32, 32, 8, 14336),
     ("llama", "70b"): _llama(8192, 80, 64, 8, 28672),
-    # Mistral-7B: sliding window 4096, rope theta 1e4, vocab 32k.
+    # Mistral-7B. The named "7b" is v0.3 (rope theta 1e6, NO sliding
+    # window) — v0.1's theta-1e4 + window-4096 combination is a different
+    # checkpoint generation and must not be mixed with v0.3 fields (no
+    # real checkpoint has both). "tiny" keeps a window so the windowed
+    # code path stays covered by the mistral family tests.
     ("mistral", "tiny"): ModelConfig(
         vocab_size=512,
         dim=256,
@@ -97,7 +128,7 @@ CONFIGS: dict[tuple[str, str], ModelConfig] = {
         sliding_window=128,
     ),
     ("mistral", "7b"): ModelConfig(
-        vocab_size=32000,
+        vocab_size=32768,  # v0.3 extended vocabulary (v0.2 was 32000)
         dim=4096,
         n_layers=32,
         n_heads=32,
@@ -105,7 +136,7 @@ CONFIGS: dict[tuple[str, str], ModelConfig] = {
         head_dim=128,
         ffn_dim=14336,
         rope_theta=1000000.0,
-        sliding_window=4096,
+        max_seq_len=32768,
     ),
     # Gemma-2: sandwich norms, softcaps, tied+scaled embeddings, gelu,
     # alternating sliding window.

@@ -287,7 +287,9 @@ def forward(
     if cfg.scale_embeddings:
         x = (x.astype(jnp.float32) * math.sqrt(cfg.dim)).astype(x.dtype)
 
-    cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_angles(
+        positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
+    )
 
     # Masks shared by all layers. Slot j is visible to in-chunk query i iff
     # it holds a real token and j <= cache_index + i (causality in slot
@@ -426,7 +428,7 @@ def forward_paged_decode(
     tokens: jnp.ndarray,  # [B, 1] int32 — single decode step
     positions: jnp.ndarray,  # [B, 1] rope positions
     pool: Cache,  # {"k","v": [L, n_pages, page_size, Hkv, D]}
-    page_table: jnp.ndarray,  # [B, Pmax] int32, -1 = unmapped
+    page_table: jnp.ndarray,  # [B, Pmax] int32; <= 0 = unmapped (0=trash)
     write_page: jnp.ndarray,  # [B] physical page for this token's KV
     write_off: jnp.ndarray,  # [B] slot within that page
     bounds: jnp.ndarray,  # [B, 2] (start, end) valid logical-slot window
@@ -447,7 +449,9 @@ def forward_paged_decode(
     B = tokens.shape[0]
     page_size = pool["k"].shape[2]
     layer_ids = jnp.arange(cfg.n_layers)
-    cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+    cos, sin = rope_angles(
+        positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
+    )
 
     x = params["embed"][tokens]
     if cfg.scale_embeddings:
@@ -494,8 +498,11 @@ def forward_paged_decode(
             )
             T = k_dense.shape[1]
             slot = jnp.arange(T)[None, None, :]
+            # <= 0 is unmapped: page 0 is the reserved trash page (callers
+            # shift allocator ids +1), negatives are table padding. Same
+            # convention as ops/pallas_paged.py.
             mapped = jnp.repeat(
-                page_table >= 0, page_size, axis=1
+                page_table > 0, page_size, axis=1
             )[:, None, :]
             mask = (
                 mapped

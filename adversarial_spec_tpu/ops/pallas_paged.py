@@ -67,9 +67,12 @@ def _paged_attn_kernel(
     page_id = table_ref[b, p]
     t0 = p * page_size  # logical token offset of this page
 
-    # Unmapped pages (id < 0) and pages wholly outside [start, end) are
-    # masked; compute still runs (SPMD) but contributes nothing.
-    @pl.when((page_id >= 0) & (t0 < end))
+    # Unmapped pages — id <= 0: physical page 0 is the reserved TRASH page
+    # (callers shift allocator ids +1; engine/scheduler.py:TRASH_PAGE) and
+    # negative ids are table padding — and pages wholly outside
+    # [start, end) are masked; compute still runs (SPMD) but contributes
+    # nothing.
+    @pl.when((page_id > 0) & (t0 < end))
     def _accumulate():
         q = q_ref[0, 0].astype(jnp.float32) * scale
         k = k_ref[0, :, 0].astype(jnp.float32)  # [page, D]
@@ -104,13 +107,20 @@ def paged_decode_attention(
     q: jnp.ndarray,  # [B, Hq, D]
     k_pages: jnp.ndarray,  # [n_pages, page_size, Hkv, D]
     v_pages: jnp.ndarray,  # [n_pages, page_size, Hkv, D]
-    page_table: jnp.ndarray,  # [B, P] int32, -1 = unmapped
+    page_table: jnp.ndarray,  # [B, P] int32; <= 0 = unmapped (see below)
     bounds: jnp.ndarray,  # [B, 2] int32 (start, end) token window
     attn_softcap: float = 0.0,
     scale: float | None = None,
     interpret: bool = False,
 ) -> jnp.ndarray:
-    """Fused paged decode attention. Returns [B, Hq, D]."""
+    """Fused paged decode attention. Returns [B, Hq, D].
+
+    Page-table sentinel convention (shared with the jnp gather path in
+    models/transformer.py:forward_paged_decode): physical page 0 is the
+    reserved TRASH page — callers allocate real pages from id 1 up — so
+    any table entry <= 0 (trash or negative padding) is treated as
+    unmapped and masked out of the softmax.
+    """
     B, Hq, D = q.shape
     page_size, Hkv = k_pages.shape[1], k_pages.shape[2]
     P = page_table.shape[1]

@@ -10,17 +10,43 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
+def _llama3_scale(freqs: jnp.ndarray, scaling) -> jnp.ndarray:
+    """Llama-3.1/3.2 frequency-dependent NTK scaling.
+
+    Long-wavelength (low-frequency) components are stretched by ``factor``;
+    short wavelengths are kept; the band between ``low_freq_factor`` and
+    ``high_freq_factor`` (in units of original_max/wavelength) interpolates
+    smoothly. Matches HF ``rope_type="llama3"``.
+    """
+    factor, low, high, original_max = scaling
+    wavelen = 2.0 * jnp.pi / freqs
+    ratio = original_max / wavelen
+    smooth = jnp.clip((ratio - low) / (high - low), 0.0, 1.0)
+    return jnp.where(
+        ratio < low,
+        freqs / factor,
+        (1.0 - smooth) * freqs / factor + smooth * freqs,
+    )
+
+
 def rope_angles(
-    positions: jnp.ndarray, head_dim: int, theta: float
+    positions: jnp.ndarray,
+    head_dim: int,
+    theta: float,
+    scaling: tuple[float, float, float, float] | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """cos/sin tables for integer positions.
 
     positions: [...]; returns cos/sin of shape [..., head_dim//2], f32.
+    ``scaling``: optional llama-3 rope scaling as (factor, low_freq_factor,
+    high_freq_factor, original_max_seq_len); None = unscaled.
     """
     half = head_dim // 2
     freqs = 1.0 / (
         theta ** (jnp.arange(0, half, dtype=jnp.float32) / half)
     )
+    if scaling is not None:
+        freqs = _llama3_scale(freqs, scaling)
     ang = positions.astype(jnp.float32)[..., None] * freqs
     return jnp.cos(ang), jnp.sin(ang)
 

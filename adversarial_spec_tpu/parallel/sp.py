@@ -118,7 +118,9 @@ def sp_prefill(
             - pad_lens_rep[:, None],
             0,
         )
-        cos, sin = rope_angles(positions, cfg.head_dim, cfg.rope_theta)
+        cos, sin = rope_angles(
+            positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
+        )
 
         x = params_l["embed"][tokens_l]  # embed is tp-replicated
         if cfg.scale_embeddings:

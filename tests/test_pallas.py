@@ -106,7 +106,7 @@ class TestPagedKernel:
         )
 
         for b in range(B):
-            pages = [p for p in table[b] if p >= 0]
+            pages = [p for p in table[b] if p > 0]
             k = jnp.concatenate([kp[p] for p in pages], 0)[None]
             v = jnp.concatenate([vp[p] for p in pages], 0)[None]
             ref = _dense_ref(q[b : b + 1], k, v, bounds[b : b + 1])
@@ -129,6 +129,31 @@ class TestPagedKernel:
             q, kp, vp, jnp.asarray(table), bounds, interpret=True
         )
         ref = _dense_ref(q, kp[2][None], vp[2][None], bounds)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+    def test_trash_page_zero_is_masked(self):
+        """Physical page 0 is the reserved trash page (callers shift real
+        ids +1): a table entry of 0 must contribute nothing, even when
+        bounds would otherwise admit its slots. Kills a '> 0' → '>= 0'
+        regression that every other case in this class would miss (their
+        tables never contain 0)."""
+        B, Hq, Hkv, D = 1, 4, 2, 64
+        page_size, n_pages, P = 8, 4, 4
+        ks = jax.random.split(jax.random.key(3), 3)
+        q = jax.random.normal(ks[0], (B, Hq, D), jnp.float32)
+        kp = jax.random.normal(ks[1], (n_pages, page_size, Hkv, D), jnp.float32)
+        vp = jax.random.normal(ks[2], (n_pages, page_size, Hkv, D), jnp.float32)
+        # Logical page 0 → physical 2 (real), logical page 1 → physical 0
+        # (trash). Bounds cover both pages' slots.
+        table = np.array([[2, 0, 0, 0]], np.int32)
+        bounds = jnp.array([[0, 16]], jnp.int32)
+        out = paged_decode_attention(
+            q, kp, vp, jnp.asarray(table), bounds, interpret=True
+        )
+        # Reference attends ONLY to physical page 2's slots.
+        ref = _dense_ref(q, kp[2][None], vp[2][None], jnp.array([[0, 8]]))
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
         )

@@ -77,6 +77,31 @@ class TestShardedParams:
             np.asarray(params["layers"]["wq"]),
         )
 
+    def test_materialize_random_respects_tp_rules(self):
+        """The random-checkpoint branch hands jax DictKey paths to the
+        loader's device_put hook; the hook must still resolve the rule
+        (a miss silently replicates every param — OOM at 70B/tp=8)."""
+        from adversarial_spec_tpu.engine.loader import materialize_params
+        from adversarial_spec_tpu.parallel.sharding import make_device_put
+
+        mesh = make_mesh({"tp": 2})
+        params, _ = materialize_params(
+            "random",
+            "llama",
+            "tiny",
+            dtype=jnp.float32,
+            device_put=make_device_put(mesh, jnp.float32),
+        )
+        assert params["layers"]["wq"].sharding.spec == (
+            jax.sharding.PartitionSpec(None, None, TP)
+        )
+        assert params["layers"]["wo"].sharding.spec == (
+            jax.sharding.PartitionSpec(None, TP, None)
+        )
+        assert params["lm_head"].sharding.spec == (
+            jax.sharding.PartitionSpec(None, TP)
+        )
+
     def test_sharding_tree_matches_params_tree(self):
         cfg = get_config("qwen2", "tiny")  # includes biases
         params = T.init_params(jax.random.key(0), cfg)

@@ -48,6 +48,13 @@ class TestExtractSpec:
         text = "[SPEC]outer [SPEC]inner[/SPEC] tail[/SPEC]"
         assert extract_spec(text) == "outer [SPEC]inner[/SPEC] tail"
 
+    def test_multi_close_takes_last(self):
+        """Deliberate departure from the reference (which stops at the
+        FIRST [/SPEC]): an embedded literal close tag does not truncate.
+        Pins the divergence called out in extract_spec's docstring."""
+        text = "[SPEC]a[/SPEC]b[/SPEC]"
+        assert extract_spec(text) == "a[/SPEC]b"
+
     def test_multiline(self):
         spec = "# Title\n\nBody line 1\nBody line 2"
         assert extract_spec(f"critique\n[SPEC]\n{spec}\n[/SPEC]\ndone") == spec

@@ -214,3 +214,64 @@ def test_count_params():
     assert n > 0
     # Embedding + lm_head dominate: V*D*2 = 512*256*2.
     assert n > 2 * cfg.vocab_size * cfg.dim
+
+
+class TestRopeScaling:
+    """Llama-3.1/3.2 rope scaling (ops/rope.py:_llama3_scale)."""
+
+    def test_llama3_scaling_matches_hf_formula(self):
+        """Independent numpy re-derivation of HF rope_type="llama3"."""
+        from adversarial_spec_tpu.ops.rope import rope_angles
+
+        head_dim, theta = 64, 500000.0
+        factor, low, high, orig = 32.0, 1.0, 4.0, 8192.0
+        half = head_dim // 2
+        freqs = 1.0 / theta ** (np.arange(half, dtype=np.float64) / half)
+        # HF modeling_rope_utils._compute_llama3_parameters, re-derived.
+        low_wl = orig / low
+        high_wl = orig / high
+        expected = []
+        for f in freqs:
+            wl = 2 * np.pi / f
+            if wl < high_wl:
+                expected.append(f)
+            elif wl > low_wl:
+                expected.append(f / factor)
+            else:
+                smooth = (orig / wl - low) / (high - low)
+                expected.append((1 - smooth) * f / factor + smooth * f)
+        expected = np.asarray(expected)
+
+        pos = jnp.array([1.0])
+        cos, sin = rope_angles(
+            pos, head_dim, theta, scaling=(factor, low, high, orig)
+        )
+        # At position 1, angle == scaled frequency.
+        got = np.arctan2(np.asarray(sin[0]), np.asarray(cos[0]))
+        np.testing.assert_allclose(got, expected, rtol=1e-6)
+
+    def test_scaling_changes_low_freqs_only(self):
+        from adversarial_spec_tpu.ops.rope import rope_angles
+
+        pos = jnp.array([100.0])
+        plain = rope_angles(pos, 64, 500000.0)
+        scaled = rope_angles(
+            pos, 64, 500000.0, scaling=(32.0, 1.0, 4.0, 8192.0)
+        )
+        # Highest-frequency component (index 0) is untouched.
+        np.testing.assert_allclose(plain[0][0, 0], scaled[0][0, 0])
+        # Lowest-frequency component is stretched (angle shrinks).
+        assert abs(float(scaled[1][0, -1])) < abs(float(plain[1][0, -1]))
+
+    def test_named_configs_are_checkpoint_consistent(self):
+        """ADVICE r1: each named config matches ONE real checkpoint gen."""
+        c1b = get_config("llama", "1b")
+        assert c1b.tied_embeddings and c1b.rope_scaling is not None
+        c3b = get_config("llama", "3b")
+        assert c3b.tied_embeddings and c3b.rope_scaling is not None
+        c8b = get_config("llama", "8b")
+        assert not c8b.tied_embeddings and c8b.rope_scaling is None
+        # Mistral-7B v0.3: theta 1e6, NO sliding window, 32768 vocab.
+        m7b = get_config("mistral", "7b")
+        assert m7b.rope_theta == 1000000.0 and m7b.sliding_window == 0
+        assert m7b.vocab_size == 32768
