@@ -153,6 +153,7 @@ def prefill_chunk(
         "use_top_p",
         "use_pallas_decode",
         "pallas_interpret",
+        "mesh",
     ),
     donate_argnames=("cache", "out_buf"),
 )
@@ -178,6 +179,7 @@ def decode_chunk_steps(
     use_top_p: bool = True,
     use_pallas_decode: bool = False,
     pallas_interpret: bool = False,
+    mesh=None,
 ) -> tuple[Cache, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Up to ``chunk`` single-token decode steps inside one XLA program.
 
@@ -213,6 +215,7 @@ def decode_chunk_steps(
             kv_valid,
             use_pallas_decode=use_pallas_decode,
             pallas_interpret=pallas_interpret,
+            mesh=mesh,
         )
         key, nxt, finished, out_buf = _sample_step(
             logits[:, 0],
@@ -275,7 +278,9 @@ def generate(
     a dp multiple by replicating the last prompt; extra rows dropped from
     the result) and token inputs are placed with NamedShardings — GSPMD
     propagates dp through activations and the KV cache, while params carry
-    their tp shardings from the loader (parallel/sharding.py).
+    their tp shardings from the loader (parallel/sharding.py). The fused
+    decode kernel runs under shard_map on such meshes (dp over rows, tp
+    over KV heads) whenever tp divides n_kv_heads.
 
     ``share_prefix``: a debate round sends IDENTICAL prompts to every
     opponent sharing a model (round-level focus/persona apply to all), so
@@ -320,11 +325,27 @@ def generate(
         # through the (dequant-fused) jnp attention path.
         use_pallas_decode = False
     if use_pallas_decode is None:
-        # Auto: fused kernel on a real single-device TPU; jnp path for
-        # GSPMD-sharded meshes (the kernel isn't partitionable) and CPU.
-        single = mesh is None or mesh.size == 1
-        use_pallas_decode = single and jax.default_backend() == "tpu"
+        # Auto: fused kernel on a real TPU. Multi-device meshes run it
+        # under shard_map (batch over dp, KV heads over tp); the support
+        # gate below demotes unsupported tp degrees for auto and explicit
+        # callers alike.
+        use_pallas_decode = jax.default_backend() == "tpu"
     pallas_interpret = jax.default_backend() == "cpu"
+    if use_pallas_decode and mesh is not None and mesh.size > 1:
+        from adversarial_spec_tpu.ops.pallas_decode import (
+            tp_decode_supported,
+        )
+
+        if not tp_decode_supported(cfg.n_kv_heads, mesh):
+            if explicit_pallas:
+                import sys as _sys
+
+                print(
+                    f"warning: fused decode needs tp | n_kv_heads "
+                    f"({cfg.n_kv_heads}); using the jnp attention path",
+                    file=_sys.stderr,
+                )
+            use_pallas_decode = False
 
     n_real = len(prompt_ids)
     if mesh is not None:
@@ -670,6 +691,7 @@ def generate(
                 use_top_p=use_top_p,
                 use_pallas_decode=use_pallas_decode,
                 pallas_interpret=pallas_interpret,
+                mesh=mesh if (mesh is not None and mesh.size > 1) else None,
             )
         step.block_until_ready()
     decode_time = time.monotonic() - t1

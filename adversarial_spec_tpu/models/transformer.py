@@ -267,6 +267,7 @@ def forward(
     use_pallas_decode: bool = False,
     pallas_interpret: bool = False,
     lm_head_last_only: bool = False,
+    mesh=None,
 ) -> tuple[jnp.ndarray, Cache]:
     """One forward pass over a chunk (prefill: S=chunk, decode: S=1).
 
@@ -276,8 +277,10 @@ def forward(
     Returns (logits [B, S, vocab] f32, updated cache).
 
     ``use_pallas_decode`` routes S==1 attention through the fused Pallas
-    flash-decoding kernel (ops/pallas_decode.py) — single-device meshes
-    only; GSPMD-sharded runs keep the partitionable jnp path.
+    flash-decoding kernel (ops/pallas_decode.py). On a multi-device
+    ``mesh`` the kernel runs under shard_map — batch over dp, KV heads
+    over tp (ops/pallas_decode.py:decode_attention_tp); callers gate on
+    ``tp_decode_supported``.
     """
     B, S = tokens.shape
     T = cache["k"].shape[2]
@@ -352,21 +355,34 @@ def forward(
         if pallas_decode:
             from adversarial_spec_tpu.ops.pallas_decode import (
                 decode_attention,
+                decode_attention_tp,
             )
 
             start = _layer_window_start(
                 cfg, layer_id, pallas_start, cache_index
             )
             bounds = jnp.stack([start, pallas_end], axis=1)
-            out = decode_attention(
-                q[:, 0],
-                k_read,
-                v_read,
-                bounds,
-                attn_softcap=cfg.attn_softcap,
-                scale=cfg.attn_scale,
-                interpret=pallas_interpret,
-            )[:, None]
+            if mesh is not None and mesh.size > 1:
+                out = decode_attention_tp(
+                    q[:, 0],
+                    k_read,
+                    v_read,
+                    bounds,
+                    mesh,
+                    attn_softcap=cfg.attn_softcap,
+                    scale=cfg.attn_scale,
+                    interpret=pallas_interpret,
+                )[:, None]
+            else:
+                out = decode_attention(
+                    q[:, 0],
+                    k_read,
+                    v_read,
+                    bounds,
+                    attn_softcap=cfg.attn_softcap,
+                    scale=cfg.attn_scale,
+                    interpret=pallas_interpret,
+                )[:, None]
         else:
             if cfg.sliding_window > 0 and cfg.sliding_window_pattern > 1:
                 # Gemma-2: alternate windowed / global layers.

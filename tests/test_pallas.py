@@ -195,3 +195,65 @@ class TestPallasInGenerate:
         out_w = generate(params, cfg_w, prompts, use_pallas_decode=True, **kw)
         out_g = generate(params, cfg_g, prompts, use_pallas_decode=True, **kw)
         assert not np.array_equal(out_w.tokens, out_g.tokens)
+
+
+class TestShardedPallasDecode:
+    """decode_attention_tp: the fused kernel under shard_map (dp×tp).
+
+    VERDICT r1 item 2 — BASELINE configs 3-5 decode through Pallas instead
+    of the jnp fallback. Parity on the virtual 8-device mesh is the
+    correctness bar; interpret mode stands in for the Mosaic compile.
+    """
+
+    @pytest.fixture(autouse=True)
+    def _needs_8_devices(self):
+        if len(jax.devices()) < 8:
+            pytest.skip("requires 8 virtual devices")
+
+    def test_kernel_parity_on_mesh(self):
+        from adversarial_spec_tpu.ops.pallas_decode import (
+            decode_attention,
+            decode_attention_tp,
+        )
+        from adversarial_spec_tpu.parallel.mesh import make_mesh
+
+        B, Hq, Hkv, D, T_ = 4, 8, 2, 64, 256
+        ks = jax.random.split(jax.random.key(7), 3)
+        q = jax.random.normal(ks[0], (B, Hq, D), jnp.float32)
+        k = jax.random.normal(ks[1], (B, T_, Hkv, D), jnp.float32)
+        v = jax.random.normal(ks[2], (B, T_, Hkv, D), jnp.float32)
+        bounds = jnp.array(
+            [[0, 256], [3, 100], [100, 256], [17, 18]], jnp.int32
+        )
+        ref = decode_attention(q, k, v, bounds, interpret=True)
+        mesh = make_mesh({"dp": 4, "tp": 2})
+        with mesh:
+            out = decode_attention_tp(
+                q, k, v, bounds, mesh, interpret=True
+            )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+    @pytest.mark.parametrize("mesh_spec", [{"tp": 2}, {"dp": 4, "tp": 2}])
+    def test_generate_parity_sharded_kernel_vs_jnp(self, mesh_spec):
+        """Greedy decode through the shard_mapped kernel must reproduce
+        the single-device jnp tokens on dp×tp meshes."""
+        from adversarial_spec_tpu.engine.generate import generate
+        from adversarial_spec_tpu.parallel.mesh import make_mesh
+        from adversarial_spec_tpu.parallel.sharding import shard_params
+
+        cfg = get_config("llama", "tiny")  # n_kv_heads=2 — tp=2 divides
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        prompts = [[1, 5, 9, 3], [2, 6], [8, 8, 8], [4]]
+        kw = dict(max_new_tokens=6, eos_ids=[], greedy=True)
+
+        ref = generate(params, cfg, prompts, use_pallas_decode=False, **kw)
+        mesh = make_mesh(mesh_spec)
+        sharded = shard_params(mesh, params)
+        with mesh:
+            out = generate(
+                sharded, cfg, prompts, mesh=mesh,
+                use_pallas_decode=True, speculative=False, **kw,
+            )
+        np.testing.assert_array_equal(ref.tokens, out.tokens)
