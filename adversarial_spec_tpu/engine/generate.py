@@ -571,28 +571,32 @@ def generate(
         paged_max_new = jnp.full((B,), max_new_tokens, jnp.int32)
         paged_active = ~finished
 
-    # Speculative eligibility: greedy, one row, dense cache, one device,
-    # enough output budget for at least one γ+1 span — and an explicit
-    # use_pallas_decode=True wins over auto-speculation (speculation
-    # forces the jnp attention path; see below).
+    # Speculative eligibility: dense cache, one device, enough output
+    # budget for at least one γ+1 span. Any batch size and any sampling
+    # mode qualify (per-row accept lengths + rejection sampling) — the
+    # bench shape (4 opponents, temperature 0.7) is the target workload.
+    # An explicit use_pallas_decode=True wins over auto-speculation
+    # (speculation forces the jnp attention path; see below).
     from adversarial_spec_tpu.engine.speculative import GAMMA
 
     if speculative is None:
         speculative = not explicit_pallas
     use_spec = (
         speculative
-        and B == 1
-        and greedy
         and not paged
         and (mesh is None or mesh.size == 1)
         and max_new_tokens > GAMMA + 1
     )
+    desynced = False  # per-row steps diverge after any speculative phase
+    steps_rows = None
     if use_spec:
         from adversarial_spec_tpu.engine.speculative import (
+            rowwise_decode_steps,
             speculative_decode_steps,
         )
 
-        prev_tok = tokens[0, -1]
+        prev_rows = tokens[:, -1]
+        steps_rows = jnp.ones((B,), jnp.int32)
         # Keep the whole call on ONE attention implementation: the
         # verification forward runs the jnp path (S=γ+1 — the fused
         # Pallas kernel is single-query), so the single-token tail must
@@ -600,38 +604,93 @@ def generate(
         use_pallas_decode = False
 
     t1 = time.monotonic()
-    while int(step) < max_new_tokens and not bool(finished.all()):
+
+    def _steps_exit() -> int:
+        """Host-side loop scalar: min over rows of (done ? max_new :
+        steps) — max_new only once every row is finished or at budget."""
+        if steps_rows is None:
+            return int(step)
+        s = np.asarray(steps_rows)
+        f = np.asarray(finished)
+        return int(np.where(f, max_new_tokens, s).min())
+
+    while _steps_exit() < max_new_tokens and not bool(finished.all()):
         if deadline is not None and time.monotonic() >= deadline:
             timed_out = True
             break
         key, chunk_key = jax.random.split(key)
-        if use_spec and int(step) + GAMMA + 1 <= max_new_tokens:
-            step_before = int(step)
-            cache, prev_tok, cur_scalar, finished, out_buf, step, n_iters = (
-                speculative_decode_steps(
-                    params,
-                    cfg,
-                    cache,
-                    tokens,
-                    prev_tok,
-                    cur[0],
-                    pad_lens,
-                    finished,
-                    out_buf,
-                    step,
-                    jnp.int32(max_new_tokens),
-                    eos,
-                    prompt_len=S,
-                    chunk=DECODE_CHUNK,
-                )
+        if use_spec:
+            spec_mask = ~np.asarray(finished) & (
+                np.asarray(steps_rows) + GAMMA + 1 <= max_new_tokens
             )
-            cur = cur_scalar[None]
+            spec_fits = bool(spec_mask.any())
+        else:
+            spec_fits = False
+        if spec_fits:
+            (
+                cache,
+                prev_rows,
+                cur,
+                finished,
+                out_buf,
+                steps_rows,
+                n_iters,
+                n_emitted,
+                n_row_iters,
+            ) = speculative_decode_steps(
+                params,
+                cfg,
+                cache,
+                tokens,
+                prev_rows,
+                cur,
+                pad_lens,
+                finished,
+                out_buf,
+                steps_rows,
+                jnp.int32(max_new_tokens),
+                eos,
+                chunk_key,
+                temp,
+                tp,
+                prompt_len=S,
+                iters=max(1, DECODE_CHUNK // (GAMMA + 1)),
+                greedy=greedy,
+                top_k=top_k,
+                use_top_p=use_top_p,
+            )
+            desynced = True
+            step = jnp.max(steps_rows)
             # Adaptive off-switch: each verification forward is γ+1 wide;
-            # if it averages barely more than one emitted token, drafts
-            # aren't matching and plain decode is cheaper.
-            iters = max(int(n_iters), 1)
-            if (int(step) - step_before) / iters < 1.5:
+            # if it averages barely more than one emitted token per
+            # active row-iteration (exact count from the device loop),
+            # drafts aren't matching and plain decode is cheaper.
+            if int(n_emitted) / max(int(n_row_iters), 1) < 1.5:
                 use_spec = False
+        elif desynced:
+            # Rows no longer share a step count: finish on the per-row-
+            # slot tail loop (speculative.py), same sampling semantics.
+            cache, cur, finished, out_buf, steps_rows = rowwise_decode_steps(
+                params,
+                cfg,
+                cache,
+                cur,
+                pad_lens,
+                finished,
+                out_buf,
+                steps_rows,
+                jnp.int32(max_new_tokens),
+                eos,
+                chunk_key,
+                temp,
+                tp,
+                prompt_len=S,
+                chunk=DECODE_CHUNK,
+                greedy=greedy,
+                top_k=top_k,
+                use_top_p=use_top_p,
+            )
+            step = jnp.max(steps_rows)
         elif paged:
             from adversarial_spec_tpu.engine.scheduler import (
                 scheduler_decode_chunk,
@@ -698,16 +757,25 @@ def generate(
 
     out_np = np.asarray(out_buf)[:n_real, :max_new_tokens]
     B = n_real  # dp-padding rows dropped
-    n_steps = min(int(step), max_new_tokens)
+    # Per-row step counts: shared scalar on the synced paths; the
+    # speculative paths desynchronize rows (a timeout can strand them at
+    # different steps — a shared max would count a slower row's zero
+    # slots as output).
+    if steps_rows is not None:
+        row_steps = np.minimum(
+            np.asarray(steps_rows)[:n_real], max_new_tokens
+        )
+    else:
+        row_steps = np.full((B,), min(int(step), max_new_tokens))
     eos_np = np.asarray(sorted(set(eos_ids)) or [-1])
     n_generated = np.zeros((B,), np.int64)
     for b in range(B):
-        row = out_np[b, :n_steps]
+        row = out_np[b, : row_steps[b]]
         eos_hits = np.isin(row, eos_np)
         if eos_hits.any():
             n_generated[b] = int(np.argmax(eos_hits)) + 1
         else:
-            n_generated[b] = n_steps
+            n_generated[b] = row_steps[b]
     return GenerateResult(
         tokens=out_np,
         n_generated=n_generated,

@@ -11,9 +11,8 @@ import jax
 import jax.numpy as jnp
 
 
-def sample_tokens(
-    logits: jnp.ndarray,  # [B, V] f32
-    key: jax.Array,
+def filtered_logits(
+    logits: jnp.ndarray,  # [..., V] f32
     *,
     greedy: bool,
     top_k: int,
@@ -21,14 +20,23 @@ def sample_tokens(
     top_p: jnp.ndarray,  # scalar f32
     use_top_p: bool = True,
 ) -> jnp.ndarray:
-    """Sample one token per row. Returns [B] int32.
+    """The post-filter logits whose softmax IS the sampling distribution.
 
-    ``use_top_p`` is a static switch: callers that know (at trace time)
-    top_p >= 1 skip the full-vocab sort/cumsum entirely — it would be a
-    semantic no-op that still costs a vocab-sized sort per decode step.
+    Exposed separately from ``sample_tokens`` because speculative decoding
+    (engine/speculative.py) needs the target *distribution* per verified
+    position for rejection sampling — acceptance tests and residual draws
+    must use exactly what plain decode would sample from, or speculation
+    changes the output distribution. Greedy (and temperature <= 0)
+    degenerates to a one-hot at the argmax.
     """
+    onehot = jnp.where(
+        jnp.arange(logits.shape[-1])
+        == jnp.argmax(logits, axis=-1, keepdims=True),
+        0.0,
+        -jnp.inf,
+    )
     if greedy:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return onehot
 
     # temperature == 0 degrades to greedy without retracing.
     safe_t = jnp.maximum(temperature, 1e-6)
@@ -53,6 +61,35 @@ def sample_tokens(
         )
         scaled = jnp.where(scaled < cutoff_logit, -jnp.inf, scaled)
 
-    sampled = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
-    argmax = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jnp.where(temperature <= 0.0, argmax, sampled)
+    return jnp.where(temperature <= 0.0, onehot, scaled)
+
+
+def sample_tokens(
+    logits: jnp.ndarray,  # [B, V] f32
+    key: jax.Array,
+    *,
+    greedy: bool,
+    top_k: int,
+    temperature: jnp.ndarray,  # scalar f32
+    top_p: jnp.ndarray,  # scalar f32
+    use_top_p: bool = True,
+) -> jnp.ndarray:
+    """Sample one token per row. Returns [B] int32.
+
+    ``use_top_p`` is a static switch: callers that know (at trace time)
+    top_p >= 1 skip the full-vocab sort/cumsum entirely — it would be a
+    semantic no-op that still costs a vocab-sized sort per decode step.
+    """
+    if greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    filt = filtered_logits(
+        logits,
+        greedy=greedy,
+        top_k=top_k,
+        temperature=temperature,
+        top_p=top_p,
+        use_top_p=use_top_p,
+    )
+    # temperature <= 0: filtered_logits already degenerated to the argmax
+    # one-hot, and categorical over a one-hot returns it deterministically.
+    return jax.random.categorical(key, filt, axis=-1).astype(jnp.int32)

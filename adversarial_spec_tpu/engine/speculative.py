@@ -1,32 +1,42 @@
-"""Prompt-lookup speculative decoding (greedy, single-row).
+"""Prompt-lookup speculative decoding — batched, any sampling mode.
 
 The debate workload's dominant output is a ``[SPEC]...[/SPEC]`` revision —
 a near-copy of the input document with edits. That makes *prompt-lookup*
 drafting (LLMA / prompt-lookup decoding: match the last n-gram of the
-generated text against the prompt and draft the tokens that followed it
+generated text against the context and draft the tokens that followed it
 there) exceptionally effective: long runs of the revision are verbatim
-prompt spans, so most drafts verify and the model emits several tokens per
-forward pass instead of one. No draft model, no extra weights — the draft
-source is the prompt itself.
+context spans, so most drafts verify and the model emits several tokens
+per forward pass instead of one. No draft model, no extra weights — the
+draft source is the prompt *plus the text generated so far* (revision
+notes repeat across rounds, so generated text matters).
 
-One step: draft γ tokens from the best (most recent) n-gram match; run ONE
-verification forward over [cur, d_0..d_{γ-1}] (γ+1 positions, the same
-KV-cached forward prefill chunks use); accept the longest prefix of drafts
-that equals the greedy argmax chain; emit the accepted tokens plus the
-model's own next token (always ≥1 token of progress, bit-identical to
-plain greedy decode by construction).
+One step, per batch row: draft γ tokens from the most recent n-gram match;
+run ONE verification forward over [cur, d_0..d_{γ-1}] (γ+1 positions, the
+same KV-cached forward prefill chunks use, with per-row cache slots since
+rows desynchronize); accept drafts by REJECTION SAMPLING against the true
+sampling distribution (engine/sampling.py:filtered_logits):
 
-Cache discipline: the verification forward writes γ+1 KV slots; rejected
-drafts leave stale KV above slot cache_index+n_acc, but the next step's
-write region starts exactly there (new cache_index = old + n_emit) and
-layer writes land before attention, so stale slots are never read.
+    draft token d_i is a delta distribution, so accept with probability
+    p_i(d_i) (u < p catches both: greedy p is one-hot → exact argmax
+    match); on the first rejection sample from the residual p with d_i
+    zeroed and renormalized — the marginal at every position is exactly p,
+    so speculation is *distribution-preserving* at any temperature and
+    bit-identical to plain decode when greedy.
 
-Scope (v1): greedy sampling, one row (B=1 — BASELINE config 2's
-single-opponent critique), dense KV cache, jnp attention (generate()
-forces the tail decode off the Pallas kernel so one attention
-implementation governs the whole call — near-tie argmaxes must not
-diverge between verify and tail). Exact-output parity with plain greedy
-decode on the same attention path is the correctness contract (tested).
+Cache discipline: the verification forward writes γ+1 KV slots per row at
+that row's own offset; rejected drafts leave stale KV above slot
+cache_index+n_acc, but the row's next write region starts exactly there
+(new cache_index = old + n_emit) and layer writes land before attention,
+so stale slots are never read.
+
+Because rows accept different draft counts, they desynchronize — after any
+speculative phase the tail must finish on ``rowwise_decode_steps`` (per-row
+cache slots), not the shared-slot loop in engine/generate.py.
+
+Scope: dense KV cache, single-device, jnp attention (generate() forces the
+whole call off the Pallas kernel — the single-query kernel can't verify
+γ+1-wide spans, and one attention implementation must govern the call so
+near-tie argmaxes can't diverge between verify and tail).
 
 EOS contract (mirror of generate._sample_step — change BOTH together):
 the EOS token itself is kept in the output; slots after it emit 0.
@@ -39,122 +49,355 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from adversarial_spec_tpu.engine.sampling import (
+    filtered_logits,
+    sample_tokens,
+)
 from adversarial_spec_tpu.models.config import ModelConfig
 from adversarial_spec_tpu.models.transformer import Cache, Params, forward
 
 GAMMA = 8  # draft length per step
 
 
+def _rowwise_slice(buf: jnp.ndarray, starts: jnp.ndarray, size: int):
+    """[B, N] gathered at per-row starts → [B, size]."""
+    return jax.vmap(
+        lambda row, s: jax.lax.dynamic_slice(row, (s,), (size,))
+    )(buf, starts)
+
+
+def _rowwise_write(buf: jnp.ndarray, vals: jnp.ndarray, starts: jnp.ndarray):
+    """Write [B, size] into [B, N] at per-row starts."""
+    return jax.vmap(
+        lambda row, v, s: jax.lax.dynamic_update_slice(row, v, (s,))
+    )(buf, vals, starts)
+
+
+def _draft(context, prev, cur, limits, gamma):
+    """Most recent [prev, cur] bigram match in each row's context.
+
+    context: [B, N] prompt ++ generated-so-far (zeros beyond ``limits``);
+    limits: [B] one past the last real context token. Returns draft
+    [B, gamma] — the tokens that followed the match (zeros when none;
+    drafts never affect correctness, only acceptance rate).
+    """
+    B, N = context.shape
+    pos = jnp.arange(N - 1)[None, :]
+    match = (
+        (context[:, :-1] == prev[:, None])
+        & (context[:, 1:] == cur[:, None])
+        # The bigram AND at least one drafted token must be real context.
+        & (pos + 2 < limits[:, None])
+    )
+    best = jnp.max(jnp.where(match, pos, -1), axis=1)  # [B]
+    has_match = best >= 0
+    d_start = jnp.clip(best + 2, 0, N - gamma)
+    draft = _rowwise_slice(context, d_start, gamma)
+    return jnp.where(has_match[:, None], draft, jnp.zeros_like(draft))
+
+
 @partial(
     jax.jit,
-    static_argnames=("cfg", "prompt_len", "chunk", "gamma"),
+    static_argnames=(
+        "cfg",
+        "prompt_len",
+        "iters",
+        "gamma",
+        "greedy",
+        "top_k",
+        "use_top_p",
+    ),
     donate_argnames=("cache", "out_buf"),
 )
 def speculative_decode_steps(
     params: Params,
     cfg: ModelConfig,
     cache: Cache,
-    prompt_tokens: jnp.ndarray,  # [1, S] the left-padded prompt (draft source)
-    prev_token: jnp.ndarray,  # [] token before cur (n-gram context)
-    cur_token: jnp.ndarray,  # [] last emitted token
-    pad_lens: jnp.ndarray,  # [1]
-    finished: jnp.ndarray,  # [1] bool
-    out_buf: jnp.ndarray,  # [1, max_new]
-    start_step: jnp.ndarray,  # scalar
-    stop_at: jnp.ndarray,  # scalar
+    prompt_tokens: jnp.ndarray,  # [B, S] left-padded prompts (draft source)
+    prev_tokens: jnp.ndarray,  # [B] token before cur (n-gram context)
+    cur_tokens: jnp.ndarray,  # [B] last emitted token per row
+    pad_lens: jnp.ndarray,  # [B]
+    finished: jnp.ndarray,  # [B] bool
+    out_buf: jnp.ndarray,  # [B, max_new]
+    steps: jnp.ndarray,  # [B] per-row decode step (out_buf position)
+    stop_at: jnp.ndarray,  # scalar: decode no further than this step
     eos_ids: jnp.ndarray,  # [E]
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    top_p: jnp.ndarray,
     *,
     prompt_len: int,
-    chunk: int,
+    iters: int,
     gamma: int = GAMMA,
+    greedy: bool = False,
+    top_k: int = 0,
+    use_top_p: bool = True,
 ):
-    """Run speculative greedy steps while ≥ γ+1 output slots remain.
+    """Up to ``iters`` speculative rounds over whichever rows still fit a
+    full γ+1 span.
 
-    Returns (cache, prev, cur, finished, out_buf, step, n_iters) — the
-    caller finishes any tail with the plain single-token loop, and can use
-    step-progress / n_iters (mean tokens emitted per verification forward)
-    to turn speculation OFF when drafts aren't matching (each rejected
-    round costs a γ+1-wide forward to emit one token).
+    Returns (cache, prev, cur, finished, out_buf, steps, n_iters,
+    n_emitted_total, n_row_iters) — the caller finishes budget-capped
+    rows with ``rowwise_decode_steps`` and can use n_emitted_total /
+    n_row_iters (exact per-active-row emit rate: n_row_iters counts
+    active rows summed over iterations) to turn speculation OFF when
+    drafts aren't matching (each rejected round costs a γ+1-wide forward
+    to emit one token).
     """
-    S = prompt_tokens.shape[1]
+    B, S = prompt_tokens.shape
     T = cache["k"].shape[2]
     max_new = out_buf.shape[1]
-    pt = prompt_tokens[0]
     kv_base = jnp.arange(T)[None, :] >= pad_lens[:, None]
-    draft_span = gamma + 1
+    span = gamma + 1
+    rows = jnp.arange(B)
+    bound = jnp.minimum(stop_at, max_new)
+
+    def active_rows(steps, finished):
+        return ~finished & (steps + span <= bound)
 
     def cond(state):
-        step, finished = state[0], state[5]
-        # The full span must fit the output budget; the chunk bound only
-        # paces how much work one host call performs.
-        fits = step + draft_span <= jnp.minimum(stop_at, max_new)
-        return fits & (step < start_step + chunk) & ~finished.all()
+        it, steps, finished = state[0], state[1], state[6]
+        return (it < iters) & active_rows(steps, finished).any()
 
     def body(state):
-        step, prev, cur, cache, out_buf, finished, n_iters = state
+        (
+            it,
+            steps,
+            prev,
+            cur,
+            cache,
+            out_buf,
+            finished,
+            key,
+            n_emit_tot,
+            n_row_iters,
+        ) = state
+        active = active_rows(steps, finished)
 
-        # --- Draft: most recent prompt position following [prev, cur]. ---
-        match = (pt[:-1] == prev) & (pt[1:] == cur)  # [S-1]
-        pos = jnp.arange(S - 1)
-        best = jnp.max(jnp.where(match, pos, -1))
-        has_match = best >= 0
-        d_start = jnp.clip(best + 2, 0, S - gamma)
-        draft = jax.lax.dynamic_slice(pt, (d_start,), (gamma,))
-        draft = jnp.where(has_match, draft, jnp.zeros_like(draft))
+        # --- Draft from prompt ++ generated text (most recent match). ---
+        context = jnp.concatenate([prompt_tokens, out_buf], axis=1)
+        draft = _draft(context, prev, cur, prompt_len + steps, gamma)
 
-        # --- Verify: one forward over [cur, draft]. ---
-        toks = jnp.concatenate([cur[None], draft])[None]  # [1, γ+1]
-        cache_index = prompt_len + step - 1
+        # --- Verify: one forward over [cur, draft] at per-row slots. ---
+        toks = jnp.concatenate([cur[:, None], draft], axis=1)  # [B, γ+1]
+        cache_index = prompt_len + steps - 1  # [B]
         positions = (
-            cache_index
-            + jnp.arange(draft_span, dtype=jnp.int32)[None, :]
+            cache_index[:, None]
+            + jnp.arange(span, dtype=jnp.int32)[None, :]
             - pad_lens[:, None]
         )
         logits, cache = forward(
             params, cfg, toks, positions, cache, cache_index, kv_base
         )
-        greedy_chain = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)
+        # The true per-position sampling distribution (one-hot if greedy).
+        filt = filtered_logits(
+            logits,
+            greedy=greedy,
+            top_k=top_k,
+            temperature=temperature,
+            top_p=top_p,
+            use_top_p=use_top_p,
+        )  # [B, γ+1, V]
+        probs = jax.nn.softmax(filt, axis=-1)
 
-        # --- Accept the longest verified prefix, emit + bonus token. ---
-        matches = draft == greedy_chain[:-1]  # [γ]
-        n_acc = jnp.sum(jnp.cumprod(matches.astype(jnp.int32)))
-        emitted = jnp.concatenate([draft, jnp.zeros((1,), draft.dtype)])
-        emitted = emitted.at[n_acc].set(greedy_chain[n_acc])
+        # --- Rejection-sample the accept length per row. ---
+        key, u_key, res_key = jax.random.split(key, 3)
+        p_draft = jnp.take_along_axis(
+            probs[:, :-1], draft[..., None], axis=-1
+        )[..., 0]  # [B, γ] target prob of each draft token
+        u = jax.random.uniform(u_key, (B, gamma))
+        accept = u < p_draft  # greedy: p ∈ {0,1} ⇒ exact argmax match
+        n_acc = jnp.sum(
+            jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1
+        )  # [B]
 
-        is_eos = (emitted[:, None] == eos_ids[None, :]).any(axis=-1)
-        j = jnp.arange(draft_span)
-        eos_hits = is_eos & (j <= n_acc)
-        any_eos = eos_hits.any()
-        first_eos = jnp.argmax(eos_hits)
-        n_emit = jnp.where(any_eos, first_eos + 1, n_acc + 1)
-        emitted = jnp.where(j < n_emit, emitted, 0)
-
-        out_buf = jax.lax.dynamic_update_slice(
-            out_buf, emitted[None], (0, step)
+        # --- The (γ+1)-th token: residual draw at the rejection point,
+        # or a fresh draw from the last position when all drafts hit. ---
+        at = probs[rows, n_acc]  # [B, V] distribution at emit position
+        rejected = n_acc < gamma
+        rej_draft = draft[rows, jnp.minimum(n_acc, gamma - 1)]
+        # Residual: zero the rejected draft token, renormalize. Marginal
+        # over (accept, residual) is exactly `at` — see module docstring.
+        res = at.at[rows, rej_draft].set(
+            jnp.where(rejected, 0.0, at[rows, rej_draft])
         )
-        finished = finished | any_eos
-        new_cur = emitted[n_emit - 1]
-        new_prev = jnp.where(n_emit >= 2, emitted[n_emit - 2], cur)
+        res = res / jnp.maximum(res.sum(-1, keepdims=True), 1e-30)
+        bonus = jax.random.categorical(
+            res_key, jnp.log(jnp.maximum(res, 1e-30)), axis=-1
+        ).astype(jnp.int32)
+        if greedy:
+            # Bit-identical contract: no RNG in the greedy path. The
+            # residual of a one-hot is one-hot ⇒ argmax, computed directly.
+            bonus = jnp.argmax(res, axis=-1).astype(jnp.int32)
+
+        emitted = jnp.concatenate(
+            [draft, jnp.zeros((B, 1), draft.dtype)], axis=1
+        )
+        emitted = emitted.at[rows, n_acc].set(bonus)
+
+        # --- EOS + per-row emit counts (EOS kept, zeros after). ---
+        is_eos = (emitted[..., None] == eos_ids[None, None, :]).any(-1)
+        j = jnp.arange(span)[None, :]
+        eos_hits = is_eos & (j <= n_acc[:, None])
+        any_eos = eos_hits.any(axis=1)
+        first_eos = jnp.argmax(eos_hits, axis=1)
+        n_emit = jnp.where(any_eos, first_eos + 1, n_acc + 1)
+        n_emit = jnp.where(active, n_emit, 0)
+        emitted = jnp.where(j < n_emit[:, None], emitted, 0)
+
+        # Inactive rows write their existing slots back (no-op write —
+        # a clamped zero-write could smash a budget-capped row's tail).
+        w_start = jnp.minimum(steps, max_new - span)
+        current = _rowwise_slice(out_buf, w_start, span)
+        out_buf = _rowwise_write(
+            out_buf,
+            jnp.where(active[:, None], emitted, current),
+            w_start,
+        )
+
+        finished = finished | (any_eos & active)
+        new_cur = jnp.where(
+            active, emitted[rows, jnp.maximum(n_emit - 1, 0)], cur
+        )
+        new_prev = jnp.where(
+            active,
+            jnp.where(n_emit >= 2, emitted[rows, n_emit - 2], cur),
+            prev,
+        )
         return (
-            step + n_emit,
+            it + 1,
+            steps + n_emit,
             new_prev,
             new_cur,
             cache,
             out_buf,
             finished,
-            n_iters + 1,
+            key,
+            n_emit_tot + n_emit.sum(),
+            n_row_iters + active.sum(),
         )
 
     state = (
-        start_step,
-        prev_token,
-        cur_token,
+        jnp.int32(0),
+        steps,
+        prev_tokens,
+        cur_tokens,
         cache,
         out_buf,
         finished,
+        key,
+        jnp.int32(0),
         jnp.int32(0),
     )
-    step, prev, cur, cache, out_buf, finished, n_iters = jax.lax.while_loop(
+    (
+        it,
+        steps,
+        prev,
+        cur,
+        cache,
+        out_buf,
+        finished,
+        key,
+        n_emit_tot,
+        n_row_iters,
+    ) = jax.lax.while_loop(cond, body, state)
+    return (
+        cache,
+        prev,
+        cur,
+        finished,
+        out_buf,
+        steps,
+        it,
+        n_emit_tot,
+        n_row_iters,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "cfg",
+        "prompt_len",
+        "chunk",
+        "greedy",
+        "top_k",
+        "use_top_p",
+    ),
+    donate_argnames=("cache", "out_buf"),
+)
+def rowwise_decode_steps(
+    params: Params,
+    cfg: ModelConfig,
+    cache: Cache,
+    cur_tokens: jnp.ndarray,  # [B]
+    pad_lens: jnp.ndarray,  # [B]
+    finished: jnp.ndarray,  # [B] bool
+    out_buf: jnp.ndarray,  # [B, max_new]
+    steps: jnp.ndarray,  # [B] per-row decode step
+    stop_at: jnp.ndarray,  # scalar
+    eos_ids: jnp.ndarray,
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    top_p: jnp.ndarray,
+    *,
+    prompt_len: int,
+    chunk: int,
+    greedy: bool,
+    top_k: int,
+    use_top_p: bool = True,
+):
+    """Plain single-token decode with PER-ROW cache slots.
+
+    The tail loop after any speculative phase: rows desynchronize there
+    (different accepted draft counts), so the shared-slot
+    ``decode_chunk_steps`` can no longer drive them. Same sampling and
+    EOS semantics as generate._sample_step.
+    """
+    B = cur_tokens.shape[0]
+    T = cache["k"].shape[2]
+    max_new = out_buf.shape[1]
+    kv_base = jnp.arange(T)[None, :] >= pad_lens[:, None]
+    rows = jnp.arange(B)
+    bound = jnp.minimum(stop_at, max_new)
+
+    def active_rows(steps, finished):
+        return ~finished & (steps < bound)
+
+    def cond(state):
+        it, steps, finished = state[0], state[1], state[4]
+        return (it < chunk) & active_rows(steps, finished).any()
+
+    def body(state):
+        it, steps, cur, cache, finished, out_buf, key = state
+        active = active_rows(steps, finished)
+        cache_index = prompt_len + steps - 1  # [B]
+        positions = (cache_index - pad_lens)[:, None]
+        logits, cache = forward(
+            params, cfg, cur[:, None], positions, cache, cache_index, kv_base
+        )
+        key, sub = jax.random.split(key)
+        nxt = sample_tokens(
+            logits[:, 0],
+            sub,
+            greedy=greedy,
+            top_k=top_k,
+            temperature=temperature,
+            top_p=top_p,
+            use_top_p=use_top_p,
+        )
+        is_eos = (nxt[:, None] == eos_ids[None, :]).any(axis=-1)
+        nxt = jnp.where(finished, 0, nxt)
+        idx = jnp.minimum(steps, max_new - 1)
+        vals = jnp.where(active, nxt, out_buf[rows, idx])
+        out_buf = out_buf.at[rows, idx].set(vals)
+        finished = finished | (is_eos & active)
+        steps = steps + active.astype(jnp.int32)
+        cur = jnp.where(active, nxt, cur)
+        return it + 1, steps, cur, cache, finished, out_buf, key
+
+    state = (jnp.int32(0), steps, cur_tokens, cache, finished, out_buf, key)
+    it, steps, cur, cache, finished, out_buf, key = jax.lax.while_loop(
         cond, body, state
     )
-    return cache, prev, cur, finished, out_buf, step, n_iters
+    return cache, cur, finished, out_buf, steps

@@ -261,7 +261,7 @@ def forward(
     tokens: jnp.ndarray,  # [B, S] int32
     positions: jnp.ndarray,  # [B, S] rope positions (0 at each row's start)
     cache: Cache,
-    cache_index: jnp.ndarray,  # scalar: slot where this chunk's KV goes
+    cache_index: jnp.ndarray,  # scalar or [B]: slot where this chunk's KV goes
     kv_valid: jnp.ndarray,  # [B, T] bool: slots holding real tokens
     *,
     use_pallas_decode: bool = False,
@@ -272,8 +272,11 @@ def forward(
     """One forward pass over a chunk (prefill: S=chunk, decode: S=1).
 
     The caller maintains left-padded rows so every row writes its KV at the
-    same ``cache_index`` (static-shape dynamic_update_slice), and passes
-    ``kv_valid`` marking which cache slots are real (pads excluded).
+    same scalar ``cache_index`` (static-shape dynamic_update_slice), and
+    passes ``kv_valid`` marking which cache slots are real (pads
+    excluded). A vector ``cache_index`` ([B]) writes each row's KV at its
+    own slot (vmapped update) — the layout speculative decoding needs once
+    rows accept different draft lengths and desynchronize.
     Returns (logits [B, S, vocab] f32, updated cache).
 
     ``use_pallas_decode`` routes S==1 attention through the fused Pallas
@@ -297,9 +300,13 @@ def forward(
     # Masks shared by all layers. Slot j is visible to in-chunk query i iff
     # it holds a real token and j <= cache_index + i (causality in slot
     # space — valid because rows are left-padded so slot order = position
-    # order).
+    # order). Reshape unifies scalar ([1,1,1]) and per-row ([B,1,1])
+    # cache_index under one broadcast.
     slot_ids = jnp.arange(T)[None, None, :]  # [1, 1, T]
-    q_slot = cache_index + jnp.arange(S)[None, :, None]  # [1, S, 1]
+    q_slot = (
+        jnp.reshape(cache_index, (-1, 1, 1))
+        + jnp.arange(S)[None, :, None]
+    )  # [1|B, S, 1]
     causal = slot_ids <= q_slot
     base_mask = kv_valid[:, None, :] & causal  # [B, S, T]
     if cfg.sliding_window > 0:
@@ -319,13 +326,23 @@ def forward(
 
     quant_kv = "ks" in cache  # int8 K/V with per-(token, head) scales
 
+    vector_index = jnp.ndim(cache_index) > 0
+
     def _write_and_read_kv(cache_l: Cache, k, v, x_dtype):
         """Store this chunk's K/V into the layer's cache slice and return
         (updated slice, attention-readable K, V). One site owns both the
-        plain and int8 layouts."""
-        upd = lambda buf, val: jax.lax.dynamic_update_slice(  # noqa: E731
-            buf, val, (0, cache_index, 0, 0)
-        )
+        plain and int8 layouts, and both index modes (shared scalar slot
+        vs per-row slots)."""
+        if vector_index:
+            upd = lambda buf, val: jax.vmap(  # noqa: E731
+                lambda b, v_, i: jax.lax.dynamic_update_slice(
+                    b, v_, (i,) + (0,) * (b.ndim - 1)
+                )
+            )(buf, val, cache_index)
+        else:
+            upd = lambda buf, val: jax.lax.dynamic_update_slice(  # noqa: E731
+                buf, val, (0, cache_index, 0, 0)
+            )
         if quant_kv:
             kq, ks = _quantize_kv(k)
             vq, vs = _quantize_kv(v)

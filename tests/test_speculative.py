@@ -1,8 +1,12 @@
 """Prompt-lookup speculative decoding tests.
 
-Correctness contract: speculative greedy decode is BIT-IDENTICAL to plain
-greedy decode (acceptance only reorders how many tokens emerge per
-forward, never which tokens)."""
+Correctness contracts:
+- greedy speculative decode is BIT-IDENTICAL to plain greedy decode, at
+  any batch size (acceptance only reorders how many tokens emerge per
+  forward, never which tokens);
+- at temperature > 0, rejection sampling preserves the sampling
+  distribution exactly (tested at the per-step marginal).
+"""
 
 import jax
 import jax.numpy as jnp
@@ -22,6 +26,29 @@ def tiny_model():
     return params, cfg
 
 
+def _spec_args(prompt, max_new, *, B=1, key_seed=0):
+    """Boilerplate state for direct speculative_decode_steps calls."""
+    S = prompt.shape[1]
+    cfg = get_config("llama", "tiny")
+    cache = T.init_cache(cfg, B, S + max_new, dtype=jnp.float32)
+    out_buf = jnp.zeros((B, max_new), jnp.int32)
+    return dict(
+        cache=cache,
+        prompt_tokens=prompt,
+        prev_tokens=jnp.broadcast_to(prompt[0, -2], (B,)),
+        cur_tokens=jnp.broadcast_to(prompt[0, -1], (B,)),
+        pad_lens=jnp.zeros((B,), jnp.int32),
+        finished=jnp.zeros((B,), bool),
+        out_buf=out_buf,
+        steps=jnp.ones((B,), jnp.int32),
+        stop_at=jnp.int32(max_new),
+        eos_ids=jnp.asarray([-1], jnp.int32),
+        key=jax.random.key(key_seed),
+        temperature=jnp.float32(0.0),
+        top_p=jnp.float32(1.0),
+    )
+
+
 class TestSpeculativeParity:
     def test_matches_plain_greedy(self, tiny_model):
         params, cfg = tiny_model
@@ -29,6 +56,22 @@ class TestSpeculativeParity:
         kw = dict(max_new_tokens=24, eos_ids=[], greedy=True)
         plain = generate(params, cfg, [prompt], speculative=False, **kw)
         spec = generate(params, cfg, [prompt], speculative=True, **kw)
+        np.testing.assert_array_equal(plain.tokens, spec.tokens)
+        np.testing.assert_array_equal(plain.n_generated, spec.n_generated)
+
+    def test_matches_plain_greedy_batched(self, tiny_model):
+        """The round-2 headline: B>1 rows accept different draft counts,
+        desynchronize, and must still reproduce plain greedy exactly
+        (spec phase + rowwise tail both covered)."""
+        params, cfg = tiny_model
+        prompts = [
+            [((i * 13) % 500) + 3 for i in range(40)],
+            [5, 9, 7, 5, 9, 7, 5, 9, 7, 5, 9, 7, 5, 9],
+            [((i * 7) % 450) + 9 for i in range(25)],
+        ]
+        kw = dict(max_new_tokens=24, eos_ids=[], greedy=True)
+        plain = generate(params, cfg, prompts, speculative=False, **kw)
+        spec = generate(params, cfg, prompts, speculative=True, **kw)
         np.testing.assert_array_equal(plain.tokens, spec.tokens)
         np.testing.assert_array_equal(plain.n_generated, spec.n_generated)
 
@@ -54,31 +97,87 @@ class TestSpeculativeParity:
         np.testing.assert_array_equal(plain.tokens, spec.tokens)
         np.testing.assert_array_equal(plain.n_generated, spec.n_generated)
 
-    def test_disabled_for_batches_and_sampling(self, tiny_model):
-        """Multi-row and temperature>0 silently use the plain path (no
-        crash, valid output shapes)."""
+    def test_eos_parity_batched(self, tiny_model):
+        """Rows hitting EOS at different steps freeze while others keep
+        speculating; outputs must match plain greedy row-for-row."""
         params, cfg = tiny_model
-        multi = generate(
+        prompts = [[1, 2], [7, 3, 9], [2, 2, 2, 2]]
+        probe = generate(
+            params, cfg, prompts, max_new_tokens=6, eos_ids=[], greedy=True
+        )
+        eos = int(probe.tokens[0, 2])
+        kw = dict(max_new_tokens=30, eos_ids=[eos], greedy=True)
+        plain = generate(params, cfg, prompts, speculative=False, **kw)
+        spec = generate(params, cfg, prompts, speculative=True, **kw)
+        np.testing.assert_array_equal(plain.tokens, spec.tokens)
+        np.testing.assert_array_equal(plain.n_generated, spec.n_generated)
+
+    def test_sampled_batch_shapes_and_validity(self, tiny_model):
+        """Temperature speculation: shapes, vocab range, and n_generated
+        bookkeeping hold for the bench shape (4 rows, temp 0.7)."""
+        params, cfg = tiny_model
+        prompts = [[3 + i, 40 + i, 3 + i, 40 + i] * 4 for i in range(4)]
+        out = generate(
             params,
             cfg,
-            [[1, 2], [3, 4]],
-            max_new_tokens=6,
+            prompts,
+            max_new_tokens=16,
             eos_ids=[],
-            greedy=True,
+            temperature=0.7,
+            seed=11,
             speculative=True,
         )
-        assert multi.tokens.shape == (2, 6)
-        sampled = generate(
-            params,
-            cfg,
-            [[1, 2]],
-            max_new_tokens=6,
-            eos_ids=[],
-            temperature=1.0,
-            seed=3,
-            speculative=True,
+        assert out.tokens.shape == (4, 16)
+        assert (out.tokens >= 0).all() and (out.tokens < cfg.vocab_size).all()
+        np.testing.assert_array_equal(out.n_generated, [16] * 4)
+
+
+class TestRejectionSamplingMarginal:
+    def test_first_token_marginal_matches_target(self, monkeypatch):
+        """The step marginal must equal the target distribution p exactly:
+        P(tok = d) = p(d) via acceptance, P(tok = x≠d) = (1-p(d)) ·
+        p(x)/(1-p(d)) via the residual. Monte Carlo over seeds against a
+        forward with a known 4-token distribution."""
+        cfg = get_config("llama", "tiny")
+        V = cfg.vocab_size
+        support = np.array([10, 20, 30, 40])
+        target = np.array([0.4, 0.3, 0.2, 0.1])
+        base = np.full((V,), -1e9, np.float32)
+        base[support] = np.log(target)
+
+        def fake_forward(params, cfg_, toks, positions, cache, ci, kv, **kw):
+            B, span = toks.shape
+            logits = jnp.broadcast_to(
+                jnp.asarray(base)[None, None, :], (B, span, V)
+            )
+            return logits, cache
+
+        monkeypatch.setattr(spec_mod, "forward", fake_forward)
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        # Prompt engineered so the [prev, cur] bigram matches mid-prompt
+        # and the draft is token 10 (the high-probability one): both the
+        # accept and the reject→residual paths get exercised.
+        prompt = jnp.asarray(
+            [[7, 8, 10, 10, 10, 10, 10, 10, 10, 10, 10, 7, 8]], jnp.int32
         )
-        assert sampled.tokens.shape == (1, 6)
+        counts = {int(t): 0 for t in support}
+        N = 400
+        for seed in range(N):
+            args = _spec_args(prompt, max_new=16, key_seed=seed)
+            args["temperature"] = jnp.float32(1.0)
+            out = spec_mod.speculative_decode_steps(
+                params,
+                cfg,
+                **args,
+                prompt_len=prompt.shape[1],
+                iters=1,
+                greedy=False,
+            )
+            first = int(np.asarray(out[4])[0, 1])  # out_buf slot 1
+            assert first in counts, f"emitted off-support token {first}"
+            counts[first] += 1
+        freq = np.array([counts[int(t)] for t in support]) / N
+        np.testing.assert_allclose(freq, target, atol=0.07)
 
 
 class TestAcceptanceArithmetic:
@@ -91,7 +190,6 @@ class TestAcceptanceArithmetic:
         def fake_forward(params, cfg_, toks, positions, cache, ci, kv, **kw):
             # argmax(logits[i]) == toks[i+1] for i < span-1 (accept all);
             # last position predicts token 7 (the bonus).
-            span = toks.shape[1]
             nxt = jnp.concatenate(
                 [toks[0, 1:], jnp.array([7], toks.dtype)]
             )
@@ -103,31 +201,17 @@ class TestAcceptanceArithmetic:
         params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
         S, max_new, gamma = 16, 32, spec_mod.GAMMA
         prompt = jnp.arange(3, 3 + S, dtype=jnp.int32)[None]
-        cache = T.init_cache(cfg, 1, S + max_new, dtype=jnp.float32)
-        out_buf = jnp.zeros((1, max_new), jnp.int32)
-
-        cache, prev, cur, finished, out_buf, step, n_iters = (
-            spec_mod.speculative_decode_steps(
-                params,
-                cfg,
-                cache,
-                prompt,
-                prompt[0, -2],
-                prompt[0, -1],
-                jnp.zeros((1,), jnp.int32),
-                jnp.zeros((1,), bool),
-                out_buf,
-                jnp.int32(1),
-                jnp.int32(max_new),
-                jnp.asarray([-1], jnp.int32),
-                prompt_len=S,
-                chunk=64,
-            )
+        args = _spec_args(prompt, max_new)
+        out = spec_mod.speculative_decode_steps(
+            params,
+            cfg,
+            **args,
+            prompt_len=S,
+            iters=8,
+            greedy=True,
         )
-        # [prev, cur] = last two prompt tokens match at the prompt's end;
-        # clamped draft comes from the prompt tail and fully verifies, so
-        # every iteration advances by γ+1.
-        n_steps = int(step) - 1
+        steps, n_iters = out[5], out[6]
+        n_steps = int(steps[0]) - 1
         assert n_steps % (gamma + 1) == 0
         assert n_steps >= gamma + 1
         # Every verification forward emitted the full span.
@@ -140,7 +224,6 @@ class TestAcceptanceArithmetic:
         V = cfg.vocab_size
 
         def fake_forward(params, cfg_, toks, positions, cache, ci, kv, **kw):
-            span = toks.shape[1]
             # Predict token (draft + 1) everywhere: never matches drafts.
             nxt = (toks[0] + 1) % V
             logits = jax.nn.one_hot(nxt, V, dtype=jnp.float32)[None] * 10.0
@@ -150,23 +233,14 @@ class TestAcceptanceArithmetic:
         params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
         S, max_new = 16, 16
         prompt = jnp.arange(3, 3 + S, dtype=jnp.int32)[None]
-        cache = T.init_cache(cfg, 1, S + max_new, dtype=jnp.float32)
-        out_buf = jnp.zeros((1, max_new), jnp.int32)
-        _, _, _, _, out_buf, step, n_iters = spec_mod.speculative_decode_steps(
+        args = _spec_args(prompt, max_new)
+        out = spec_mod.speculative_decode_steps(
             params,
             cfg,
-            cache,
-            prompt,
-            prompt[0, -2],
-            prompt[0, -1],
-            jnp.zeros((1,), jnp.int32),
-            jnp.zeros((1,), bool),
-            out_buf,
-            jnp.int32(1),
-            jnp.int32(max_new),
-            jnp.asarray([-1], jnp.int32),
+            **args,
             prompt_len=S,
-            chunk=3,  # 3 single-token steps fit the chunk bound
+            iters=3,
         )
-        assert int(step) == 4  # start 1 + chunk bound 3 → exactly 3 steps
-        assert int(n_iters) == 3  # one wide forward per single emitted token
+        steps, n_iters = out[5], out[6]
+        assert int(steps[0]) == 4  # start 1 + 3 iterations × 1 token
+        assert int(n_iters) == 3  # one wide forward per emitted token
