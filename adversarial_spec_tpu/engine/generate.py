@@ -302,28 +302,29 @@ def generate(
     revision-style outputs. None = auto (on when eligible).
 
     ``kv_dtype="int8"``: store the dense KV cache int8 with per-token-head
-    scales — half the cache HBM (and half the bytes read per decoded
-    token on the jnp attention path). Dense single-device path only:
-    forces the jnp attention implementation (the fused kernels read raw
-    K/V; int8 kernel tiles are round-2 work) and is ignored for paged
-    and sp-prefill runs.
+    scales — half the cache HBM and half the bytes read per decoded
+    token. Composes with the fused decode kernel (dequant inside the
+    kernel tiles) and with sharded meshes; the paged pool still stores
+    raw-dtype pages, so paged runs fall back to full precision.
     """
-    if kv_dtype == "int8" and (paged or (mesh is not None and mesh.size > 1)):
+    sp_degree = mesh.shape.get("sp", 1) if mesh is not None else 1
+    if kv_dtype == "int8" and (paged or sp_degree > 1):
         import sys as _sys
 
+        reason = (
+            "the paged pool stores raw-dtype pages"
+            if paged
+            else "sp prefill builds a raw-dtype cache"
+        )
         print(
-            "warning: kv_dtype=int8 applies to the dense single-device "
-            "cache only; using full-precision KV here",
+            f"warning: kv_dtype=int8 unsupported here ({reason}); "
+            "using full-precision KV",
             file=_sys.stderr,
         )
         kv_dtype = ""
     # An explicit use_pallas_decode=True records caller intent (it gates
-    # auto-speculation) BEFORE the int8-KV override clears the flag.
+    # auto-speculation).
     explicit_pallas = use_pallas_decode is True
-    if kv_dtype == "int8":
-        # The fused kernels read raw-dtype K/V tiles; int8 cache decodes
-        # through the (dequant-fused) jnp attention path.
-        use_pallas_decode = False
     if use_pallas_decode is None:
         # Auto: fused kernel on a real TPU. Multi-device meshes run it
         # under shard_map (batch over dp, KV heads over tp); the support
@@ -381,17 +382,26 @@ def generate(
     eos = jnp.asarray(sorted(set(eos_ids)) or [-1], dtype=jnp.int32)
 
     deadline = time.monotonic() + timeout_s if timeout_s > 0 else None
-    # Paged decode is single-device (the kernel is not GSPMD-partitionable);
-    # resolve that now so the prefill cache can be sized to the prompt only.
+    # Paged decode scales over dp (per-device page pools, zero cross-
+    # device page traffic — engine/scheduler.py:
+    # sharded_scheduler_decode_chunk) but not tp/sp; resolve that now so
+    # the prefill cache can be sized to the prompt only.
+    paged_dp = 1
     if paged and mesh is not None and mesh.size > 1:
-        import sys
+        from adversarial_spec_tpu.parallel.mesh import DP as _DP
 
-        print(
-            f"warning: paged KV decode is single-device; falling back to "
-            f"the dense cache on this {mesh.size}-device mesh",
-            file=sys.stderr,
-        )
-        paged = False
+        if mesh.size == mesh.shape[_DP]:
+            paged_dp = mesh.shape[_DP]
+        else:
+            import sys
+
+            print(
+                f"warning: paged KV decode shards over dp only; falling "
+                f"back to the dense cache on this tp/sp mesh "
+                f"({dict(mesh.shape)})",
+                file=sys.stderr,
+            )
+            paged = False
 
     # Shared-prefix: identical rows prefill once and tile. Qualifies off-
     # mesh and on single-device meshes (the TpuEngine always passes a
@@ -529,6 +539,25 @@ def generate(
                 + 1
             )
             n_phys_pages = prompt_pages + B * decode_pages
+        elif paged_dp > 1:
+            # dp-sharded pool: device d's pool slice holds its OWN trash
+            # page 0 plus its rows' pages, and the table carries device-
+            # LOCAL ids (what the shard_mapped chunk loop indexes with).
+            # Migration below runs on the global pool, so it needs the
+            # global ids (local + device slice offset).
+            local_rows = B // paged_dp
+            local_pool_pages = 1 + local_rows * n_pages_per_row
+            lr = np.arange(B) % local_rows
+            table_np = (
+                1
+                + lr[:, None] * n_pages_per_row
+                + np.arange(n_pages_per_row)[None, :]
+            ).astype(np.int32)
+            dev = np.arange(B) // local_rows
+            migrate_table_np = (
+                table_np + (dev * local_pool_pages)[:, None]
+            )
+            n_pool_pages = paged_dp * local_pool_pages
         else:
             allocator = PageAllocator(B * n_pages_per_row, page_size)
             for b in range(B):
@@ -538,22 +567,37 @@ def generate(
                 allocator.table_array(list(range(B)), n_pages_per_row) + 1
             )
             n_phys_pages = B * n_pages_per_row
+        if paged_dp == 1:
+            migrate_table_np = table_np
+            n_pool_pages = n_phys_pages + 1  # +1: trash page 0
         page_table = jnp.asarray(table_np)
         layout = PagedCacheLayout(
-            n_pages=n_phys_pages + 1,  # +1: trash page 0
+            n_pages=n_pool_pages,
             page_size=page_size,
             n_layers=cfg.n_layers,
             n_kv_heads=cfg.n_kv_heads,
             head_dim=cfg.head_dim,
         )
         pool = init_page_pool(layout, dtype=cache["k"].dtype)
+        if paged_dp > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from adversarial_spec_tpu.parallel.mesh import DP as _DP
+
+            pool = jax.tree.map(
+                lambda x: jax.device_put(
+                    x, NamedSharding(mesh, P(None, _DP, None, None, None))
+                ),
+                pool,
+            )
         # Migrate prompt KV (slots [0, S)) from the dense prefill cache
         # into pages (vectorized table lookup); pad-slot garbage lands too
         # but stays masked by the per-row bounds start. With shared prompt
         # pages the (untiled, single-row) cache scatters ONCE.
         B_mig = cache["k"].shape[1]
         slots = np.tile(np.arange(S, dtype=np.int32)[None, :], (B_mig, 1))
-        page_ids = table_np[np.arange(B_mig)[:, None], slots // page_size]
+        page_ids = migrate_table_np[
+            np.arange(B_mig)[:, None], slots // page_size
+        ]
         offsets = slots % page_size
         pool = write_tokens(
             pool, cache["k"][:, :, :S], cache["v"][:, :, :S], page_ids, offsets
@@ -694,16 +738,18 @@ def generate(
         elif paged:
             from adversarial_spec_tpu.engine.scheduler import (
                 scheduler_decode_chunk,
+                sharded_scheduler_decode_chunk,
             )
 
-            (
-                pool,
-                cur,
-                paged_cur_len,
-                paged_n_emitted,
-                out_buf,
-                paged_active,
-            ) = scheduler_decode_chunk(
+            static_kw = dict(
+                chunk=DECODE_CHUNK,
+                greedy=greedy,
+                top_k=top_k,
+                use_top_p=use_top_p,
+                use_pallas=use_paged_kernel,
+                pallas_interpret=pallas_interpret,
+            )
+            chunk_args = (
                 params,
                 cfg,
                 pool,
@@ -719,12 +765,20 @@ def generate(
                 chunk_key,
                 temp,
                 tp,
-                chunk=DECODE_CHUNK,
-                greedy=greedy,
-                top_k=top_k,
-                use_top_p=use_top_p,
-                use_pallas=use_paged_kernel,
-                pallas_interpret=pallas_interpret,
+            )
+            (
+                pool,
+                cur,
+                paged_cur_len,
+                paged_n_emitted,
+                out_buf,
+                paged_active,
+            ) = (
+                sharded_scheduler_decode_chunk(
+                    mesh, *chunk_args, **static_kw
+                )
+                if paged_dp > 1
+                else scheduler_decode_chunk(*chunk_args, **static_kw)
             )
             step = jnp.max(paged_n_emitted)
             finished = ~paged_active

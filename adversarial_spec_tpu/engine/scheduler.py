@@ -184,6 +184,123 @@ def scheduler_decode_chunk(
     return pool, cur, cur_len, n_emitted, out_buf, active
 
 
+def sharded_scheduler_decode_chunk(
+    mesh,
+    params,
+    cfg: ModelConfig,
+    pool,
+    page_table: jnp.ndarray,  # [B, Pmax] DEVICE-LOCAL physical ids
+    cur_tok: jnp.ndarray,
+    cur_len: jnp.ndarray,
+    pad_lens: jnp.ndarray,
+    n_emitted: jnp.ndarray,
+    max_new: jnp.ndarray,
+    active: jnp.ndarray,
+    out_buf: jnp.ndarray,
+    eos_ids: jnp.ndarray,
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    top_p: jnp.ndarray,
+    **static_kw,
+):
+    """``scheduler_decode_chunk`` over a dp-sharded mesh.
+
+    Paged decode scales over ``dp`` with ZERO cross-device page traffic:
+    each device owns a slice of the page pool (pool axis 1 split over dp)
+    holding its rows' pages plus its own trash page 0, and the page
+    tables carry device-LOCAL physical ids (the caller lays pages out
+    per-device — generate()'s paged setup). shard_map then runs the
+    whole chunk loop independently per device; devices even early-exit
+    their while_loops at different trip counts. tp/sp stay unsupported
+    for paged (the kernel grid would need head sharding — dense decode
+    covers those configs).
+
+    Sampling keys are folded with the device index so rows on different
+    devices draw independent randomness.
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from adversarial_spec_tpu.parallel.mesh import DP
+
+    rows = P(DP)
+    pool_spec = jax.tree.map(lambda _: P(None, DP), pool)
+
+    def local_chunk(
+        params_l,
+        pool_l,
+        table_l,
+        cur_l,
+        len_l,
+        pads_l,
+        nem_l,
+        maxn_l,
+        act_l,
+        out_l,
+        eos_l,
+        key_l,
+        temp_l,
+        tp_l,
+    ):
+        key_l = jax.random.fold_in(key_l, jax.lax.axis_index(DP))
+        return scheduler_decode_chunk(
+            params_l,
+            cfg,
+            pool_l,
+            table_l,
+            cur_l,
+            len_l,
+            pads_l,
+            nem_l,
+            maxn_l,
+            act_l,
+            out_l,
+            eos_l,
+            key_l,
+            temp_l,
+            tp_l,
+            **static_kw,
+        )
+
+    return shard_map(
+        local_chunk,
+        mesh=mesh,
+        in_specs=(
+            P(),  # params replicated (dp-only gate: tp == 1)
+            pool_spec,
+            rows,  # page_table [B, Pmax]
+            rows,
+            rows,
+            rows,
+            rows,
+            rows,
+            rows,
+            rows,  # out_buf [B, cap]
+            P(),
+            P(),
+            P(),
+            P(),
+        ),
+        out_specs=(pool_spec, rows, rows, rows, rows, rows),
+        check_rep=False,
+    )(
+        params,
+        pool,
+        page_table,
+        cur_tok,
+        cur_len,
+        pad_lens,
+        n_emitted,
+        max_new,
+        active,
+        out_buf,
+        eos_ids,
+        key,
+        temperature,
+        top_p,
+    )
+
+
 class ContinuousBatcher:
     """Admits requests into decode slots over one shared model + pool."""
 

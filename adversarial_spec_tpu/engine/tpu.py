@@ -94,15 +94,20 @@ class TpuEngine:
         self._evict_to(MAX_RESIDENT_MODELS - 1)
         maybe_initialize_distributed()
         mesh = make_mesh(spec.mesh)
-        if spec.kv_dtype == "int8" and (spec.kv == "paged" or mesh.size > 1):
+        if spec.kv_dtype == "int8" and (
+            spec.kv == "paged" or mesh.shape.get("sp", 1) > 1
+        ):
             # Resolve the incompatibility ONCE at load, not with a stderr
-            # warning on every debate turn.
+            # warning on every debate turn. (int8 composes with dp/tp
+            # meshes — dense cache + scale tiles in the kernel — but the
+            # paged pool stores raw-dtype pages and sp prefill builds a
+            # raw-dtype cache.)
             import dataclasses
             import sys
 
             print(
                 f"warning: tpu://{alias}: kv_dtype=int8 applies to the "
-                "dense single-device cache only; serving full-precision KV",
+                "dense dp/tp cache only; serving full-precision KV",
                 file=sys.stderr,
             )
             spec = dataclasses.replace(spec, kv_dtype="")

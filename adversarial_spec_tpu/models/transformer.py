@@ -379,26 +379,38 @@ def forward(
                 cfg, layer_id, pallas_start, cache_index
             )
             bounds = jnp.stack([start, pallas_end], axis=1)
+            if quant_kv:
+                # Hand the kernel the raw int8 tiles + scale tiles; the
+                # dequantized k_read/v_read above are dead code here and
+                # XLA drops them — HBM traffic stays at int8 bytes.
+                k_in, v_in = cache_l["k"], cache_l["v"]
+                qkw = dict(
+                    k_scale=cache_l["ks"], v_scale=cache_l["vs"]
+                )
+            else:
+                k_in, v_in, qkw = k_read, v_read, {}
             if mesh is not None and mesh.size > 1:
                 out = decode_attention_tp(
                     q[:, 0],
-                    k_read,
-                    v_read,
+                    k_in,
+                    v_in,
                     bounds,
                     mesh,
                     attn_softcap=cfg.attn_softcap,
                     scale=cfg.attn_scale,
                     interpret=pallas_interpret,
+                    **qkw,
                 )[:, None]
             else:
                 out = decode_attention(
                     q[:, 0],
-                    k_read,
-                    v_read,
+                    k_in,
+                    v_in,
                     bounds,
                     attn_softcap=cfg.attn_softcap,
                     scale=cfg.attn_scale,
                     interpret=pallas_interpret,
+                    **qkw,
                 )[:, None]
         else:
             if cfg.sliding_window > 0 and cfg.sliding_window_pattern > 1:

@@ -46,15 +46,20 @@ def _decode_attn_kernel(
     q_ref,  # VMEM [1, 1, G8, D]
     k_ref,  # VMEM [1, block_t, 1, D] — one streamed tile
     v_ref,  # VMEM [1, block_t, 1, D]
-    o_ref,  # VMEM [1, 1, G8, D]
-    m_ref,  # VMEM scratch [G8, 1]
-    l_ref,  # VMEM scratch [G8, 1]
-    acc_ref,  # VMEM scratch [G8, D]
-    *,
+    *rest,  # [ks_ref, vs_ref,] o_ref, m_ref, l_ref, acc_ref
     scale: float,
     attn_softcap: float,
     block_t: int,
+    quantized: bool,
 ):
+    # int8-KV mode streams per-(token, head) scale tiles alongside the
+    # int8 K/V tiles and dequantizes IN VMEM — the HBM read per decoded
+    # token stays at the int8 byte count (the whole point of the int8
+    # cache; previously int8 forced the jnp fallback path).
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
     b = pl.program_id(0)
     t = pl.program_id(2)
     n_blocks = pl.num_programs(2)
@@ -77,6 +82,9 @@ def _decode_attn_kernel(
         q = q_ref[0, 0].astype(jnp.float32) * scale
         k = k_ref[0, :, 0].astype(jnp.float32)
         v = v_ref[0, :, 0].astype(jnp.float32)
+        if quantized:
+            k = k * ks_ref[0, :, 0]  # [block_t, 1] broadcasts over D
+            v = v * vs_ref[0, :, 0]
         m, l, acc = flash_update(
             q,
             k,
@@ -109,6 +117,8 @@ def decode_attention_tp(
     attn_softcap: float = 0.0,
     scale: float | None = None,
     interpret: bool = False,
+    k_scale: jnp.ndarray | None = None,  # [B, T, Hkv, 1] f32 (int8 KV)
+    v_scale: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Fused decode attention on a GSPMD-sharded mesh.
 
@@ -136,18 +146,28 @@ def decode_attention_tp(
         scale=scale,
         interpret=interpret,
     )
+    in_specs = [
+        P(DP, TP, None),
+        P(DP, None, TP, None),
+        P(DP, None, TP, None),
+        P(DP, None),
+    ]
+    operands = [q, k_cache, v_cache, bounds]
+    if k_scale is not None:
+        fn = lambda q_, k_, v_, b_, ks_, vs_: kernel(  # noqa: E731
+            q_, k_, v_, b_, k_scale=ks_, v_scale=vs_
+        )
+        in_specs += [P(DP, None, TP, None), P(DP, None, TP, None)]
+        operands += [k_scale, v_scale]
+    else:
+        fn = kernel
     return shard_map(
-        kernel,
+        fn,
         mesh=mesh,
-        in_specs=(
-            P(DP, TP, None),
-            P(DP, None, TP, None),
-            P(DP, None, TP, None),
-            P(DP, None),
-        ),
+        in_specs=tuple(in_specs),
         out_specs=P(DP, TP, None),
         check_rep=False,
-    )(q, k_cache, v_cache, bounds)
+    )(*operands)
 
 
 def tp_decode_supported(n_kv_heads: int, mesh) -> bool:
@@ -162,19 +182,27 @@ def tp_decode_supported(n_kv_heads: int, mesh) -> bool:
 )
 def decode_attention(
     q: jnp.ndarray,  # [B, Hq, D] one query token per row
-    k_cache: jnp.ndarray,  # [B, T, Hkv, D]
+    k_cache: jnp.ndarray,  # [B, T, Hkv, D] (any float dtype, or int8)
     v_cache: jnp.ndarray,  # [B, T, Hkv, D]
     bounds: jnp.ndarray,  # [B, 2] int32 (start, end) valid slot window
     attn_softcap: float = 0.0,
     scale: float | None = None,
     interpret: bool = False,
+    k_scale: jnp.ndarray | None = None,  # [B, T, Hkv, 1] f32 (int8 KV)
+    v_scale: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
-    """Fused decode attention. Returns [B, Hq, D] in q.dtype."""
+    """Fused decode attention. Returns [B, Hq, D] in q.dtype.
+
+    ``k_scale``/``v_scale`` (both or neither): the caches are int8 with
+    per-(token, head) symmetric scales (models/transformer.py:
+    _quantize_kv); dequant happens inside the kernel tiles.
+    """
     B, Hq, D = q.shape
     T, Hkv = k_cache.shape[1], k_cache.shape[2]
     g = Hq // Hkv
     G8 = max(_SUBLANE, g)
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    quantized = k_scale is not None
     # Largest tileable block that divides the (static) cache length.
     block_t = next(
         (b for b in (BLOCK_T, 128, 64, 32, 16, 8) if T % b == 0), T
@@ -186,6 +214,22 @@ def decode_attention(
     if G8 != g:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, G8 - g), (0, 0)))
 
+    kv_spec = pl.BlockSpec(
+        (1, block_t, 1, D), lambda b, h, t, _: (b, t, h, 0)
+    )
+    scale_spec = pl.BlockSpec(
+        (1, block_t, 1, 1), lambda b, h, t, _: (b, t, h, 0)
+    )
+    in_specs = [
+        pl.BlockSpec((1, 1, G8, D), lambda b, h, t, _: (b, h, 0, 0)),
+        kv_spec,
+        kv_spec,
+    ]
+    operands = [qg, k_cache, v_cache]
+    if quantized:
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
+
     grid = (B, Hkv, T // block_t)
     out = pl.pallas_call(
         functools.partial(
@@ -193,21 +237,12 @@ def decode_attention(
             scale=scale,
             attn_softcap=attn_softcap,
             block_t=block_t,
+            quantized=quantized,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec(
-                    (1, 1, G8, D), lambda b, h, t, _: (b, h, 0, 0)
-                ),
-                pl.BlockSpec(
-                    (1, block_t, 1, D), lambda b, h, t, _: (b, t, h, 0)
-                ),
-                pl.BlockSpec(
-                    (1, block_t, 1, D), lambda b, h, t, _: (b, t, h, 0)
-                ),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, 1, G8, D), lambda b, h, t, _: (b, h, 0, 0)
             ),
@@ -219,6 +254,6 @@ def decode_attention(
         ),
         out_shape=jax.ShapeDtypeStruct((B, Hkv, G8, D), q.dtype),
         interpret=interpret,
-    )(bounds, qg, k_cache, v_cache)
+    )(bounds, *operands)
 
     return out[:, :, :g, :].reshape(B, Hq, D)

@@ -257,3 +257,72 @@ class TestShardedPallasDecode:
                 use_pallas_decode=True, speculative=False, **kw,
             )
         np.testing.assert_array_equal(ref.tokens, out.tokens)
+
+
+class TestInt8KernelTiles:
+    """int8 KV dequant inside the fused kernel tiles (VERDICT r1 item 4):
+    the int8 cache and the Pallas kernel are no longer mutually
+    exclusive."""
+
+    def test_kernel_matches_dequant_dense(self):
+        B, Hq, Hkv, D, T_ = 2, 8, 2, 64, 256
+        ks = jax.random.split(jax.random.key(9), 3)
+        q = jax.random.normal(ks[0], (B, Hq, D), jnp.float32)
+        k = jax.random.normal(ks[1], (B, T_, Hkv, D), jnp.float32)
+        v = jax.random.normal(ks[2], (B, T_, Hkv, D), jnp.float32)
+        # Quantize exactly as the cache does (per-token-head symmetric).
+        amax = jnp.max(jnp.abs(k), axis=-1, keepdims=True)
+        ksc = jnp.maximum(amax, 1e-8) / 127.0
+        k8 = jnp.clip(jnp.round(k / ksc), -127, 127).astype(jnp.int8)
+        amax = jnp.max(jnp.abs(v), axis=-1, keepdims=True)
+        vsc = jnp.maximum(amax, 1e-8) / 127.0
+        v8 = jnp.clip(jnp.round(v / vsc), -127, 127).astype(jnp.int8)
+        bounds = jnp.array([[0, 200], [37, 256]], jnp.int32)
+
+        out = decode_attention(
+            q, k8, v8, bounds, interpret=True, k_scale=ksc, v_scale=vsc
+        )
+        # Reference: dense attention over the DEQUANTIZED cache.
+        ref = _dense_ref(q, k8 * ksc, v8 * vsc, bounds)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+    def test_generate_int8_pallas_matches_int8_jnp(self):
+        """Greedy tokens through (int8 cache + fused kernel) must equal
+        (int8 cache + jnp path) — same quantization, different attention
+        implementation."""
+        cfg = get_config("llama", "tiny")
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        prompts = [[3, 7, 11, 15], [2, 4]]
+        kw = dict(
+            max_new_tokens=8, eos_ids=[], greedy=True,
+            kv_dtype="int8", speculative=False,
+        )
+        jnp_path = generate(params, cfg, prompts, use_pallas_decode=False, **kw)
+        kern = generate(params, cfg, prompts, use_pallas_decode=True, **kw)
+        np.testing.assert_array_equal(jnp_path.tokens, kern.tokens)
+
+    def test_generate_int8_on_mesh(self):
+        """int8 KV + sharded fused kernel on a dp×tp mesh."""
+        if len(jax.devices()) < 8:
+            pytest.skip("requires 8 virtual devices")
+        from adversarial_spec_tpu.parallel.mesh import make_mesh
+        from adversarial_spec_tpu.parallel.sharding import shard_params
+
+        cfg = get_config("llama", "tiny")
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        prompts = [[1, 5, 9, 3], [2, 6], [8, 8, 8], [4]]
+        kw = dict(
+            max_new_tokens=6, eos_ids=[], greedy=True,
+            kv_dtype="int8", speculative=False,
+        )
+        ref = generate(params, cfg, prompts, use_pallas_decode=False, **kw)
+        mesh = make_mesh({"dp": 4, "tp": 2})
+        sharded = shard_params(mesh, params)
+        with mesh:
+            out = generate(
+                sharded, cfg, prompts, mesh=mesh,
+                use_pallas_decode=True, **kw,
+            )
+        np.testing.assert_array_equal(ref.tokens, out.tokens)

@@ -172,7 +172,9 @@ class TestQuantizedModel:
             rtol=2e-4, atol=2e-4,
         )
 
-    def test_int8_kv_falls_back_on_mesh(self, capsys):
+    def test_int8_kv_composes_with_mesh(self, capsys):
+        """int8 KV no longer falls back on sharded meshes: the sharded
+        decode matches the single-device int8 tokens exactly."""
         import jax as _jax
         from adversarial_spec_tpu.engine.generate import generate
         from adversarial_spec_tpu.parallel.mesh import make_mesh
@@ -182,19 +184,34 @@ class TestQuantizedModel:
             pytest.skip("needs multiple devices")
         cfg = get_config("llama", "tiny")
         params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        kw = dict(
+            max_new_tokens=4, eos_ids=[], greedy=True, kv_dtype="int8",
+            speculative=False,
+        )
+        ref = generate(params, cfg, [[1, 2, 3]], **kw)
         mesh = make_mesh({"tp": 2})
         sharded = shard_params(mesh, params)
         with mesh:
-            out = generate(
-                sharded,
-                cfg,
-                [[1, 2, 3]],
-                max_new_tokens=4,
-                eos_ids=[],
-                greedy=True,
-                mesh=mesh,
-                kv_dtype="int8",
-            )
+            out = generate(sharded, cfg, [[1, 2, 3]], mesh=mesh, **kw)
+        np.testing.assert_array_equal(ref.tokens, out.tokens)
+        assert "full-precision KV" not in capsys.readouterr().err
+
+    def test_int8_kv_falls_back_when_paged(self, capsys):
+        from adversarial_spec_tpu.engine.generate import generate
+
+        cfg = get_config("llama", "tiny")
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        out = generate(
+            params,
+            cfg,
+            [[1, 2, 3]],
+            max_new_tokens=4,
+            eos_ids=[],
+            greedy=True,
+            kv_dtype="int8",
+            paged=True,
+            page_size=16,
+        )
         assert out.tokens.shape == (1, 4)
         assert "full-precision KV" in capsys.readouterr().err
 
@@ -207,3 +224,27 @@ class TestQuantizedModel:
 
         save_registry_entry(ModelSpec(alias="q8", quant="int8"))
         assert load_registry()["q8"].quant == "int8"
+
+    def test_int8_kv_falls_back_on_sp_mesh(self, capsys):
+        """sp prefill builds a raw-dtype cache: int8 must warn + fall
+        back, not silently drop the request."""
+        import jax as _jax
+        from adversarial_spec_tpu.engine.generate import generate
+        from adversarial_spec_tpu.parallel.mesh import make_mesh
+        from adversarial_spec_tpu.parallel.sharding import shard_params
+
+        if len(_jax.devices()) < 8:
+            pytest.skip("needs 8 virtual devices")
+        cfg = get_config("llama", "tiny")
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        mesh = make_mesh({"sp": 4})
+        sharded = shard_params(mesh, params)
+        prompt = list(range(3, 3 + 128))  # S % sp == 0 → sp prefill
+        with mesh:
+            out = generate(
+                sharded, cfg, [prompt], mesh=mesh,
+                max_new_tokens=4, eos_ids=[], greedy=True,
+                kv_dtype="int8", speculative=False,
+            )
+        assert out.tokens.shape == (1, 4)
+        assert "full-precision KV" in capsys.readouterr().err

@@ -151,3 +151,54 @@ class TestContinuousBatcher:
         for i, p in enumerate([[1, 5], [2, 6]]):
             ref = _reference(params, cfg, p, 8)
             np.testing.assert_array_equal(results[i].tokens, np.asarray(ref))
+
+
+class TestPagedUnderDp:
+    """Paged decode over a dp-sharded mesh: per-device page pools,
+    device-local tables, zero cross-device page traffic (VERDICT r1
+    item 4 — paged no longer excludes multi-device)."""
+
+    @pytest.fixture(autouse=True)
+    def _needs_8_devices(self):
+        if len(jax.devices()) < 8:
+            pytest.skip("requires 8 virtual devices")
+
+    @pytest.mark.parametrize("n_prompts", [4, 3])
+    def test_paged_dp_matches_single_device(self, n_prompts):
+        """Greedy paged decode on dp=4 (with dp-padding for 3 prompts)
+        must reproduce single-device paged tokens exactly."""
+        from adversarial_spec_tpu.parallel.mesh import make_mesh
+        from adversarial_spec_tpu.parallel.sharding import shard_params
+
+        cfg = get_config("llama", "tiny")
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        prompts = [[1 + i, 5, 9, 3 + i] for i in range(n_prompts)]
+        kw = dict(
+            max_new_tokens=6, eos_ids=[], greedy=True,
+            paged=True, page_size=16, speculative=False,
+        )
+        ref = generate(params, cfg, prompts, **kw)
+        mesh = make_mesh({})  # all 8 devices on dp
+        sharded = shard_params(mesh, params)
+        with mesh:
+            out = generate(sharded, cfg, prompts, mesh=mesh, **kw)
+        np.testing.assert_array_equal(ref.tokens, out.tokens)
+        np.testing.assert_array_equal(ref.n_generated, out.n_generated)
+
+    def test_paged_tp_mesh_falls_back_to_dense(self, capsys):
+        from adversarial_spec_tpu.parallel.mesh import make_mesh
+        from adversarial_spec_tpu.parallel.sharding import shard_params
+
+        cfg = get_config("llama", "tiny")
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        prompts = [[1, 5], [2, 6]]
+        mesh = make_mesh({"tp": 2})
+        sharded = shard_params(mesh, params)
+        with mesh:
+            out = generate(
+                sharded, cfg, prompts, mesh=mesh,
+                max_new_tokens=4, eos_ids=[], greedy=True,
+                paged=True, page_size=16, speculative=False,
+            )
+        assert out.tokens.shape == (2, 4)
+        assert "dp only" in capsys.readouterr().err
