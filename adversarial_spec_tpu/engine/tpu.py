@@ -266,9 +266,18 @@ class TpuEngine:
             )
         total_time = time.monotonic() - t0
 
+        # Per-row attribution: decode time proportional to each row's
+        # actual decoded tokens (an early-EOS row consumed fewer decode
+        # steps than a full-budget row); the prefill/overhead remainder
+        # splits evenly (prefill is genuinely shared batch work). Row
+        # sums reproduce the call totals exactly.
+        tok_total = float(result.n_generated.sum())
+        prefill_share = (total_time - result.decode_time_s) / len(batch)
         completions = []
         for row, req in enumerate(batch):
             n = int(result.n_generated[row])
+            frac = (n / tok_total) if tok_total > 0 else 1.0 / len(batch)
+            decode_share = result.decode_time_s * frac
             text = tok.decode(result.tokens[row, :n])
             completions.append(
                 Completion(
@@ -276,9 +285,9 @@ class TpuEngine:
                     usage=Usage(
                         input_tokens=len(prompts[row]),
                         output_tokens=n,
-                        device_time_s=total_time / len(batch),
+                        device_time_s=prefill_share + decode_share,
                         decode_tokens=n,
-                        decode_time_s=result.decode_time_s / len(batch),
+                        decode_time_s=decode_share,
                     ),
                 )
             )

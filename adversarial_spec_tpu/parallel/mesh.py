@@ -30,13 +30,27 @@ MeshAxes = (DP, TP, SP)
 
 def maybe_initialize_distributed() -> None:
     """Bring up the multi-host runtime when launched as one process per
-    host (JAX reads coordinator/process env vars). Safe no-op otherwise.
+    host. Safe no-op otherwise.
+
+    Launch contract (one process per host):
+
+        JAX_COORDINATOR_ADDRESS=host0:1234   # process 0's address
+        JAX_NUM_PROCESSES=N
+        JAX_PROCESS_ID=i                     # 0..N-1, unique per process
+
+    ``jax.distributed.initialize()`` only auto-detects managed clusters
+    (SLURM, Cloud TPU metadata); for the generic env-var launch above it
+    requires explicit arguments, so this passes them through. Exercised
+    for real by the two-process CPU smoke test
+    (tests/test_multihost.py), so the v5p-16 multi-host config is not
+    first debugged on scarce hardware.
 
     The idempotence check must NOT touch the backend (jax.process_count /
     jax.devices would initialize XLA and make distributed.initialize
     illegal), so it inspects the distributed client state directly.
     """
-    if not os.environ.get("JAX_COORDINATOR_ADDRESS"):
+    coordinator = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if not coordinator:
         return
     is_init = getattr(jax.distributed, "is_initialized", None)
     if is_init is not None:
@@ -45,8 +59,36 @@ def maybe_initialize_distributed() -> None:
         from jax._src import distributed as _dist
 
         already = _dist.global_state.client is not None
-    if not already:
-        jax.distributed.initialize()
+    if already:
+        return
+    num = os.environ.get("JAX_NUM_PROCESSES")
+    pid = os.environ.get("JAX_PROCESS_ID")
+    if (num is None) != (pid is None):
+        # Fail fast with the actual cause — falling through to cluster
+        # auto-detect would hang the other hosts at the coordinator
+        # barrier or die with an opaque error.
+        missing = "JAX_PROCESS_ID" if pid is None else "JAX_NUM_PROCESSES"
+        raise RuntimeError(
+            f"multi-host launch: JAX_COORDINATOR_ADDRESS is set but "
+            f"{missing} is not; set both JAX_NUM_PROCESSES and "
+            f"JAX_PROCESS_ID (or neither, for managed clusters)"
+        )
+    if num is not None:
+        try:
+            num_i, pid_i = int(num), int(pid)
+        except ValueError:
+            raise RuntimeError(
+                f"multi-host launch: JAX_NUM_PROCESSES={num!r} / "
+                f"JAX_PROCESS_ID={pid!r} must be integers"
+            ) from None
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_i,
+            process_id=pid_i,
+        )
+    else:
+        # Managed-cluster path: let jax's cluster plugins fill the rest.
+        jax.distributed.initialize(coordinator_address=coordinator)
 
 
 def mesh_shape_from_spec(

@@ -113,3 +113,45 @@ class TestCliTpuPath:
         assert r["error"] is None
         assert r["output_tokens"] > 0
         assert data["cost"]["models"]["tpu://random-tiny"]["cost_usd"] == 0.0
+
+
+class TestPerRowUsageAttribution:
+    def test_early_eos_row_billed_less(self, engine, monkeypatch):
+        """VERDICT r1 item 8: device time attributes proportionally to
+        per-row decode counts — an early-EOS row must report less device
+        and decode time than a full-budget row, and the row sums must
+        reproduce the call totals."""
+        import numpy as np
+
+        from adversarial_spec_tpu.engine import tpu as tpu_mod
+        from adversarial_spec_tpu.engine.generate import GenerateResult
+
+        def fake_generate(params, cfg, prompts, **kw):
+            B = len(prompts)
+            toks = np.zeros((B, 8), np.int32)
+            toks[:, :] = 5
+            return GenerateResult(
+                tokens=toks,
+                n_generated=np.array([2, 8][:B], np.int64),
+                prefill_time_s=0.5,
+                decode_time_s=1.0,
+                decode_tokens=10,
+            )
+
+        monkeypatch.setattr(tpu_mod, "generate", fake_generate)
+        comps = engine.chat(
+            [_req("tpu://random-tiny", "a"), _req("tpu://random-tiny", "b")],
+            PARAMS,
+        )
+        short, full = comps
+        assert short.usage.output_tokens == 2
+        assert full.usage.output_tokens == 8
+        # Proportional decode attribution: 2/10 vs 8/10 of 1.0 s.
+        assert abs(short.usage.decode_time_s - 0.2) < 1e-9
+        assert abs(full.usage.decode_time_s - 0.8) < 1e-9
+        assert short.usage.device_time_s < full.usage.device_time_s
+        # Sums reproduce the totals (decode exactly; device time includes
+        # the evenly split prefill/overhead remainder).
+        assert abs(
+            short.usage.decode_time_s + full.usage.decode_time_s - 1.0
+        ) < 1e-9
