@@ -51,6 +51,16 @@ def maybe_initialize_distributed() -> None:
     """
     coordinator = os.environ.get("JAX_COORDINATOR_ADDRESS")
     if not coordinator:
+        if os.environ.get("JAX_NUM_PROCESSES") or os.environ.get(
+            "JAX_PROCESS_ID"
+        ):
+            # Half a launch contract: this host would silently run
+            # single-process while its peers block at the coordinator
+            # barrier forever. Fail fast with the cause.
+            raise RuntimeError(
+                "multi-host launch: JAX_NUM_PROCESSES/JAX_PROCESS_ID are "
+                "set but JAX_COORDINATOR_ADDRESS is not; set all three"
+            )
         return
     is_init = getattr(jax.distributed, "is_initialized", None)
     if is_init is not None:

@@ -11,9 +11,16 @@ Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": "tok/s/chip", "vs_baseline": N/1500}
 
 Robustness: the TPU tunnel in this environment can wedge (backend init
-blocks forever), so platform selection happens via a short subprocess
-probe; if the TPU doesn't come up, the bench runs on CPU with a smaller
-config and says so in the "platform" field rather than hanging the driver.
+blocks forever), so platform selection happens via a DETACHED subprocess
+probe with a file handshake — the probe is never killed (SIGKILLing a
+TPU-holding process is what wedges the tunnel for every later process;
+NOTES.md round 1); if it doesn't report in time we simply stop waiting,
+leave it to finish on its own, and run the bench on CPU with a smaller
+config, saying so in the "platform" field rather than hanging the driver.
+
+Modes:
+  python bench.py                 # north-star decode bench (one JSON line)
+  python bench.py --long-context  # 16k-token prefill bench (one JSON line)
 """
 
 from __future__ import annotations
@@ -22,27 +29,60 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 BASELINE_TOK_S_CHIP = 1500.0
 N_OPPONENTS = 4
 PROMPT_TOKENS = 1024
 DECODE_TOKENS = 256
+LONG_CONTEXT_TOKENS = 16384
 
 
 def _probe_tpu(timeout_s: float = 120.0) -> bool:
-    """Can a fresh process initialize the accelerator backend in time?"""
-    code = "import jax; d=jax.devices(); print(d[0].platform)"
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c", code],
-            capture_output=True,
-            timeout=timeout_s,
-            text=True,
-        )
-    except subprocess.TimeoutExpired:
-        return False
-    return out.returncode == 0 and "cpu" not in out.stdout.strip().lower()
+    """Can a fresh process initialize the accelerator backend in time?
+
+    Wedge-safe: the probe runs detached and writes its verdict to a
+    marker file. On timeout the probe is LEFT RUNNING — a timeout-killed
+    TPU process wedges the axon tunnel for the whole session (learned in
+    round 1) — and we just proceed on CPU.
+    """
+    marker_dir = tempfile.mkdtemp(prefix="tpu_probe_")
+    marker = os.path.join(marker_dir, "verdict")
+    # Atomic handshake: write to a temp name, then rename — the parent
+    # can never observe a half-written verdict.
+    code = (
+        "import jax, os\n"
+        "d = jax.devices()\n"
+        f"tmp = {marker!r} + '.tmp'\n"
+        "open(tmp, 'w').write(d[0].platform)\n"
+        f"os.rename(tmp, {marker!r})\n"
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        start_new_session=True,  # survives us; never signaled
+    )
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if os.path.exists(marker):
+            platform = open(marker).read().strip().lower()
+            if platform in ("", "cpu"):
+                return False
+            # The tunnel is single-client: wait for the probe to release
+            # the TPU before the parent initializes its own client. If
+            # teardown itself hangs, fall back to CPU (and leave the
+            # probe alone — killing it is what wedges the tunnel).
+            try:
+                proc.wait(timeout=max(10.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                return False
+            return True
+        if proc.poll() is not None and not os.path.exists(marker):
+            return False  # probe died without a verdict (backend error)
+        time.sleep(1.0)
+    return False  # timed out: leave the probe alone, fall back to CPU
 
 
 def _run_bench(platform: str) -> dict:
@@ -120,18 +160,93 @@ def _run_bench(platform: str) -> dict:
     }
 
 
+def _run_long_context(platform: str) -> dict:
+    """16k-token prefill (BASELINE config 5's context scale).
+
+    Multi-device meshes prefill sequence-parallel (ring attention over
+    sp — parallel/sp.py); single device uses chunked prefill. CPU runs a
+    thin model so the 16k×16k attention is tractable; the measurement
+    structure is identical either way.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from adversarial_spec_tpu.engine.generate import generate
+    from adversarial_spec_tpu.models import transformer as T
+    from adversarial_spec_tpu.models.config import get_config
+
+    if platform != "cpu":
+        cfg = get_config("llama", "1b", max_seq_len=LONG_CONTEXT_TOKENS + 64)
+        dtype = jnp.bfloat16
+    else:
+        from dataclasses import replace
+
+        cfg = replace(
+            get_config("llama", "tiny"),
+            n_layers=2,
+            max_seq_len=LONG_CONTEXT_TOKENS + 64,
+        )
+        dtype = jnp.float32
+    params = T.init_params(jax.random.key(0), cfg, dtype=dtype)
+
+    rng = __import__("random").Random(1)
+    prompt = [
+        rng.randrange(3, cfg.vocab_size) for _ in range(LONG_CONTEXT_TOKENS)
+    ]
+
+    n_devices = len(jax.devices())
+    mesh = None
+    mode = "chunked"
+    if n_devices > 1:
+        from adversarial_spec_tpu.parallel.mesh import make_mesh
+        from adversarial_spec_tpu.parallel.sharding import shard_params
+
+        sp = max(d for d in (4, 2, 1) if n_devices % d == 0)
+        mesh = make_mesh({"sp": sp, "dp": n_devices // sp})
+        params = shard_params(mesh, params)
+        mode = f"sp{sp}"
+
+    kw = dict(
+        max_new_tokens=8,  # prefill is the measurement; decode is a tail
+        eos_ids=[],
+        greedy=True,
+        mesh=mesh,
+        speculative=False,
+    )
+    generate(params, cfg, [prompt], **kw)  # warmup/compile
+    t0 = time.monotonic()
+    result = generate(params, cfg, [prompt], **kw)
+    wall = time.monotonic() - t0
+
+    prefill_tok_s = LONG_CONTEXT_TOKENS / result.prefill_time_s
+    return {
+        "metric": "prefill_16k_tokens_per_sec",
+        "value": round(prefill_tok_s, 1),
+        "unit": "tok/s",
+        "vs_baseline": None,  # BASELINE publishes no prefill number
+        "platform": platform,
+        "mode": mode,
+        "model": "llama-1b" if platform != "cpu" else "llama-tiny-2L",
+        "context_tokens": LONG_CONTEXT_TOKENS,
+        "prefill_time_s": round(result.prefill_time_s, 3),
+        "wall_s": round(wall, 3),
+    }
+
+
 def main() -> int:
+    long_context = "--long-context" in sys.argv[1:]
+    runner = _run_long_context if long_context else _run_bench
     if os.environ.get("BENCH_FORCE_CPU") == "1" or not _probe_tpu():
         # Backend unreachable (or forced): pin CPU before jax import.
         os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-        payload = _run_bench("cpu")
+        payload = runner("cpu")
     else:
         import jax
 
-        payload = _run_bench(jax.devices()[0].platform)
+        payload = runner(jax.devices()[0].platform)
     print(json.dumps(payload))
     return 0
 

@@ -398,3 +398,52 @@ class TestRingAttention:
         np.testing.assert_allclose(
             np.asarray(jit_out), np.asarray(eager), rtol=1e-6, atol=1e-6
         )
+
+
+class TestLongContext16k:
+    """16k-token sp prefill numerics (VERDICT r1 item 6 / BASELINE
+    config 5's context scale). A thin 2-layer model keeps the CPU cost
+    tractable; the sequence length is the real thing."""
+
+    @pytest.mark.slow
+    def test_sp_prefill_matches_chunked_at_16k(self):
+        """Ring-attention sp prefill vs the chunked dense reference at a
+        REAL 16384-token sequence (a 1-layer thin model keeps the S²
+        attention tractable on CPU; ~80 s)."""
+        from dataclasses import replace
+
+        from adversarial_spec_tpu.engine.generate import prefill_chunk
+        from adversarial_spec_tpu.parallel.sp import sp_prefill
+
+        S = 16384
+        cfg = replace(
+            get_config("llama", "tiny"),
+            n_layers=1,
+            n_heads=2,
+            n_kv_heads=2,
+            dim=128,
+            ffn_dim=256,
+            max_seq_len=S + 64,
+        )
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        tokens = jnp.asarray(
+            np.random.default_rng(5).integers(3, cfg.vocab_size, (1, S)),
+            jnp.int32,
+        )
+        pads = jnp.zeros((1,), jnp.int32)
+
+        mesh = make_mesh({"sp": 4, "dp": 2})
+        sharded = shard_params(mesh, params)
+        with mesh:
+            logits_sp, _ = sp_prefill(sharded, cfg, tokens, pads, mesh)
+
+        cache = T.init_cache(cfg, 1, S, dtype=jnp.float32)
+        last = None
+        for ci in range(0, S, 1024):
+            cache, last = prefill_chunk(
+                params, cfg, tokens[:, ci : ci + 1024], pads, cache,
+                jnp.int32(ci),
+            )
+        np.testing.assert_allclose(
+            np.asarray(logits_sp), np.asarray(last), rtol=3e-4, atol=3e-4
+        )
