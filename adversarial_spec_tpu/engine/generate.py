@@ -322,8 +322,8 @@ def generate(
             file=_sys.stderr,
         )
         kv_dtype = ""
-    # An explicit use_pallas_decode=True records caller intent (it gates
-    # auto-speculation).
+    # An explicit use_pallas_decode=True records caller intent (it
+    # selects a louder fallback when the mesh can't support the kernel).
     explicit_pallas = use_pallas_decode is True
     if use_pallas_decode is None:
         # Auto: fused kernel on a real TPU. Multi-device meshes run it
@@ -619,12 +619,12 @@ def generate(
     # budget for at least one γ+1 span. Any batch size and any sampling
     # mode qualify (per-row accept lengths + rejection sampling) — the
     # bench shape (4 opponents, temperature 0.7) is the target workload.
-    # An explicit use_pallas_decode=True wins over auto-speculation
-    # (speculation forces the jnp attention path; see below).
+    # Composes with the fused kernels: verification spans run the
+    # multi-query kernel, the tail the single-query one.
     from adversarial_spec_tpu.engine.speculative import GAMMA
 
     if speculative is None:
-        speculative = not explicit_pallas
+        speculative = True
     use_spec = (
         speculative
         and not paged
@@ -641,11 +641,11 @@ def generate(
 
         prev_rows = tokens[:, -1]
         steps_rows = jnp.ones((B,), jnp.int32)
-        # Keep the whole call on ONE attention implementation: the
-        # verification forward runs the jnp path (S=γ+1 — the fused
-        # Pallas kernel is single-query), so the single-token tail must
-        # too, or near-tie argmaxes could diverge mid-sequence.
-        use_pallas_decode = False
+        # One attention implementation must govern the whole speculative
+        # call (verify and tail see the same near-tie argmaxes). The MQ
+        # kernel can't read int8 tiles, so int8 speculation runs all-jnp
+        # rather than mixing a jnp verify with a Pallas int8 tail.
+        spec_pallas = use_pallas_decode and kv_dtype != "int8"
 
     t1 = time.monotonic()
 
@@ -702,6 +702,8 @@ def generate(
                 greedy=greedy,
                 top_k=top_k,
                 use_top_p=use_top_p,
+                use_pallas=spec_pallas,
+                pallas_interpret=pallas_interpret,
             )
             desynced = True
             step = jnp.max(steps_rows)
@@ -733,6 +735,8 @@ def generate(
                 greedy=greedy,
                 top_k=top_k,
                 use_top_p=use_top_p,
+                use_pallas=spec_pallas,
+                pallas_interpret=pallas_interpret,
             )
             step = jnp.max(steps_rows)
         elif paged:

@@ -33,10 +33,12 @@ Because rows accept different draft counts, they desynchronize — after any
 speculative phase the tail must finish on ``rowwise_decode_steps`` (per-row
 cache slots), not the shared-slot loop in engine/generate.py.
 
-Scope: dense KV cache, single-device, jnp attention (generate() forces the
-whole call off the Pallas kernel — the single-query kernel can't verify
-γ+1-wide spans, and one attention implementation must govern the call so
-near-tie argmaxes can't diverge between verify and tail).
+Scope: dense KV cache, single-device. On TPU the verification forward
+runs the MULTI-QUERY fused kernel (ops/pallas_decode.py:
+decode_attention_mq — the whole γ+1 span in one pass over the KV cache)
+and the tail loop the single-query kernel, so speculation no longer
+costs the fused-attention path (round-1's shortcut). int8-KV spans fall
+back to the jnp mask path inside forward().
 
 EOS contract (mirror of generate._sample_step — change BOTH together):
 the EOS token itself is kept in the output; slots after it emit 0.
@@ -106,6 +108,8 @@ def _draft(context, prev, cur, limits, gamma):
         "greedy",
         "top_k",
         "use_top_p",
+        "use_pallas",
+        "pallas_interpret",
     ),
     donate_argnames=("cache", "out_buf"),
 )
@@ -132,6 +136,8 @@ def speculative_decode_steps(
     greedy: bool = False,
     top_k: int = 0,
     use_top_p: bool = True,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
 ):
     """Up to ``iters`` speculative rounds over whichever rows still fit a
     full γ+1 span.
@@ -187,7 +193,15 @@ def speculative_decode_steps(
             - pad_lens[:, None]
         )
         logits, cache = forward(
-            params, cfg, toks, positions, cache, cache_index, kv_base
+            params,
+            cfg,
+            toks,
+            positions,
+            cache,
+            cache_index,
+            kv_base,
+            use_pallas_decode=use_pallas,
+            pallas_interpret=pallas_interpret,
         )
         # The true per-position sampling distribution (one-hot if greedy).
         filt = filtered_logits(
@@ -323,6 +337,8 @@ def speculative_decode_steps(
         "greedy",
         "top_k",
         "use_top_p",
+        "use_pallas",
+        "pallas_interpret",
     ),
     donate_argnames=("cache", "out_buf"),
 )
@@ -346,6 +362,8 @@ def rowwise_decode_steps(
     greedy: bool,
     top_k: int,
     use_top_p: bool = True,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
 ):
     """Plain single-token decode with PER-ROW cache slots.
 
@@ -374,7 +392,15 @@ def rowwise_decode_steps(
         cache_index = prompt_len + steps - 1  # [B]
         positions = (cache_index - pad_lens)[:, None]
         logits, cache = forward(
-            params, cfg, cur[:, None], positions, cache, cache_index, kv_base
+            params,
+            cfg,
+            cur[:, None],
+            positions,
+            cache,
+            cache_index,
+            kv_base,
+            use_pallas_decode=use_pallas,
+            pallas_interpret=pallas_interpret,
         )
         key, sub = jax.random.split(key)
         nxt = sample_tokens(

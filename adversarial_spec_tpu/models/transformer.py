@@ -288,6 +288,16 @@ def forward(
     B, S = tokens.shape
     T = cache["k"].shape[2]
     pallas_decode = use_pallas_decode and S == 1
+    # Short multi-query spans (speculative verification: S = γ+1) run
+    # the multi-query kernel — one pass over the KV cache for the whole
+    # span. Single-device, non-quantized (the MQ kernel reads raw tiles;
+    # int8 spans fall back to the jnp mask path).
+    pallas_mq = (
+        use_pallas_decode
+        and 1 < S <= 16
+        and (mesh is None or mesh.size == 1)
+        and "ks" not in cache
+    )
 
     x = params["embed"][tokens]
     if cfg.scale_embeddings:
@@ -316,13 +326,21 @@ def forward(
 
     layer_ids = jnp.arange(cfg.n_layers)
 
-    if pallas_decode:
-        # Per-row valid window [start, end) for the fused kernel; the
+    if pallas_decode or pallas_mq:
+        # Per-row valid window [start, end) for the fused kernels; the
         # sliding-window start tightening happens per layer below.
         pallas_start = jnp.argmax(kv_valid.astype(jnp.int32), axis=1).astype(
             jnp.int32
         )
         pallas_end = jnp.full((B,), 0, jnp.int32) + cache_index + 1
+    if pallas_mq:
+        # Per-query positions: query j of row b sits at slot
+        # cache_index_b + j, sees [start_bj, cache_index_b + j + 1).
+        mq_q_pos = jnp.broadcast_to(
+            jnp.reshape(cache_index, (-1, 1))
+            + jnp.arange(S, dtype=jnp.int32),
+            (B, S),
+        )
 
     quant_kv = "ks" in cache  # int8 K/V with per-(token, head) scales
 
@@ -412,6 +430,24 @@ def forward(
                     interpret=pallas_interpret,
                     **qkw,
                 )[:, None]
+        elif pallas_mq:
+            from adversarial_spec_tpu.ops.pallas_decode import (
+                decode_attention_mq,
+            )
+
+            starts_l = _layer_window_start(
+                cfg, layer_id, pallas_start[:, None], mq_q_pos
+            )
+            out = decode_attention_mq(
+                q,
+                k_read,
+                v_read,
+                starts_l,
+                mq_q_pos + 1,
+                attn_softcap=cfg.attn_softcap,
+                scale=cfg.attn_scale,
+                interpret=pallas_interpret,
+            )
         else:
             if cfg.sliding_window > 0 and cfg.sliding_window_pattern > 1:
                 # Gemma-2: alternate windowed / global layers.

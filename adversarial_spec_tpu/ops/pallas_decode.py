@@ -108,6 +108,159 @@ def _decode_attn_kernel(
         ).astype(o_ref.dtype)
 
 
+def _mq_attn_kernel(
+    bounds_ref,  # SMEM [B, G8, 2]: per (row-of-program) [start, end)
+    q_ref,  # VMEM [1, 1, G8, D] — G8 = pad(S·g) query rows
+    k_ref,  # VMEM [1, block_t, 1, D]
+    v_ref,  # VMEM [1, block_t, 1, D]
+    o_ref,  # VMEM [1, 1, G8, D]
+    m_ref,
+    l_ref,
+    acc_ref,
+    *,
+    scale: float,
+    attn_softcap: float,
+    block_t: int,
+):
+    b = pl.program_id(0)
+    t = pl.program_id(2)
+    n_blocks = pl.num_programs(2)
+    G8, D = q_ref.shape[2], q_ref.shape[3]
+
+    @pl.when(t == 0)
+    def _init():
+        m_ref[:] = jnp.full((G8, 1), -jnp.inf, jnp.float32)
+        l_ref[:] = jnp.zeros((G8, 1), jnp.float32)
+        acc_ref[:] = jnp.zeros((G8, D), jnp.float32)
+
+    starts = bounds_ref[b, :, 0]  # [G8]
+    ends = bounds_ref[b, :, 1]
+    t0 = t * block_t
+
+    # Skip tiles wholly outside EVERY query's window.
+    @pl.when((t0 < jnp.max(ends)) & (t0 + block_t > jnp.min(starts)))
+    def _accumulate():
+        q = q_ref[0, 0].astype(jnp.float32) * scale
+        k = k_ref[0, :, 0].astype(jnp.float32)
+        v = v_ref[0, :, 0].astype(jnp.float32)
+        m, l, acc = flash_update(
+            q,
+            k,
+            v,
+            t0,
+            starts[:, None],  # per-row bounds broadcast inside
+            ends[:, None],
+            m_ref[:],
+            l_ref[:],
+            acc_ref[:],
+            attn_softcap=attn_softcap,
+        )
+        m_ref[:] = m
+        l_ref[:] = l
+        acc_ref[:] = acc
+
+    @pl.when(t == n_blocks - 1)
+    def _finalize():
+        o_ref[0, 0] = (
+            acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("attn_softcap", "scale", "interpret")
+)
+def decode_attention_mq(
+    q: jnp.ndarray,  # [B, S, Hq, D] — a SHORT query span (spec verify)
+    k_cache: jnp.ndarray,  # [B, T, Hkv, D]
+    v_cache: jnp.ndarray,  # [B, T, Hkv, D]
+    starts: jnp.ndarray,  # [B, S] int32 first valid slot per query
+    ends: jnp.ndarray,  # [B, S] int32 one-past-last valid slot per query
+    attn_softcap: float = 0.0,
+    scale: float | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Multi-query fused decode attention. Returns [B, S, Hq, D].
+
+    The speculative-verification shape: γ+1 query positions per row, each
+    attending to the KV cache under its OWN [start, end) window (end
+    grows by one per query — in-span causality). Same streamed-tile
+    flash recurrence as ``decode_attention``; the queries of one
+    (row, kv-head) program stack into the sublane dimension, so the
+    whole span costs ONE pass over the KV cache instead of γ+1. This is
+    what lets speculative decoding keep the fused kernel instead of
+    dropping the entire call to the jnp path (round-1 shortcut).
+    """
+    B, S, Hq, D = q.shape
+    T, Hkv = k_cache.shape[1], k_cache.shape[2]
+    g = Hq // Hkv
+    rows = S * g
+    G8 = -(-rows // _SUBLANE) * _SUBLANE
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    block_t = next(
+        (b for b in (BLOCK_T, 128, 64, 32, 16, 8) if T % b == 0), T
+    )
+
+    # [B, Hkv, S·g, D]: row r = query (r // g), group lane (r % g).
+    qg = jnp.transpose(
+        q.reshape(B, S, Hkv, g, D), (0, 2, 1, 3, 4)
+    ).reshape(B, Hkv, rows, D)
+    # Per-row bounds; pad rows get an empty window [0, 0) → masked
+    # everywhere → zero output (dropped below). starts/ends may arrive
+    # [B, 1] (global layers share one start per row) — broadcast first.
+    starts = jnp.broadcast_to(starts, (B, S))
+    ends = jnp.broadcast_to(ends, (B, S))
+    bnd = jnp.stack(
+        [
+            jnp.repeat(starts, g, axis=1),
+            jnp.repeat(ends, g, axis=1),
+        ],
+        axis=2,
+    ).astype(jnp.int32)  # [B, rows, 2]
+    if G8 != rows:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, G8 - rows), (0, 0)))
+        # Pad rows get the empty window [T, 0): a zero start would feed
+        # the kernel's min(starts) tile-skip guard and silently disable
+        # leading-tile skipping for windowed layers.
+        bnd = jnp.pad(bnd, ((0, 0), (0, G8 - rows), (0, 0)))
+        bnd = bnd.at[:, rows:, 0].set(T)
+
+    kv_spec = pl.BlockSpec(
+        (1, block_t, 1, D), lambda b, h, t, _: (b, t, h, 0)
+    )
+    out = pl.pallas_call(
+        functools.partial(
+            _mq_attn_kernel,
+            scale=scale,
+            attn_softcap=attn_softcap,
+            block_t=block_t,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, Hkv, T // block_t),
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, G8, D), lambda b, h, t, _: (b, h, 0, 0)
+                ),
+                kv_spec,
+                kv_spec,
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, G8, D), lambda b, h, t, _: (b, h, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((G8, 1), jnp.float32),
+                pltpu.VMEM((G8, 1), jnp.float32),
+                pltpu.VMEM((G8, D), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G8, D), q.dtype),
+        interpret=interpret,
+    )(bnd, qg, k_cache, v_cache)
+
+    out = out[:, :, :rows, :].reshape(B, Hkv, S, g, D)
+    return jnp.transpose(out, (0, 2, 1, 3, 4)).reshape(B, S, Hq, D)
+
+
 def decode_attention_tp(
     q: jnp.ndarray,  # [B, Hq, D]
     k_cache: jnp.ndarray,  # [B, T, Hkv, D]
