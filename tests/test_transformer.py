@@ -275,3 +275,69 @@ class TestRopeScaling:
         m7b = get_config("mistral", "7b")
         assert m7b.rope_theta == 1000000.0 and m7b.sliding_window == 0
         assert m7b.vocab_size == 32768
+
+
+def test_hf_parity_llama3_rope_scaling(tmp_path):
+    """Llama-3.2-style rope scaling (HF rope_type="llama3") against the
+    real transformers implementation — long positions are where scaled
+    and unscaled frequencies diverge, so the prompt exceeds the original
+    8-position window the test config declares."""
+    torch = pytest.importorskip("torch")
+    import transformers
+    from dataclasses import replace
+
+    cfg = replace(
+        get_config("llama", "tiny"),
+        tied_embeddings=True,
+        rope_scaling_factor=32.0,
+        rope_original_max=8,  # tiny "original" window: positions past 8
+        max_seq_len=256,      # exercise the scaled regime immediately
+    )
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.dim,
+        num_hidden_layers=cfg.n_layers,
+        num_attention_heads=cfg.n_heads,
+        num_key_value_heads=cfg.n_kv_heads,
+        intermediate_size=cfg.ffn_dim,
+        rope_theta=cfg.rope_theta,
+        rms_norm_eps=cfg.rms_eps,
+        max_position_embeddings=256,
+        tie_word_embeddings=True,
+        rope_scaling={
+            "rope_type": "llama3",
+            "factor": cfg.rope_scaling_factor,
+            "low_freq_factor": cfg.rope_low_freq_factor,
+            "high_freq_factor": cfg.rope_high_freq_factor,
+            "original_max_position_embeddings": cfg.rope_original_max,
+        },
+    )
+    torch.manual_seed(1)
+    hf_model = transformers.AutoModelForCausalLM.from_config(hf_cfg)
+    hf_model.eval()
+    ckpt = tmp_path / "ckpt"
+    hf_model.save_pretrained(ckpt, safe_serialization=True)
+
+    from adversarial_spec_tpu.engine.loader import load_hf_checkpoint
+
+    params = load_hf_checkpoint(ckpt, cfg, "llama", dtype=jnp.float32)
+
+    S = 24  # well past rope_original_max=8
+    rng = np.random.default_rng(7)
+    ids = rng.integers(1, cfg.vocab_size, (1, S))
+    with torch.no_grad():
+        hf_logits = hf_model(torch.from_numpy(ids)).logits.numpy()
+
+    ours, _ = _full_forward(params, cfg, jnp.asarray(ids, jnp.int32), S)
+    np.testing.assert_allclose(
+        np.asarray(ours), hf_logits, rtol=2e-3, atol=2e-3
+    )
+    # Guard: scaling genuinely changes the output in this regime (the
+    # parity above must not be vacuous).
+    unscaled = replace(cfg, rope_scaling_factor=0.0)
+    ours_unscaled, _ = _full_forward(
+        params, unscaled, jnp.asarray(ids, jnp.int32), S
+    )
+    assert not np.allclose(
+        np.asarray(ours), np.asarray(ours_unscaled), atol=1e-3
+    )
