@@ -122,7 +122,7 @@ def prefill_chunk(
     Returns (cache, last-position logits [B, vocab]).
     """
     B, Sc = tokens.shape
-    T = cache["k"].shape[2]
+    T = cache["k"].shape[3]  # [L, B, Hkv, T, D]
     positions = jnp.maximum(
         cache_index + jnp.arange(Sc, dtype=jnp.int32)[None, :]
         - pad_lens[:, None],
@@ -187,7 +187,7 @@ def decode_chunk_steps(
     batches don't burn MXU cycles padding out the chunk.
     """
     B = cur_tokens.shape[0]
-    T = cache["k"].shape[2]
+    T = cache["k"].shape[3]  # [L, B, Hkv, T, D]
     max_new = out_buf.shape[1]
     kv_base = jnp.arange(T)[None, :] >= pad_lens[:, None]
 
@@ -600,7 +600,7 @@ def generate(
         ]
         offsets = slots % page_size
         pool = write_tokens(
-            pool, cache["k"][:, :, :S], cache["v"][:, :, :S], page_ids, offsets
+            pool, cache["k"][..., :S, :], cache["v"][..., :S, :], page_ids, offsets
         )
         cache = None  # dense cache no longer needed
         # Same switch as the dense path: auto-resolved above (fused kernel

@@ -3,7 +3,7 @@
 Host-side bookkeeping (free list, per-sequence page tables) stays in numpy
 — it is O(pages) integer work with data-dependent control flow that has no
 business inside an XLA program — while the page pool itself lives on
-device as two dense arrays [n_pages, page_size, Hkv, D] per layer group,
+device as two dense arrays [n_pages, Hkv, page_size, D] per layer group,
 written with vectorized scatters and read by the paged Pallas kernel
 (ops/pallas_paged.py).
 
@@ -111,8 +111,8 @@ def init_page_pool(
     shape = (
         layout.n_layers,
         layout.n_pages,
-        layout.page_size,
         layout.n_kv_heads,
+        layout.page_size,
         layout.head_dim,
     )
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
@@ -120,21 +120,26 @@ def init_page_pool(
 
 def write_tokens(
     pool: dict[str, jnp.ndarray],
-    k_new: jnp.ndarray,  # [L, B, S, Hkv, D]
+    k_new: jnp.ndarray,  # [L, B, Hkv, S, D] — heads-major cache layout
     v_new: jnp.ndarray,
     page_ids: np.ndarray,  # [B, S] physical page per token
     offsets: np.ndarray,  # [B, S] slot within page per token
 ) -> dict[str, jnp.ndarray]:
     """Scatter freshly computed K/V into their pages (vectorized)."""
-    L, B, S = k_new.shape[0], k_new.shape[1], k_new.shape[2]
+    L, B, H, S, D = k_new.shape
     pid = jnp.asarray(page_ids).reshape(-1)  # [B*S]
     off = jnp.asarray(offsets).reshape(-1)
-    k_flat = k_new.reshape(L, B * S, *k_new.shape[3:])
-    v_flat = v_new.reshape(L, B * S, *v_new.shape[3:])
-    # pool[l, pid[n], off[n]] = new[l, n] for every layer l and token n.
+
+    def flat(x):  # [L, B, H, S, D] → [B*S, L, H, D] (token-major updates)
+        return jnp.transpose(x, (1, 3, 0, 2, 4)).reshape(B * S, L, H, D)
+
+    # pool[l, pid[n], :, off[n]] = new[n, l] for every layer l, token n.
+    # Advanced indices (pid at dim 1, off at dim 3) are separated by the
+    # head slice, so the token axis lands in front of the result — the
+    # updates are built token-major to match.
     return {
-        "k": pool["k"].at[:, pid, off].set(k_flat),
-        "v": pool["v"].at[:, pid, off].set(v_flat),
+        "k": pool["k"].at[:, pid, :, off].set(flat(k_new)),
+        "v": pool["v"].at[:, pid, :, off].set(flat(v_new)),
     }
 
 

@@ -113,7 +113,7 @@ def scheduler_decode_chunk(
     write-page lookup, bounds, and sampling glue exist exactly once.
     """
     B = cur_tok.shape[0]
-    page_size = pool["k"].shape[2]
+    page_size = pool["k"].shape[3]
     cap = out_buf.shape[1]
     rows = jnp.arange(B)
 

@@ -151,7 +151,7 @@ def speculative_decode_steps(
     to emit one token).
     """
     B, S = prompt_tokens.shape
-    T = cache["k"].shape[2]
+    T = cache["k"].shape[3]  # [L, B, Hkv, T, D]
     max_new = out_buf.shape[1]
     kv_base = jnp.arange(T)[None, :] >= pad_lens[:, None]
     span = gamma + 1
@@ -373,7 +373,7 @@ def rowwise_decode_steps(
     EOS semantics as generate._sample_step.
     """
     B = cur_tokens.shape[0]
-    T = cache["k"].shape[2]
+    T = cache["k"].shape[3]  # [L, B, Hkv, T, D]
     max_new = out_buf.shape[1]
     kv_base = jnp.arange(T)[None, :] >= pad_lens[:, None]
     rows = jnp.arange(B)

@@ -12,7 +12,13 @@ TPU-first design decisions (why this is not a torch translation):
   2-layer test configs to 80-layer 70B.
 - **Static shapes everywhere.** Batches are left-padded to a bucketed length
   (engine/generate.py); the KV cache is a dense preallocated
-  ``[L, B, S_max, H_kv, D]`` buffer written with ``dynamic_update_slice``.
+  ``[L, B, H_kv, S_max, D]`` buffer written with ``dynamic_update_slice``.
+  Heads-major layout is a Mosaic requirement, not a style choice: the
+  Pallas decode kernels stream ``[block_t, D]`` tiles, and TPU block
+  shapes must keep the (sublane, lane) = (seq, head_dim) axes minor —
+  a seq-major cache would need per-head blocks of sublane size 1, which
+  the TPU lowering rejects. It also makes each tp shard's cache slice
+  contiguous (heads axis is the sharded one).
   No data-dependent Python control flow — decode early-exit lives in a
   ``lax.while_loop`` in the generation loop, not here.
 - **bf16 params/activations, f32 where it matters** (RMSNorm accumulation,
@@ -112,7 +118,7 @@ def init_cache(
     (decode is KV-bandwidth-bound at long contexts); dequant fuses into
     the attention matmuls. Presence of "ks" marks a quantized cache.
     """
-    shape = (cfg.n_layers, batch, max_seq, cfg.n_kv_heads, cfg.head_dim)
+    shape = (cfg.n_layers, batch, cfg.n_kv_heads, max_seq, cfg.head_dim)
     kw = {"device": device} if device is not None else {}
     if kv_dtype == "int8":
         sshape = shape[:-1] + (1,)
@@ -161,22 +167,21 @@ def _activation(x: jnp.ndarray, kind: str) -> jnp.ndarray:
 
 def attention(
     q: jnp.ndarray,  # [B, S, Hq, D]
-    k: jnp.ndarray,  # [B, T, Hkv, D]
-    v: jnp.ndarray,  # [B, T, Hkv, D]
+    k: jnp.ndarray,  # [B, Hkv, T, D] — heads-major (cache layout)
+    v: jnp.ndarray,  # [B, Hkv, T, D]
     mask: jnp.ndarray,  # [B, S, T] bool — True = attend
     attn_softcap: float = 0.0,
     scale: float | None = None,
 ) -> jnp.ndarray:
     """Masked GQA attention, f32 softmax. Returns [B, S, Hq, D]."""
     B, S, Hq, D = q.shape
-    T = k.shape[1]
-    Hkv = k.shape[2]
+    Hkv, T = k.shape[1], k.shape[2]
     g = Hq // Hkv
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     qg = q.reshape(B, S, Hkv, g, D)
     # [B, Hkv, g, S, T]
     logits = jnp.einsum(
-        "bshgd,bthd->bhgst", qg, k, preferred_element_type=jnp.float32
+        "bshgd,bhtd->bhgst", qg, k, preferred_element_type=jnp.float32
     )
     logits = logits * scale
     if attn_softcap > 0.0:
@@ -185,7 +190,7 @@ def attention(
     logits = jnp.where(mask[:, None, None, :, :], logits, neg)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum(
-        "bhgst,bthd->bshgd", probs.astype(v.dtype), v
+        "bhgst,bhtd->bshgd", probs.astype(v.dtype), v
     )
     return out.reshape(B, S, Hq, D)
 
@@ -286,7 +291,7 @@ def forward(
     ``tp_decode_supported``.
     """
     B, S = tokens.shape
-    T = cache["k"].shape[2]
+    T = cache["k"].shape[3]  # [L, B, Hkv, T, D]
     pallas_decode = use_pallas_decode and S == 1
     # Short multi-query spans (speculative verification: S = γ+1) run
     # the multi-query kernel — one pass over the KV cache for the whole
@@ -350,17 +355,24 @@ def forward(
         """Store this chunk's K/V into the layer's cache slice and return
         (updated slice, attention-readable K, V). One site owns both the
         plain and int8 layouts, and both index modes (shared scalar slot
-        vs per-row slots)."""
+        vs per-row slots).
+
+        Fresh k/v arrive token-major [B, S, Hkv, D|1] and are transposed
+        to the heads-major cache layout [B, Hkv, S, D|1] here — the chunk
+        transpose is O(S·H·D), negligible next to the cache read."""
         if vector_index:
+            # Per-row slots: buf [Hkv, T, D], val [Hkv, S, D], seq at dim 1.
             upd = lambda buf, val: jax.vmap(  # noqa: E731
                 lambda b, v_, i: jax.lax.dynamic_update_slice(
-                    b, v_, (i,) + (0,) * (b.ndim - 1)
+                    b, v_, (0, i) + (0,) * (b.ndim - 2)
                 )
             )(buf, val, cache_index)
         else:
             upd = lambda buf, val: jax.lax.dynamic_update_slice(  # noqa: E731
-                buf, val, (0, cache_index, 0, 0)
+                buf, val, (0, 0, cache_index, 0)
             )
+        k = jnp.swapaxes(k, 1, 2)  # [B, Hkv, S, D]
+        v = jnp.swapaxes(v, 1, 2)
         if quant_kv:
             kq, ks = _quantize_kv(k)
             vq, vs = _quantize_kv(v)
@@ -508,7 +520,7 @@ def forward_paged_decode(
     cfg: ModelConfig,
     tokens: jnp.ndarray,  # [B, 1] int32 — single decode step
     positions: jnp.ndarray,  # [B, 1] rope positions
-    pool: Cache,  # {"k","v": [L, n_pages, page_size, Hkv, D]}
+    pool: Cache,  # {"k","v": [L, n_pages, Hkv, page_size, D]}
     page_table: jnp.ndarray,  # [B, Pmax] int32; <= 0 = unmapped (0=trash)
     write_page: jnp.ndarray,  # [B] physical page for this token's KV
     write_off: jnp.ndarray,  # [B] slot within that page
@@ -528,7 +540,7 @@ def forward_paged_decode(
     Returns (logits [B, 1, vocab], updated pool).
     """
     B = tokens.shape[0]
-    page_size = pool["k"].shape[2]
+    page_size = pool["k"].shape[3]
     layer_ids = jnp.arange(cfg.n_layers)
     cos, sin = rope_angles(
         positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
@@ -543,10 +555,13 @@ def forward_paged_decode(
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps, cfg.norm_scale_plus_one)
         q, k, v = _project_qkv(lp, cfg, h, B, 1, cos, sin)
 
-        k_pages = k_pages.at[write_page, write_off].set(
+        # Pages are heads-major [n_pages, Hkv, page_size, D]; advanced
+        # indices (write_page at dim 0, write_off at dim 2) separated by
+        # the head slice put the batch axis first → update [B, Hkv, D].
+        k_pages = k_pages.at[write_page, :, write_off].set(
             k[:, 0].astype(k_pages.dtype)
         )
-        v_pages = v_pages.at[write_page, write_off].set(
+        v_pages = v_pages.at[write_page, :, write_off].set(
             v[:, 0].astype(v_pages.dtype)
         )
 
@@ -569,15 +584,18 @@ def forward_paged_decode(
                 interpret=pallas_interpret,
             )[:, None]
         else:
-            # Gather reference path: page table → dense [B, T, Hkv, D].
+            # Gather reference path: page table → dense [B, Hkv, T, D].
             safe_table = jnp.maximum(page_table, 0)
-            k_dense = k_pages[safe_table].reshape(
-                B, -1, cfg.n_kv_heads, cfg.head_dim
-            )
-            v_dense = v_pages[safe_table].reshape(
-                B, -1, cfg.n_kv_heads, cfg.head_dim
-            )
-            T = k_dense.shape[1]
+
+            def to_dense(pages):  # [B, P, Hkv, page, D] → [B, Hkv, T, D]
+                g = pages[safe_table]
+                return jnp.swapaxes(g, 1, 2).reshape(
+                    B, cfg.n_kv_heads, -1, cfg.head_dim
+                )
+
+            k_dense = to_dense(k_pages)
+            v_dense = to_dense(v_pages)
+            T = k_dense.shape[2]
             slot = jnp.arange(T)[None, None, :]
             # <= 0 is unmapped: page 0 is the reserved trash page (callers
             # shift allocator ids +1), negatives are table padding. Same

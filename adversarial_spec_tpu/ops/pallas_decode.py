@@ -44,8 +44,8 @@ _SUBLANE = 8
 def _decode_attn_kernel(
     bounds_ref,  # SMEM [B, 2] int32: (start, end) valid-slot window per row
     q_ref,  # VMEM [1, 1, G8, D]
-    k_ref,  # VMEM [1, block_t, 1, D] — one streamed tile
-    v_ref,  # VMEM [1, block_t, 1, D]
+    k_ref,  # VMEM [1, 1, block_t, D] — one streamed tile (heads-major cache)
+    v_ref,  # VMEM [1, 1, block_t, D]
     *rest,  # [ks_ref, vs_ref,] o_ref, m_ref, l_ref, acc_ref
     scale: float,
     attn_softcap: float,
@@ -80,11 +80,11 @@ def _decode_attn_kernel(
     @pl.when((t0 < end) & (t0 + block_t > start))
     def _accumulate():
         q = q_ref[0, 0].astype(jnp.float32) * scale
-        k = k_ref[0, :, 0].astype(jnp.float32)
-        v = v_ref[0, :, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)  # [block_t, D]
+        v = v_ref[0, 0].astype(jnp.float32)
         if quantized:
-            k = k * ks_ref[0, :, 0]  # [block_t, 1] broadcasts over D
-            v = v * vs_ref[0, :, 0]
+            k = k * ks_ref[0, 0]  # [block_t, 1] broadcasts over D
+            v = v * vs_ref[0, 0]
         m, l, acc = flash_update(
             q,
             k,
@@ -109,10 +109,12 @@ def _decode_attn_kernel(
 
 
 def _mq_attn_kernel(
-    bounds_ref,  # SMEM [B, G8, 2]: per (row-of-program) [start, end)
+    bounds_ref,  # VMEM [1, G8, 2]: per (row-of-program) [start, end).
+    # VMEM, not SMEM scalar-prefetch: Mosaic can only load SCALARS from
+    # SMEM, and this kernel needs the whole per-query bounds vector.
     q_ref,  # VMEM [1, 1, G8, D] — G8 = pad(S·g) query rows
-    k_ref,  # VMEM [1, block_t, 1, D]
-    v_ref,  # VMEM [1, block_t, 1, D]
+    k_ref,  # VMEM [1, 1, block_t, D]
+    v_ref,  # VMEM [1, 1, block_t, D]
     o_ref,  # VMEM [1, 1, G8, D]
     m_ref,
     l_ref,
@@ -122,7 +124,6 @@ def _mq_attn_kernel(
     attn_softcap: float,
     block_t: int,
 ):
-    b = pl.program_id(0)
     t = pl.program_id(2)
     n_blocks = pl.num_programs(2)
     G8, D = q_ref.shape[2], q_ref.shape[3]
@@ -133,16 +134,16 @@ def _mq_attn_kernel(
         l_ref[:] = jnp.zeros((G8, 1), jnp.float32)
         acc_ref[:] = jnp.zeros((G8, D), jnp.float32)
 
-    starts = bounds_ref[b, :, 0]  # [G8]
-    ends = bounds_ref[b, :, 1]
+    starts = bounds_ref[0, :, 0]  # [G8]
+    ends = bounds_ref[0, :, 1]
     t0 = t * block_t
 
     # Skip tiles wholly outside EVERY query's window.
     @pl.when((t0 < jnp.max(ends)) & (t0 + block_t > jnp.min(starts)))
     def _accumulate():
         q = q_ref[0, 0].astype(jnp.float32) * scale
-        k = k_ref[0, :, 0].astype(jnp.float32)
-        v = v_ref[0, :, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
         m, l, acc = flash_update(
             q,
             k,
@@ -171,8 +172,8 @@ def _mq_attn_kernel(
 )
 def decode_attention_mq(
     q: jnp.ndarray,  # [B, S, Hq, D] — a SHORT query span (spec verify)
-    k_cache: jnp.ndarray,  # [B, T, Hkv, D]
-    v_cache: jnp.ndarray,  # [B, T, Hkv, D]
+    k_cache: jnp.ndarray,  # [B, Hkv, T, D] heads-major
+    v_cache: jnp.ndarray,  # [B, Hkv, T, D]
     starts: jnp.ndarray,  # [B, S] int32 first valid slot per query
     ends: jnp.ndarray,  # [B, S] int32 one-past-last valid slot per query
     attn_softcap: float = 0.0,
@@ -191,7 +192,7 @@ def decode_attention_mq(
     dropping the entire call to the jnp path (round-1 shortcut).
     """
     B, S, Hq, D = q.shape
-    T, Hkv = k_cache.shape[1], k_cache.shape[2]
+    Hkv, T = k_cache.shape[1], k_cache.shape[2]
     g = Hq // Hkv
     rows = S * g
     G8 = -(-rows // _SUBLANE) * _SUBLANE
@@ -225,7 +226,7 @@ def decode_attention_mq(
         bnd = bnd.at[:, rows:, 0].set(T)
 
     kv_spec = pl.BlockSpec(
-        (1, block_t, 1, D), lambda b, h, t, _: (b, t, h, 0)
+        (1, 1, block_t, D), lambda b, h, t: (b, h, t, 0)
     )
     out = pl.pallas_call(
         functools.partial(
@@ -234,25 +235,24 @@ def decode_attention_mq(
             attn_softcap=attn_softcap,
             block_t=block_t,
         ),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=(B, Hkv, T // block_t),
-            in_specs=[
-                pl.BlockSpec(
-                    (1, 1, G8, D), lambda b, h, t, _: (b, h, 0, 0)
-                ),
-                kv_spec,
-                kv_spec,
-            ],
-            out_specs=pl.BlockSpec(
-                (1, 1, G8, D), lambda b, h, t, _: (b, h, 0, 0)
-            ),
-            scratch_shapes=[
-                pltpu.VMEM((G8, 1), jnp.float32),
-                pltpu.VMEM((G8, 1), jnp.float32),
-                pltpu.VMEM((G8, D), jnp.float32),
-            ],
+        grid=(B, Hkv, T // block_t),
+        in_specs=[
+            # Bounds ride in VMEM ([1, G8, 2] block — sublane G8 is a
+            # multiple of 8, lane 2 spans the array) because the kernel
+            # reads them as vectors; SMEM only serves scalar loads.
+            pl.BlockSpec((1, G8, 2), lambda b, h, t: (b, 0, 0)),
+            pl.BlockSpec((1, 1, G8, D), lambda b, h, t: (b, h, 0, 0)),
+            kv_spec,
+            kv_spec,
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, G8, D), lambda b, h, t: (b, h, 0, 0)
         ),
+        scratch_shapes=[
+            pltpu.VMEM((G8, 1), jnp.float32),
+            pltpu.VMEM((G8, 1), jnp.float32),
+            pltpu.VMEM((G8, D), jnp.float32),
+        ],
         out_shape=jax.ShapeDtypeStruct((B, Hkv, G8, D), q.dtype),
         interpret=interpret,
     )(bnd, qg, k_cache, v_cache)
@@ -263,14 +263,14 @@ def decode_attention_mq(
 
 def decode_attention_tp(
     q: jnp.ndarray,  # [B, Hq, D]
-    k_cache: jnp.ndarray,  # [B, T, Hkv, D]
-    v_cache: jnp.ndarray,  # [B, T, Hkv, D]
+    k_cache: jnp.ndarray,  # [B, Hkv, T, D] heads-major
+    v_cache: jnp.ndarray,  # [B, Hkv, T, D]
     bounds: jnp.ndarray,  # [B, 2]
     mesh,
     attn_softcap: float = 0.0,
     scale: float | None = None,
     interpret: bool = False,
-    k_scale: jnp.ndarray | None = None,  # [B, T, Hkv, 1] f32 (int8 KV)
+    k_scale: jnp.ndarray | None = None,  # [B, Hkv, T, 1] f32 (int8 KV)
     v_scale: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Fused decode attention on a GSPMD-sharded mesh.
@@ -301,8 +301,8 @@ def decode_attention_tp(
     )
     in_specs = [
         P(DP, TP, None),
-        P(DP, None, TP, None),
-        P(DP, None, TP, None),
+        P(DP, TP, None, None),
+        P(DP, TP, None, None),
         P(DP, None),
     ]
     operands = [q, k_cache, v_cache, bounds]
@@ -310,7 +310,7 @@ def decode_attention_tp(
         fn = lambda q_, k_, v_, b_, ks_, vs_: kernel(  # noqa: E731
             q_, k_, v_, b_, k_scale=ks_, v_scale=vs_
         )
-        in_specs += [P(DP, None, TP, None), P(DP, None, TP, None)]
+        in_specs += [P(DP, TP, None, None), P(DP, TP, None, None)]
         operands += [k_scale, v_scale]
     else:
         fn = kernel
@@ -335,13 +335,13 @@ def tp_decode_supported(n_kv_heads: int, mesh) -> bool:
 )
 def decode_attention(
     q: jnp.ndarray,  # [B, Hq, D] one query token per row
-    k_cache: jnp.ndarray,  # [B, T, Hkv, D] (any float dtype, or int8)
-    v_cache: jnp.ndarray,  # [B, T, Hkv, D]
+    k_cache: jnp.ndarray,  # [B, Hkv, T, D] heads-major (any float, or int8)
+    v_cache: jnp.ndarray,  # [B, Hkv, T, D]
     bounds: jnp.ndarray,  # [B, 2] int32 (start, end) valid slot window
     attn_softcap: float = 0.0,
     scale: float | None = None,
     interpret: bool = False,
-    k_scale: jnp.ndarray | None = None,  # [B, T, Hkv, 1] f32 (int8 KV)
+    k_scale: jnp.ndarray | None = None,  # [B, Hkv, T, 1] f32 (int8 KV)
     v_scale: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Fused decode attention. Returns [B, Hq, D] in q.dtype.
@@ -351,7 +351,7 @@ def decode_attention(
     _quantize_kv); dequant happens inside the kernel tiles.
     """
     B, Hq, D = q.shape
-    T, Hkv = k_cache.shape[1], k_cache.shape[2]
+    Hkv, T = k_cache.shape[1], k_cache.shape[2]
     g = Hq // Hkv
     G8 = max(_SUBLANE, g)
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
@@ -368,10 +368,10 @@ def decode_attention(
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, G8 - g), (0, 0)))
 
     kv_spec = pl.BlockSpec(
-        (1, block_t, 1, D), lambda b, h, t, _: (b, t, h, 0)
+        (1, 1, block_t, D), lambda b, h, t, _: (b, h, t, 0)
     )
     scale_spec = pl.BlockSpec(
-        (1, block_t, 1, 1), lambda b, h, t, _: (b, t, h, 0)
+        (1, 1, block_t, 1), lambda b, h, t, _: (b, h, t, 0)
     )
     in_specs = [
         pl.BlockSpec((1, 1, G8, D), lambda b, h, t, _: (b, h, 0, 0)),

@@ -1,9 +1,9 @@
 """Pallas TPU kernel: decode attention over a PAGED KV cache.
 
 Paged KV (the second kernel BASELINE.json's north star names): instead of
-one dense [B, T_max, H, D] buffer per batch — which must be sized for the
+one dense [B, H, T_max, D] buffer per batch — which must be sized for the
 longest sequence and reallocated/copied as debates grow — key/value live in
-fixed-size pages [n_pages, page_size, Hkv, D] shared by all sequences, and
+fixed-size pages [n_pages, Hkv, page_size, D] shared by all sequences, and
 each row owns an ordered page list (the page table). Debate rounds grow
 sequences at different rates (opponents finish at different lengths), so
 paging keeps HBM occupancy at O(tokens actually written) and makes
@@ -40,8 +40,8 @@ def _paged_attn_kernel(
     bounds_ref,  # SMEM [B, 2]: (start, end) token window per row
     table_ref,  # SMEM [B, P]: physical page id per (row, logical page)
     q_ref,  # VMEM [1, 1, G8, D]
-    k_ref,  # VMEM [1, page, 1, D] — page selected by index_map
-    v_ref,  # VMEM [1, page, 1, D]
+    k_ref,  # VMEM [1, 1, page, D] — page selected by index_map
+    v_ref,  # VMEM [1, 1, page, D]
     o_ref,  # VMEM [1, 1, G8, D]
     m_ref,  # VMEM scratch [G8, 1]
     l_ref,  # VMEM scratch [G8, 1]
@@ -75,8 +75,8 @@ def _paged_attn_kernel(
     @pl.when((page_id > 0) & (t0 < end))
     def _accumulate():
         q = q_ref[0, 0].astype(jnp.float32) * scale
-        k = k_ref[0, :, 0].astype(jnp.float32)  # [page, D]
-        v = v_ref[0, :, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)  # [page, D]
+        v = v_ref[0, 0].astype(jnp.float32)
         m, l, acc = flash_update(
             q,
             k,
@@ -105,8 +105,8 @@ def _paged_attn_kernel(
 )
 def paged_decode_attention(
     q: jnp.ndarray,  # [B, Hq, D]
-    k_pages: jnp.ndarray,  # [n_pages, page_size, Hkv, D]
-    v_pages: jnp.ndarray,  # [n_pages, page_size, Hkv, D]
+    k_pages: jnp.ndarray,  # [n_pages, Hkv, page_size, D] heads-major
+    v_pages: jnp.ndarray,  # [n_pages, Hkv, page_size, D]
     page_table: jnp.ndarray,  # [B, P] int32; <= 0 = unmapped (see below)
     bounds: jnp.ndarray,  # [B, 2] int32 (start, end) token window
     attn_softcap: float = 0.0,
@@ -122,7 +122,7 @@ def paged_decode_attention(
     unmapped and masked out of the softmax.
     """
     B, Hq, D = q.shape
-    page_size, Hkv = k_pages.shape[1], k_pages.shape[2]
+    Hkv, page_size = k_pages.shape[1], k_pages.shape[2]
     P = page_table.shape[1]
     g = Hq // Hkv
     G8 = max(_SUBLANE, g)
@@ -133,7 +133,7 @@ def paged_decode_attention(
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, G8 - g), (0, 0)))
 
     def page_map(b, h, p, bounds_ref, table_ref):
-        return (jnp.maximum(table_ref[b, p], 0), 0, h, 0)
+        return (jnp.maximum(table_ref[b, p], 0), h, 0, 0)
 
     out = pl.pallas_call(
         functools.partial(
@@ -149,8 +149,8 @@ def paged_decode_attention(
                 pl.BlockSpec(
                     (1, 1, G8, D), lambda b, h, p, *_: (b, h, 0, 0)
                 ),
-                pl.BlockSpec((1, page_size, 1, D), page_map),
-                pl.BlockSpec((1, page_size, 1, D), page_map),
+                pl.BlockSpec((1, 1, page_size, D), page_map),
+                pl.BlockSpec((1, 1, page_size, D), page_map),
             ],
             out_specs=pl.BlockSpec(
                 (1, 1, G8, D), lambda b, h, p, *_: (b, h, 0, 0)

@@ -121,8 +121,8 @@ def make_device_put(mesh: Mesh, dtype):
 
 
 def cache_sharding(mesh: Mesh) -> NamedSharding:
-    """KV cache [L, B, S, H_kv, D]: batch over dp, heads over tp."""
-    return NamedSharding(mesh, P(None, DP, None, TP, None))
+    """KV cache [L, B, H_kv, S, D]: batch over dp, heads over tp."""
+    return NamedSharding(mesh, P(None, DP, TP, None, None))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

@@ -69,7 +69,7 @@ def sp_prefill(
 ):
     """Sequence-parallel (× tensor-parallel) prefill over the full prompt.
 
-    Returns (last_logits [B, vocab] f32, cache {"k","v": [L, B, S, Hkv, D]}
+    Returns (last_logits [B, vocab] f32, cache {"k","v": [L, B, Hkv, S, D]}
     sequence-sharded over sp and head-sharded over tp).
 
     Sliding-window families work too: the per-layer window (including
@@ -162,6 +162,10 @@ def sp_prefill(
         x, (k_all, v_all) = jax.lax.scan(
             layer_body, x, (params_l["layers"], layer_ids)
         )
+        # Scan stacks token-major [L, B, S_loc, H, D]; the cache contract
+        # is heads-major [L, B, H, S_loc, D] (models/transformer.py).
+        k_all = jnp.swapaxes(k_all, 2, 3)
+        v_all = jnp.swapaxes(v_all, 2, 3)
 
         # Last-position logits: the shared lm-head tail (final norm +
         # tied/untied projection + softcap — one source of truth with the
@@ -181,7 +185,7 @@ def sp_prefill(
         return logits, k_all, v_all
 
     seq_spec = P(None, SP)
-    cache_spec = P(None, None, SP, TP, None)  # [L, B, S(sp), Hkv(tp), D]
+    cache_spec = P(None, None, TP, SP, None)  # [L, B, Hkv(tp), S(sp), D]
     logits, k_all, v_all = jax.shard_map(
         local,
         mesh=mesh,
@@ -197,13 +201,13 @@ def reshard_cache_for_decode(cache, mesh: Mesh, total_len: int):
     axis, pad to ``total_len`` slots, shard batch over dp / heads over tp."""
     from adversarial_spec_tpu.parallel.sharding import cache_sharding
 
-    S = cache["k"].shape[2]
+    S = cache["k"].shape[3]
     out = {}
     for name, arr in cache.items():
         arr = jax.device_put(arr, cache_sharding(mesh))  # gathers sp
         if total_len > S:
             pad = [(0, 0)] * arr.ndim
-            pad[2] = (0, total_len - S)
+            pad[3] = (0, total_len - S)
             arr = jnp.pad(arr, pad)
         out[name] = arr
     return out

@@ -89,8 +89,9 @@ class TestPagePool:
         a.extend(0, 3)
 
         L, B, S = 2, 1, 3
+        # Heads-major cache layout [L, B, Hkv, S, D].
         k_new = jnp.arange(L * B * S * 2 * 4, dtype=jnp.float32).reshape(
-            L, B, S, 2, 4
+            L, B, 2, S, 4
         )
         v_new = -k_new
         positions = np.array([[0, 1, 2]])
@@ -100,16 +101,20 @@ class TestPagePool:
         table = a.table(0)
         # Token 0 → page table[0] slot 0; token 2 → page table[1] slot 0.
         np.testing.assert_array_equal(
-            np.asarray(pool["k"][:, table[0], 0]), np.asarray(k_new[:, 0, 0])
+            np.asarray(pool["k"][:, table[0], :, 0]),
+            np.asarray(k_new[:, 0, :, 0]),
         )
         np.testing.assert_array_equal(
-            np.asarray(pool["k"][:, table[0], 1]), np.asarray(k_new[:, 0, 1])
+            np.asarray(pool["k"][:, table[0], :, 1]),
+            np.asarray(k_new[:, 0, :, 1]),
         )
         np.testing.assert_array_equal(
-            np.asarray(pool["k"][:, table[1], 0]), np.asarray(k_new[:, 0, 2])
+            np.asarray(pool["k"][:, table[1], :, 0]),
+            np.asarray(k_new[:, 0, :, 2]),
         )
         np.testing.assert_array_equal(
-            np.asarray(pool["v"][:, table[0], 0]), np.asarray(v_new[:, 0, 0])
+            np.asarray(pool["v"][:, table[0], :, 0]),
+            np.asarray(v_new[:, 0, :, 0]),
         )
 
     def test_capacity(self):

@@ -18,25 +18,25 @@ from adversarial_spec_tpu.ops.pallas_paged import paged_decode_attention
 
 def _dense_ref(q, k, v, bounds, attn_softcap=0.0):
     B, Hq, D = q.shape
-    T_, Hkv = k.shape[1], k.shape[2]
+    Hkv, T_ = k.shape[1], k.shape[2]
     g = Hq // Hkv
     qg = q.reshape(B, Hkv, g, D)
-    s = jnp.einsum("bhgd,bthd->bhgt", qg, k) / math.sqrt(D)
+    s = jnp.einsum("bhgd,bhtd->bhgt", qg, k) / math.sqrt(D)
     if attn_softcap > 0:
         s = jnp.tanh(s / attn_softcap) * attn_softcap
     slot = jnp.arange(T_)
     valid = (slot[None, :] >= bounds[:, 0:1]) & (slot[None, :] < bounds[:, 1:2])
     s = jnp.where(valid[:, None, None, :], s, -jnp.inf)
     p = jax.nn.softmax(s, -1)
-    return jnp.einsum("bhgt,bthd->bhgd", p, v).reshape(B, Hq, D)
+    return jnp.einsum("bhgt,bhtd->bhgd", p, v).reshape(B, Hq, D)
 
 
 class TestDecodeKernel:
     def _rand(self, B=3, Hq=8, Hkv=2, D=64, T_=512, dtype=jnp.float32):
         ks = jax.random.split(jax.random.key(0), 3)
         q = jax.random.normal(ks[0], (B, Hq, D), dtype)
-        k = jax.random.normal(ks[1], (B, T_, Hkv, D), dtype)
-        v = jax.random.normal(ks[2], (B, T_, Hkv, D), dtype)
+        k = jax.random.normal(ks[1], (B, Hkv, T_, D), dtype)
+        v = jax.random.normal(ks[2], (B, Hkv, T_, D), dtype)
         return q, k, v
 
     def test_matches_dense(self):
@@ -72,7 +72,7 @@ class TestDecodeKernel:
         bounds = jnp.array([[17, 18]], jnp.int32)
         out = decode_attention(q, k, v, bounds, interpret=True)
         g = 8 // 2
-        expect = jnp.repeat(v[:, 17], g, axis=1).reshape(1, 8, 64)
+        expect = jnp.repeat(v[:, :, 17], g, axis=1).reshape(1, 8, 64)
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(expect), rtol=1e-5, atol=1e-5
         )
@@ -94,8 +94,8 @@ class TestPagedKernel:
         page_size, n_pages, P = 16, 32, 8
         ks = jax.random.split(jax.random.key(1), 3)
         q = jax.random.normal(ks[0], (B, Hq, D), jnp.float32)
-        kp = jax.random.normal(ks[1], (n_pages, page_size, Hkv, D), jnp.float32)
-        vp = jax.random.normal(ks[2], (n_pages, page_size, Hkv, D), jnp.float32)
+        kp = jax.random.normal(ks[1], (n_pages, Hkv, page_size, D), jnp.float32)
+        vp = jax.random.normal(ks[2], (n_pages, Hkv, page_size, D), jnp.float32)
         table = np.full((B, P), -1, np.int32)
         table[0, :3] = [3, 7, 1]
         table[1, 0] = 5
@@ -107,8 +107,8 @@ class TestPagedKernel:
 
         for b in range(B):
             pages = [p for p in table[b] if p > 0]
-            k = jnp.concatenate([kp[p] for p in pages], 0)[None]
-            v = jnp.concatenate([vp[p] for p in pages], 0)[None]
+            k = jnp.concatenate([kp[p] for p in pages], 1)[None]
+            v = jnp.concatenate([vp[p] for p in pages], 1)[None]
             ref = _dense_ref(q[b : b + 1], k, v, bounds[b : b + 1])
             np.testing.assert_allclose(
                 np.asarray(out[b]), np.asarray(ref[0]), rtol=2e-5, atol=2e-5
@@ -120,8 +120,8 @@ class TestPagedKernel:
         page_size, n_pages, P = 8, 4, 8
         ks = jax.random.split(jax.random.key(2), 3)
         q = jax.random.normal(ks[0], (B, Hq, D), jnp.float32)
-        kp = jax.random.normal(ks[1], (n_pages, page_size, Hkv, D), jnp.float32)
-        vp = jax.random.normal(ks[2], (n_pages, page_size, Hkv, D), jnp.float32)
+        kp = jax.random.normal(ks[1], (n_pages, Hkv, page_size, D), jnp.float32)
+        vp = jax.random.normal(ks[2], (n_pages, Hkv, page_size, D), jnp.float32)
         table = np.full((B, P), -1, np.int32)
         table[0, 0] = 2
         bounds = jnp.array([[0, 8]], jnp.int32)
@@ -143,8 +143,8 @@ class TestPagedKernel:
         page_size, n_pages, P = 8, 4, 4
         ks = jax.random.split(jax.random.key(3), 3)
         q = jax.random.normal(ks[0], (B, Hq, D), jnp.float32)
-        kp = jax.random.normal(ks[1], (n_pages, page_size, Hkv, D), jnp.float32)
-        vp = jax.random.normal(ks[2], (n_pages, page_size, Hkv, D), jnp.float32)
+        kp = jax.random.normal(ks[1], (n_pages, Hkv, page_size, D), jnp.float32)
+        vp = jax.random.normal(ks[2], (n_pages, Hkv, page_size, D), jnp.float32)
         # Logical page 0 → physical 2 (real), logical page 1 → physical 0
         # (trash). Bounds cover both pages' slots.
         table = np.array([[2, 0, 0, 0]], np.int32)
@@ -227,8 +227,8 @@ class TestShardedPallasDecode:
         B, Hq, Hkv, D, T_ = 4, 8, 2, 64, 256
         ks = jax.random.split(jax.random.key(7), 3)
         q = jax.random.normal(ks[0], (B, Hq, D), jnp.float32)
-        k = jax.random.normal(ks[1], (B, T_, Hkv, D), jnp.float32)
-        v = jax.random.normal(ks[2], (B, T_, Hkv, D), jnp.float32)
+        k = jax.random.normal(ks[1], (B, Hkv, T_, D), jnp.float32)
+        v = jax.random.normal(ks[2], (B, Hkv, T_, D), jnp.float32)
         bounds = jnp.array(
             [[0, 256], [3, 100], [100, 256], [17, 18]], jnp.int32
         )
@@ -275,8 +275,8 @@ class TestInt8KernelTiles:
         B, Hq, Hkv, D, T_ = 2, 8, 2, 64, 256
         ks = jax.random.split(jax.random.key(9), 3)
         q = jax.random.normal(ks[0], (B, Hq, D), jnp.float32)
-        k = jax.random.normal(ks[1], (B, T_, Hkv, D), jnp.float32)
-        v = jax.random.normal(ks[2], (B, T_, Hkv, D), jnp.float32)
+        k = jax.random.normal(ks[1], (B, Hkv, T_, D), jnp.float32)
+        v = jax.random.normal(ks[2], (B, Hkv, T_, D), jnp.float32)
         # Quantize exactly as the cache does (per-token-head symmetric).
         amax = jnp.max(jnp.abs(k), axis=-1, keepdims=True)
         ksc = jnp.maximum(amax, 1e-8) / 127.0
@@ -350,8 +350,8 @@ class TestMultiQueryKernel:
         B, S, Hq, Hkv, D, T_ = 2, 9, 8, 2, 64, 256
         ks = jax.random.split(jax.random.key(11), 3)
         q = jax.random.normal(ks[0], (B, S, Hq, D), jnp.float32)
-        k = jax.random.normal(ks[1], (B, T_, Hkv, D), jnp.float32)
-        v = jax.random.normal(ks[2], (B, T_, Hkv, D), jnp.float32)
+        k = jax.random.normal(ks[1], (B, Hkv, T_, D), jnp.float32)
+        v = jax.random.normal(ks[2], (B, Hkv, T_, D), jnp.float32)
         base = np.array([100, 37])
         starts = np.tile(np.array([[3], [0]]), (1, S)).astype(np.int32)
         ends = (base[:, None] + np.arange(1, S + 1)[None, :]).astype(np.int32)
@@ -362,14 +362,14 @@ class TestMultiQueryKernel:
 
         g = Hq // Hkv
         qg = q.reshape(B, S, Hkv, g, D)
-        s = jnp.einsum("bshgd,bthd->bhsgt", qg, k) / _math.sqrt(D)
+        s = jnp.einsum("bshgd,bhtd->bhsgt", qg, k) / _math.sqrt(D)
         slot = np.arange(T_)
         mask = (slot[None, None, :] >= starts[:, :, None]) & (
             slot[None, None, :] < ends[:, :, None]
         )
         s = jnp.where(jnp.asarray(mask)[:, None, :, None, :], s, -jnp.inf)
         p = jax.nn.softmax(s, -1)
-        ref = jnp.einsum("bhsgt,bthd->bshgd", p, v).reshape(B, S, Hq, D)
+        ref = jnp.einsum("bhsgt,bhtd->bshgd", p, v).reshape(B, S, Hq, D)
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
         )

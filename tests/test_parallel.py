@@ -210,9 +210,9 @@ class TestSequenceParallelPrefill:
 
         with mesh:
             resharded = reshard_cache_for_decode(cache_sp, mesh, S + 8)
-        assert resharded["k"].shape[2] == S + 8
+        assert resharded["k"].shape[3] == S + 8
         np.testing.assert_allclose(
-            np.asarray(resharded["k"][:, :, :S]),
+            np.asarray(resharded["k"][..., :S, :]),
             np.asarray(dense_cache["k"]),
             rtol=2e-4,
             atol=2e-4,
