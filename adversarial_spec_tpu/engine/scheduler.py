@@ -371,6 +371,13 @@ class ContinuousBatcher:
         self.capacity_tokens = n_pages * page_size
         self.queue: list[SchedRequest] = []
         self.results: list[SchedResult] = []
+        # Wall-clock telemetry: admission prefills vs decode chunks.
+        # decode_time_s feeds the engine's per-row usage attribution
+        # (engine/tpu.py:_chat_continuous); prefill_time_s is surfaced for
+        # perf diagnosis (how much of a round went to admission pauses —
+        # the number the chunked-prefill interleave work will shrink).
+        self.prefill_time_s = 0.0
+        self.decode_time_s = 0.0
 
     # -- admission ---------------------------------------------------------
 
@@ -401,6 +408,8 @@ class ContinuousBatcher:
     def _admit_one(self, slot: int, req: SchedRequest) -> bool:
         """Admit into ``slot``; False if the pool is momentarily full (the
         request stays queued and retries after residents free pages)."""
+        import time
+
         tokens_np, pads_np = pad_batch([req.prompt_ids], pad_id=0)
         S = tokens_np.shape[1]
         total = S + req.max_new_tokens
@@ -412,6 +421,7 @@ class ContinuousBatcher:
             self.allocator.free_sequence(seq_id)
             return False
         self._seq_counter += 1
+        t_admit = time.monotonic()
 
         # Prefill the prompt into a throwaway dense cache, then scatter
         # into this sequence's pages (+1 shift: page 0 is trash).
@@ -463,6 +473,7 @@ class ContinuousBatcher:
         )
         self._slot_req[slot] = req
         self._slot_seq[slot] = seq_id
+        self.prefill_time_s += time.monotonic() - t_admit
         if not self.active[slot]:
             self._finish_slot(slot)
         return True
@@ -500,11 +511,34 @@ class ContinuousBatcher:
 
     # -- main loop ---------------------------------------------------------
 
-    def run_all(self) -> list[SchedResult]:
-        """Drain the queue: admit, decode a chunk, collect, repeat."""
+    def run_all(self, timeout_s: float = 0.0) -> list[SchedResult]:
+        """Drain the queue: admit, decode a chunk, collect, repeat.
+
+        ``timeout_s`` > 0 is a best-effort wall-clock budget (parity with
+        generate()'s deadline, checked between chunks): on expiry, resident
+        rows finish with whatever they have emitted and queued requests
+        return zero tokens rather than blocking the caller.
+        """
+        import time
+
+        deadline = time.monotonic() + timeout_s if timeout_s > 0 else None
         while self.queue or any(r is not None for r in self._slot_req):
+            if deadline is not None and time.monotonic() > deadline:
+                self.active = jnp.zeros_like(self.active)
+                self._collect()
+                for req in self.queue:
+                    self.results.append(
+                        SchedResult(
+                            req_id=req.req_id,
+                            tokens=np.zeros((0,), np.int32),
+                            n_generated=0,
+                        )
+                    )
+                self.queue.clear()
+                break
             self._admit()
             if bool(self.active.any()):
+                t_dec = time.monotonic()
                 self._key, sub = jax.random.split(self._key)
                 (
                     self.pool,
@@ -536,5 +570,7 @@ class ContinuousBatcher:
                     use_pallas=self._use_pallas,
                     pallas_interpret=self._pallas_interpret,
                 )
+                jax.block_until_ready(self.active)
+                self.decode_time_s += time.monotonic() - t_dec
             self._collect()
         return sorted(self.results, key=lambda r: r.req_id)

@@ -245,6 +245,16 @@ class TpuEngine:
                 ids = ids[:1] + ids[len(ids) - (budget - 1) :]
             prompts.append(ids)
 
+        # Paged single-device specs serve through the continuous batcher:
+        # opponents occupy decode slots, early-EOS rows free their pages
+        # mid-round, and queued requests (opponent pools larger than the
+        # slot count) admit into freed slots without waiting for the whole
+        # batch — the multi-session serving path NOTES.md round 2 left
+        # unwired. Sharded meshes keep the round-synchronous generate()
+        # (its paged path shards the pool over dp).
+        if lm.spec.kv == "paged" and lm.mesh.size == 1:
+            return self._chat_continuous(lm, prompts, params)
+
         t0 = time.monotonic()
         with lm.mesh:
             result = generate(
@@ -287,6 +297,108 @@ class TpuEngine:
                         output_tokens=n,
                         device_time_s=prefill_share + decode_share,
                         decode_tokens=n,
+                        decode_time_s=decode_share,
+                    ),
+                )
+            )
+        return completions
+
+    def _chat_continuous(
+        self, lm: LoadedModel, prompts: list[list[int]], params: SamplingParams
+    ) -> list[Completion]:
+        """Serve one model's requests through the ContinuousBatcher.
+
+        Pool capacity is bucketed to a power of two so repeat rounds of
+        similar size reuse the compiled chunk program (pool shape is a
+        jit constant).
+        """
+        from adversarial_spec_tpu.engine.generate import bucket_length
+        from adversarial_spec_tpu.engine.scheduler import (
+            ContinuousBatcher,
+            SchedRequest,
+        )
+
+        import os
+
+        tok = lm.tokenizer
+        # The batcher checks bucket_length(prompt) + budget against the
+        # model context; the engine-level trim above only bounded the RAW
+        # length, so a near-limit prompt would round up past the context
+        # and error the whole group. Re-trim against the bucketed length.
+        max_prompt = lm.cfg.max_seq_len - params.max_new_tokens
+        while max_prompt > 1 and bucket_length(max_prompt) > max_prompt:
+            nxt = bucket_length(max_prompt) // 2
+            if nxt >= max_prompt:  # at the minimum bucket already
+                break
+            max_prompt = nxt
+        prompts = [
+            p if len(p) <= max_prompt else p[:1] + p[len(p) - (max_prompt - 1):]
+            for p in prompts
+        ]
+        # Pool capacity covers CONCURRENT residency (the max_batch largest
+        # requests), not the whole queue — finished rows free their pages
+        # and queued requests admit into them; sizing by the queue total
+        # would make pool HBM scale with round size, which is exactly what
+        # paging exists to avoid.
+        n_slots = min(len(prompts), 8)
+        per_req = sorted(
+            (bucket_length(len(p)) + params.max_new_tokens for p in prompts),
+            reverse=True,
+        )
+        need = sum(per_req[:n_slots])
+        capacity = 2048
+        while capacity < need:
+            capacity *= 2
+
+        t0 = time.monotonic()
+        with lm.mesh:
+            batcher = ContinuousBatcher(
+                lm.params,
+                lm.cfg,
+                max_batch=n_slots,
+                capacity_tokens=capacity,
+                max_new_cap=params.max_new_tokens,
+                eos_ids=list(tok.eos_ids),
+                greedy=params.greedy,
+                temperature=params.temperature,
+                top_k=params.top_k,
+                top_p=params.top_p,
+                # seed=None means fresh entropy (as generate() does) —
+                # pinning 0 would make every unseeded round sample
+                # identically.
+                seed=(
+                    params.seed
+                    if params.seed is not None
+                    else int.from_bytes(os.urandom(4), "little")
+                ),
+            )
+            for i, ids in enumerate(prompts):
+                batcher.submit(
+                    SchedRequest(
+                        req_id=i,
+                        prompt_ids=ids,
+                        max_new_tokens=params.max_new_tokens,
+                    )
+                )
+            results = batcher.run_all(timeout_s=params.timeout_s)
+        total_time = time.monotonic() - t0
+
+        # Same attribution scheme as the dense path: decode time splits
+        # by decoded tokens, the prefill/overhead remainder evenly.
+        tok_total = float(sum(r.n_generated for r in results)) or 1.0
+        overhead = total_time - batcher.decode_time_s
+        completions = []
+        for r in results:  # sorted by req_id == prompt order
+            frac = r.n_generated / tok_total
+            decode_share = batcher.decode_time_s * frac
+            completions.append(
+                Completion(
+                    text=tok.decode(r.tokens[: r.n_generated]),
+                    usage=Usage(
+                        input_tokens=len(prompts[r.req_id]),
+                        output_tokens=r.n_generated,
+                        device_time_s=overhead / len(results) + decode_share,
+                        decode_tokens=r.n_generated,
                         decode_time_s=decode_share,
                     ),
                 )

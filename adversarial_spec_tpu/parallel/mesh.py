@@ -107,7 +107,12 @@ def mesh_shape_from_spec(
     """Normalize a registry mesh spec {axis: size} to a full {dp,tp,sp}.
 
     Unspecified axes default to 1; leftover devices go to dp so a spec like
-    {"tp": 2} on 8 devices yields dp=4, tp=2, sp=1.
+    {"tp": 2} on 8 devices yields dp=4, tp=2, sp=1. A spec that pins dp
+    EXPLICITLY may describe a SUBMESH (dp·tp·sp < device count): the mesh
+    is built on the LEADING devices, so a small model can run on one chip
+    of a slice. (Placing several submesh entries on DISJOINT chips is not
+    implemented — every submesh starts at device 0; pass ``devices`` to
+    make_mesh for manual placement.)
     """
     n = n_devices if n_devices is not None else len(jax.devices())
     spec = dict(mesh_spec or {})
@@ -116,14 +121,15 @@ def mesh_shape_from_spec(
         raise ValueError(f"unknown mesh axes {sorted(unknown)}; use {MeshAxes}")
     tp = int(spec.get(TP, 1))
     sp = int(spec.get(SP, 1))
-    if n % (tp * sp) != 0:
+    if DP not in spec and n % (tp * sp) != 0:
         raise ValueError(
             f"mesh tp={tp} sp={sp} does not divide device count {n}"
         )
     dp = int(spec.get(DP, n // (tp * sp)))
-    if dp * tp * sp != n:
+    total = dp * tp * sp
+    if total > n or (DP not in spec and total != n):
         raise ValueError(
-            f"mesh dp*tp*sp = {dp * tp * sp} != device count {n}"
+            f"mesh dp*tp*sp = {total} != device count {n}"
         )
     return {DP: dp, TP: tp, SP: sp}
 
@@ -139,5 +145,6 @@ def make_mesh(
     """
     devs = devices if devices is not None else jax.devices()
     shape = mesh_shape_from_spec(mesh_spec, n_devices=len(devs))
-    arr = np.asarray(devs).reshape(shape[DP], shape[SP], shape[TP])
+    total = shape[DP] * shape[SP] * shape[TP]
+    arr = np.asarray(devs[:total]).reshape(shape[DP], shape[SP], shape[TP])
     return Mesh(arr, (DP, SP, TP))
