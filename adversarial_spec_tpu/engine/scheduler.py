@@ -53,6 +53,7 @@ from adversarial_spec_tpu.models.transformer import (
 )
 
 TRASH_PAGE = 0
+PREFILL_CHUNK = 512  # admission prompts prefill in chunks of this many
 
 
 @dataclass
@@ -60,6 +61,23 @@ class SchedRequest:
     req_id: int
     prompt_ids: list[int]
     max_new_tokens: int
+
+
+@dataclass
+class _Admission:
+    """An in-flight admission: its prompt prefills one chunk per scheduler
+    iteration (interleaved with resident rows' decode chunks) instead of
+    stalling decode for the whole prompt."""
+
+    slot: int
+    req: SchedRequest
+    seq_id: int
+    tokens: object  # [1, S] device array
+    pads: object  # [1]
+    cache: object  # 1-row dense cache being prefilled
+    pos: int  # next chunk start
+    S: int
+    last_logits: object = None
 
 
 @dataclass
@@ -367,6 +385,7 @@ class ContinuousBatcher:
 
         self._slot_req: list[SchedRequest | None] = [None] * B
         self._slot_seq: list[int | None] = [None] * B
+        self._admission: _Admission | None = None
         self._seq_counter = 0
         self.capacity_tokens = n_pages * page_size
         self.queue: list[SchedRequest] = []
@@ -405,11 +424,10 @@ class ContinuousBatcher:
             )
         self.queue.append(req)
 
-    def _admit_one(self, slot: int, req: SchedRequest) -> bool:
-        """Admit into ``slot``; False if the pool is momentarily full (the
-        request stays queued and retries after residents free pages)."""
-        import time
-
+    def _start_admission(self, slot: int, req: SchedRequest) -> bool:
+        """Reserve pages and set up the chunked prefill for ``slot``;
+        False if the pool is momentarily full (the request stays queued
+        and retries after residents free pages)."""
         tokens_np, pads_np = pad_batch([req.prompt_ids], pad_id=0)
         S = tokens_np.shape[1]
         total = S + req.max_new_tokens
@@ -421,23 +439,55 @@ class ContinuousBatcher:
             self.allocator.free_sequence(seq_id)
             return False
         self._seq_counter += 1
-        t_admit = time.monotonic()
+        self._admission = _Admission(
+            slot=slot,
+            req=req,
+            seq_id=seq_id,
+            tokens=jnp.asarray(tokens_np),
+            pads=jnp.asarray(pads_np),
+            cache=init_cache(self.cfg, 1, S, dtype=self._dtype),
+            pos=0,
+            S=S,
+        )
+        return True
 
-        # Prefill the prompt into a throwaway dense cache, then scatter
-        # into this sequence's pages (+1 shift: page 0 is trash).
-        cache = init_cache(self.cfg, 1, S, dtype=self._dtype)
-        tokens = jnp.asarray(tokens_np)
-        pads = jnp.asarray(pads_np)
-        chunk_len = min(S, 512)
-        for ci in range(0, S, chunk_len):
-            cache, last_logits = prefill_chunk(
-                self.params,
-                self.cfg,
-                tokens[:, ci : ci + chunk_len],
-                pads,
-                cache,
-                jnp.int32(ci),
-            )
+    def _advance_admission(self) -> None:
+        """One prefill chunk of the in-flight admission. Resident rows'
+        decode chunks run between calls — admission no longer pauses the
+        batch for the whole prompt (the round-2 shortcut NOTES.md lists)."""
+        import time
+
+        adm = self._admission
+        t0 = time.monotonic()
+        chunk_len = min(adm.S, PREFILL_CHUNK)
+        adm.cache, adm.last_logits = prefill_chunk(
+            self.params,
+            self.cfg,
+            adm.tokens[:, adm.pos : adm.pos + chunk_len],
+            adm.pads,
+            adm.cache,
+            jnp.int32(adm.pos),
+        )
+        adm.pos += chunk_len
+        # Block before stamping: async dispatch would otherwise push this
+        # chunk's device time into the NEXT decode chunk's blocked wait,
+        # billing resident rows for the newcomer's prefill.
+        jax.block_until_ready(adm.last_logits)
+        self.prefill_time_s += time.monotonic() - t0
+        if adm.pos >= adm.S:
+            self._finish_admission()
+
+    def _finish_admission(self) -> None:
+        """Prefill done: scatter the dense cache into this sequence's
+        pages (+1 shift: page 0 is trash) and activate the slot."""
+        import time
+
+        t0 = time.monotonic()
+        adm = self._admission
+        self._admission = None
+        slot, req, seq_id, S = adm.slot, adm.req, adm.seq_id, adm.S
+        cache, last_logits = adm.cache, adm.last_logits
+        pads_np = np.asarray(adm.pads)
         table = np.asarray(self.allocator.table(seq_id), np.int32) + 1
         slots = np.arange(S, dtype=np.int32)[None, :]
         page_ids = table[slots // self.page_size]
@@ -473,23 +523,28 @@ class ContinuousBatcher:
         )
         self._slot_req[slot] = req
         self._slot_seq[slot] = seq_id
-        self.prefill_time_s += time.monotonic() - t_admit
+        self.prefill_time_s += time.monotonic() - t0
         if not self.active[slot]:
             self._finish_slot(slot)
-        return True
 
     def _admit(self) -> None:
+        """Fill free slots from the queue. Single-chunk (short) prompts
+        admit to completion immediately so a burst of requests fills the
+        batch BEFORE the next decode chunk; the first MULTI-chunk prompt
+        stays in flight and its remaining chunks interleave with decode
+        (one chunked admission at a time)."""
         active_np = np.asarray(self.active)
         for slot in range(self.B):
-            if not self.queue:
+            if self._admission is not None or not self.queue:
                 return
             if self._slot_req[slot] is None and not active_np[slot]:
-                if not self._admit_one(slot, self.queue[0]):
-                    # Pool full right now: keep the request queued (FIFO)
-                    # and stop admitting until residents free pages.
+                if not self._start_admission(slot, self.queue[0]):
+                    # Pool full right now — the request stays queued
+                    # (FIFO) until residents free pages.
                     return
                 self.queue.pop(0)
-                active_np = np.asarray(self.active)
+                if self._admission.S <= PREFILL_CHUNK:
+                    self._advance_admission()  # completes in one chunk
 
     # -- completion --------------------------------------------------------
 
@@ -522,8 +577,17 @@ class ContinuousBatcher:
         import time
 
         deadline = time.monotonic() + timeout_s if timeout_s > 0 else None
-        while self.queue or any(r is not None for r in self._slot_req):
+        while (
+            self.queue
+            or self._admission is not None
+            or any(r is not None for r in self._slot_req)
+        ):
             if deadline is not None and time.monotonic() > deadline:
+                if self._admission is not None:
+                    adm = self._admission
+                    self._admission = None
+                    self.allocator.free_sequence(adm.seq_id)
+                    self.queue.insert(0, adm.req)  # report with the queue
                 self.active = jnp.zeros_like(self.active)
                 self._collect()
                 for req in self.queue:
@@ -537,6 +601,10 @@ class ContinuousBatcher:
                 self.queue.clear()
                 break
             self._admit()
+            if self._admission is not None:
+                # One prompt chunk, then fall through to a decode chunk —
+                # resident rows keep emitting while the newcomer prefills.
+                self._advance_admission()
             if bool(self.active.any()):
                 t_dec = time.monotonic()
                 self._key, sub = jax.random.split(self._key)

@@ -61,6 +61,15 @@ _TRANSIENT_MARKERS = (
 )
 
 
+def _trim_prompt(ids: list[int], limit: int) -> list[int]:
+    """Trim to ``limit`` tokens keeping the first token (BOS/template
+    head) and the most recent tail — one definition for every serving
+    path."""
+    if limit > 0 and len(ids) > limit:
+        return ids[:1] + ids[len(ids) - (limit - 1) :]
+    return ids
+
+
 @dataclass
 class LoadedModel:
     spec: ModelSpec
@@ -240,10 +249,9 @@ class TpuEngine:
             )
             ids = tok.encode(text)
             # Reserve room for generation within the model's context.
-            budget = lm.cfg.max_seq_len - params.max_new_tokens
-            if budget > 0 and len(ids) > budget:
-                ids = ids[:1] + ids[len(ids) - (budget - 1) :]
-            prompts.append(ids)
+            prompts.append(
+                _trim_prompt(ids, lm.cfg.max_seq_len - params.max_new_tokens)
+            )
 
         # Paged single-device specs serve through the continuous batcher:
         # opponents occupy decode slots, early-EOS rows free their pages
@@ -251,8 +259,15 @@ class TpuEngine:
         # slot count) admit into freed slots without waiting for the whole
         # batch — the multi-session serving path NOTES.md round 2 left
         # unwired. Sharded meshes keep the round-synchronous generate()
-        # (its paged path shards the pool over dp).
-        if lm.spec.kv == "paged" and lm.mesh.size == 1:
+        # (its paged path shards the pool over dp), as do budgets so large
+        # that no bucketed prompt passes the batcher's context check (the
+        # dense path has no such check and still serves them).
+        from adversarial_spec_tpu.engine.generate import MIN_BUCKET
+
+        fits_batcher = (
+            lm.cfg.max_seq_len - params.max_new_tokens >= MIN_BUCKET
+        )
+        if lm.spec.kv == "paged" and lm.mesh.size == 1 and fits_batcher:
             return self._chat_continuous(lm, prompts, params)
 
         t0 = time.monotonic()

@@ -202,3 +202,65 @@ class TestPagedUnderDp:
             )
         assert out.tokens.shape == (2, 4)
         assert "dp only" in capsys.readouterr().err
+
+
+class TestChunkedPrefillInterleave:
+    """Admission prefill no longer pauses decode: a multi-chunk prompt's
+    prefill chunks interleave with resident rows' decode chunks (NOTES
+    round-2 shortcut 'scheduler admission pauses decode')."""
+
+    def test_decode_runs_between_admission_chunks(self, tiny_model):
+        import adversarial_spec_tpu.engine.scheduler as sched_mod
+
+        params, cfg = tiny_model
+        calls = []
+        real_prefill = sched_mod.prefill_chunk
+        real_decode = sched_mod.scheduler_decode_chunk
+
+        def spy_prefill(*a, **kw):
+            calls.append("P")
+            return real_prefill(*a, **kw)
+
+        def spy_decode(*a, **kw):
+            calls.append("D")
+            return real_decode(*a, **kw)
+
+        sched_mod.prefill_chunk = spy_prefill
+        sched_mod.scheduler_decode_chunk = spy_decode
+        try:
+            b = ContinuousBatcher(
+                params, cfg, max_batch=2, max_new_cap=64, chunk=8
+            )
+            long_prompt = [((i * 11) % 500) + 3 for i in range(600)]
+            b.submit(
+                SchedRequest(req_id=0, prompt_ids=[1, 5, 9],
+                             max_new_tokens=64)
+            )
+            b.submit(
+                SchedRequest(req_id=1, prompt_ids=long_prompt,
+                             max_new_tokens=8)
+            )
+            results = b.run_all()
+        finally:
+            sched_mod.prefill_chunk = real_prefill
+            sched_mod.scheduler_decode_chunk = real_decode
+
+        assert len(results) == 2
+        # The 600-token prompt buckets to 1024 → two 512-token prefill
+        # chunks; a decode chunk (row 0 emitting) must run between them.
+        s = "".join(calls)
+        assert "PDP" in s, f"no decode between admission chunks: {s}"
+        # Interleaving must not change tokens (row independence).
+        ref0 = _reference(params, cfg, [1, 5, 9], 64)
+        ref1 = _reference(params, cfg, long_prompt, 8)
+        np.testing.assert_array_equal(results[0].tokens, np.asarray(ref0))
+        np.testing.assert_array_equal(results[1].tokens, np.asarray(ref1))
+
+    def test_prefill_time_telemetry_accumulates(self, tiny_model):
+        params, cfg = tiny_model
+        b = ContinuousBatcher(params, cfg, max_batch=1, max_new_cap=8)
+        b.submit(SchedRequest(req_id=0, prompt_ids=[2, 4, 6],
+                              max_new_tokens=4))
+        b.run_all()
+        assert b.prefill_time_s > 0
+        assert b.decode_time_s > 0
