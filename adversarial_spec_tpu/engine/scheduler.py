@@ -53,7 +53,10 @@ from adversarial_spec_tpu.models.transformer import (
 )
 
 TRASH_PAGE = 0
-PREFILL_CHUNK = 512  # admission prompts prefill in chunks of this many
+# Admission prefill granularity — deliberately finer than generate.py's
+# PREFILL_CHUNK (1024): smaller chunks mean decode chunks slot in between
+# more often while a newcomer's prompt streams in.
+ADMISSION_CHUNK = 512
 
 
 @dataclass
@@ -459,7 +462,7 @@ class ContinuousBatcher:
 
         adm = self._admission
         t0 = time.monotonic()
-        chunk_len = min(adm.S, PREFILL_CHUNK)
+        chunk_len = min(adm.S, ADMISSION_CHUNK)
         adm.cache, adm.last_logits = prefill_chunk(
             self.params,
             self.cfg,
@@ -543,7 +546,7 @@ class ContinuousBatcher:
                     # (FIFO) until residents free pages.
                     return
                 self.queue.pop(0)
-                if self._admission.S <= PREFILL_CHUNK:
+                if self._admission.S <= ADMISSION_CHUNK:
                     self._advance_admission()  # completes in one chunk
 
     # -- completion --------------------------------------------------------

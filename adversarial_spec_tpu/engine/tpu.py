@@ -346,10 +346,7 @@ class TpuEngine:
             if nxt >= max_prompt:  # at the minimum bucket already
                 break
             max_prompt = nxt
-        prompts = [
-            p if len(p) <= max_prompt else p[:1] + p[len(p) - (max_prompt - 1):]
-            for p in prompts
-        ]
+        prompts = [_trim_prompt(p, max_prompt) for p in prompts]
         # Pool capacity covers CONCURRENT residency (the max_batch largest
         # requests), not the whole queue — finished rows free their pages
         # and queued requests admit into them; sizing by the queue total

@@ -186,9 +186,16 @@ def attention(
     logits = logits * scale
     if attn_softcap > 0.0:
         logits = _softcap(logits, attn_softcap)
-    neg = jnp.finfo(jnp.float32).min
-    logits = jnp.where(mask[:, None, None, :, :], logits, neg)
-    probs = jax.nn.softmax(logits, axis=-1)
+    # Masked softmax with the framework-wide contract that FULLY-masked
+    # rows (left-pad query slots) produce EXACT zeros — matching the
+    # Pallas kernels and the ring (which early-outs of windowed hops, so
+    # pad garbage may not even see the same key set twice). -inf masking
+    # with a guarded max keeps those rows NaN-free.
+    logits = jnp.where(mask[:, None, None, :, :], logits, -jnp.inf)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    m = jnp.where(jnp.isfinite(m), m, 0.0)
+    p = jnp.exp(logits - m)
+    probs = p / jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
     out = jnp.einsum(
         "bhgst,bhtd->bshgd", probs.astype(v.dtype), v
     )

@@ -55,8 +55,12 @@ def _block_attend(
     s = s.reshape(B, H, Sq, k.shape[1])
     if attn_softcap > 0.0:
         s = jnp.tanh(s / attn_softcap) * attn_softcap
-    neg = jnp.finfo(jnp.float32).min
-    s = jnp.where(mask[:, None, :, :], s, neg)
+    # -inf (not finfo.min): a fully-masked row must yield EXACT zeros —
+    # finfo.min would make it a uniform average over however many keys
+    # this run happened to process (hop-count-dependent garbage). The
+    # m/alpha guards below keep -inf NaN-free; same contract as the
+    # Pallas kernels (ops/flash_common.py) and attention().
+    s = jnp.where(mask[:, None, :, :], s, -jnp.inf)
 
     m_new = jnp.maximum(m, s.max(axis=-1))
     # Guard fully-masked rows: keep m finite so exp() stays 0, not NaN.
@@ -69,6 +73,32 @@ def _block_attend(
     delta = delta.reshape(B, Sq, H, D)
     acc_new = acc * alpha.transpose(0, 2, 1)[..., None] + delta
     return m_new, l_new, acc_new
+
+
+def ring_hops(sp: int, block: int, window, causal: bool):
+    """Number of ring hops that can possibly contribute.
+
+    Causal + sliding window W: hop h hands device idx the K block from
+    src = idx - h (mod sp); non-wrapped blocks sit h·block slots behind
+    the query block, and every (query, key) pair in hop h is outside the
+    window once (h-1)·block + 1 >= W — the SAME bound on every device, so
+    the trip count shrinks uniformly and ppermutes stay matched. Wrapped
+    blocks are entirely in the future and already masked. Returns a
+    Python int when ``window`` is static (fori_loop keeps a static trip
+    count), a traced scalar when it is traced (gemma2's per-layer
+    alternation inside scan — lowers to a uniform while_loop).
+    """
+    if not causal:
+        return sp
+    if isinstance(window, int):
+        if window <= 0:
+            return sp
+        return min(sp, (window + block - 2) // block + 1)
+    return jnp.where(
+        window > 0,
+        jnp.minimum(sp, (window + block - 2) // block + 1),
+        sp,
+    )
 
 
 def ring_attention_local(
@@ -86,8 +116,11 @@ def ring_attention_local(
     """Per-device ring attention body (call inside shard_map over sp).
 
     ``window`` may be a traced scalar (per-layer alternation inside a
-    scan): key slots below q_slot - window + 1 are masked. The ring still
-    makes all sp hops (SPMD uniformity); distant blocks contribute zeros.
+    scan): key slots below q_slot - window + 1 are masked. Sliding-window
+    layers EARLY-OUT of the ring after ``ring_hops`` hops — the remaining
+    blocks are fully outside every query's window on every device, so the
+    trip count shrinks uniformly (SPMD-safe) instead of masking sp-1 hops
+    of dead compute at 16k contexts.
     """
     idx = jax.lax.axis_index(axis_name)
     B, Sq, H, D = qb.shape
@@ -136,7 +169,8 @@ def ring_attention_local(
         vb = jax.lax.ppermute(vb, axis_name, perm)
         return m, l, acc, kb, vb
 
-    m, l, acc, _, _ = jax.lax.fori_loop(0, sp, step, (m, l, acc, kb, vb))
+    hops = ring_hops(sp, Sq, window, causal)
+    m, l, acc, _, _ = jax.lax.fori_loop(0, hops, step, (m, l, acc, kb, vb))
     l_safe = jnp.maximum(l, 1e-30)
     out = acc / l_safe.transpose(0, 2, 1)[..., None]
     return out.astype(qb.dtype)

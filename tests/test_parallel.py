@@ -447,3 +447,81 @@ class TestLongContext16k:
         np.testing.assert_allclose(
             np.asarray(logits_sp), np.asarray(last), rtol=3e-4, atol=3e-4
         )
+
+
+class TestWindowedRingEarlyOut:
+    """Sliding-window layers stop the ring after ring_hops hops instead
+    of masking dead compute (NOTES round-2 shortcut)."""
+
+    def test_hop_bound_formula(self):
+        from adversarial_spec_tpu.parallel.ring import ring_hops
+
+        # Global attention or non-causal: every hop can contribute.
+        assert ring_hops(8, 512, 0, True) == 8
+        assert ring_hops(8, 512, 64, False) == 8
+        # Window within one block: diagonal + one predecessor.
+        assert ring_hops(8, 512, 8, True) == 2
+        assert ring_hops(8, 512, 512, True) == 2
+        # Window a hair past a block boundary pulls in one more hop.
+        assert ring_hops(8, 512, 513, True) == 2
+        assert ring_hops(8, 512, 514, True) == 3
+        # Huge windows clamp at sp.
+        assert ring_hops(4, 512, 10**6, True) == 4
+        # Traced window (gemma2 alternation) gives the same numbers.
+        import jax.numpy as jnp
+
+        assert int(ring_hops(8, 512, jnp.int32(8), True)) == 2
+        assert int(ring_hops(8, 512, jnp.int32(0), True)) == 8
+
+    def test_windowed_ring_matches_full_ring(self):
+        """Early-out must not change the result: windowed ring output ==
+        the same ring forced to run all sp hops (window as mask only)."""
+        if len(jax.devices()) < 4:
+            pytest.skip("requires 4 virtual devices")
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        from adversarial_spec_tpu.parallel import ring as ring_mod
+        from adversarial_spec_tpu.parallel.mesh import make_mesh
+
+        B, S, H, Hkv, D, W = 2, 64, 4, 2, 16, 7
+        ks = jax.random.split(jax.random.key(21), 3)
+        q = jax.random.normal(ks[0], (B, S, H, D), jnp.float32)
+        k = jax.random.normal(ks[1], (B, S, Hkv, D), jnp.float32)
+        v = jax.random.normal(ks[2], (B, S, Hkv, D), jnp.float32)
+        mesh = make_mesh({"sp": 4, "dp": 2})
+        spec = P(None, "sp", None, None)
+
+        def run(window):
+            def local(qb, kb, vb):
+                return ring_mod.ring_attention_local(
+                    qb, kb, vb, 4, causal=True, window=window
+                )
+
+            return jax.shard_map(
+                local, mesh=mesh,
+                in_specs=(spec, spec, spec), out_specs=spec,
+                check_vma=False,
+            )(q, k, v)
+
+        early = run(W)  # static int window → shortened fori_loop
+        # Force all hops by passing the window traced-but-equal: trip
+        # count identical math, exercises the traced path too.
+        traced = run(jnp.int32(W))
+        np.testing.assert_allclose(
+            np.asarray(early), np.asarray(traced), rtol=1e-6, atol=1e-6
+        )
+        # And against the full-hop reference: window big enough to keep
+        # all hops, then mask manually via a huge-window run on the
+        # windowed mask — i.e., compare W-windowed early-out vs the old
+        # behavior (all hops, W mask) reconstructed with hops forced to
+        # sp by monkeypatching ring_hops.
+        orig = ring_mod.ring_hops
+        ring_mod.ring_hops = lambda sp_, b_, w_, c_: sp_
+        try:
+            full = run(W)
+        finally:
+            ring_mod.ring_hops = orig
+        np.testing.assert_allclose(
+            np.asarray(early), np.asarray(full), rtol=1e-6, atol=1e-6
+        )
