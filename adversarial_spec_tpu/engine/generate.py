@@ -24,6 +24,7 @@ jitted functions (parallel/sharding.py), not via this file's logic.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass
 from functools import partial
@@ -41,8 +42,16 @@ from adversarial_spec_tpu.models.transformer import (
     init_cache,
 )
 
-DECODE_CHUNK = 64
+DECODE_CHUNK = 128
 MIN_BUCKET = 128
+
+# Auto-select the fused Pallas decode kernel only at context lengths where
+# streaming the cache beats XLA's fused attention. At short T the kernel's
+# sequential grid (B·Hkv·T/block programs on one TensorCore) costs more
+# than it saves (measured on v5e: jnp 491 vs kernel 384 tok/s at T=1280);
+# at long T the kernel's O(block·D) VMEM and early block-skip win.
+# Explicit use_pallas_decode=True always wins over this heuristic.
+PALLAS_DECODE_MIN_T = int(os.environ.get("ADVSPEC_PALLAS_MIN_T", "4096"))
 
 
 def bucket_length(n: int, minimum: int = MIN_BUCKET) -> int:
@@ -325,12 +334,37 @@ def generate(
     # An explicit use_pallas_decode=True records caller intent (it
     # selects a louder fallback when the mesh can't support the kernel).
     explicit_pallas = use_pallas_decode is True
+    # The PAGED kernel switch ignores the dense-path context-length
+    # heuristic below: the paged alternative is the gather reference path
+    # (densifies the whole pool every layer), strictly worse than the
+    # kernel at any context length. Only an explicit caller False (or a
+    # non-TPU backend) disables it.
+    requested_pallas = use_pallas_decode
+
+    n_real = len(prompt_ids)
+    if mesh is not None:
+        from adversarial_spec_tpu.parallel.mesh import DP
+
+        dp = mesh.shape[DP]
+        short = (-len(prompt_ids)) % dp
+        prompt_ids = prompt_ids + [prompt_ids[-1]] * short
+
+    tokens_np, pad_lens_np = pad_batch(prompt_ids, pad_id)
+    B, S = tokens_np.shape
+    max_new = bucket_length(max_new_tokens, minimum=DECODE_CHUNK)
+    total_len = S + max_new
+
     if use_pallas_decode is None:
-        # Auto: fused kernel on a real TPU. Multi-device meshes run it
-        # under shard_map (batch over dp, KV heads over tp); the support
-        # gate below demotes unsupported tp degrees for auto and explicit
+        # Auto: fused kernel on a real TPU, but only once the cache is
+        # long enough for streaming to beat XLA's attention (see
+        # PALLAS_DECODE_MIN_T). Multi-device meshes run it under
+        # shard_map (batch over dp, KV heads over tp); the support gate
+        # below demotes unsupported tp degrees for auto and explicit
         # callers alike.
-        use_pallas_decode = jax.default_backend() == "tpu"
+        use_pallas_decode = (
+            jax.default_backend() == "tpu"
+            and total_len >= PALLAS_DECODE_MIN_T
+        )
     pallas_interpret = jax.default_backend() == "cpu"
     if use_pallas_decode and mesh is not None and mesh.size > 1:
         from adversarial_spec_tpu.ops.pallas_decode import (
@@ -348,19 +382,6 @@ def generate(
                 )
             use_pallas_decode = False
 
-    n_real = len(prompt_ids)
-    if mesh is not None:
-        from adversarial_spec_tpu.parallel.mesh import DP
-
-        dp = mesh.shape[DP]
-        short = (-len(prompt_ids)) % dp
-        prompt_ids = prompt_ids + [prompt_ids[-1]] * short
-
-    tokens_np, pad_lens_np = pad_batch(prompt_ids, pad_id)
-    B, S = tokens_np.shape
-    max_new = bucket_length(max_new_tokens, minimum=DECODE_CHUNK)
-    total_len = S + max_new
-
     tokens = jnp.asarray(tokens_np)
     pad_lens = jnp.asarray(pad_lens_np)
     if mesh is not None:
@@ -373,8 +394,14 @@ def generate(
     if seed is None:
         # Fresh entropy per call: unseeded debate rounds must actually vary
         # (seed=0 aliasing would make every round's "samples" identical).
-        seed = int.from_bytes(__import__("os").urandom(4), "little")
-    key = jax.random.key(seed)
+        seed = int.from_bytes(os.urandom(4), "little")
+    # Sampling draws full-vocab uniforms every step (gumbel-max
+    # categorical); threefry is pure ALU and shows up at 128k vocab. The
+    # TPU's hardware RNG ("rbg") generates the same bits-shape orders of
+    # magnitude cheaper. Streams differ between impls, so seeds are
+    # reproducible per platform, not across platforms (never promised).
+    impl = "rbg" if jax.default_backend() == "tpu" else "threefry2x32"
+    key = jax.random.key(seed, impl=impl)
     key, prefill_key = jax.random.split(key)
     temp = jnp.float32(temperature)
     tp = jnp.float32(top_p)
@@ -603,10 +630,15 @@ def generate(
             pool, cache["k"][..., :S, :], cache["v"][..., :S, :], page_ids, offsets
         )
         cache = None  # dense cache no longer needed
-        # Same switch as the dense path: auto-resolved above (fused kernel
-        # on real single-device TPU), overridable by the caller — interpret
-        # mode makes the kernel testable on CPU too.
-        use_paged_kernel = use_pallas_decode
+        # NOT the dense-path switch: the paged fallback (gather path)
+        # densifies the whole pool every layer, so the kernel wins at any
+        # context length — only an explicit caller False or a non-TPU
+        # backend turns it off (interpret mode keeps it testable on CPU).
+        use_paged_kernel = (
+            requested_pallas
+            if requested_pallas is not None
+            else jax.default_backend() == "tpu"
+        )
         # Per-row decode state for the shared paged loop
         # (engine/scheduler.py::scheduler_decode_chunk — one loop serves
         # both this round-synchronous path and the continuous batcher).
@@ -714,31 +746,59 @@ def generate(
             if int(n_emitted) / max(int(n_row_iters), 1) < 1.5:
                 use_spec = False
         elif desynced:
-            # Rows no longer share a step count: finish on the per-row-
-            # slot tail loop (speculative.py), same sampling semantics.
-            cache, cur, finished, out_buf, steps_rows = rowwise_decode_steps(
-                params,
-                cfg,
-                cache,
-                cur,
-                pad_lens,
-                finished,
-                out_buf,
-                steps_rows,
-                jnp.int32(max_new_tokens),
-                eos,
-                chunk_key,
-                temp,
-                tp,
-                prompt_len=S,
-                chunk=DECODE_CHUNK,
-                greedy=greedy,
-                top_k=top_k,
-                use_top_p=use_top_p,
-                use_pallas=spec_pallas,
-                pallas_interpret=pallas_interpret,
-            )
-            step = jnp.max(steps_rows)
+            # Rows no longer share a step count. If speculation is OFF
+            # with budget left, only let the laggards CATCH UP to the
+            # frontmost UNFINISHED row (rowwise slots are ~2x slower per
+            # step than the shared-slot loop: per-row scattered cache
+            # writes), then clear the desync so the rest of the budget
+            # decodes synced. With speculation merely out of span-budget,
+            # rowwise runs the whole tail.
+            need_catchup = True
+            if use_spec:
+                target = max_new_tokens
+            else:
+                sr = np.asarray(steps_rows)
+                unfin = ~np.asarray(finished)
+                target = min(int(sr[unfin].max()), max_new_tokens)
+                if bool((sr[unfin] >= target).all()):
+                    # Already level (e.g. B == 1, or equal accept
+                    # counts): no catch-up dispatch needed.
+                    desynced = False
+                    step = jnp.int32(target)
+                    need_catchup = False
+            if need_catchup:
+                cache, cur, finished, out_buf, steps_rows = (
+                    rowwise_decode_steps(
+                        params,
+                        cfg,
+                        cache,
+                        cur,
+                        pad_lens,
+                        finished,
+                        out_buf,
+                        steps_rows,
+                        jnp.int32(target),
+                        eos,
+                        chunk_key,
+                        temp,
+                        tp,
+                        prompt_len=S,
+                        chunk=DECODE_CHUNK,
+                        greedy=greedy,
+                        top_k=top_k,
+                        use_top_p=use_top_p,
+                        use_pallas=spec_pallas,
+                        pallas_interpret=pallas_interpret,
+                    )
+                )
+                step = jnp.max(steps_rows)
+                if not use_spec:
+                    sr = np.asarray(steps_rows)
+                    fin = np.asarray(finished)
+                    if bool((fin | (sr >= target)).all()):
+                        # Level again: unfinished rows all sit at target.
+                        desynced = False
+                        step = jnp.int32(target)
         elif paged:
             from adversarial_spec_tpu.engine.scheduler import (
                 scheduler_decode_chunk,
@@ -810,6 +870,12 @@ def generate(
                 pallas_interpret=pallas_interpret,
                 mesh=mesh if (mesh is not None and mesh.size > 1) else None,
             )
+            if steps_rows is not None:
+                # Synced again after a speculative phase + catch-up:
+                # every unfinished row advanced to `step`. Raising a
+                # finished row's count only widens its EOS-scan region —
+                # the scan still stops at its first EOS (zeros follow).
+                steps_rows = jnp.maximum(steps_rows, step)
         step.block_until_ready()
     decode_time = time.monotonic() - t1
 

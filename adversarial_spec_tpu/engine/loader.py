@@ -19,6 +19,7 @@ hard part (c): 70B within host RAM).
 from __future__ import annotations
 
 import json
+import os
 from pathlib import Path
 
 import jax
@@ -87,12 +88,18 @@ def load_hf_checkpoint(
     family: str,
     dtype: jnp.dtype = jnp.bfloat16,
     device_put=None,
+    transposed_head: bool | None = None,
 ) -> Params:
     """Read an HF checkpoint dir into the layer-stacked pytree.
 
     ``device_put(path_tuple, np_array) -> jax.Array`` lets the caller shard
     each tensor as it is read (defaults to plain jnp.asarray on the default
     device).
+
+    ``transposed_head``: materialize the [D, V] head copy for tied
+    configs (models/transformer.py:init_params). None reads the
+    ADVSPEC_TRANSPOSED_HEAD env var (default on); set it to 0 on
+    memory-tight fits to save the V·D bytes.
     """
     import ml_dtypes
 
@@ -143,18 +150,30 @@ def load_hf_checkpoint(
     layers = {
         k: put(("layers", k), stack(k)) for k in layer_keys
     }
+    embed_np = np.asarray(
+        _read_tensor(files, f"{prefix}embed_tokens.weight")
+    )
     params: Params = {
-        "embed": put(
-            ("embed",), np.asarray(_read_tensor(files, f"{prefix}embed_tokens.weight"))
-        ),
+        "embed": put(("embed",), embed_np),
         "layers": layers,
         "final_norm": put(
             ("final_norm",), np.asarray(_read_tensor(files, f"{prefix}norm.weight"))
         ),
     }
+    if transposed_head is None:
+        transposed_head = (
+            os.environ.get("ADVSPEC_TRANSPOSED_HEAD", "1") != "0"
+        )
     if not cfg.tied_embeddings:
         head = np.asarray(_read_tensor(files, "lm_head.weight")).T
         params["lm_head"] = put(("lm_head",), head)
+    elif transposed_head:
+        # Transposed [D, V] head copy for tied embeddings — the decode
+        # hot path's head matmul at full bandwidth (see
+        # models/transformer.py:init_params). np .T is a view of the
+        # table already read for "embed"; `put` materializes it in the
+        # target dtype/sharding.
+        params["lm_head_t"] = put(("lm_head_t",), embed_np.T)
     return params
 
 

@@ -51,10 +51,22 @@ Cache = dict[str, jnp.ndarray]
 
 
 def init_params(
-    rng: jax.Array, cfg: ModelConfig, dtype: jnp.dtype = jnp.bfloat16
+    rng: jax.Array,
+    cfg: ModelConfig,
+    dtype: jnp.dtype = jnp.bfloat16,
+    transposed_head: bool = True,
 ) -> Params:
     """Random init with truncated-normal fan-in scaling (for synthetic
-    checkpoints and tests; real weights come from engine/loader.py)."""
+    checkpoints and tests; real weights come from engine/loader.py).
+
+    ``transposed_head``: for tied-embedding configs, also store the
+    ``[dim, vocab]`` transposed head copy (see the comment at the
+    assignment below). Disable to save the V·D bytes on memory-tight
+    fits; the einsum fallback over the embed table computes the same
+    logits (exactly equivalent until ``quantize_params`` runs — the
+    copy quantizes like any head matmul, the embed-table einsum stays
+    full precision).
+    """
     keys = iter(jax.random.split(rng, 16))
 
     def dense(key, shape, fan_in):
@@ -99,6 +111,16 @@ def init_params(
     }
     if not cfg.tied_embeddings:
         params["lm_head"] = dense(next(keys), (D, cfg.vocab_size), D)
+    elif transposed_head:
+        # Tied embeddings force the head matmul to contract the embed
+        # table's MINOR axis ("bsd,vd->bsv") — measured ~2-5x slower than
+        # a [D, V] layout on TPU (the MXU wants the contraction on the
+        # major axis; XLA inserts a relayout of the full table). A decode
+        # step re-reads the whole head every token, so the head is the
+        # single largest per-step HBM item for small models. Materialize
+        # a transposed copy once at init/load: +V·D bytes of HBM buys the
+        # full-bandwidth matmul every step.
+        params["lm_head_t"] = jnp.swapaxes(params["embed"], 0, 1)
     return params
 
 
@@ -507,12 +529,20 @@ def _lm_head_logits(
         # the [B, S, vocab] projection (the largest prefill activation).
         x = x[:, -1:]
     if cfg.tied_embeddings:
-        logits = jnp.einsum(
-            "bsd,vd->bsv",
-            x,
-            params["embed"],
-            preferred_element_type=jnp.float32,
-        )
+        if "lm_head_t" in params:
+            # Pre-transposed [D, V] copy (init_params/loader): contracts
+            # the major axis at full HBM bandwidth instead of relayouting
+            # the embed table every decode step.
+            logits = matmul(
+                x, params["lm_head_t"], preferred_element_type=jnp.float32
+            )
+        else:
+            logits = jnp.einsum(
+                "bsd,vd->bsv",
+                x,
+                params["embed"],
+                preferred_element_type=jnp.float32,
+            )
     else:
         logits = matmul(
             x, params["lm_head"], preferred_element_type=jnp.float32

@@ -11,9 +11,10 @@ Representation: a quantized matmul weight is a dict leaf
 custom pytree node) so the sharding rules, loaders, and tree utilities need
 no new node types; the transformer's ``matmul`` helper dispatches on it.
 
-Only matmul weights quantize (wq/wk/wv/wo/w_gate/w_up/w_down, lm_head);
-embeddings and norms stay full precision (gather tables and scale vectors
-are bandwidth-trivial and precision-sensitive).
+Only matmul weights quantize (wq/wk/wv/wo/w_gate/w_up/w_down, lm_head,
+and the tied-embedding transposed head copy lm_head_t); embeddings and
+norms stay full precision (gather tables and scale vectors are
+bandwidth-trivial and precision-sensitive).
 """
 
 from __future__ import annotations
@@ -21,7 +22,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 QUANTIZABLE = frozenset(
-    {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head"}
+    {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down", "lm_head", "lm_head_t"}
 )
 
 

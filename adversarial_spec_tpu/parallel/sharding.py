@@ -30,6 +30,7 @@ _PARAM_RULES: dict[str, P] = {
     "embed": P(),
     "final_norm": P(),
     "lm_head": P(None, TP),
+    "lm_head_t": P(None, TP),
     "attn_norm": P(None, None),
     "ffn_norm": P(None, None),
     "post_attn_norm": P(None, None),

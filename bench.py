@@ -86,6 +86,9 @@ def _probe_tpu(timeout_s: float = 120.0) -> bool:
 
 
 def _run_bench(platform: str) -> dict:
+    from adversarial_spec_tpu.utils.jaxenv import configure_jax
+
+    configure_jax()  # persistent compile cache: repeat runs skip XLA compiles
     import jax
 
     from adversarial_spec_tpu.engine.generate import generate
@@ -168,6 +171,9 @@ def _run_long_context(platform: str) -> dict:
     thin model so the 16k×16k attention is tractable; the measurement
     structure is identical either way.
     """
+    from adversarial_spec_tpu.utils.jaxenv import configure_jax
+
+    configure_jax()
     import jax
     import jax.numpy as jnp
 

@@ -342,9 +342,16 @@ class TestGenerate:
             params, cfg, [prompt] * B, paged=True, page_size=page, **kw
         )
         np.testing.assert_array_equal(ref.tokens, out.tokens)
-        # 128/16=8 shared prompt pages + 3 rows × ceil(64/16)=4 decode
-        # pages + 1 trash page = 21, versus 3×12+1=37 unshared.
-        assert pool_sizes == [8 + 3 * 4 + 1]
+        # 128/16=8 shared prompt pages + per-row decode pages for the
+        # DECODE_CHUNK-bucketed output budget + 1 trash page — versus
+        # 3 full per-row tables + trash unshared.
+        from adversarial_spec_tpu.engine.generate import (
+            DECODE_CHUNK,
+            bucket_length,
+        )
+
+        decode_pages = bucket_length(16, minimum=DECODE_CHUNK) // page
+        assert pool_sizes == [8 + B * decode_pages + 1]
 
     def test_paged_decode_with_eos(self, tiny_model):
         params, cfg = tiny_model

@@ -244,3 +244,26 @@ class TestAcceptanceArithmetic:
         steps, n_iters = out[5], out[6]
         assert int(steps[0]) == 4  # start 1 + 3 iterations × 1 token
         assert int(n_iters) == 3  # one wide forward per emitted token
+
+
+class TestAdaptiveResync:
+    def test_off_switch_resyncs_then_matches_plain_greedy(self, tiny_model):
+        """Random prompts make prompt-lookup drafts useless: the adaptive
+        off-switch fires, laggards catch up on the rowwise loop, and the
+        remaining budget (crossing a chunk boundary) decodes on the
+        shared-slot path — output must stay bit-identical to plain
+        greedy throughout the mode changes."""
+        from adversarial_spec_tpu.engine.generate import DECODE_CHUNK
+
+        params, cfg = tiny_model
+        rng = np.random.default_rng(3)
+        prompts = [
+            list(rng.integers(3, 500, 31)),
+            list(rng.integers(3, 500, 17)),
+            list(rng.integers(3, 500, 40)),
+        ]
+        kw = dict(max_new_tokens=DECODE_CHUNK + 12, eos_ids=[], greedy=True)
+        plain = generate(params, cfg, prompts, speculative=False, **kw)
+        spec = generate(params, cfg, prompts, speculative=True, **kw)
+        np.testing.assert_array_equal(plain.tokens, spec.tokens)
+        np.testing.assert_array_equal(plain.n_generated, spec.n_generated)

@@ -341,3 +341,63 @@ def test_hf_parity_llama3_rope_scaling(tmp_path):
     assert not np.allclose(
         np.asarray(ours), np.asarray(ours_unscaled), atol=1e-3
     )
+
+
+class TestTransposedHead:
+    """Tied-embedding configs materialize a [D, V] head copy at init/load
+    (full-bandwidth decode matmul); it must be numerically interchangeable
+    with the einsum over the [V, D] embed table."""
+
+    def _tied_cfg(self):
+        from dataclasses import replace
+
+        return replace(get_config("llama", "tiny"), tied_embeddings=True)
+
+    def test_logits_parity_with_einsum_path(self):
+        cfg = self._tied_cfg()
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        assert "lm_head_t" in params
+        ids = jnp.asarray([[3, 5, 7, 11]], jnp.int32)
+        fast, _ = _full_forward(params, cfg, ids, ids.shape[1])
+        slow_params = {k: v for k, v in params.items() if k != "lm_head_t"}
+        slow, _ = _full_forward(slow_params, cfg, ids, ids.shape[1])
+        np.testing.assert_allclose(
+            np.asarray(fast), np.asarray(slow), rtol=1e-5, atol=1e-5
+        )
+
+    def test_optional(self):
+        cfg = self._tied_cfg()
+        params = T.init_params(
+            jax.random.key(0), cfg, dtype=jnp.float32, transposed_head=False
+        )
+        assert "lm_head_t" not in params
+
+    def test_loader_materializes_transposed_head(self, tmp_path):
+        torch = pytest.importorskip("torch")
+        import transformers
+
+        cfg = self._tied_cfg()
+        hf_cfg = transformers.LlamaConfig(
+            vocab_size=cfg.vocab_size,
+            hidden_size=cfg.dim,
+            num_hidden_layers=cfg.n_layers,
+            num_attention_heads=cfg.n_heads,
+            num_key_value_heads=cfg.n_kv_heads,
+            intermediate_size=cfg.ffn_dim,
+            rope_theta=cfg.rope_theta,
+            rms_norm_eps=cfg.rms_eps,
+            tie_word_embeddings=True,
+        )
+        torch.manual_seed(0)
+        hf_model = transformers.AutoModelForCausalLM.from_config(hf_cfg)
+        ckpt = tmp_path / "ckpt"
+        hf_model.save_pretrained(ckpt, safe_serialization=True)
+
+        from adversarial_spec_tpu.engine.loader import load_hf_checkpoint
+
+        params = load_hf_checkpoint(ckpt, cfg, "llama", dtype=jnp.float32)
+        assert "lm_head_t" in params
+        np.testing.assert_array_equal(
+            np.asarray(params["lm_head_t"]),
+            np.asarray(params["embed"]).T,
+        )
