@@ -604,12 +604,6 @@ def handle_registry(args: argparse.Namespace, rest: list[str]) -> int:
         if len(rest) < 2:
             _err("usage: debate registry add-model <alias> --checkpoint DIR")
             return EXIT_VALIDATION
-        if args.kv == "paged" and args.kv_dtype == "int8":
-            _err(
-                "error: --kv paged does not support --kv-dtype int8 yet "
-                "(int8 KV applies to the dense cache)"
-            )
-            return EXIT_VALIDATION
         alias = rest[1]
         spec = model_registry.ModelSpec(
             alias=alias,

@@ -310,27 +310,13 @@ def generate(
     forward; bit-identical outputs, multiple tokens per step on
     revision-style outputs. None = auto (on when eligible).
 
-    ``kv_dtype="int8"``: store the dense KV cache int8 with per-token-head
+    ``kv_dtype="int8"``: store the KV cache int8 with per-token-head
     scales — half the cache HBM and half the bytes read per decoded
     token. Composes with the fused decode kernel (dequant inside the
-    kernel tiles) and with sharded meshes; the paged pool still stores
-    raw-dtype pages, so paged runs fall back to full precision.
+    kernel tiles), with sharded meshes, with ``paged`` (int8 pages +
+    scale pages, in-kernel dequant), and with sp prefill (quantized at
+    the reshard-to-decode boundary).
     """
-    sp_degree = mesh.shape.get("sp", 1) if mesh is not None else 1
-    if kv_dtype == "int8" and (paged or sp_degree > 1):
-        import sys as _sys
-
-        reason = (
-            "the paged pool stores raw-dtype pages"
-            if paged
-            else "sp prefill builds a raw-dtype cache"
-        )
-        print(
-            f"warning: kv_dtype=int8 unsupported here ({reason}); "
-            "using full-precision KV",
-            file=_sys.stderr,
-        )
-        kv_dtype = ""
     # An explicit use_pallas_decode=True records caller intent (it
     # selects a louder fallback when the mesh can't support the kernel).
     explicit_pallas = use_pallas_decode is True
@@ -473,8 +459,12 @@ def generate(
             params, cfg, sp_tokens, prefill_pads, mesh
         )
         # (paged cannot reach here: it is force-disabled on multi-device
-        # meshes above, and sp > 1 implies multi-device.)
-        cache = reshard_cache_for_decode(cache, mesh, total_len)
+        # meshes above, and sp > 1 implies multi-device.) int8 KV
+        # quantizes at this reshard boundary — the ring itself ran on
+        # full-precision K/V.
+        cache = reshard_cache_for_decode(
+            cache, mesh, total_len, kv_dtype=kv_dtype
+        )
     else:
         # Paged runs drop the dense cache after migrating prompt KV, so
         # it only needs the prompt slots — not the decode region.
@@ -605,7 +595,11 @@ def generate(
             n_kv_heads=cfg.n_kv_heads,
             head_dim=cfg.head_dim,
         )
-        pool = init_page_pool(layout, dtype=cache["k"].dtype)
+        pool = init_page_pool(
+            layout,
+            dtype=params["embed"].dtype if kv_dtype else cache["k"].dtype,
+            kv_dtype=kv_dtype,
+        )
         if paged_dp > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
             from adversarial_spec_tpu.parallel.mesh import DP as _DP
@@ -627,7 +621,13 @@ def generate(
         ]
         offsets = slots % page_size
         pool = write_tokens(
-            pool, cache["k"][..., :S, :], cache["v"][..., :S, :], page_ids, offsets
+            pool,
+            cache["k"][..., :S, :],
+            cache["v"][..., :S, :],
+            page_ids,
+            offsets,
+            ks_new=cache["ks"][..., :S, :] if "ks" in cache else None,
+            vs_new=cache["vs"][..., :S, :] if "ks" in cache else None,
         )
         cache = None  # dense cache no longer needed
         # NOT the dense-path switch: the paged fallback (gather path)
@@ -647,20 +647,32 @@ def generate(
         paged_max_new = jnp.full((B,), max_new_tokens, jnp.int32)
         paged_active = ~finished
 
-    # Speculative eligibility: dense cache, one device, enough output
-    # budget for at least one γ+1 span. Any batch size and any sampling
-    # mode qualify (per-row accept lengths + rejection sampling) — the
-    # bench shape (4 opponents, temperature 0.7) is the target workload.
-    # Composes with the fused kernels: verification spans run the
-    # multi-query kernel, the tail the single-query one.
+    # Speculative eligibility: dense cache, enough output budget for at
+    # least one γ+1 span, and a single device OR a dp-only mesh (rows
+    # shard over dp and each device runs its own accept loop — per-row
+    # desync never crosses devices; tp/sp would need manual collectives
+    # inside the loop). Any batch size and any sampling mode qualify
+    # (per-row accept lengths + rejection sampling) — the bench shape
+    # (4 opponents, temperature 0.7) is the target workload. Composes
+    # with the fused kernels: verification spans run the multi-query
+    # kernel, the tail the single-query one.
     from adversarial_spec_tpu.engine.speculative import GAMMA
 
     if speculative is None:
         speculative = True
+    spec_dp = 1
+    if mesh is not None and mesh.size > 1:
+        from adversarial_spec_tpu.parallel.mesh import DP as _SPEC_DP
+
+        spec_dp = (
+            mesh.shape[_SPEC_DP]
+            if mesh.size == mesh.shape[_SPEC_DP]
+            else 0  # tp/sp present: speculation unsupported
+        )
     use_spec = (
         speculative
         and not paged
-        and (mesh is None or mesh.size == 1)
+        and spec_dp > 0
         and max_new_tokens > GAMMA + 1
     )
     desynced = False  # per-row steps diverge after any speculative phase
@@ -673,11 +685,10 @@ def generate(
 
         prev_rows = tokens[:, -1]
         steps_rows = jnp.ones((B,), jnp.int32)
-        # One attention implementation must govern the whole speculative
-        # call (verify and tail see the same near-tie argmaxes). The MQ
-        # kernel can't read int8 tiles, so int8 speculation runs all-jnp
-        # rather than mixing a jnp verify with a Pallas int8 tail.
-        spec_pallas = use_pallas_decode and kv_dtype != "int8"
+        # One attention implementation governs the whole speculative call
+        # (verify and tail see the same near-tie argmaxes): MQ kernel for
+        # spans, single-query kernel for the tail — both read int8 tiles.
+        spec_pallas = use_pallas_decode
 
     t1 = time.monotonic()
 
@@ -703,20 +714,16 @@ def generate(
         else:
             spec_fits = False
         if spec_fits:
-            (
-                cache,
-                prev_rows,
-                cur,
-                finished,
-                out_buf,
-                steps_rows,
-                n_iters,
-                n_emitted,
-                n_row_iters,
-            ) = speculative_decode_steps(
-                params,
-                cfg,
-                cache,
+            spec_static = dict(
+                prompt_len=S,
+                iters=max(1, DECODE_CHUNK // (GAMMA + 1)),
+                greedy=greedy,
+                top_k=top_k,
+                use_top_p=use_top_p,
+                use_pallas=spec_pallas,
+                pallas_interpret=pallas_interpret,
+            )
+            spec_args = (
                 tokens,
                 prev_rows,
                 cur,
@@ -729,14 +736,30 @@ def generate(
                 chunk_key,
                 temp,
                 tp,
-                prompt_len=S,
-                iters=max(1, DECODE_CHUNK // (GAMMA + 1)),
-                greedy=greedy,
-                top_k=top_k,
-                use_top_p=use_top_p,
-                use_pallas=spec_pallas,
-                pallas_interpret=pallas_interpret,
             )
+            if spec_dp > 1:
+                from adversarial_spec_tpu.engine.speculative import (
+                    speculative_decode_steps_dp,
+                )
+
+                ret = speculative_decode_steps_dp(
+                    mesh, params, cfg, cache, *spec_args, **spec_static
+                )
+            else:
+                ret = speculative_decode_steps(
+                    params, cfg, cache, *spec_args, **spec_static
+                )
+            (
+                cache,
+                prev_rows,
+                cur,
+                finished,
+                out_buf,
+                steps_rows,
+                n_iters,
+                n_emitted,
+                n_row_iters,
+            ) = ret
             desynced = True
             step = jnp.max(steps_rows)
             # Adaptive off-switch: each verification forward is γ+1 wide;
@@ -767,30 +790,43 @@ def generate(
                     step = jnp.int32(target)
                     need_catchup = False
             if need_catchup:
-                cache, cur, finished, out_buf, steps_rows = (
-                    rowwise_decode_steps(
-                        params,
-                        cfg,
-                        cache,
-                        cur,
-                        pad_lens,
-                        finished,
-                        out_buf,
-                        steps_rows,
-                        jnp.int32(target),
-                        eos,
-                        chunk_key,
-                        temp,
-                        tp,
-                        prompt_len=S,
-                        chunk=DECODE_CHUNK,
-                        greedy=greedy,
-                        top_k=top_k,
-                        use_top_p=use_top_p,
-                        use_pallas=spec_pallas,
-                        pallas_interpret=pallas_interpret,
-                    )
+                rw_args = (
+                    cur,
+                    pad_lens,
+                    finished,
+                    out_buf,
+                    steps_rows,
+                    jnp.int32(target),
+                    eos,
+                    chunk_key,
+                    temp,
+                    tp,
                 )
+                rw_static = dict(
+                    prompt_len=S,
+                    chunk=DECODE_CHUNK,
+                    greedy=greedy,
+                    top_k=top_k,
+                    use_top_p=use_top_p,
+                    use_pallas=spec_pallas,
+                    pallas_interpret=pallas_interpret,
+                )
+                if spec_dp > 1:
+                    from adversarial_spec_tpu.engine.speculative import (
+                        rowwise_decode_steps_dp,
+                    )
+
+                    cache, cur, finished, out_buf, steps_rows = (
+                        rowwise_decode_steps_dp(
+                            mesh, params, cfg, cache, *rw_args, **rw_static
+                        )
+                    )
+                else:
+                    cache, cur, finished, out_buf, steps_rows = (
+                        rowwise_decode_steps(
+                            params, cfg, cache, *rw_args, **rw_static
+                        )
+                    )
                 step = jnp.max(steps_rows)
                 if not use_spec:
                     sr = np.asarray(steps_rows)

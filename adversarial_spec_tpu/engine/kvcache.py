@@ -105,9 +105,15 @@ class PageAllocator:
 
 
 def init_page_pool(
-    layout: PagedCacheLayout, dtype=jnp.bfloat16
+    layout: PagedCacheLayout, dtype=jnp.bfloat16, kv_dtype: str = ""
 ) -> dict[str, jnp.ndarray]:
-    """Device page pool: per-layer stacked K/V pages."""
+    """Device page pool: per-layer stacked K/V pages.
+
+    ``kv_dtype="int8"``: pages store int8 K/V plus per-(token, head)
+    f32 scale pages ("ks"/"vs", trailing dim 1) — the paged counterpart
+    of the dense cache's int8 layout (models/transformer.py:init_cache).
+    Presence of "ks" marks a quantized pool.
+    """
     shape = (
         layout.n_layers,
         layout.n_pages,
@@ -115,6 +121,14 @@ def init_page_pool(
         layout.page_size,
         layout.head_dim,
     )
+    if kv_dtype == "int8":
+        sshape = shape[:-1] + (1,)
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "ks": jnp.zeros(sshape, jnp.float32),
+            "vs": jnp.zeros(sshape, jnp.float32),
+        }
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
@@ -124,23 +138,39 @@ def write_tokens(
     v_new: jnp.ndarray,
     page_ids: np.ndarray,  # [B, S] physical page per token
     offsets: np.ndarray,  # [B, S] slot within page per token
+    ks_new: jnp.ndarray | None = None,  # [L, B, Hkv, S, 1] (int8 pools)
+    vs_new: jnp.ndarray | None = None,
 ) -> dict[str, jnp.ndarray]:
-    """Scatter freshly computed K/V into their pages (vectorized)."""
+    """Scatter freshly computed K/V into their pages (vectorized).
+
+    Quantized pools take the matching scale slices (both or neither) —
+    the same [L, B, Hkv, S, 1] layout the dense int8 cache stores.
+    """
     L, B, H, S, D = k_new.shape
     pid = jnp.asarray(page_ids).reshape(-1)  # [B*S]
     off = jnp.asarray(offsets).reshape(-1)
 
-    def flat(x):  # [L, B, H, S, D] → [B*S, L, H, D] (token-major updates)
-        return jnp.transpose(x, (1, 3, 0, 2, 4)).reshape(B * S, L, H, D)
+    def flat(x):  # [L, B, H, S, *] → [B*S, L, H, *] (token-major updates)
+        return jnp.transpose(x, (1, 3, 0, 2, 4)).reshape(
+            B * S, L, H, x.shape[-1]
+        )
 
     # pool[l, pid[n], :, off[n]] = new[n, l] for every layer l, token n.
     # Advanced indices (pid at dim 1, off at dim 3) are separated by the
     # head slice, so the token axis lands in front of the result — the
     # updates are built token-major to match.
-    return {
+    out = {
         "k": pool["k"].at[:, pid, :, off].set(flat(k_new)),
         "v": pool["v"].at[:, pid, :, off].set(flat(v_new)),
     }
+    if "ks" in pool:
+        if ks_new is None or vs_new is None:
+            raise ValueError(
+                "quantized pool requires ks_new/vs_new scale slices"
+            )
+        out["ks"] = pool["ks"].at[:, pid, :, off].set(flat(ks_new))
+        out["vs"] = pool["vs"].at[:, pid, :, off].set(flat(vs_new))
+    return out
 
 
 def token_positions_to_pages(

@@ -341,12 +341,14 @@ class ContinuousBatcher:
         top_p: float = 1.0,
         seed: int = 0,
         chunk: int = 32,
+        kv_dtype: str = "",
     ):
         self.params = params
         self.cfg = cfg
         self.B = max_batch
         self.page_size = page_size
         self.chunk = chunk
+        self.kv_dtype = kv_dtype
         self.greedy = greedy
         self.top_k = top_k
         self._temp = jnp.float32(temperature)
@@ -369,7 +371,9 @@ class ContinuousBatcher:
             head_dim=cfg.head_dim,
         )
         self._dtype = jax.tree.leaves(params)[0].dtype
-        self.pool = init_page_pool(layout, dtype=self._dtype)
+        self.pool = init_page_pool(
+            layout, dtype=self._dtype, kv_dtype=kv_dtype
+        )
         self.max_pages_per_seq = -(-(cfg.max_seq_len) // page_size)
         # Fused paged kernel on real TPUs; gather path elsewhere.
         self._use_pallas = jax.default_backend() == "tpu"
@@ -448,7 +452,9 @@ class ContinuousBatcher:
             seq_id=seq_id,
             tokens=jnp.asarray(tokens_np),
             pads=jnp.asarray(pads_np),
-            cache=init_cache(self.cfg, 1, S, dtype=self._dtype),
+            cache=init_cache(
+                self.cfg, 1, S, dtype=self._dtype, kv_dtype=self.kv_dtype
+            ),
             pos=0,
             S=S,
         )
@@ -496,7 +502,13 @@ class ContinuousBatcher:
         page_ids = table[slots // self.page_size]
         offsets = slots % self.page_size
         self.pool = write_tokens(
-            self.pool, cache["k"], cache["v"], page_ids, offsets
+            self.pool,
+            cache["k"],
+            cache["v"],
+            page_ids,
+            offsets,
+            ks_new=cache.get("ks"),
+            vs_new=cache.get("vs"),
         )
 
         self._key, sub = jax.random.split(self._key)

@@ -427,3 +427,128 @@ def rowwise_decode_steps(
         cond, body, state
     )
     return cache, cur, finished, out_buf, steps
+
+
+def speculative_decode_steps_dp(
+    mesh,
+    params,
+    cfg,
+    cache,
+    prompt_tokens,
+    prev_tokens,
+    cur_tokens,
+    pad_lens,
+    finished,
+    out_buf,
+    steps,
+    stop_at,
+    eos_ids,
+    key,
+    temperature,
+    top_p,
+    **static_kw,
+):
+    """``speculative_decode_steps`` with rows sharded over a dp-only mesh.
+
+    dp-only (tp = sp = 1): inside shard_map the layer matmuls see full
+    weights (replicated), so no manual tp collectives are needed. The
+    engine gates on ``mesh.size == mesh.shape[DP]``.
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from adversarial_spec_tpu.parallel.mesh import DP
+
+    row_arrays = (
+        prompt_tokens,
+        prev_tokens,
+        cur_tokens,
+        pad_lens,
+        finished,
+        out_buf,
+        steps,
+    )
+    rowspec = tuple(P(DP, *([None] * (a.ndim - 1))) for a in row_arrays)
+    cache_spec = jax.tree.map(
+        lambda x: P(None, DP, *([None] * (x.ndim - 2))), cache
+    )
+    param_spec = jax.tree.map(lambda _: P(), params)
+
+    def local(params_l, cache_l, prompt_l, prev_l, cur_l, pads_l, fin_l,
+              out_l, steps_l, stop_at_l, eos_l, key_l, temp_l, tp_l):
+        key_l = jax.random.fold_in(key_l, jax.lax.axis_index(DP))
+        (
+            cache_o, prev_o, cur_o, fin_o, out_o, steps_o,
+            it, n_emit, n_row_iters,
+        ) = speculative_decode_steps(
+            params_l, cfg, cache_l, prompt_l, prev_l, cur_l, pads_l,
+            fin_l, out_l, steps_l, stop_at_l, eos_l, key_l, temp_l, tp_l,
+            **static_kw,
+        )
+        return (
+            cache_o, prev_o, cur_o, fin_o, out_o, steps_o,
+            jax.lax.pmax(it, DP),
+            jax.lax.psum(n_emit, DP),
+            jax.lax.psum(n_row_iters, DP),
+        )
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(param_spec, cache_spec, *rowspec,
+                  P(), P(), P(), P(), P()),
+        out_specs=(cache_spec, rowspec[1], rowspec[2], rowspec[4],
+                   rowspec[5], rowspec[6], P(), P(), P()),
+        check_rep=False,
+    )(params, cache, *row_arrays, stop_at, eos_ids, key, temperature,
+      top_p)
+
+
+def rowwise_decode_steps_dp(
+    mesh,
+    params,
+    cfg,
+    cache,
+    cur_tokens,
+    pad_lens,
+    finished,
+    out_buf,
+    steps,
+    stop_at,
+    eos_ids,
+    key,
+    temperature,
+    top_p,
+    **static_kw,
+):
+    """``rowwise_decode_steps`` with rows sharded over a dp-only mesh."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from adversarial_spec_tpu.parallel.mesh import DP
+
+    row_arrays = (cur_tokens, pad_lens, finished, out_buf, steps)
+    rowspec = tuple(P(DP, *([None] * (a.ndim - 1))) for a in row_arrays)
+    cache_spec = jax.tree.map(
+        lambda x: P(None, DP, *([None] * (x.ndim - 2))), cache
+    )
+    param_spec = jax.tree.map(lambda _: P(), params)
+
+    def local(params_l, cache_l, cur_l, pads_l, fin_l, out_l, steps_l,
+              stop_at_l, eos_l, key_l, temp_l, tp_l):
+        key_l = jax.random.fold_in(key_l, jax.lax.axis_index(DP))
+        return rowwise_decode_steps(
+            params_l, cfg, cache_l, cur_l, pads_l, fin_l, out_l, steps_l,
+            stop_at_l, eos_l, key_l, temp_l, tp_l, **static_kw,
+        )
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(param_spec, cache_spec, *rowspec,
+                  P(), P(), P(), P(), P()),
+        out_specs=(cache_spec, rowspec[0], rowspec[2], rowspec[3],
+                   rowspec[4]),
+        check_rep=False,
+    )(params, cache, *row_arrays, stop_at, eos_ids, key, temperature,
+      top_p)

@@ -103,23 +103,6 @@ class TpuEngine:
         self._evict_to(MAX_RESIDENT_MODELS - 1)
         maybe_initialize_distributed()
         mesh = make_mesh(spec.mesh)
-        if spec.kv_dtype == "int8" and (
-            spec.kv == "paged" or mesh.shape.get("sp", 1) > 1
-        ):
-            # Resolve the incompatibility ONCE at load, not with a stderr
-            # warning on every debate turn. (int8 composes with dp/tp
-            # meshes — dense cache + scale tiles in the kernel — but the
-            # paged pool stores raw-dtype pages and sp prefill builds a
-            # raw-dtype cache.)
-            import dataclasses
-            import sys
-
-            print(
-                f"warning: tpu://{alias}: kv_dtype=int8 applies to the "
-                "dense dp/tp cache only; serving full-precision KV",
-                file=sys.stderr,
-            )
-            spec = dataclasses.replace(spec, kv_dtype="")
         params, cfg = self._materialize(spec, dtype, mesh)
         tokenizer = load_tokenizer(spec.tokenizer)
         lm = LoadedModel(
@@ -383,6 +366,11 @@ class TpuEngine:
                     if params.seed is not None
                     else int.from_bytes(os.urandom(4), "little")
                 ),
+                # Same KV precision on both serving paths: the
+                # round-synchronous fallback passes spec.kv_dtype to
+                # generate(); the batcher must honor it too (int8
+                # pages + scale pages).
+                kv_dtype=lm.spec.kv_dtype,
             )
             for i, ids in enumerate(prompts):
                 batcher.submit(

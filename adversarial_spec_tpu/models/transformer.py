@@ -324,13 +324,12 @@ def forward(
     pallas_decode = use_pallas_decode and S == 1
     # Short multi-query spans (speculative verification: S = γ+1) run
     # the multi-query kernel — one pass over the KV cache for the whole
-    # span. Single-device, non-quantized (the MQ kernel reads raw tiles;
-    # int8 spans fall back to the jnp mask path).
+    # span, int8 tiles included (scale tiles stream like the
+    # single-query kernel's). Single-device.
     pallas_mq = (
         use_pallas_decode
         and 1 < S <= 16
         and (mesh is None or mesh.size == 1)
-        and "ks" not in cache
     )
 
     x = params["embed"][tokens]
@@ -479,15 +478,26 @@ def forward(
             starts_l = _layer_window_start(
                 cfg, layer_id, pallas_start[:, None], mq_q_pos
             )
+            if quant_kv:
+                # Raw int8 tiles + scale tiles; the dequantized
+                # k_read/v_read are dead here (XLA drops them) so HBM
+                # traffic stays at int8 bytes.
+                mq_k, mq_v = cache_l["k"], cache_l["v"]
+                mq_kw = dict(
+                    k_scale=cache_l["ks"], v_scale=cache_l["vs"]
+                )
+            else:
+                mq_k, mq_v, mq_kw = k_read, v_read, {}
             out = decode_attention_mq(
                 q,
-                k_read,
-                v_read,
+                mq_k,
+                mq_v,
                 starts_l,
                 mq_q_pos + 1,
                 attn_softcap=cfg.attn_softcap,
                 scale=cfg.attn_scale,
                 interpret=pallas_interpret,
+                **mq_kw,
             )
         else:
             if cfg.sliding_window > 0 and cfg.sliding_window_pattern > 1:
@@ -557,7 +567,8 @@ def forward_paged_decode(
     cfg: ModelConfig,
     tokens: jnp.ndarray,  # [B, 1] int32 — single decode step
     positions: jnp.ndarray,  # [B, 1] rope positions
-    pool: Cache,  # {"k","v": [L, n_pages, Hkv, page_size, D]}
+    pool: Cache,  # {"k","v": [L, n_pages, Hkv, page_size, D]} (+"ks"/"vs"
+    # [..., 1] f32 scale pages when the pool is int8)
     page_table: jnp.ndarray,  # [B, Pmax] int32; <= 0 = unmapped (0=trash)
     write_page: jnp.ndarray,  # [B] physical page for this token's KV
     write_off: jnp.ndarray,  # [B] slot within that page
@@ -579,6 +590,7 @@ def forward_paged_decode(
     B = tokens.shape[0]
     page_size = pool["k"].shape[3]
     layer_ids = jnp.arange(cfg.n_layers)
+    quant_kv = "ks" in pool  # int8 pages + per-(token, head) scale pages
     cos, sin = rope_angles(
         positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
     )
@@ -588,19 +600,30 @@ def forward_paged_decode(
         x = (x.astype(jnp.float32) * math.sqrt(cfg.dim)).astype(x.dtype)
 
     def layer_body(x, scanned):
-        lp, layer_id, k_pages, v_pages = scanned
+        lp, layer_id, pool_l = scanned
+        k_pages, v_pages = pool_l["k"], pool_l["v"]
+        ks_pages = pool_l.get("ks")
+        vs_pages = pool_l.get("vs")
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps, cfg.norm_scale_plus_one)
         q, k, v = _project_qkv(lp, cfg, h, B, 1, cos, sin)
 
         # Pages are heads-major [n_pages, Hkv, page_size, D]; advanced
         # indices (write_page at dim 0, write_off at dim 2) separated by
         # the head slice put the batch axis first → update [B, Hkv, D].
-        k_pages = k_pages.at[write_page, :, write_off].set(
-            k[:, 0].astype(k_pages.dtype)
-        )
-        v_pages = v_pages.at[write_page, :, write_off].set(
-            v[:, 0].astype(v_pages.dtype)
-        )
+        if quant_kv:
+            kq, ks = _quantize_kv(k[:, 0])  # [B, Hkv, D], [B, Hkv, 1]
+            vq, vs = _quantize_kv(v[:, 0])
+            k_pages = k_pages.at[write_page, :, write_off].set(kq)
+            v_pages = v_pages.at[write_page, :, write_off].set(vq)
+            ks_pages = ks_pages.at[write_page, :, write_off].set(ks)
+            vs_pages = vs_pages.at[write_page, :, write_off].set(vs)
+        else:
+            k_pages = k_pages.at[write_page, :, write_off].set(
+                k[:, 0].astype(k_pages.dtype)
+            )
+            v_pages = v_pages.at[write_page, :, write_off].set(
+                v[:, 0].astype(v_pages.dtype)
+            )
 
         start = _layer_window_start(cfg, layer_id, bounds[:, 0], q_pos)
         layer_bounds = jnp.stack([start, bounds[:, 1]], axis=1)
@@ -610,6 +633,9 @@ def forward_paged_decode(
                 paged_decode_attention,
             )
 
+            qkw = (
+                dict(k_scale=ks_pages, v_scale=vs_pages) if quant_kv else {}
+            )
             out = paged_decode_attention(
                 q[:, 0],
                 k_pages,
@@ -619,19 +645,30 @@ def forward_paged_decode(
                 attn_softcap=cfg.attn_softcap,
                 scale=cfg.attn_scale,
                 interpret=pallas_interpret,
+                **qkw,
             )[:, None]
         else:
             # Gather reference path: page table → dense [B, Hkv, T, D].
             safe_table = jnp.maximum(page_table, 0)
 
-            def to_dense(pages):  # [B, P, Hkv, page, D] → [B, Hkv, T, D]
+            def to_dense(pages):  # [B, P, Hkv, page, *] → [B, Hkv, T, *]
                 g = pages[safe_table]
                 return jnp.swapaxes(g, 1, 2).reshape(
-                    B, cfg.n_kv_heads, -1, cfg.head_dim
+                    B, cfg.n_kv_heads, -1, pages.shape[-1]
                 )
 
-            k_dense = to_dense(k_pages)
-            v_dense = to_dense(v_pages)
+            if quant_kv:
+                k_dense = (
+                    to_dense(k_pages).astype(jnp.float32)
+                    * to_dense(ks_pages)
+                ).astype(x.dtype)
+                v_dense = (
+                    to_dense(v_pages).astype(jnp.float32)
+                    * to_dense(vs_pages)
+                ).astype(x.dtype)
+            else:
+                k_dense = to_dense(k_pages)
+                v_dense = to_dense(v_pages)
             T = k_dense.shape[2]
             slot = jnp.arange(T)[None, None, :]
             # <= 0 is unmapped: page 0 is the reserved trash page (callers
@@ -654,15 +691,18 @@ def forward_paged_decode(
                 scale=cfg.attn_scale,
             )
         x = _attn_out_and_ffn(x, out, lp, cfg, B, 1)
-        return x, (k_pages, v_pages)
+        new_l = {"k": k_pages, "v": v_pages}
+        if quant_kv:
+            new_l.update(ks=ks_pages, vs=vs_pages)
+        return x, new_l
 
-    x, (new_k, new_v) = jax.lax.scan(
-        layer_body,
-        x,
-        (params["layers"], layer_ids, pool["k"], pool["v"]),
+    # The pool dict scans as a pytree (same pattern as forward()'s
+    # cache): one scan serves both the raw and int8 layouts.
+    x, new_pool = jax.lax.scan(
+        layer_body, x, (params["layers"], layer_ids, pool)
     )
     logits = _lm_head_logits(params, cfg, x, lm_head_last_only=False)
-    return logits, {"k": new_k, "v": new_v}
+    return logits, new_pool
 
 
 def count_params(params: Params) -> int:

@@ -115,15 +115,18 @@ def _mq_attn_kernel(
     q_ref,  # VMEM [1, 1, G8, D] — G8 = pad(S·g) query rows
     k_ref,  # VMEM [1, 1, block_t, D]
     v_ref,  # VMEM [1, 1, block_t, D]
-    o_ref,  # VMEM [1, 1, G8, D]
-    m_ref,
-    l_ref,
-    acc_ref,
-    *,
+    *rest,  # [ks_ref, vs_ref,] o_ref, m_ref, l_ref, acc_ref
     scale: float,
     attn_softcap: float,
     block_t: int,
+    quantized: bool,
 ):
+    # int8-KV mode mirrors _decode_attn_kernel: scale tiles stream
+    # alongside the int8 K/V tiles, dequant in VMEM.
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
     t = pl.program_id(2)
     n_blocks = pl.num_programs(2)
     G8, D = q_ref.shape[2], q_ref.shape[3]
@@ -144,6 +147,9 @@ def _mq_attn_kernel(
         q = q_ref[0, 0].astype(jnp.float32) * scale
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
+        if quantized:
+            k = k * ks_ref[0, 0]  # [block_t, 1] broadcasts over D
+            v = v * vs_ref[0, 0]
         m, l, acc = flash_update(
             q,
             k,
@@ -172,13 +178,15 @@ def _mq_attn_kernel(
 )
 def decode_attention_mq(
     q: jnp.ndarray,  # [B, S, Hq, D] — a SHORT query span (spec verify)
-    k_cache: jnp.ndarray,  # [B, Hkv, T, D] heads-major
+    k_cache: jnp.ndarray,  # [B, Hkv, T, D] heads-major (any float or int8)
     v_cache: jnp.ndarray,  # [B, Hkv, T, D]
     starts: jnp.ndarray,  # [B, S] int32 first valid slot per query
     ends: jnp.ndarray,  # [B, S] int32 one-past-last valid slot per query
     attn_softcap: float = 0.0,
     scale: float | None = None,
     interpret: bool = False,
+    k_scale: jnp.ndarray | None = None,  # [B, Hkv, T, 1] f32 (int8 KV)
+    v_scale: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Multi-query fused decode attention. Returns [B, S, Hq, D].
 
@@ -197,6 +205,7 @@ def decode_attention_mq(
     rows = S * g
     G8 = -(-rows // _SUBLANE) * _SUBLANE
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    quantized = k_scale is not None
     block_t = next(
         (b for b in (BLOCK_T, 128, 64, 32, 16, 8) if T % b == 0), T
     )
@@ -228,23 +237,32 @@ def decode_attention_mq(
     kv_spec = pl.BlockSpec(
         (1, 1, block_t, D), lambda b, h, t: (b, h, t, 0)
     )
+    in_specs = [
+        # Bounds ride in VMEM ([1, G8, 2] block — sublane G8 is a
+        # multiple of 8, lane 2 spans the array) because the kernel
+        # reads them as vectors; SMEM only serves scalar loads.
+        pl.BlockSpec((1, G8, 2), lambda b, h, t: (b, 0, 0)),
+        pl.BlockSpec((1, 1, G8, D), lambda b, h, t: (b, h, 0, 0)),
+        kv_spec,
+        kv_spec,
+    ]
+    operands = [bnd, qg, k_cache, v_cache]
+    if quantized:
+        scale_spec = pl.BlockSpec(
+            (1, 1, block_t, 1), lambda b, h, t: (b, h, t, 0)
+        )
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
     out = pl.pallas_call(
         functools.partial(
             _mq_attn_kernel,
             scale=scale,
             attn_softcap=attn_softcap,
             block_t=block_t,
+            quantized=quantized,
         ),
         grid=(B, Hkv, T // block_t),
-        in_specs=[
-            # Bounds ride in VMEM ([1, G8, 2] block — sublane G8 is a
-            # multiple of 8, lane 2 spans the array) because the kernel
-            # reads them as vectors; SMEM only serves scalar loads.
-            pl.BlockSpec((1, G8, 2), lambda b, h, t: (b, 0, 0)),
-            pl.BlockSpec((1, 1, G8, D), lambda b, h, t: (b, h, 0, 0)),
-            kv_spec,
-            kv_spec,
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec(
             (1, 1, G8, D), lambda b, h, t: (b, h, 0, 0)
         ),
@@ -255,7 +273,7 @@ def decode_attention_mq(
         ],
         out_shape=jax.ShapeDtypeStruct((B, Hkv, G8, D), q.dtype),
         interpret=interpret,
-    )(bnd, qg, k_cache, v_cache)
+    )(*operands)
 
     out = out[:, :, :rows, :].reshape(B, Hkv, S, g, D)
     return jnp.transpose(out, (0, 2, 1, 3, 4)).reshape(B, S, Hq, D)

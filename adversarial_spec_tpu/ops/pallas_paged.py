@@ -42,15 +42,19 @@ def _paged_attn_kernel(
     q_ref,  # VMEM [1, 1, G8, D]
     k_ref,  # VMEM [1, 1, page, D] — page selected by index_map
     v_ref,  # VMEM [1, 1, page, D]
-    o_ref,  # VMEM [1, 1, G8, D]
-    m_ref,  # VMEM scratch [G8, 1]
-    l_ref,  # VMEM scratch [G8, 1]
-    acc_ref,  # VMEM scratch [G8, D]
-    *,
+    *rest,  # [ks_ref, vs_ref,] o_ref, m_ref, l_ref, acc_ref
     scale: float,
     page_size: int,
     attn_softcap: float,
+    quantized: bool,
 ):
+    # int8 pools stream per-(token, head) scale pages alongside the int8
+    # K/V pages and dequantize IN VMEM — HBM read per decoded token stays
+    # at the int8 byte count (mirrors ops/pallas_decode.py's dense mode).
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
     b = pl.program_id(0)
     p = pl.program_id(2)
     n_pages = pl.num_programs(2)
@@ -77,6 +81,9 @@ def _paged_attn_kernel(
         q = q_ref[0, 0].astype(jnp.float32) * scale
         k = k_ref[0, 0].astype(jnp.float32)  # [page, D]
         v = v_ref[0, 0].astype(jnp.float32)
+        if quantized:
+            k = k * ks_ref[0, 0]  # [page, 1] broadcasts over D
+            v = v * vs_ref[0, 0]
         m, l, acc = flash_update(
             q,
             k,
@@ -112,6 +119,8 @@ def paged_decode_attention(
     attn_softcap: float = 0.0,
     scale: float | None = None,
     interpret: bool = False,
+    k_scale: jnp.ndarray | None = None,  # [n_pages, Hkv, page, 1] (int8)
+    v_scale: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Fused paged decode attention. Returns [B, Hq, D].
 
@@ -120,6 +129,10 @@ def paged_decode_attention(
     reserved TRASH page — callers allocate real pages from id 1 up — so
     any table entry <= 0 (trash or negative padding) is treated as
     unmapped and masked out of the softmax.
+
+    ``k_scale``/``v_scale`` (both or neither): the pages are int8 with
+    per-(token, head) symmetric scale pages; dequant happens inside the
+    kernel on the VMEM-resident page.
     """
     B, Hq, D = q.shape
     Hkv, page_size = k_pages.shape[1], k_pages.shape[2]
@@ -127,6 +140,7 @@ def paged_decode_attention(
     g = Hq // Hkv
     G8 = max(_SUBLANE, g)
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    quantized = k_scale is not None
 
     qg = q.reshape(B, Hkv, g, D)
     if G8 != g:
@@ -135,23 +149,30 @@ def paged_decode_attention(
     def page_map(b, h, p, bounds_ref, table_ref):
         return (jnp.maximum(table_ref[b, p], 0), h, 0, 0)
 
+    page_spec = pl.BlockSpec((1, 1, page_size, D), page_map)
+    in_specs = [
+        pl.BlockSpec((1, 1, G8, D), lambda b, h, p, *_: (b, h, 0, 0)),
+        page_spec,
+        page_spec,
+    ]
+    operands = [qg, k_pages, v_pages]
+    if quantized:
+        scale_spec = pl.BlockSpec((1, 1, page_size, 1), page_map)
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
+
     out = pl.pallas_call(
         functools.partial(
             _paged_attn_kernel,
             scale=scale,
             page_size=page_size,
             attn_softcap=attn_softcap,
+            quantized=quantized,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(B, Hkv, P),
-            in_specs=[
-                pl.BlockSpec(
-                    (1, 1, G8, D), lambda b, h, p, *_: (b, h, 0, 0)
-                ),
-                pl.BlockSpec((1, 1, page_size, D), page_map),
-                pl.BlockSpec((1, 1, page_size, D), page_map),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, 1, G8, D), lambda b, h, p, *_: (b, h, 0, 0)
             ),
@@ -163,6 +184,6 @@ def paged_decode_attention(
         ),
         out_shape=jax.ShapeDtypeStruct((B, Hkv, G8, D), q.dtype),
         interpret=interpret,
-    )(bounds, page_table, qg, k_pages, v_pages)
+    )(bounds, page_table, *operands)
 
     return out[:, :, :g, :].reshape(B, Hq, D)

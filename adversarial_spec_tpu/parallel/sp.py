@@ -196,9 +196,18 @@ def sp_prefill(
     return logits, {"k": k_all, "v": v_all}
 
 
-def reshard_cache_for_decode(cache, mesh: Mesh, total_len: int):
+def reshard_cache_for_decode(
+    cache, mesh: Mesh, total_len: int, kv_dtype: str = ""
+):
     """Sequence-sharded prefill cache → decode layout: gather the sequence
-    axis, pad to ``total_len`` slots, shard batch over dp / heads over tp."""
+    axis, pad to ``total_len`` slots, shard batch over dp / heads over tp.
+
+    ``kv_dtype="int8"``: quantize the gathered cache into the int8
+    decode layout (models/transformer.py:init_cache). The ring attention
+    itself ran on full-precision K/V — sp prefill quantizes at this
+    boundary, where the dense path quantizes at each prefill write
+    (prompt-token KV values are identical either way; prefill-attention
+    reads differ in the int8 rounding, in sp's favor)."""
     from adversarial_spec_tpu.parallel.sharding import cache_sharding
 
     S = cache["k"].shape[3]
@@ -210,4 +219,10 @@ def reshard_cache_for_decode(cache, mesh: Mesh, total_len: int):
             pad[3] = (0, total_len - S)
             arr = jnp.pad(arr, pad)
         out[name] = arr
+    if kv_dtype == "int8":
+        from adversarial_spec_tpu.models.transformer import _quantize_kv
+
+        k8, ks = _quantize_kv(out["k"])
+        v8, vs = _quantize_kv(out["v"])
+        out = {"k": k8, "v": v8, "ks": ks, "vs": vs}
     return out

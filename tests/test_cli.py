@@ -405,12 +405,14 @@ class TestRegistry:
         )
         assert code == 2
 
-    def test_paged_int8_kv_combo_rejected(self, monkeypatch, capsys):
+    def test_paged_int8_kv_combo_accepted(self, monkeypatch, capsys):
+        """paged + int8 KV is a supported composition (int8 pages +
+        scale pages) — registration must succeed."""
         code, _, err = run_cli(
             [
                 "registry",
                 "add-model",
-                "bad",
+                "pq8",
                 "--kv",
                 "paged",
                 "--kv-dtype",
@@ -419,8 +421,11 @@ class TestRegistry:
             monkeypatch=monkeypatch,
             capsys=capsys,
         )
-        assert code == 2
-        assert "does not support" in err
+        assert code == 0
+        from adversarial_spec_tpu.engine.registry import load_registry
+
+        spec = load_registry()["pq8"]
+        assert spec.kv == "paged" and spec.kv_dtype == "int8"
 
     def test_remove_missing_exits_2(self, monkeypatch, capsys):
         code, _, _ = run_cli(

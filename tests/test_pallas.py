@@ -410,3 +410,120 @@ class TestMultiQueryKernel:
         a = generate(params, cfg, prompts, use_pallas_decode=False, **kw)
         b = generate(params, cfg, prompts, use_pallas_decode=True, **kw)
         np.testing.assert_array_equal(a.tokens, b.tokens)
+
+
+class TestInt8PagedPool:
+    """int8 pages + scale pages: the paged pool and the int8 KV cache are
+    no longer mutually exclusive (round-2 shortcut in NOTES.md)."""
+
+    def test_paged_kernel_matches_gathered_dequant(self):
+        from adversarial_spec_tpu.ops.pallas_paged import (
+            paged_decode_attention,
+        )
+
+        B, Hq, Hkv, D, page, P_ = 2, 4, 2, 64, 16, 6
+        ks = jax.random.split(jax.random.key(11), 3)
+        n_pages = 1 + B * P_  # page 0 = trash
+        q = jax.random.normal(ks[0], (B, Hq, D), jnp.float32)
+        kf = jax.random.normal(ks[1], (n_pages, Hkv, page, D), jnp.float32)
+        vf = jax.random.normal(ks[2], (n_pages, Hkv, page, D), jnp.float32)
+        amax = jnp.max(jnp.abs(kf), axis=-1, keepdims=True)
+        ksc = jnp.maximum(amax, 1e-8) / 127.0
+        k8 = jnp.clip(jnp.round(kf / ksc), -127, 127).astype(jnp.int8)
+        amax = jnp.max(jnp.abs(vf), axis=-1, keepdims=True)
+        vsc = jnp.maximum(amax, 1e-8) / 127.0
+        v8 = jnp.clip(jnp.round(vf / vsc), -127, 127).astype(jnp.int8)
+        table = (
+            1 + jnp.arange(B * P_, dtype=jnp.int32).reshape(B, P_)
+        )
+        bounds = jnp.array([[0, 90], [5, 96]], jnp.int32)
+
+        out = paged_decode_attention(
+            q, k8, v8, table, bounds, interpret=True,
+            k_scale=ksc, v_scale=vsc,
+        )
+        # Reference: dense attention over the DEQUANTIZED gathered pages.
+        kd = (k8 * ksc)[table]  # [B, P, Hkv, page, D]
+        vd = (v8 * vsc)[table]
+        kd = jnp.swapaxes(kd, 1, 2).reshape(B, Hkv, P_ * page, D)
+        vd = jnp.swapaxes(vd, 1, 2).reshape(B, Hkv, P_ * page, D)
+        ref = _dense_ref(q, kd, vd, bounds)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+    def test_generate_paged_int8_matches_dense_int8(self):
+        """Greedy tokens through (int8 paged pool) must equal (int8 dense
+        cache) — identical per-token quantization, different storage."""
+        cfg = get_config("llama", "tiny")
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        prompts = [[3, 7, 11, 15], [2, 4]]
+        kw = dict(
+            max_new_tokens=8, eos_ids=[], greedy=True,
+            kv_dtype="int8", speculative=False, share_prefix=False,
+        )
+        dense = generate(params, cfg, prompts, paged=False, **kw)
+        paged = generate(params, cfg, prompts, paged=True, page_size=16, **kw)
+        np.testing.assert_array_equal(dense.tokens, paged.tokens)
+
+    def test_generate_paged_int8_kernel_matches_gather(self):
+        """Same quantized pool, kernel (interpret) vs gather path."""
+        cfg = get_config("llama", "tiny")
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        prompts = [[1, 5, 9, 3, 7, 2]]
+        kw = dict(
+            max_new_tokens=8, eos_ids=[], greedy=True,
+            kv_dtype="int8", speculative=False, paged=True, page_size=16,
+        )
+        gather = generate(params, cfg, prompts, use_pallas_decode=False, **kw)
+        kern = generate(params, cfg, prompts, use_pallas_decode=True, **kw)
+        np.testing.assert_array_equal(gather.tokens, kern.tokens)
+
+
+class TestInt8MqKernel:
+    def test_mq_kernel_matches_dequant_reference(self):
+        from adversarial_spec_tpu.ops.pallas_decode import (
+            decode_attention_mq,
+        )
+
+        B, S, Hq, Hkv, D, T_ = 2, 5, 4, 2, 64, 128
+        ks = jax.random.split(jax.random.key(13), 3)
+        q = jax.random.normal(ks[0], (B, S, Hq, D), jnp.float32)
+        kf = jax.random.normal(ks[1], (B, Hkv, T_, D), jnp.float32)
+        vf = jax.random.normal(ks[2], (B, Hkv, T_, D), jnp.float32)
+        amax = jnp.max(jnp.abs(kf), axis=-1, keepdims=True)
+        ksc = jnp.maximum(amax, 1e-8) / 127.0
+        k8 = jnp.clip(jnp.round(kf / ksc), -127, 127).astype(jnp.int8)
+        amax = jnp.max(jnp.abs(vf), axis=-1, keepdims=True)
+        vsc = jnp.maximum(amax, 1e-8) / 127.0
+        v8 = jnp.clip(jnp.round(vf / vsc), -127, 127).astype(jnp.int8)
+        starts = jnp.zeros((B, S), jnp.int32)
+        ends = 100 + jnp.arange(S, dtype=jnp.int32)[None, :] + jnp.array(
+            [[0], [7]], jnp.int32
+        )
+
+        out = decode_attention_mq(
+            q, k8, v8, starts, ends, interpret=True,
+            k_scale=ksc, v_scale=vsc,
+        )
+        ref = decode_attention_mq(
+            q, k8 * ksc, v8 * vsc, starts, ends, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+    def test_int8_speculative_generate_matches_int8_plain(self, ):
+        """Greedy speculation with an int8 cache (MQ kernel verify +
+        single-query kernel tail, both on int8 tiles) must equal plain
+        int8 greedy decode bit-for-bit."""
+        cfg = get_config("llama", "tiny")
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        prompt = [5, 9, 7, 5, 9, 7, 5, 9, 7, 5, 9, 7, 5, 9]
+        kw = dict(
+            max_new_tokens=20, eos_ids=[], greedy=True,
+            kv_dtype="int8", use_pallas_decode=True,
+        )
+        plain = generate(params, cfg, [prompt], speculative=False, **kw)
+        spec = generate(params, cfg, [prompt], speculative=True, **kw)
+        np.testing.assert_array_equal(plain.tokens, spec.tokens)

@@ -264,3 +264,30 @@ class TestChunkedPrefillInterleave:
         b.run_all()
         assert b.prefill_time_s > 0
         assert b.decode_time_s > 0
+
+
+class TestBatcherInt8Pool:
+    def test_int8_pool_matches_int8_reference(self, tiny_model):
+        """ContinuousBatcher with kv_dtype=int8: output must match the
+        round-synchronous int8 dense-cache generate() for each request."""
+        params, cfg = tiny_model
+        b = ContinuousBatcher(
+            params, cfg, max_batch=2, max_new_cap=16, kv_dtype="int8"
+        )
+        assert "ks" in b.pool
+        b.submit(SchedRequest(req_id=0, prompt_ids=[1, 5, 9], max_new_tokens=8))
+        results = b.run_all()
+        ref = generate(
+            params,
+            cfg,
+            [[1, 5, 9]],
+            max_new_tokens=8,
+            eos_ids=[],
+            greedy=True,
+            speculative=False,
+            kv_dtype="int8",
+        )
+        np.testing.assert_array_equal(
+            results[0].tokens,
+            np.asarray(ref.tokens[0, : ref.n_generated[0]]),
+        )
