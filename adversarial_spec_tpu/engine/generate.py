@@ -301,8 +301,9 @@ def generate(
     ``paged``: decode against the paged KV pool (engine/kvcache.py +
     ops/pallas_paged.py) instead of the dense per-row cache — prompt KV is
     scattered into pages after prefill and every decode step writes through
-    the page table. Single-device only (the paged kernel is not
-    GSPMD-partitionable); sharded meshes silently use the dense path.
+    the page table. Scales over dp-only meshes (per-device pools) and
+    tp-only meshes (head-sharded global pool, kernel under shard_map);
+    mixed dp×tp and sp meshes warn and use the dense path.
 
     ``speculative``: prompt-lookup speculative decoding
     (engine/speculative.py) — greedy, single-row, dense-cache runs draft
@@ -397,21 +398,32 @@ def generate(
     deadline = time.monotonic() + timeout_s if timeout_s > 0 else None
     # Paged decode scales over dp (per-device page pools, zero cross-
     # device page traffic — engine/scheduler.py:
-    # sharded_scheduler_decode_chunk) but not tp/sp; resolve that now so
-    # the prefill cache can be sized to the prompt only.
-    paged_dp = 1
+    # sharded_scheduler_decode_chunk) and over tp-only meshes (global
+    # pool, head axis tp-sharded, kernel under shard_map —
+    # ops/pallas_paged.py:paged_decode_attention_tp). Mixed dp×tp and
+    # sp fall back to dense. Resolve now so the prefill cache can be
+    # sized to the prompt only.
+    paged_dp = paged_tp = 1
     if paged and mesh is not None and mesh.size > 1:
-        from adversarial_spec_tpu.parallel.mesh import DP as _DP
+        from adversarial_spec_tpu.parallel.mesh import (
+            DP as _DP,
+            TP as _TP,
+        )
 
         if mesh.size == mesh.shape[_DP]:
             paged_dp = mesh.shape[_DP]
+        elif (
+            mesh.size == mesh.shape[_TP]
+            and cfg.n_kv_heads % mesh.shape[_TP] == 0
+        ):
+            paged_tp = mesh.shape[_TP]
         else:
             import sys
 
             print(
-                f"warning: paged KV decode shards over dp only; falling "
-                f"back to the dense cache on this tp/sp mesh "
-                f"({dict(mesh.shape)})",
+                f"warning: paged KV decode shards over dp-only or "
+                f"tp-only meshes (tp | n_kv_heads); falling back to the "
+                f"dense cache on this mesh ({dict(mesh.shape)})",
                 file=sys.stderr,
             )
             paged = False
@@ -607,6 +619,19 @@ def generate(
             pool = jax.tree.map(
                 lambda x: jax.device_put(
                     x, NamedSharding(mesh, P(None, _DP, None, None, None))
+                ),
+                pool,
+            )
+        elif paged_tp > 1:
+            # Global pool, head axis tp-sharded — each device holds every
+            # page's slice of its own KV heads (same placement the dense
+            # tp cache uses).
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from adversarial_spec_tpu.parallel.mesh import TP as _TP
+
+            pool = jax.tree.map(
+                lambda x: jax.device_put(
+                    x, NamedSharding(mesh, P(None, None, _TP, None, None))
                 ),
                 pool,
             )
@@ -878,7 +903,14 @@ def generate(
                     mesh, *chunk_args, **static_kw
                 )
                 if paged_dp > 1
-                else scheduler_decode_chunk(*chunk_args, **static_kw)
+                # tp-only meshes: the kernel runs under shard_map inside
+                # the GSPMD program (head-sharded pool); the dp path
+                # above shards whole per-device pools instead.
+                else scheduler_decode_chunk(
+                    *chunk_args,
+                    **static_kw,
+                    mesh=mesh if paged_tp > 1 else None,
+                )
             )
             step = jnp.max(paged_n_emitted)
             finished = ~paged_active

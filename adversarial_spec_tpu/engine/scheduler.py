@@ -100,6 +100,7 @@ class SchedResult:
         "use_top_p",
         "use_pallas",
         "pallas_interpret",
+        "mesh",
     ),
     donate_argnames=("pool", "out_buf"),
 )
@@ -126,6 +127,7 @@ def scheduler_decode_chunk(
     use_top_p: bool = True,
     use_pallas: bool = False,
     pallas_interpret: bool = False,
+    mesh=None,
 ):
     """Up to ``chunk`` decode steps over whatever rows are active.
 
@@ -166,6 +168,7 @@ def scheduler_decode_chunk(
             q_pos,
             use_pallas=use_pallas,
             pallas_interpret=pallas_interpret,
+            mesh=mesh,
         )
         key, sub = jax.random.split(key)
         nxt = sample_tokens(

@@ -577,6 +577,7 @@ def forward_paged_decode(
     *,
     use_pallas: bool = False,
     pallas_interpret: bool = False,
+    mesh=None,
 ) -> tuple[jnp.ndarray, Cache]:
     """One decode step over the PAGED KV pool.
 
@@ -586,6 +587,12 @@ def forward_paged_decode(
     table — the fused Pallas kernel on real TPUs, a gather + masked jnp
     reference path elsewhere (both against the same bounds semantics).
     Returns (logits [B, 1, vocab], updated pool).
+
+    On a multi-device ``mesh`` the kernel runs under shard_map with the
+    pool's head axis tp-sharded (ops/pallas_paged.py:
+    paged_decode_attention_tp); callers gate on tp | n_kv_heads. The
+    non-kernel math (projections, scatter, gather path) partitions
+    under GSPMD as usual.
     """
     B = tokens.shape[0]
     page_size = pool["k"].shape[3]
@@ -631,22 +638,37 @@ def forward_paged_decode(
         if use_pallas:
             from adversarial_spec_tpu.ops.pallas_paged import (
                 paged_decode_attention,
+                paged_decode_attention_tp,
             )
 
             qkw = (
                 dict(k_scale=ks_pages, v_scale=vs_pages) if quant_kv else {}
             )
-            out = paged_decode_attention(
-                q[:, 0],
-                k_pages,
-                v_pages,
-                page_table,
-                layer_bounds,
-                attn_softcap=cfg.attn_softcap,
-                scale=cfg.attn_scale,
-                interpret=pallas_interpret,
-                **qkw,
-            )[:, None]
+            if mesh is not None and mesh.size > 1:
+                out = paged_decode_attention_tp(
+                    q[:, 0],
+                    k_pages,
+                    v_pages,
+                    page_table,
+                    layer_bounds,
+                    mesh,
+                    attn_softcap=cfg.attn_softcap,
+                    scale=cfg.attn_scale,
+                    interpret=pallas_interpret,
+                    **qkw,
+                )[:, None]
+            else:
+                out = paged_decode_attention(
+                    q[:, 0],
+                    k_pages,
+                    v_pages,
+                    page_table,
+                    layer_bounds,
+                    attn_softcap=cfg.attn_softcap,
+                    scale=cfg.attn_scale,
+                    interpret=pallas_interpret,
+                    **qkw,
+                )[:, None]
         else:
             # Gather reference path: page table → dense [B, Hkv, T, D].
             safe_table = jnp.maximum(page_table, 0)

@@ -187,3 +187,65 @@ def paged_decode_attention(
     )(bounds, page_table, *operands)
 
     return out[:, :, :g, :].reshape(B, Hq, D)
+
+
+def paged_decode_attention_tp(
+    q: jnp.ndarray,  # [B, Hq, D]
+    k_pages: jnp.ndarray,  # [n_pages, Hkv, page_size, D]
+    v_pages: jnp.ndarray,  # [n_pages, Hkv, page_size, D]
+    page_table: jnp.ndarray,  # [B, P] GLOBAL physical ids
+    bounds: jnp.ndarray,  # [B, 2]
+    mesh,
+    attn_softcap: float = 0.0,
+    scale: float | None = None,
+    interpret: bool = False,
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Fused paged decode attention with the HEAD axis tp-sharded.
+
+    The paged-pool counterpart of ops/pallas_decode.py:
+    decode_attention_tp: GSPMD cannot partition a pallas_call, so
+    tp-sharded paged configs (BASELINE 5: TP over a 70B judge) would
+    fall back to the gather path. shard_map splits the pool's Hkv axis
+    (and q's head axis) over ``tp``; the page table and bounds replicate
+    — every device reads the same pages, its own head slice. GQA groups
+    stay device-local (callers gate on tp | n_kv_heads), so there are no
+    collectives in the kernel. The batch axis stays UNSHARDED here: the
+    global-page-table layout has no per-device page locality (dp-local
+    pools are the scheduler's sharded path, engine/scheduler.py).
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from adversarial_spec_tpu.parallel.mesh import TP
+
+    kernel = functools.partial(
+        paged_decode_attention,
+        attn_softcap=attn_softcap,
+        scale=scale,
+        interpret=interpret,
+    )
+    in_specs = [
+        P(None, TP, None),  # q: heads over tp
+        P(None, TP, None, None),  # pages: Hkv over tp
+        P(None, TP, None, None),
+        P(None, None),  # table: replicated
+        P(None, None),  # bounds: replicated
+    ]
+    operands = [q, k_pages, v_pages, page_table, bounds]
+    if k_scale is not None:
+        fn = lambda q_, k_, v_, t_, b_, ks_, vs_: kernel(  # noqa: E731
+            q_, k_, v_, t_, b_, k_scale=ks_, v_scale=vs_
+        )
+        in_specs += [P(None, TP, None, None), P(None, TP, None, None)]
+        operands += [k_scale, v_scale]
+    else:
+        fn = kernel
+    return shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=P(None, TP, None),
+        check_rep=False,
+    )(*operands)

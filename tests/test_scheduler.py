@@ -185,24 +185,6 @@ class TestPagedUnderDp:
         np.testing.assert_array_equal(ref.tokens, out.tokens)
         np.testing.assert_array_equal(ref.n_generated, out.n_generated)
 
-    def test_paged_tp_mesh_falls_back_to_dense(self, capsys):
-        from adversarial_spec_tpu.parallel.mesh import make_mesh
-        from adversarial_spec_tpu.parallel.sharding import shard_params
-
-        cfg = get_config("llama", "tiny")
-        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
-        prompts = [[1, 5], [2, 6]]
-        mesh = make_mesh({"tp": 2})
-        sharded = shard_params(mesh, params)
-        with mesh:
-            out = generate(
-                sharded, cfg, prompts, mesh=mesh,
-                max_new_tokens=4, eos_ids=[], greedy=True,
-                paged=True, page_size=16, speculative=False,
-            )
-        assert out.tokens.shape == (2, 4)
-        assert "dp only" in capsys.readouterr().err
-
 
 class TestChunkedPrefillInterleave:
     """Admission prefill no longer pauses decode: a multi-chunk prompt's
@@ -291,3 +273,84 @@ class TestBatcherInt8Pool:
             results[0].tokens,
             np.asarray(ref.tokens[0, : ref.n_generated[0]]),
         )
+
+
+class TestPagedUnderTp:
+    def test_paged_tp_matches_single_device(self, tiny_model):
+        """Paged decode on a tp-only mesh (head-sharded global pool, the
+        fused kernel under shard_map in interpret mode) must reproduce
+        single-device paged tokens."""
+        if len(jax.devices()) < 2:
+            pytest.skip("requires 2 virtual devices")
+        from adversarial_spec_tpu.parallel.mesh import make_mesh
+        from adversarial_spec_tpu.parallel.sharding import shard_params
+
+        params, cfg = tiny_model  # n_kv_heads=2 → tp=2 divides
+        prompts = [[1, 5, 9, 3, 7, 2], [4, 4, 8]]
+        kw = dict(
+            max_new_tokens=8, eos_ids=[], greedy=True,
+            paged=True, page_size=16, speculative=False,
+            share_prefix=False,
+        )
+        ref = generate(params, cfg, prompts, **kw)
+        mesh = make_mesh({"tp": 2})
+        sharded = shard_params(mesh, params)
+        with mesh:
+            # Exercise the shard_mapped KERNEL (interpret on CPU), not
+            # just the GSPMD gather path.
+            out = generate(
+                sharded, cfg, prompts, mesh=mesh,
+                use_pallas_decode=True, **kw
+            )
+        np.testing.assert_array_equal(ref.tokens, out.tokens)
+        # And the gather path for completeness.
+        with mesh:
+            out2 = generate(
+                sharded, cfg, prompts, mesh=mesh,
+                use_pallas_decode=False, **kw
+            )
+        np.testing.assert_array_equal(ref.tokens, out2.tokens)
+
+    def test_paged_tp_int8_pool(self, tiny_model):
+        """int8 pages compose with the tp-sharded pool."""
+        if len(jax.devices()) < 2:
+            pytest.skip("requires 2 virtual devices")
+        from adversarial_spec_tpu.parallel.mesh import make_mesh
+        from adversarial_spec_tpu.parallel.sharding import shard_params
+
+        params, cfg = tiny_model
+        prompts = [[1, 5, 9, 3, 7, 2], [4, 4, 8]]
+        kw = dict(
+            max_new_tokens=6, eos_ids=[], greedy=True,
+            paged=True, page_size=16, speculative=False,
+            share_prefix=False, kv_dtype="int8",
+        )
+        ref = generate(params, cfg, prompts, **kw)
+        mesh = make_mesh({"tp": 2})
+        sharded = shard_params(mesh, params)
+        with mesh:
+            out = generate(
+                sharded, cfg, prompts, mesh=mesh,
+                use_pallas_decode=True, **kw
+            )
+        np.testing.assert_array_equal(ref.tokens, out.tokens)
+
+    def test_paged_mixed_mesh_falls_back_dense(self, tiny_model, capsys):
+        """dp×tp mixed meshes still warn + fall back to the dense cache."""
+        if len(jax.devices()) < 4:
+            pytest.skip("requires 4 virtual devices")
+        from adversarial_spec_tpu.parallel.mesh import make_mesh
+        from adversarial_spec_tpu.parallel.sharding import shard_params
+
+        params, cfg = tiny_model
+        prompts = [[1, 5, 9], [2, 6], [8, 8], [4]]
+        mesh = make_mesh({"dp": 2, "tp": 2})
+        sharded = shard_params(mesh, params)
+        with mesh:
+            out = generate(
+                sharded, cfg, prompts, mesh=mesh,
+                max_new_tokens=4, eos_ids=[], greedy=True,
+                paged=True, speculative=False,
+            )
+        assert out.tokens.shape[0] == 4
+        assert "falling back to the dense cache" in capsys.readouterr().err
