@@ -8,8 +8,10 @@ stacking, no transposes, no torch-layout work). The debate-state tier
 (sessions/round snapshots, debate/session.py) is unchanged and independent.
 
 Cache location: ``<checkpoint_dir>/.native-cache/<fingerprint>`` beside the
-HF checkpoint, fingerprinted by family/size/dtype/quant so a config change
-never reads a stale layout.
+HF checkpoint, fingerprinted by family/size/dtype/quant — plus the
+transposed-head flag when the config ties embeddings (the flag adds an
+``lm_head_t`` leaf, i.e. changes the pytree layout) — so neither a config
+change nor an env toggle ever reads a stale layout.
 """
 
 from __future__ import annotations
@@ -37,12 +39,31 @@ def _source_stat(checkpoint: str) -> list:
     return entries
 
 
+def transposed_head_flag() -> bool:
+    """ONE reading of ADVSPEC_TRANSPOSED_HEAD (default on) — the cache
+    fingerprint, the restore template, and the HF loader must all parse
+    it identically or caches thrash (save one layout, template another)."""
+    return os.environ.get("ADVSPEC_TRANSPOSED_HEAD", "1") != "0"
+
+
 def cache_dir_for(
-    checkpoint: str, family: str, size: str, dtype: str, quant: str = ""
+    checkpoint: str,
+    family: str,
+    size: str,
+    dtype: str,
+    quant: str = "",
+    tied_embeddings: bool = False,
 ) -> Path:
+    # For tied-embedding configs the transposed-head flag changes the
+    # pytree LAYOUT (extra lm_head_t leaf), so it must be part of the
+    # fingerprint: toggling ADVSPEC_TRANSPOSED_HEAD must select a
+    # different cache dir, not thrash or silently serve the old layout.
+    # Untied configs have identical layout under both flag values — keep
+    # their fingerprint flag-independent (no spurious reconversion).
+    t_head = tied_embeddings and transposed_head_flag()
     fingerprint = hashlib.sha1(
         json.dumps(
-            [family, size, dtype, quant, _source_stat(checkpoint)]
+            [family, size, dtype, quant, int(t_head), _source_stat(checkpoint)]
         ).encode()
     ).hexdigest()[:12]
     return Path(checkpoint) / ".native-cache" / fingerprint

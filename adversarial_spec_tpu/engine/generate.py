@@ -385,9 +385,21 @@ def generate(
     # Sampling draws full-vocab uniforms every step (gumbel-max
     # categorical); threefry is pure ALU and shows up at 128k vocab. The
     # TPU's hardware RNG ("rbg") generates the same bits-shape orders of
-    # magnitude cheaper. Streams differ between impls, so seeds are
-    # reproducible per platform, not across platforms (never promised).
-    impl = "rbg" if jax.default_backend() == "tpu" else "threefry2x32"
+    # magnitude cheaper. Tradeoffs, deliberate: (1) streams differ
+    # between impls, so seeds are reproducible per platform, not across
+    # platforms (never promised); (2) JAX only guarantees independent
+    # streams after split/fold_in for threefry — this loop splits per
+    # chunk and the dp wrappers fold_in per device, so rbg streams carry
+    # a weaker (empirical, not proven) independence guarantee. For
+    # sampling diversity in a debate round that is acceptable; callers
+    # needing threefry's guarantees set ADVSPEC_PRNG=threefry (the full
+    # impl string "threefry2x32" is accepted too).
+    impl = (
+        "rbg"
+        if jax.default_backend() == "tpu"
+        and not os.environ.get("ADVSPEC_PRNG", "rbg").startswith("threefry")
+        else "threefry2x32"
+    )
     key = jax.random.key(seed, impl=impl)
     key, prefill_key = jax.random.split(key)
     temp = jnp.float32(temperature)
@@ -689,11 +701,19 @@ def generate(
     if mesh is not None and mesh.size > 1:
         from adversarial_spec_tpu.parallel.mesh import DP as _SPEC_DP
 
-        spec_dp = (
-            mesh.shape[_SPEC_DP]
-            if mesh.size == mesh.shape[_SPEC_DP]
-            else 0  # tp/sp present: speculation unsupported
-        )
+        # Speculation's host-side control flow (spec_mask, _steps_exit,
+        # catch-up targets) fetches steps_rows/finished with np.asarray;
+        # on a multi-host dp mesh those arrays span non-addressable
+        # devices and the fetch would raise. Keep speculation a
+        # single-host feature until those scalars are reduced on-device.
+        if jax.process_count() > 1:
+            spec_dp = 0
+        else:
+            spec_dp = (
+                mesh.shape[_SPEC_DP]
+                if mesh.size == mesh.shape[_SPEC_DP]
+                else 0  # tp/sp present: speculation unsupported
+            )
     use_spec = (
         speculative
         and not paged

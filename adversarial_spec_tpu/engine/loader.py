@@ -19,13 +19,13 @@ hard part (c): 70B within host RAM).
 from __future__ import annotations
 
 import json
-import os
 from pathlib import Path
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from adversarial_spec_tpu.engine.checkpoint import transposed_head_flag
 from adversarial_spec_tpu.models.config import ModelConfig, get_config
 from adversarial_spec_tpu.models.transformer import Params, init_params
 
@@ -161,9 +161,7 @@ def load_hf_checkpoint(
         ),
     }
     if transposed_head is None:
-        transposed_head = (
-            os.environ.get("ADVSPEC_TRANSPOSED_HEAD", "1") != "0"
-        )
+        transposed_head = transposed_head_flag()
     if not cfg.tied_embeddings:
         head = np.asarray(_read_tensor(files, "lm_head.weight")).T
         params["lm_head"] = put(("lm_head",), head)

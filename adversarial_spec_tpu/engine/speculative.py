@@ -33,12 +33,15 @@ Because rows accept different draft counts, they desynchronize — after any
 speculative phase the tail must finish on ``rowwise_decode_steps`` (per-row
 cache slots), not the shared-slot loop in engine/generate.py.
 
-Scope: dense KV cache, single-device. On TPU the verification forward
-runs the MULTI-QUERY fused kernel (ops/pallas_decode.py:
-decode_attention_mq — the whole γ+1 span in one pass over the KV cache)
-and the tail loop the single-query kernel, so speculation no longer
-costs the fused-attention path (round-1's shortcut). int8-KV spans fall
-back to the jnp mask path inside forward().
+Scope: dense KV cache; single device, or a single-host dp-only mesh via
+the ``*_dp`` shard_mapped wrappers below (rows shard over dp, each
+device runs its own accept loop — per-row desync never crosses devices).
+On TPU the verification forward runs the MULTI-QUERY fused kernel
+(ops/pallas_decode.py:decode_attention_mq — the whole γ+1 span in one
+pass over the KV cache) and the tail loop the single-query kernel, so
+speculation no longer costs the fused-attention path (round-1's
+shortcut). int8 KV composes: the MQ kernel reads int8 tiles and
+dequantizes in-kernel.
 
 EOS contract (mirror of generate._sample_step — change BOTH together):
 the EOS token itself is kept in the output; slots after it emit 0.

@@ -130,22 +130,34 @@ class TpuEngine:
         import sys
 
         quantize = spec.quant == "int8"
+        cfg = get_config(spec.family, spec.size, max_seq_len=spec.max_seq_len)
         cache_path = None
         if spec.checkpoint != "random":
             cache_path = ckpt_mod.cache_dir_for(
-                spec.checkpoint, spec.family, spec.size, spec.dtype, spec.quant
+                spec.checkpoint,
+                spec.family,
+                spec.size,
+                spec.dtype,
+                spec.quant,
+                tied_embeddings=cfg.tied_embeddings,
             )
         if cache_path is not None and ckpt_mod.has_native(cache_path):
             # Cache is an optimization in BOTH directions: a corrupt or
             # layout-incompatible cache falls back to HF conversion
             # instead of permanently breaking the model.
             try:
-                cfg = get_config(
-                    spec.family, spec.size, max_seq_len=spec.max_seq_len
-                )
+                # The restore template must match the layout the cache was
+                # SAVED with: same transposed-head flag reading as
+                # load_hf_checkpoint and the cache fingerprint (a toggled
+                # env selects a different cache dir rather than failing
+                # restore against this template).
+                t_head = ckpt_mod.transposed_head_flag()
 
                 def build():
-                    p = init_params(jax.random.key(0), cfg, dtype)
+                    p = init_params(
+                        jax.random.key(0), cfg, dtype,
+                        transposed_head=t_head,
+                    )
                     return quantize_params(p) if quantize else p
 
                 shapes = jax.eval_shape(build)

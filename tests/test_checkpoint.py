@@ -55,6 +55,23 @@ class TestNativeCacheRoundtrip:
         assert len({a.name, b.name, c.name}) == 3
         assert a.parent == b.parent == c.parent
 
+    def test_fingerprint_transposed_head_flag(self, monkeypatch):
+        """The ADVSPEC_TRANSPOSED_HEAD toggle changes the pytree layout
+        ONLY for tied-embedding configs — the fingerprint must follow
+        exactly that (ADVICE r2: a template/cache layout mismatch caused
+        permanent cache thrash; an untied flag-sensitivity would cause
+        spurious reconversion)."""
+        kw = dict(dtype="bfloat16", tied_embeddings=True)
+        monkeypatch.setenv("ADVSPEC_TRANSPOSED_HEAD", "1")
+        tied_on = ckpt_mod.cache_dir_for("/x", "llama", "1b", **kw)
+        untied_on = ckpt_mod.cache_dir_for("/x", "llama", "1b", "bfloat16")
+        monkeypatch.setenv("ADVSPEC_TRANSPOSED_HEAD", "0")
+        tied_off = ckpt_mod.cache_dir_for("/x", "llama", "1b", **kw)
+        untied_off = ckpt_mod.cache_dir_for("/x", "llama", "1b", "bfloat16")
+        assert tied_on.name != tied_off.name  # layout differs → new dir
+        assert untied_on.name == untied_off.name  # same layout → same dir
+        assert tied_off.name == untied_off.name  # both lack lm_head_t
+
     def test_atomic_save_no_tmp_left(self, tmp_path):
         cfg = get_config("llama", "tiny")
         params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)

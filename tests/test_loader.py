@@ -206,7 +206,9 @@ print("PEAK", peak)
         # Peak numpy staging is a small constant times the largest
         # single staged buffer (buffer + one in-flight copy + slack) —
         # NOT the checkpoint size, which a read-everything loader would
-        # hit. The margin (3x vs the ~4.3x total/max_staged ratio here)
-        # is what 70B-within-host-RAM rests on.
-        assert peak < 3 * max_staged, (peak, max_staged)
-        assert peak < 0.6 * total, (peak, total)
+        # hit (peak ≈ total ≈ 4.25x max_staged at this config). Measured
+        # steady-state is ~2.4-2.9x max_staged; the 3.5x/0.75x margins
+        # absorb allocator noise while still rejecting read-everything —
+        # the property 70B-within-host-RAM rests on.
+        assert peak < 3.5 * max_staged, (peak, max_staged)
+        assert peak < 0.75 * total, (peak, total)
