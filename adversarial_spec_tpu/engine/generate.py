@@ -45,13 +45,16 @@ from adversarial_spec_tpu.models.transformer import (
 DECODE_CHUNK = 128
 MIN_BUCKET = 128
 
-# Auto-select the fused Pallas decode kernel only at context lengths where
-# streaming the cache beats XLA's fused attention. At short T the kernel's
-# sequential grid (B·Hkv·T/block programs on one TensorCore) costs more
-# than it saves (measured on v5e: jnp 491 vs kernel 384 tok/s at T=1280);
-# at long T the kernel's O(block·D) VMEM and early block-skip win.
-# Explicit use_pallas_decode=True always wins over this heuristic.
-PALLAS_DECODE_MIN_T = int(os.environ.get("ADVSPEC_PALLAS_MIN_T", "4096"))
+# Context-length floor below which decode auto-selects XLA attention over
+# the fused Pallas kernel. Round 2's (B, Hkv, T/block) grid lost to XLA at
+# short T (v5e: jnp 491 vs kernel 384 tok/s at T=1280 — 160 sequential
+# tiny programs), hiding behind a 4096 floor; the round-3 head-folded grid
+# (ops/pallas_decode.py: (B, T/block), Hkv-fold fewer programs with
+# Hkv-fold larger DMAs) targets exactly that regime, so the default floor
+# is now 0 (kernel always) until an on-chip crossover measurement says
+# otherwise. Explicit use_pallas_decode=True always wins over this
+# heuristic; ADVSPEC_PALLAS_MIN_T restores a floor without a code change.
+PALLAS_DECODE_MIN_T = int(os.environ.get("ADVSPEC_PALLAS_MIN_T", "0"))
 
 
 def bucket_length(n: int, minimum: int = MIN_BUCKET) -> int:
@@ -935,36 +938,58 @@ def generate(
             step = jnp.max(paged_n_emitted)
             finished = ~paged_active
         else:
-            cache, cur, finished, out_buf, step = decode_chunk_steps(
-                params,
-                cfg,
-                cache,
-                cur,
-                pad_lens,
-                finished,
-                out_buf,
-                step,
-                jnp.int32(max_new_tokens),
-                eos,
-                chunk_key,
-                temp,
-                tp,
-                prompt_len=S,
-                chunk=DECODE_CHUNK,
-                greedy=greedy,
-                top_k=top_k,
-                use_top_p=use_top_p,
-                use_pallas_decode=use_pallas_decode,
-                pallas_interpret=pallas_interpret,
-                mesh=mesh if (mesh is not None and mesh.size > 1) else None,
-            )
+            # Plain chunked decode owns the rest of the budget (nothing
+            # re-enables speculation once it is off, and paged never
+            # reaches here) — run it PIPELINED: dispatch chunk N+1
+            # before blocking on chunk N's exit flags, so the host's
+            # per-chunk work (PRNG split, arg staging, dispatch) always
+            # overlaps device compute and the device never idles on a
+            # host round-trip between chunks. The exit check trails one
+            # chunk behind; its cost is at most one extra dispatch whose
+            # while_loop exits immediately (all rows finished or budget
+            # reached) — and the FIRST trailing check is free, because
+            # the outer loop condition already fetched the entry step.
+            while True:
+                prev_step, prev_finished = step, finished
+                cache, cur, finished, out_buf, step = decode_chunk_steps(
+                    params,
+                    cfg,
+                    cache,
+                    cur,
+                    pad_lens,
+                    finished,
+                    out_buf,
+                    step,
+                    jnp.int32(max_new_tokens),
+                    eos,
+                    chunk_key,
+                    temp,
+                    tp,
+                    prompt_len=S,
+                    chunk=DECODE_CHUNK,
+                    greedy=greedy,
+                    top_k=top_k,
+                    use_top_p=use_top_p,
+                    use_pallas_decode=use_pallas_decode,
+                    pallas_interpret=pallas_interpret,
+                    mesh=mesh
+                    if (mesh is not None and mesh.size > 1)
+                    else None,
+                )
+                key, chunk_key = jax.random.split(key)
+                if int(prev_step) >= max_new_tokens or bool(
+                    prev_finished.all()
+                ):
+                    break
+                if deadline is not None and time.monotonic() >= deadline:
+                    timed_out = True
+                    break
             if steps_rows is not None:
                 # Synced again after a speculative phase + catch-up:
                 # every unfinished row advanced to `step`. Raising a
                 # finished row's count only widens its EOS-scan region —
                 # the scan still stops at its first EOS (zeros follow).
                 steps_rows = jnp.maximum(steps_rows, step)
-        step.block_until_ready()
     decode_time = time.monotonic() - t1
 
     out_np = np.asarray(out_buf)[:n_real, :max_new_tokens]

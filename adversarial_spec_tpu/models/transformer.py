@@ -37,6 +37,7 @@ star).
 from __future__ import annotations
 
 import math
+import os
 from typing import Any
 
 import jax
@@ -48,6 +49,16 @@ from adversarial_spec_tpu.ops.rope import apply_rope, rope_angles
 
 Params = dict[str, Any]
 Cache = dict[str, jnp.ndarray]
+
+# Unroll factor for the scan-over-layers during DECODE (token spans ≤ this
+# many positions). Single-token layers are HBM-bound (stream the layer's
+# weights, tiny compute); a rolled scan serializes layer i's compute behind
+# layer i's weight fetch, while a modest unroll lets XLA software-pipeline
+# layer i+1's weight DMA under layer i's compute. Prefill keeps the rolled
+# scan: its per-layer compute is MXU-bound and compile time stays flat for
+# 80-layer configs.
+_DECODE_UNROLL = int(os.environ.get("ADVSPEC_DECODE_UNROLL", "4"))
+_DECODE_UNROLL_MAX_SPAN = 16
 
 
 def init_params(
@@ -521,9 +532,13 @@ def forward(
         return x, cache_l
 
     # The cache dict scans as a pytree: every leaf carries a leading
-    # n_layers axis, so one scan serves both cache layouts.
+    # n_layers axis, so one scan serves both cache layouts. Decode spans
+    # unroll (see _DECODE_UNROLL) so weight DMA pipelines across layers.
     x, new_cache = jax.lax.scan(
-        layer_body, x, (params["layers"], layer_ids, cache)
+        layer_body,
+        x,
+        (params["layers"], layer_ids, cache),
+        unroll=_DECODE_UNROLL if S <= _DECODE_UNROLL_MAX_SPAN else 1,
     )
 
     logits = _lm_head_logits(params, cfg, x, lm_head_last_only)
@@ -719,9 +734,13 @@ def forward_paged_decode(
         return x, new_l
 
     # The pool dict scans as a pytree (same pattern as forward()'s
-    # cache): one scan serves both the raw and int8 layouts.
+    # cache): one scan serves both the raw and int8 layouts. Always a
+    # decode step here (S=1) → always unrolled for weight-DMA pipelining.
     x, new_pool = jax.lax.scan(
-        layer_body, x, (params["layers"], layer_ids, pool)
+        layer_body,
+        x,
+        (params["layers"], layer_ids, pool),
+        unroll=_DECODE_UNROLL,
     )
     logits = _lm_head_logits(params, cfg, x, lm_head_last_only=False)
     return logits, new_pool

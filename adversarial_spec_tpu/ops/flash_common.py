@@ -13,6 +13,55 @@ import jax
 import jax.numpy as jnp
 
 
+def flash_update_heads(
+    q_ref,  # VMEM ref [1, n_kv, G, D]
+    k_ref,  # VMEM ref [1, n_kv, Tb, D]
+    v_ref,  # VMEM ref [1, n_kv, Tb, D]
+    ks_ref,  # VMEM ref [1, n_kv, Tb, 1] or None (int8 KV scales)
+    vs_ref,  # VMEM ref [1, n_kv, Tb, 1] or None
+    m_ref,  # VMEM scratch [n_kv, G, 1]
+    l_ref,  # VMEM scratch [n_kv, G, 1]
+    acc_ref,  # VMEM scratch [n_kv, G, D]
+    t0,  # scalar: global slot index of this tile's first token
+    starts,  # scalar or [G, 1]: first valid slot per query row
+    ends,  # scalar or [G, 1]
+    *,
+    scale: float,
+    attn_softcap: float,
+) -> None:
+    """One online-softmax accumulation over a HEAD-FOLDED K/V tile.
+
+    The head-folded kernels (dense, multi-query, paged) all run this
+    static per-head loop — 2D dots per head against head slices of one
+    big resident tile (the fold is what makes each DMA large enough to
+    amortize); like ``flash_update`` itself, it must live in exactly one
+    place so the dense and paged paths can never drift numerically.
+    """
+    n_kv = q_ref.shape[1]
+    for h in range(n_kv):
+        q = q_ref[0, h].astype(jnp.float32) * scale
+        k = k_ref[0, h].astype(jnp.float32)
+        v = v_ref[0, h].astype(jnp.float32)
+        if ks_ref is not None:
+            k = k * ks_ref[0, h]  # [Tb, 1] broadcasts over D
+            v = v * vs_ref[0, h]
+        m, l, acc = flash_update(
+            q,
+            k,
+            v,
+            t0,
+            starts,
+            ends,
+            m_ref[h],
+            l_ref[h],
+            acc_ref[h],
+            attn_softcap=attn_softcap,
+        )
+        m_ref[h] = m
+        l_ref[h] = l
+        acc_ref[h] = acc
+
+
 def flash_update(
     q: jnp.ndarray,  # [G, D] f32, pre-scaled
     k: jnp.ndarray,  # [Tb, D] f32

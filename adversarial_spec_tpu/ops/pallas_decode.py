@@ -4,12 +4,20 @@ The decode hot loop's attention reads the whole KV cache once per step; the
 XLA fallback materializes [B, H, T] logits through HBM. This kernel fuses
 QK^T → online softmax → PV into one pass with the cache genuinely streamed:
 
-  grid = (B, Hkv, T/block_t); the T dimension lives IN THE GRID, so only
-  one [block_t, D] K tile and V tile are VMEM-resident at a time (Pallas
+  grid = (B, T/block_t); the T dimension lives IN THE GRID, so only one
+  [Hkv, block_t, D] K tile and V tile are VMEM-resident at a time (Pallas
   double-buffers the next tile's DMA behind the current tile's compute) —
-  VMEM stays O(block_t·D) regardless of context length, which is what
-  makes 16k+ contexts decodable. Each (row, KV-head) program holds the
-  g = Hq/Hkv query heads (padded to the f32 sublane tile of 8); the
+  VMEM stays O(Hkv·block_t·D) regardless of context length, which is what
+  makes 16k+ contexts decodable. Each row program folds ALL Hkv KV heads:
+  a static per-head loop over [g, D] query groups (g = Hq/Hkv, padded to
+  the f32 sublane tile of 8) against that head's K/V tile slice. Folding
+  the head axis into the program (rather than the grid, the round-2
+  design) matters at SHORT context — the north-star bench shape
+  (B=4, Hkv=8, T=1280) drops from 160 sequential programs moving 32 KB
+  tiles to 20 programs moving 256 KB tiles, so per-program dispatch
+  overhead and sub-DMA-granularity transfers stop dominating (measured
+  round 2: the 160-program grid LOST to XLA attention at T=1280, 384 vs
+  491 tok/s, and had to hide behind a context-length threshold). The
   online-softmax state (m, l, acc — ops/flash_common.py) persists in VMEM
   scratch across the sequential innermost grid dimension, initialized at
   block 0 and finalized at the last block. Per-row validity windows
@@ -35,17 +43,32 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from adversarial_spec_tpu.ops.flash_common import flash_update
+from adversarial_spec_tpu.ops.flash_common import flash_update_heads
 
-BLOCK_T = 256
 _SUBLANE = 8
+
+# Per-K-tile VMEM budget for block_t selection: tiles are [Hkv, block_t, D],
+# double-buffered, ×2 for K and V — 1 MiB per tile keeps the working set
+# ≈4 MiB, well inside a TensorCore's ~16 MiB VMEM with room for q/scratch.
+_TILE_VMEM_BUDGET = 1 << 20
+
+
+def _pick_block_t(T: int, n_kv: int, D: int, itemsize: int) -> int:
+    """Largest block that divides the (static) cache length AND keeps one
+    [Hkv, block_t, D] tile under the VMEM budget."""
+    fit = [
+        c
+        for c in (512, 256, 128, 64, 32, 16, 8)
+        if n_kv * c * D * itemsize <= _TILE_VMEM_BUDGET
+    ]
+    return next((c for c in fit if T % c == 0), T)
 
 
 def _decode_attn_kernel(
     bounds_ref,  # SMEM [B, 2] int32: (start, end) valid-slot window per row
-    q_ref,  # VMEM [1, 1, G8, D]
-    k_ref,  # VMEM [1, 1, block_t, D] — one streamed tile (heads-major cache)
-    v_ref,  # VMEM [1, 1, block_t, D]
+    q_ref,  # VMEM [1, Hkv, G8, D]
+    k_ref,  # VMEM [1, Hkv, block_t, D] — one streamed tile (heads-major)
+    v_ref,  # VMEM [1, Hkv, block_t, D]
     *rest,  # [ks_ref, vs_ref,] o_ref, m_ref, l_ref, acc_ref
     scale: float,
     attn_softcap: float,
@@ -61,15 +84,15 @@ def _decode_attn_kernel(
     else:
         o_ref, m_ref, l_ref, acc_ref = rest
     b = pl.program_id(0)
-    t = pl.program_id(2)
-    n_blocks = pl.num_programs(2)
-    G8, D = q_ref.shape[2], q_ref.shape[3]
+    t = pl.program_id(1)
+    n_blocks = pl.num_programs(1)
+    n_kv, G8, D = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
 
     @pl.when(t == 0)
     def _init():
-        m_ref[:] = jnp.full((G8, 1), -jnp.inf, jnp.float32)
-        l_ref[:] = jnp.zeros((G8, 1), jnp.float32)
-        acc_ref[:] = jnp.zeros((G8, D), jnp.float32)
+        m_ref[:] = jnp.full((n_kv, G8, 1), -jnp.inf, jnp.float32)
+        l_ref[:] = jnp.zeros((n_kv, G8, 1), jnp.float32)
+        acc_ref[:] = jnp.zeros((n_kv, G8, D), jnp.float32)
 
     start = bounds_ref[b, 0]
     end = bounds_ref[b, 1]
@@ -79,42 +102,37 @@ def _decode_attn_kernel(
     # lands — block skipping is a masking optimization, not a gather).
     @pl.when((t0 < end) & (t0 + block_t > start))
     def _accumulate():
-        q = q_ref[0, 0].astype(jnp.float32) * scale
-        k = k_ref[0, 0].astype(jnp.float32)  # [block_t, D]
-        v = v_ref[0, 0].astype(jnp.float32)
-        if quantized:
-            k = k * ks_ref[0, 0]  # [block_t, 1] broadcasts over D
-            v = v * vs_ref[0, 0]
-        m, l, acc = flash_update(
-            q,
-            k,
-            v,
+        flash_update_heads(
+            q_ref,
+            k_ref,
+            v_ref,
+            ks_ref if quantized else None,
+            vs_ref if quantized else None,
+            m_ref,
+            l_ref,
+            acc_ref,
             t0,
             start,
             end,
-            m_ref[:],
-            l_ref[:],
-            acc_ref[:],
+            scale=scale,
             attn_softcap=attn_softcap,
         )
-        m_ref[:] = m
-        l_ref[:] = l
-        acc_ref[:] = acc
 
     @pl.when(t == n_blocks - 1)
     def _finalize():
-        o_ref[0, 0] = (
+        o_ref[0] = (
             acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
         ).astype(o_ref.dtype)
 
 
 def _mq_attn_kernel(
-    bounds_ref,  # VMEM [1, G8, 2]: per (row-of-program) [start, end).
+    bounds_ref,  # VMEM [1, G8, 2]: per (query-row) [start, end) — shared
+    # by every KV head of the row (bounds are per query position).
     # VMEM, not SMEM scalar-prefetch: Mosaic can only load SCALARS from
     # SMEM, and this kernel needs the whole per-query bounds vector.
-    q_ref,  # VMEM [1, 1, G8, D] — G8 = pad(S·g) query rows
-    k_ref,  # VMEM [1, 1, block_t, D]
-    v_ref,  # VMEM [1, 1, block_t, D]
+    q_ref,  # VMEM [1, Hkv, G8, D] — G8 = pad(S·g) query rows per head
+    k_ref,  # VMEM [1, Hkv, block_t, D]
+    v_ref,  # VMEM [1, Hkv, block_t, D]
     *rest,  # [ks_ref, vs_ref,] o_ref, m_ref, l_ref, acc_ref
     scale: float,
     attn_softcap: float,
@@ -127,15 +145,15 @@ def _mq_attn_kernel(
         ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
     else:
         o_ref, m_ref, l_ref, acc_ref = rest
-    t = pl.program_id(2)
-    n_blocks = pl.num_programs(2)
-    G8, D = q_ref.shape[2], q_ref.shape[3]
+    t = pl.program_id(1)
+    n_blocks = pl.num_programs(1)
+    n_kv, G8, D = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
 
     @pl.when(t == 0)
     def _init():
-        m_ref[:] = jnp.full((G8, 1), -jnp.inf, jnp.float32)
-        l_ref[:] = jnp.zeros((G8, 1), jnp.float32)
-        acc_ref[:] = jnp.zeros((G8, D), jnp.float32)
+        m_ref[:] = jnp.full((n_kv, G8, 1), -jnp.inf, jnp.float32)
+        l_ref[:] = jnp.zeros((n_kv, G8, 1), jnp.float32)
+        acc_ref[:] = jnp.zeros((n_kv, G8, D), jnp.float32)
 
     starts = bounds_ref[0, :, 0]  # [G8]
     ends = bounds_ref[0, :, 1]
@@ -144,31 +162,25 @@ def _mq_attn_kernel(
     # Skip tiles wholly outside EVERY query's window.
     @pl.when((t0 < jnp.max(ends)) & (t0 + block_t > jnp.min(starts)))
     def _accumulate():
-        q = q_ref[0, 0].astype(jnp.float32) * scale
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        if quantized:
-            k = k * ks_ref[0, 0]  # [block_t, 1] broadcasts over D
-            v = v * vs_ref[0, 0]
-        m, l, acc = flash_update(
-            q,
-            k,
-            v,
+        flash_update_heads(
+            q_ref,
+            k_ref,
+            v_ref,
+            ks_ref if quantized else None,
+            vs_ref if quantized else None,
+            m_ref,
+            l_ref,
+            acc_ref,
             t0,
-            starts[:, None],  # per-row bounds broadcast inside
+            starts[:, None],  # per-query bounds broadcast inside
             ends[:, None],
-            m_ref[:],
-            l_ref[:],
-            acc_ref[:],
+            scale=scale,
             attn_softcap=attn_softcap,
         )
-        m_ref[:] = m
-        l_ref[:] = l
-        acc_ref[:] = acc
 
     @pl.when(t == n_blocks - 1)
     def _finalize():
-        o_ref[0, 0] = (
+        o_ref[0] = (
             acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
         ).astype(o_ref.dtype)
 
@@ -206,9 +218,7 @@ def decode_attention_mq(
     G8 = -(-rows // _SUBLANE) * _SUBLANE
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     quantized = k_scale is not None
-    block_t = next(
-        (b for b in (BLOCK_T, 128, 64, 32, 16, 8) if T % b == 0), T
-    )
+    block_t = _pick_block_t(T, Hkv, D, k_cache.dtype.itemsize)
 
     # [B, Hkv, S·g, D]: row r = query (r // g), group lane (r % g).
     qg = jnp.transpose(
@@ -235,21 +245,21 @@ def decode_attention_mq(
         bnd = bnd.at[:, rows:, 0].set(T)
 
     kv_spec = pl.BlockSpec(
-        (1, 1, block_t, D), lambda b, h, t: (b, h, t, 0)
+        (1, Hkv, block_t, D), lambda b, t: (b, 0, t, 0)
     )
     in_specs = [
         # Bounds ride in VMEM ([1, G8, 2] block — sublane G8 is a
         # multiple of 8, lane 2 spans the array) because the kernel
         # reads them as vectors; SMEM only serves scalar loads.
-        pl.BlockSpec((1, G8, 2), lambda b, h, t: (b, 0, 0)),
-        pl.BlockSpec((1, 1, G8, D), lambda b, h, t: (b, h, 0, 0)),
+        pl.BlockSpec((1, G8, 2), lambda b, t: (b, 0, 0)),
+        pl.BlockSpec((1, Hkv, G8, D), lambda b, t: (b, 0, 0, 0)),
         kv_spec,
         kv_spec,
     ]
     operands = [bnd, qg, k_cache, v_cache]
     if quantized:
         scale_spec = pl.BlockSpec(
-            (1, 1, block_t, 1), lambda b, h, t: (b, h, t, 0)
+            (1, Hkv, block_t, 1), lambda b, t: (b, 0, t, 0)
         )
         in_specs += [scale_spec, scale_spec]
         operands += [k_scale, v_scale]
@@ -261,15 +271,15 @@ def decode_attention_mq(
             block_t=block_t,
             quantized=quantized,
         ),
-        grid=(B, Hkv, T // block_t),
+        grid=(B, T // block_t),
         in_specs=in_specs,
         out_specs=pl.BlockSpec(
-            (1, 1, G8, D), lambda b, h, t: (b, h, 0, 0)
+            (1, Hkv, G8, D), lambda b, t: (b, 0, 0, 0)
         ),
         scratch_shapes=[
-            pltpu.VMEM((G8, 1), jnp.float32),
-            pltpu.VMEM((G8, 1), jnp.float32),
-            pltpu.VMEM((G8, D), jnp.float32),
+            pltpu.VMEM((Hkv, G8, 1), jnp.float32),
+            pltpu.VMEM((Hkv, G8, 1), jnp.float32),
+            pltpu.VMEM((Hkv, G8, D), jnp.float32),
         ],
         out_shape=jax.ShapeDtypeStruct((B, Hkv, G8, D), q.dtype),
         interpret=interpret,
@@ -374,10 +384,7 @@ def decode_attention(
     G8 = max(_SUBLANE, g)
     scale = scale if scale is not None else 1.0 / math.sqrt(D)
     quantized = k_scale is not None
-    # Largest tileable block that divides the (static) cache length.
-    block_t = next(
-        (b for b in (BLOCK_T, 128, 64, 32, 16, 8) if T % b == 0), T
-    )
+    block_t = _pick_block_t(T, Hkv, D, k_cache.dtype.itemsize)
 
     # [B, Hkv, G8, D] — query heads grouped under their KV head, padded to
     # the sublane tile. Pad rows attend to garbage harmlessly (dropped).
@@ -386,13 +393,13 @@ def decode_attention(
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, G8 - g), (0, 0)))
 
     kv_spec = pl.BlockSpec(
-        (1, 1, block_t, D), lambda b, h, t, _: (b, h, t, 0)
+        (1, Hkv, block_t, D), lambda b, t, _: (b, 0, t, 0)
     )
     scale_spec = pl.BlockSpec(
-        (1, 1, block_t, 1), lambda b, h, t, _: (b, h, t, 0)
+        (1, Hkv, block_t, 1), lambda b, t, _: (b, 0, t, 0)
     )
     in_specs = [
-        pl.BlockSpec((1, 1, G8, D), lambda b, h, t, _: (b, h, 0, 0)),
+        pl.BlockSpec((1, Hkv, G8, D), lambda b, t, _: (b, 0, 0, 0)),
         kv_spec,
         kv_spec,
     ]
@@ -401,7 +408,7 @@ def decode_attention(
         in_specs += [scale_spec, scale_spec]
         operands += [k_scale, v_scale]
 
-    grid = (B, Hkv, T // block_t)
+    grid = (B, T // block_t)
     out = pl.pallas_call(
         functools.partial(
             _decode_attn_kernel,
@@ -415,12 +422,12 @@ def decode_attention(
             grid=grid,
             in_specs=in_specs,
             out_specs=pl.BlockSpec(
-                (1, 1, G8, D), lambda b, h, t, _: (b, h, 0, 0)
+                (1, Hkv, G8, D), lambda b, t, _: (b, 0, 0, 0)
             ),
             scratch_shapes=[
-                pltpu.VMEM((G8, 1), jnp.float32),
-                pltpu.VMEM((G8, 1), jnp.float32),
-                pltpu.VMEM((G8, D), jnp.float32),
+                pltpu.VMEM((Hkv, G8, 1), jnp.float32),
+                pltpu.VMEM((Hkv, G8, 1), jnp.float32),
+                pltpu.VMEM((Hkv, G8, D), jnp.float32),
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((B, Hkv, G8, D), q.dtype),

@@ -10,12 +10,16 @@ paging keeps HBM occupancy at O(tokens actually written) and makes
 prefix-sharing across opponents possible (same spec prompt → same pages,
 a planned optimization).
 
-Kernel shape: grid (B, Hkv, n_pages_per_seq); the page table rides in as a
+Kernel shape: grid (B, n_pages_per_seq); the page table rides in as a
 scalar-prefetch operand so each grid step's BlockSpec ``index_map`` selects
 the physical page to DMA next — the gather happens in the pipeline, not in
-the kernel body. Online-softmax state (m, l, acc) persists in VMEM scratch
-across the sequential innermost grid dimension: initialized at page 0,
-finalized and written at the last page.
+the kernel body. One physical page id selects the whole heads-major
+[Hkv, page_size, D] slab, so each program folds ALL KV heads (static
+per-head loop), mirroring ops/pallas_decode.py's short-context redesign:
+Hkv× fewer sequential programs and Hkv× larger DMAs than the round-2
+(B, Hkv, P) grid. Online-softmax state (m, l, acc) persists in VMEM
+scratch across the sequential innermost grid dimension: initialized at
+page 0, finalized and written at the last page.
 
 Tested under ``interpret=True`` on CPU against the dense jnp reference
 (tests/test_pallas.py).
@@ -31,7 +35,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from adversarial_spec_tpu.ops.flash_common import flash_update
+from adversarial_spec_tpu.ops.flash_common import flash_update_heads
 
 _SUBLANE = 8
 
@@ -39,9 +43,9 @@ _SUBLANE = 8
 def _paged_attn_kernel(
     bounds_ref,  # SMEM [B, 2]: (start, end) token window per row
     table_ref,  # SMEM [B, P]: physical page id per (row, logical page)
-    q_ref,  # VMEM [1, 1, G8, D]
-    k_ref,  # VMEM [1, 1, page, D] — page selected by index_map
-    v_ref,  # VMEM [1, 1, page, D]
+    q_ref,  # VMEM [1, Hkv, G8, D]
+    k_ref,  # VMEM [1, Hkv, page, D] — page slab selected by index_map
+    v_ref,  # VMEM [1, Hkv, page, D]
     *rest,  # [ks_ref, vs_ref,] o_ref, m_ref, l_ref, acc_ref
     scale: float,
     page_size: int,
@@ -56,15 +60,15 @@ def _paged_attn_kernel(
     else:
         o_ref, m_ref, l_ref, acc_ref = rest
     b = pl.program_id(0)
-    p = pl.program_id(2)
-    n_pages = pl.num_programs(2)
-    G8, D = q_ref.shape[2], q_ref.shape[3]
+    p = pl.program_id(1)
+    n_pages = pl.num_programs(1)
+    n_kv, G8, D = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
 
     @pl.when(p == 0)
     def _init():
-        m_ref[:] = jnp.full((G8, 1), -jnp.inf, jnp.float32)
-        l_ref[:] = jnp.zeros((G8, 1), jnp.float32)
-        acc_ref[:] = jnp.zeros((G8, D), jnp.float32)
+        m_ref[:] = jnp.full((n_kv, G8, 1), -jnp.inf, jnp.float32)
+        l_ref[:] = jnp.zeros((n_kv, G8, 1), jnp.float32)
+        acc_ref[:] = jnp.zeros((n_kv, G8, D), jnp.float32)
 
     start = bounds_ref[b, 0]
     end = bounds_ref[b, 1]
@@ -78,31 +82,25 @@ def _paged_attn_kernel(
     # nothing.
     @pl.when((page_id > 0) & (t0 < end))
     def _accumulate():
-        q = q_ref[0, 0].astype(jnp.float32) * scale
-        k = k_ref[0, 0].astype(jnp.float32)  # [page, D]
-        v = v_ref[0, 0].astype(jnp.float32)
-        if quantized:
-            k = k * ks_ref[0, 0]  # [page, 1] broadcasts over D
-            v = v * vs_ref[0, 0]
-        m, l, acc = flash_update(
-            q,
-            k,
-            v,
+        flash_update_heads(
+            q_ref,
+            k_ref,
+            v_ref,
+            ks_ref if quantized else None,
+            vs_ref if quantized else None,
+            m_ref,
+            l_ref,
+            acc_ref,
             t0,
             start,
             end,
-            m_ref[:],
-            l_ref[:],
-            acc_ref[:],
+            scale=scale,
             attn_softcap=attn_softcap,
         )
-        m_ref[:] = m
-        l_ref[:] = l
-        acc_ref[:] = acc
 
     @pl.when(p == n_pages - 1)
     def _finalize():
-        o_ref[0, 0] = (
+        o_ref[0] = (
             acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
         ).astype(o_ref.dtype)
 
@@ -146,18 +144,18 @@ def paged_decode_attention(
     if G8 != g:
         qg = jnp.pad(qg, ((0, 0), (0, 0), (0, G8 - g), (0, 0)))
 
-    def page_map(b, h, p, bounds_ref, table_ref):
-        return (jnp.maximum(table_ref[b, p], 0), h, 0, 0)
+    def page_map(b, p, bounds_ref, table_ref):
+        return (jnp.maximum(table_ref[b, p], 0), 0, 0, 0)
 
-    page_spec = pl.BlockSpec((1, 1, page_size, D), page_map)
+    page_spec = pl.BlockSpec((1, Hkv, page_size, D), page_map)
     in_specs = [
-        pl.BlockSpec((1, 1, G8, D), lambda b, h, p, *_: (b, h, 0, 0)),
+        pl.BlockSpec((1, Hkv, G8, D), lambda b, p, *_: (b, 0, 0, 0)),
         page_spec,
         page_spec,
     ]
     operands = [qg, k_pages, v_pages]
     if quantized:
-        scale_spec = pl.BlockSpec((1, 1, page_size, 1), page_map)
+        scale_spec = pl.BlockSpec((1, Hkv, page_size, 1), page_map)
         in_specs += [scale_spec, scale_spec]
         operands += [k_scale, v_scale]
 
@@ -171,15 +169,15 @@ def paged_decode_attention(
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
-            grid=(B, Hkv, P),
+            grid=(B, P),
             in_specs=in_specs,
             out_specs=pl.BlockSpec(
-                (1, 1, G8, D), lambda b, h, p, *_: (b, h, 0, 0)
+                (1, Hkv, G8, D), lambda b, p, *_: (b, 0, 0, 0)
             ),
             scratch_shapes=[
-                pltpu.VMEM((G8, 1), jnp.float32),
-                pltpu.VMEM((G8, 1), jnp.float32),
-                pltpu.VMEM((G8, D), jnp.float32),
+                pltpu.VMEM((Hkv, G8, 1), jnp.float32),
+                pltpu.VMEM((Hkv, G8, 1), jnp.float32),
+                pltpu.VMEM((Hkv, G8, D), jnp.float32),
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((B, Hkv, G8, D), q.dtype),

@@ -78,7 +78,7 @@ class TestDecodeKernel:
         )
 
     def test_non_block_aligned_window(self):
-        """Bounds crossing BLOCK_T boundaries mask correctly."""
+        """Bounds crossing block_t tile boundaries mask correctly."""
         q, k, v = self._rand(B=1, T_=512)
         bounds = jnp.array([[250, 270]], jnp.int32)  # spans block edge 256
         out = decode_attention(q, k, v, bounds, interpret=True)
