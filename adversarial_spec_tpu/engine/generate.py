@@ -304,9 +304,11 @@ def generate(
     ``paged``: decode against the paged KV pool (engine/kvcache.py +
     ops/pallas_paged.py) instead of the dense per-row cache — prompt KV is
     scattered into pages after prefill and every decode step writes through
-    the page table. Scales over dp-only meshes (per-device pools) and
-    tp-only meshes (head-sharded global pool, kernel under shard_map);
-    mixed dp×tp and sp meshes warn and use the dense path.
+    the page table. Scales over dp-only meshes (per-device pools,
+    independent per-device chunk loops), tp-only meshes (head-sharded
+    global pool, kernel under shard_map), and mixed dp×tp meshes (one
+    GSPMD chunk loop over a per-dp-slice pool layout, kernel under a
+    dp×tp shard_map); sp meshes warn and use the dense path.
 
     ``speculative``: prompt-lookup speculative decoding
     (engine/speculative.py) — greedy, single-row, dense-cache runs draft
@@ -413,15 +415,18 @@ def generate(
     deadline = time.monotonic() + timeout_s if timeout_s > 0 else None
     # Paged decode scales over dp (per-device page pools, zero cross-
     # device page traffic — engine/scheduler.py:
-    # sharded_scheduler_decode_chunk) and over tp-only meshes (global
+    # sharded_scheduler_decode_chunk), over tp-only meshes (global
     # pool, head axis tp-sharded, kernel under shard_map —
-    # ops/pallas_paged.py:paged_decode_attention_tp). Mixed dp×tp and
-    # sp fall back to dense. Resolve now so the prefill cache can be
-    # sized to the prompt only.
+    # ops/pallas_paged.py:paged_decode_attention_tp), and over mixed
+    # dp×tp meshes (per-dp-slice pool layout, GSPMD chunk loop, kernel
+    # under the dp×tp wrapper). sp falls back to dense. Resolve now so
+    # the prefill cache can be sized to the prompt only.
     paged_dp = paged_tp = 1
+    paged_mixed = False
     if paged and mesh is not None and mesh.size > 1:
         from adversarial_spec_tpu.parallel.mesh import (
             DP as _DP,
+            SP as _SP,
             TP as _TP,
         )
 
@@ -432,12 +437,23 @@ def generate(
             and cfg.n_kv_heads % mesh.shape[_TP] == 0
         ):
             paged_tp = mesh.shape[_TP]
+        elif (
+            mesh.shape[_SP] == 1
+            and cfg.n_kv_heads % mesh.shape[_TP] == 0
+        ):
+            # Mixed dp×tp (a v5e-8 at dp=4×tp=2): ONE GSPMD-partitioned
+            # chunk loop over a per-dp-slice pool layout — rows + page
+            # slabs shard over dp, heads over tp; the kernel runs under
+            # the dp×tp shard_map wrapper with global→local id shift
+            # (ops/pallas_paged.py:paged_decode_attention_dp_tp).
+            paged_tp = mesh.shape[_TP]
+            paged_mixed = True
         else:
             import sys
 
             print(
-                f"warning: paged KV decode shards over dp-only or "
-                f"tp-only meshes (tp | n_kv_heads); falling back to the "
+                f"warning: paged KV decode shards over dp/tp meshes "
+                f"with tp | n_kv_heads and no sp; falling back to the "
                 f"dense cache on this mesh ({dict(mesh.shape)})",
                 file=sys.stderr,
             )
@@ -583,25 +599,33 @@ def generate(
                 + 1
             )
             n_phys_pages = prompt_pages + B * decode_pages
-        elif paged_dp > 1:
-            # dp-sharded pool: device d's pool slice holds its OWN trash
-            # page 0 plus its rows' pages, and the table carries device-
-            # LOCAL ids (what the shard_mapped chunk loop indexes with).
-            # Migration below runs on the global pool, so it needs the
-            # global ids (local + device slice offset).
-            local_rows = B // paged_dp
+        elif paged_dp > 1 or paged_mixed:
+            # Per-dp-slice pool layout, shared by the dp-only and mixed
+            # dp×tp modes: slice d owns local pages [0, Lp) with local
+            # page 0 reserved as that slice's trash page (shard sizes
+            # stay equal); global id = local + d·Lp. The dp-only chunk
+            # loop is shard_mapped — each device indexes its LOCAL pool
+            # slice, so its table carries local ids and only the
+            # (global-pool) migration uses global ids. The mixed chunk
+            # loop runs under GSPMD — global view — so its table IS the
+            # global one, and the kernel wrapper shifts back to local
+            # (ops/pallas_paged.py:paged_decode_attention_dp_tp). The
+            # TRASH_PAGE=0 write redirect lands on slice 0's trash page,
+            # which no table ever references.
+            slice_dp = paged_dp if paged_dp > 1 else mesh.shape[_DP]
+            local_rows = B // slice_dp
             local_pool_pages = 1 + local_rows * n_pages_per_row
             lr = np.arange(B) % local_rows
-            table_np = (
+            dev = np.arange(B) // local_rows
+            local_table = (
                 1
                 + lr[:, None] * n_pages_per_row
                 + np.arange(n_pages_per_row)[None, :]
             ).astype(np.int32)
-            dev = np.arange(B) // local_rows
-            migrate_table_np = (
-                table_np + (dev * local_pool_pages)[:, None]
-            )
-            n_pool_pages = paged_dp * local_pool_pages
+            global_table = local_table + (dev * local_pool_pages)[:, None]
+            table_np = global_table if paged_mixed else local_table
+            migrate_table_np = global_table
+            n_pool_pages = slice_dp * local_pool_pages
         else:
             allocator = PageAllocator(B * n_pages_per_row, page_size)
             for b in range(B):
@@ -611,7 +635,7 @@ def generate(
                 allocator.table_array(list(range(B)), n_pages_per_row) + 1
             )
             n_phys_pages = B * n_pages_per_row
-        if paged_dp == 1:
+        if paged_dp == 1 and not paged_mixed:
             migrate_table_np = table_np
             n_pool_pages = n_phys_pages + 1  # +1: trash page 0
         page_table = jnp.asarray(table_np)
@@ -634,6 +658,20 @@ def generate(
             pool = jax.tree.map(
                 lambda x: jax.device_put(
                     x, NamedSharding(mesh, P(None, _DP, None, None, None))
+                ),
+                pool,
+            )
+        elif paged_mixed:
+            # Page slabs over dp (per-slice layout above), heads over tp.
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from adversarial_spec_tpu.parallel.mesh import (
+                DP as _DP,
+                TP as _TP,
+            )
+
+            pool = jax.tree.map(
+                lambda x: jax.device_put(
+                    x, NamedSharding(mesh, P(None, _DP, _TP, None, None))
                 ),
                 pool,
             )
@@ -688,21 +726,33 @@ def generate(
         paged_active = ~finished
 
     # Speculative eligibility: dense cache, enough output budget for at
-    # least one γ+1 span, and a single device OR a dp-only mesh (rows
-    # shard over dp and each device runs its own accept loop — per-row
-    # desync never crosses devices; tp/sp would need manual collectives
-    # inside the loop). Any batch size and any sampling mode qualify
-    # (per-row accept lengths + rejection sampling) — the bench shape
-    # (4 opponents, temperature 0.7) is the target workload. Composes
-    # with the fused kernels: verification spans run the multi-query
-    # kernel, the tail the single-query one.
+    # least one γ+1 span, single host, and a mesh without sp. Three
+    # execution modes (any batch size, any sampling mode — per-row
+    # accept lengths + rejection sampling; the bench shape of 4
+    # opponents at temperature 0.7 is the target workload):
+    #   - single device: plain jitted accept loop;
+    #   - dp-only mesh: shard_map wrappers (rows shard over dp, each
+    #     device runs its own INDEPENDENT accept loop — per-row desync
+    #     never crosses devices);
+    #   - tp present (tp-only or dp×tp, BASELINE config 5's 70B judge):
+    #     one GSPMD-partitioned program — tp forces device lockstep
+    #     anyway, so the layer matmuls shard via the params' Megatron
+    #     shardings and the compiler inserts the psums (mesh=… below).
+    # Composes with the fused kernels: the tail loop runs the
+    # single-query kernel (under its shard_map wrapper on meshes); the
+    # verification span runs the multi-query kernel single-device and
+    # the jnp attention path (GSPMD head-sharded) under tp.
     from adversarial_spec_tpu.engine.speculative import GAMMA
 
     if speculative is None:
         speculative = True
     spec_dp = 1
+    spec_mesh = None
     if mesh is not None and mesh.size > 1:
-        from adversarial_spec_tpu.parallel.mesh import DP as _SPEC_DP
+        from adversarial_spec_tpu.parallel.mesh import (
+            DP as _SPEC_DP,
+            SP as _SPEC_SP,
+        )
 
         # Speculation's host-side control flow (spec_mask, _steps_exit,
         # catch-up targets) fetches steps_rows/finished with np.asarray;
@@ -711,12 +761,12 @@ def generate(
         # single-host feature until those scalars are reduced on-device.
         if jax.process_count() > 1:
             spec_dp = 0
+        elif mesh.size == mesh.shape[_SPEC_DP]:
+            spec_dp = mesh.shape[_SPEC_DP]
+        elif mesh.shape[_SPEC_SP] == 1:
+            spec_mesh = mesh  # tp / dp×tp: GSPMD-partitioned program
         else:
-            spec_dp = (
-                mesh.shape[_SPEC_DP]
-                if mesh.size == mesh.shape[_SPEC_DP]
-                else 0  # tp/sp present: speculation unsupported
-            )
+            spec_dp = 0  # sp decode meshes: speculation unsupported
     use_spec = (
         speculative
         and not paged
@@ -795,7 +845,16 @@ def generate(
                 )
             else:
                 ret = speculative_decode_steps(
-                    params, cfg, cache, *spec_args, **spec_static
+                    params,
+                    cfg,
+                    cache,
+                    *spec_args,
+                    # None off-mesh; the tp/GSPMD path partitions the
+                    # program over the mesh (dp wrappers take the mesh
+                    # positionally instead, and their inner calls must
+                    # see mesh=None — they already run under shard_map).
+                    mesh=spec_mesh,
+                    **spec_static,
                 )
             (
                 cache,
@@ -872,7 +931,12 @@ def generate(
                 else:
                     cache, cur, finished, out_buf, steps_rows = (
                         rowwise_decode_steps(
-                            params, cfg, cache, *rw_args, **rw_static
+                            params,
+                            cfg,
+                            cache,
+                            *rw_args,
+                            mesh=spec_mesh,
+                            **rw_static,
                         )
                     )
                 step = jnp.max(steps_rows)

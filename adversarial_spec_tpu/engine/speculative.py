@@ -113,6 +113,7 @@ def _draft(context, prev, cur, limits, gamma):
         "use_top_p",
         "use_pallas",
         "pallas_interpret",
+        "mesh",
     ),
     donate_argnames=("cache", "out_buf"),
 )
@@ -141,9 +142,20 @@ def speculative_decode_steps(
     use_top_p: bool = True,
     use_pallas: bool = False,
     pallas_interpret: bool = False,
+    mesh=None,
 ):
     """Up to ``iters`` speculative rounds over whichever rows still fit a
     full γ+1 span.
+
+    ``mesh`` (tp path): a single-host mesh whose tensor-parallel degree
+    shards the layer matmuls via GSPMD — this whole function runs as ONE
+    partitioned program (devices stay in lockstep, which tp requires
+    anyway; collectives come from the compiler, not manual psums). The
+    verify forward's attention takes the jnp path (the MQ kernel is
+    single-device; GSPMD shards its heads axis), and the dp-only case
+    uses the ``*_dp`` shard_map wrappers below instead (independent
+    per-device accept loops beat a lockstep global loop when devices
+    don't have to communicate).
 
     Returns (cache, prev, cur, finished, out_buf, steps, n_iters,
     n_emitted_total, n_row_iters) — the caller finishes budget-capped
@@ -205,6 +217,7 @@ def speculative_decode_steps(
             kv_base,
             use_pallas_decode=use_pallas,
             pallas_interpret=pallas_interpret,
+            mesh=mesh,
         )
         # The true per-position sampling distribution (one-hot if greedy).
         filt = filtered_logits(
@@ -342,6 +355,7 @@ def speculative_decode_steps(
         "use_top_p",
         "use_pallas",
         "pallas_interpret",
+        "mesh",
     ),
     donate_argnames=("cache", "out_buf"),
 )
@@ -367,13 +381,16 @@ def rowwise_decode_steps(
     use_top_p: bool = True,
     use_pallas: bool = False,
     pallas_interpret: bool = False,
+    mesh=None,
 ):
     """Plain single-token decode with PER-ROW cache slots.
 
     The tail loop after any speculative phase: rows desynchronize there
     (different accepted draft counts), so the shared-slot
     ``decode_chunk_steps`` can no longer drive them. Same sampling and
-    EOS semantics as generate._sample_step.
+    EOS semantics as generate._sample_step. ``mesh``: tp via GSPMD, same
+    contract as speculative_decode_steps (the S=1 forward routes the
+    fused kernel through its shard_map wrapper on such meshes).
     """
     B = cur_tokens.shape[0]
     T = cache["k"].shape[3]  # [L, B, Hkv, T, D]
@@ -404,6 +421,7 @@ def rowwise_decode_steps(
             kv_base,
             use_pallas_decode=use_pallas,
             pallas_interpret=pallas_interpret,
+            mesh=mesh,
         )
         key, sub = jax.random.split(key)
         nxt = sample_tokens(

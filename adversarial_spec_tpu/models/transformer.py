@@ -653,6 +653,7 @@ def forward_paged_decode(
         if use_pallas:
             from adversarial_spec_tpu.ops.pallas_paged import (
                 paged_decode_attention,
+                paged_decode_attention_dp_tp,
                 paged_decode_attention_tp,
             )
 
@@ -660,7 +661,18 @@ def forward_paged_decode(
                 dict(k_scale=ks_pages, v_scale=vs_pages) if quant_kv else {}
             )
             if mesh is not None and mesh.size > 1:
-                out = paged_decode_attention_tp(
+                from adversarial_spec_tpu.parallel.mesh import DP as _DPAX
+
+                # Mixed dp×tp meshes shard rows + page slabs over dp as
+                # well (per-slice pool layout, global ids — see the
+                # wrapper's contract); tp-only meshes replicate the pool
+                # over dp=1 trivially via the same specs.
+                wrapper = (
+                    paged_decode_attention_dp_tp
+                    if mesh.shape[_DPAX] > 1
+                    else paged_decode_attention_tp
+                )
+                out = wrapper(
                     q[:, 0],
                     k_pages,
                     v_pages,

@@ -187,6 +187,77 @@ def paged_decode_attention(
     return out[:, :, :g, :].reshape(B, Hq, D)
 
 
+def paged_decode_attention_dp_tp(
+    q: jnp.ndarray,  # [B, Hq, D]
+    k_pages: jnp.ndarray,  # [n_pages, Hkv, page_size, D]
+    v_pages: jnp.ndarray,  # [n_pages, Hkv, page_size, D]
+    page_table: jnp.ndarray,  # [B, P] GLOBAL physical ids (see contract)
+    bounds: jnp.ndarray,  # [B, 2]
+    mesh,
+    attn_softcap: float = 0.0,
+    scale: float | None = None,
+    interpret: bool = False,
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Fused paged decode attention on a MIXED dp×tp mesh.
+
+    Rows and page slabs shard over ``dp``, the head axis over ``tp`` —
+    all heavy operands stay device-local; there are no collectives in or
+    around the kernel.
+
+    Layout contract (generate()'s mixed paged setup): the pages axis is
+    laid out per-dp-slice — slice d owns global pages [d·Lp, (d+1)·Lp)
+    with Lp = n_pages/dp, local page 0 of each slice is that slice's
+    trash page, and every row's pages live in the row's OWN slice. The
+    page table carries GLOBAL ids because the surrounding chunk loop
+    (scatter + gather fallback) runs under GSPMD, which is global-view;
+    this wrapper subtracts the slice base so the kernel indexes its
+    local block. Global trash (id 0) and negative padding land ≤ 0
+    after the shift and stay masked; out-of-slice ids cannot occur by
+    construction.
+    """
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from adversarial_spec_tpu.parallel.mesh import DP, TP
+
+    n_pages = k_pages.shape[0]
+    dp = mesh.shape[DP]
+    local_pages = n_pages // dp
+
+    kernel = functools.partial(
+        paged_decode_attention,
+        attn_softcap=attn_softcap,
+        scale=scale,
+        interpret=interpret,
+    )
+
+    def fn(q_, k_, v_, t_, b_, *scales):
+        base = jax.lax.axis_index(DP) * local_pages
+        t_local = t_ - base
+        if scales:
+            return kernel(
+                q_, k_, v_, t_local, b_,
+                k_scale=scales[0], v_scale=scales[1],
+            )
+        return kernel(q_, k_, v_, t_local, b_)
+
+    page_spec = P(DP, TP, None, None)
+    in_specs = [P(DP, TP, None), page_spec, page_spec, P(DP, None), P(DP, None)]
+    operands = [q, k_pages, v_pages, page_table, bounds]
+    if k_scale is not None:
+        in_specs += [page_spec, page_spec]
+        operands += [k_scale, v_scale]
+    return shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=P(DP, TP, None),
+        check_rep=False,
+    )(*operands)
+
+
 def paged_decode_attention_tp(
     q: jnp.ndarray,  # [B, Hq, D]
     k_pages: jnp.ndarray,  # [n_pages, Hkv, page_size, D]

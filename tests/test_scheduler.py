@@ -335,8 +335,65 @@ class TestPagedUnderTp:
             )
         np.testing.assert_array_equal(ref.tokens, out.tokens)
 
-    def test_paged_mixed_mesh_falls_back_dense(self, tiny_model, capsys):
-        """dp×tp mixed meshes still warn + fall back to the dense cache."""
+    def test_paged_mixed_dp_tp_matches_single_device(self, tiny_model):
+        """Paged decode on a MIXED dp=2×tp=2 mesh (per-dp-slice pool
+        layout, GSPMD chunk loop, kernel under the dp×tp shard_map with
+        global→local id shift) must reproduce single-device paged
+        tokens — on both the kernel and gather paths."""
+        if len(jax.devices()) < 4:
+            pytest.skip("requires 4 virtual devices")
+        from adversarial_spec_tpu.parallel.mesh import make_mesh
+        from adversarial_spec_tpu.parallel.sharding import shard_params
+
+        params, cfg = tiny_model
+        prompts = [[1, 5, 9, 3, 7, 2], [4, 4, 8], [6, 1, 1, 2], [9, 9]]
+        kw = dict(
+            max_new_tokens=8, eos_ids=[], greedy=True,
+            paged=True, page_size=16, speculative=False,
+            share_prefix=False,
+        )
+        ref = generate(params, cfg, prompts, **kw)
+        mesh = make_mesh({"dp": 2, "tp": 2})
+        sharded = shard_params(mesh, params)
+        with mesh:
+            out = generate(
+                sharded, cfg, prompts, mesh=mesh,
+                use_pallas_decode=True, **kw
+            )
+        np.testing.assert_array_equal(ref.tokens, out.tokens)
+        with mesh:
+            out2 = generate(
+                sharded, cfg, prompts, mesh=mesh,
+                use_pallas_decode=False, **kw
+            )
+        np.testing.assert_array_equal(ref.tokens, out2.tokens)
+
+    def test_paged_mixed_dp_tp_int8_pool(self, tiny_model):
+        """int8 pages compose with the mixed dp×tp pool."""
+        if len(jax.devices()) < 4:
+            pytest.skip("requires 4 virtual devices")
+        from adversarial_spec_tpu.parallel.mesh import make_mesh
+        from adversarial_spec_tpu.parallel.sharding import shard_params
+
+        params, cfg = tiny_model
+        prompts = [[1, 5, 9, 3, 7, 2], [4, 4, 8], [6, 1, 1, 2], [9, 9]]
+        kw = dict(
+            max_new_tokens=6, eos_ids=[], greedy=True,
+            paged=True, page_size=16, speculative=False,
+            share_prefix=False, kv_dtype="int8",
+        )
+        ref = generate(params, cfg, prompts, **kw)
+        mesh = make_mesh({"dp": 2, "tp": 2})
+        sharded = shard_params(mesh, params)
+        with mesh:
+            out = generate(
+                sharded, cfg, prompts, mesh=mesh,
+                use_pallas_decode=True, **kw
+            )
+        np.testing.assert_array_equal(ref.tokens, out.tokens)
+
+    def test_paged_sp_mesh_falls_back_dense(self, tiny_model, capsys):
+        """sp meshes still warn + fall back to the dense cache."""
         if len(jax.devices()) < 4:
             pytest.skip("requires 4 virtual devices")
         from adversarial_spec_tpu.parallel.mesh import make_mesh
@@ -344,7 +401,7 @@ class TestPagedUnderTp:
 
         params, cfg = tiny_model
         prompts = [[1, 5, 9], [2, 6], [8, 8], [4]]
-        mesh = make_mesh({"dp": 2, "tp": 2})
+        mesh = make_mesh({"dp": 2, "sp": 2, "tp": 1})
         sharded = shard_params(mesh, params)
         with mesh:
             out = generate(

@@ -302,6 +302,62 @@ class TestSpeculativeUnderDp:
         np.testing.assert_array_equal(plain.tokens, dp.tokens)
         np.testing.assert_array_equal(plain.n_generated, dp.n_generated)
 
+    def test_tp_spec_matches_single_device_greedy(self, tiny_model):
+        """Greedy speculation on a tp-only mesh (one GSPMD-partitioned
+        program: Megatron-sharded matmuls, compiler-inserted psums) must
+        be bit-identical to plain greedy decode — BASELINE config 5's
+        70B-judge-under-TP decode lever."""
+        import jax as _jax
+
+        if len(_jax.devices()) < 2:
+            pytest.skip("requires 2 virtual devices")
+        from adversarial_spec_tpu.parallel.mesh import make_mesh
+        from adversarial_spec_tpu.parallel.sharding import shard_params
+
+        params, cfg = tiny_model
+        prompts = [
+            [((i * 13) % 500) + 3 for i in range(40)],
+            [5, 9, 7, 5, 9, 7, 5, 9, 7, 5, 9, 7, 5, 9],
+        ]
+        kw = dict(max_new_tokens=24, eos_ids=[], greedy=True)
+        plain = generate(params, cfg, prompts, speculative=False, **kw)
+        mesh = make_mesh({"dp": 1, "tp": 2})
+        sharded = shard_params(mesh, params)
+        with mesh:
+            tp = generate(
+                sharded, cfg, prompts, speculative=True, mesh=mesh, **kw
+            )
+        np.testing.assert_array_equal(plain.tokens, tp.tokens)
+        np.testing.assert_array_equal(plain.n_generated, tp.n_generated)
+
+    def test_dp_tp_spec_matches_single_device_greedy(self, tiny_model):
+        """Greedy speculation on a MIXED dp=2 × tp=2 mesh (rows GSPMD-
+        sharded over dp, matmuls over tp, one lockstep program)."""
+        import jax as _jax
+
+        if len(_jax.devices()) < 4:
+            pytest.skip("requires 4 virtual devices")
+        from adversarial_spec_tpu.parallel.mesh import make_mesh
+        from adversarial_spec_tpu.parallel.sharding import shard_params
+
+        params, cfg = tiny_model
+        prompts = [
+            [((i * 13) % 500) + 3 for i in range(40)],
+            [5, 9, 7, 5, 9, 7, 5, 9, 7, 5, 9, 7, 5, 9],
+            [((i * 7) % 450) + 9 for i in range(25)],
+            [9, 1, 9, 1, 9, 1, 9, 1, 9, 1],
+        ]
+        kw = dict(max_new_tokens=24, eos_ids=[], greedy=True)
+        plain = generate(params, cfg, prompts, speculative=False, **kw)
+        mesh = make_mesh({"dp": 2, "tp": 2})
+        sharded = shard_params(mesh, params)
+        with mesh:
+            mixed = generate(
+                sharded, cfg, prompts, speculative=True, mesh=mesh, **kw
+            )
+        np.testing.assert_array_equal(plain.tokens, mixed.tokens)
+        np.testing.assert_array_equal(plain.n_generated, mixed.n_generated)
+
     def test_dp_spec_row_padding(self, tiny_model):
         """3 rows on dp=2: generate pads to 4, drops the pad row, and the
         dp speculative path must not disturb real rows' outputs."""
