@@ -42,7 +42,7 @@ from adversarial_spec_tpu.models.transformer import (
     init_cache,
 )
 
-DECODE_CHUNK = 128
+DECODE_CHUNK = int(os.environ.get("ADVSPEC_DECODE_CHUNK", "128"))
 MIN_BUCKET = 128
 
 # Context-length floor below which decode auto-selects XLA attention over
@@ -55,6 +55,20 @@ MIN_BUCKET = 128
 # otherwise. Explicit use_pallas_decode=True always wins over this
 # heuristic; ADVSPEC_PALLAS_MIN_T restores a floor without a code change.
 PALLAS_DECODE_MIN_T = int(os.environ.get("ADVSPEC_PALLAS_MIN_T", "0"))
+
+
+def _host_fetch(x) -> np.ndarray:
+    """Fetch a possibly-sharded device array to every host.
+
+    Single-process: plain np.asarray. Multi-host: dp-sharded arrays span
+    non-addressable devices, so gather them to a replicated copy first
+    (an ICI/DCN all_gather — once per generate() call, on the two small
+    output arrays only, never in the decode loop)."""
+    if jax.process_count() > 1 and not x.is_fully_replicated:
+        from jax.experimental import multihost_utils
+
+        x = multihost_utils.process_allgather(x, tiled=True)
+    return np.asarray(x)
 
 
 def bucket_length(n: int, minimum: int = MIN_BUCKET) -> int:
@@ -754,14 +768,14 @@ def generate(
             SP as _SPEC_SP,
         )
 
-        # Speculation's host-side control flow (spec_mask, _steps_exit,
-        # catch-up targets) fetches steps_rows/finished with np.asarray;
-        # on a multi-host dp mesh those arrays span non-addressable
-        # devices and the fetch would raise. Keep speculation a
-        # single-host feature until those scalars are reduced on-device.
-        if jax.process_count() > 1:
-            spec_dp = 0
-        elif mesh.size == mesh.shape[_SPEC_DP]:
+        # Multi-host safe: speculation's host-side control flow
+        # (spec_fits, _steps_exit, catch-up targets) reduces
+        # steps_rows/finished to REPLICATED scalars on device before
+        # fetching, so no host ever touches a non-addressable shard and
+        # every host takes identical branches (BASELINE config 5's
+        # v5p-16 decode lever; exercised by the two-process spec parity
+        # test in tests/test_multihost.py).
+        if mesh.size == mesh.shape[_SPEC_DP]:
             spec_dp = mesh.shape[_SPEC_DP]
         elif mesh.shape[_SPEC_SP] == 1:
             spec_mesh = mesh  # tp / dp×tp: GSPMD-partitioned program
@@ -792,12 +806,18 @@ def generate(
 
     def _steps_exit() -> int:
         """Host-side loop scalar: min over rows of (done ? max_new :
-        steps) — max_new only once every row is finished or at budget."""
+        steps) — max_new only once every row is finished or at budget.
+
+        The reduction runs ON DEVICE so only a replicated scalar is
+        fetched: steps_rows/finished are dp-sharded, and on a multi-host
+        mesh a host-side np.asarray of them would touch non-addressable
+        shards and raise. Replicated scalars are identical on every
+        host, so all hosts take the same branch (SPMD lockstep)."""
         if steps_rows is None:
             return int(step)
-        s = np.asarray(steps_rows)
-        f = np.asarray(finished)
-        return int(np.where(f, max_new_tokens, s).min())
+        return int(
+            jnp.where(finished, jnp.int32(max_new_tokens), steps_rows).min()
+        )
 
     while _steps_exit() < max_new_tokens and not bool(finished.all()):
         if deadline is not None and time.monotonic() >= deadline:
@@ -805,10 +825,12 @@ def generate(
             break
         key, chunk_key = jax.random.split(key)
         if use_spec:
-            spec_mask = ~np.asarray(finished) & (
-                np.asarray(steps_rows) + GAMMA + 1 <= max_new_tokens
+            # Device-side reduction → replicated bool (multi-host safe).
+            spec_fits = bool(
+                jnp.any(
+                    ~finished & (steps_rows + GAMMA + 1 <= max_new_tokens)
+                )
             )
-            spec_fits = bool(spec_mask.any())
         else:
             spec_fits = False
         if spec_fits:
@@ -887,10 +909,17 @@ def generate(
             if use_spec:
                 target = max_new_tokens
             else:
-                sr = np.asarray(steps_rows)
-                unfin = ~np.asarray(finished)
-                target = min(int(sr[unfin].max()), max_new_tokens)
-                if bool((sr[unfin] >= target).all()):
+                # Unfinished-row max as a replicated device scalar; the
+                # outer loop guarantees at least one unfinished row.
+                target = min(
+                    int(
+                        jnp.where(
+                            finished, jnp.int32(-1), steps_rows
+                        ).max()
+                    ),
+                    max_new_tokens,
+                )
+                if bool(jnp.all(finished | (steps_rows >= target))):
                     # Already level (e.g. B == 1, or equal accept
                     # counts): no catch-up dispatch needed.
                     desynced = False
@@ -941,9 +970,7 @@ def generate(
                     )
                 step = jnp.max(steps_rows)
                 if not use_spec:
-                    sr = np.asarray(steps_rows)
-                    fin = np.asarray(finished)
-                    if bool((fin | (sr >= target)).all()):
+                    if bool(jnp.all(finished | (steps_rows >= target))):
                         # Level again: unfinished rows all sit at target.
                         desynced = False
                         step = jnp.int32(target)
@@ -1056,7 +1083,7 @@ def generate(
                 steps_rows = jnp.maximum(steps_rows, step)
     decode_time = time.monotonic() - t1
 
-    out_np = np.asarray(out_buf)[:n_real, :max_new_tokens]
+    out_np = _host_fetch(out_buf)[:n_real, :max_new_tokens]
     B = n_real  # dp-padding rows dropped
     # Per-row step counts: shared scalar on the synced paths; the
     # speculative paths desynchronize rows (a timeout can strand them at
@@ -1064,7 +1091,7 @@ def generate(
     # slots as output).
     if steps_rows is not None:
         row_steps = np.minimum(
-            np.asarray(steps_rows)[:n_real], max_new_tokens
+            _host_fetch(steps_rows)[:n_real], max_new_tokens
         )
     else:
         row_steps = np.full((B,), min(int(step), max_new_tokens))

@@ -52,6 +52,122 @@ _HF_LAYER_MAP = {
 _TRANSPOSE = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}
 
 
+class CheckpointConfigError(ValueError):
+    """Registered architecture contradicts the checkpoint's config.json."""
+
+
+def preflight_config(
+    ckpt_dir: str | Path, cfg: ModelConfig, family: str
+) -> None:
+    """Cross-check the registered ModelConfig against the checkpoint's own
+    ``config.json`` before any tensor is read.
+
+    A mis-registered alias (wrong --family/--size for the directory it
+    points at) would otherwise produce garbage logits with no error —
+    shapes can coincide while rope_theta, GQA ratio, or tied embeddings
+    differ. The reference fails fast with an actionable message at model
+    access time (scripts/providers.py:418-486, key/alias preflight); this
+    is the checkpoint-dir analog. A checkpoint without config.json (e.g.
+    bare safetensors exports, test fixtures) is not checked.
+    """
+    path = Path(ckpt_dir) / "config.json"
+    if not path.is_file():
+        return
+    try:
+        hf = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        raise CheckpointConfigError(
+            f"unreadable config.json under {ckpt_dir}: {e}"
+        ) from e
+
+    problems: list[str] = []
+    model_type = hf.get("model_type")
+    if model_type is not None and str(model_type) != family:
+        problems.append(
+            f"model_type: checkpoint is {model_type!r}, "
+            f"alias registered as family {family!r}"
+        )
+
+    scalar_checks = [
+        ("hidden_size", "dim", cfg.dim),
+        ("num_hidden_layers", "n_layers", cfg.n_layers),
+        ("num_attention_heads", "n_heads", cfg.n_heads),
+        ("num_key_value_heads", "n_kv_heads", cfg.n_kv_heads),
+        ("intermediate_size", "ffn_dim", cfg.ffn_dim),
+        ("vocab_size", "vocab_size", cfg.vocab_size),
+        ("head_dim", "head_dim", cfg.head_dim),
+        ("sliding_window", "sliding_window", cfg.sliding_window),
+        ("tie_word_embeddings", "tied_embeddings", cfg.tied_embeddings),
+    ]
+    for hf_key, field, want in scalar_checks:
+        got = hf.get(hf_key)
+        if got is None:
+            continue
+        ok = (
+            abs(float(got) - float(want)) < 1e-6
+            if isinstance(want, float)
+            else bool(got) == want
+            if isinstance(want, bool)
+            else int(got) == want
+        )
+        if not ok:
+            problems.append(
+                f"{hf_key}: checkpoint has {got!r}, registered config "
+                f"({field}) has {want!r}"
+            )
+
+    theta = hf.get("rope_theta")
+    if theta is not None and abs(float(theta) - cfg.rope_theta) > 1e-3:
+        problems.append(
+            f"rope_theta: checkpoint has {theta!r}, registered config "
+            f"has {cfg.rope_theta!r}"
+        )
+
+    rs = hf.get("rope_scaling")
+    rs_type = (rs or {}).get("rope_type", (rs or {}).get("type"))
+    if rs and rs_type == "llama3":
+        if cfg.rope_scaling is None:
+            problems.append(
+                "rope_scaling: checkpoint uses llama3 scaling "
+                f"(factor={rs.get('factor')}), registered config is "
+                "unscaled — long-context positions would be wrong"
+            )
+        else:
+            want_f, want_lo, want_hi, want_orig = cfg.rope_scaling
+            pairs = [
+                ("factor", rs.get("factor"), want_f),
+                ("low_freq_factor", rs.get("low_freq_factor"), want_lo),
+                ("high_freq_factor", rs.get("high_freq_factor"), want_hi),
+                (
+                    "original_max_position_embeddings",
+                    rs.get("original_max_position_embeddings"),
+                    want_orig,
+                ),
+            ]
+            for key, got, want in pairs:
+                if got is not None and abs(float(got) - want) > 1e-6:
+                    problems.append(
+                        f"rope_scaling.{key}: checkpoint has {got!r}, "
+                        f"registered config has {want!r}"
+                    )
+    elif not rs and cfg.rope_scaling is not None:
+        problems.append(
+            "rope_scaling: registered config expects llama3 scaling "
+            f"(factor={cfg.rope_scaling[0]}), checkpoint has none"
+        )
+
+    if problems:
+        detail = "\n  - ".join(problems)
+        raise CheckpointConfigError(
+            f"checkpoint {ckpt_dir} does not match the registered "
+            f"architecture for family {family!r}:\n  - {detail}\n"
+            "Fix: re-register the alias with the family/size that matches "
+            "this checkpoint (`registry` action, see `status`), or point "
+            "it at the right directory. Loading anyway would produce "
+            "garbage logits, not an error."
+        )
+
+
 def _open_safetensors(ckpt_dir: Path):
     """Return {tensor_name: (file, name)} across all shards."""
     from safetensors import safe_open
@@ -104,6 +220,7 @@ def load_hf_checkpoint(
     import ml_dtypes
 
     ckpt_dir = Path(ckpt_dir)
+    preflight_config(ckpt_dir, cfg, family)
     files = _open_safetensors(ckpt_dir)
     put = device_put or (lambda path, arr: jnp.asarray(arr, dtype=dtype))
     np_dtype = np.dtype(

@@ -8,11 +8,31 @@ import numpy as np
 import pytest
 
 from adversarial_spec_tpu.engine.loader import (
+    CheckpointConfigError,
     _open_safetensors,
     load_hf_checkpoint,
     materialize_params,
+    preflight_config,
 )
 from adversarial_spec_tpu.models.config import get_config
+
+
+def _hf_config_json(cfg, family="llama", **overrides):
+    """The config.json an HF export of ``cfg`` would carry."""
+    d = {
+        "model_type": family,
+        "hidden_size": cfg.dim,
+        "num_hidden_layers": cfg.n_layers,
+        "num_attention_heads": cfg.n_heads,
+        "num_key_value_heads": cfg.n_kv_heads,
+        "head_dim": cfg.head_dim,
+        "intermediate_size": cfg.ffn_dim,
+        "vocab_size": cfg.vocab_size,
+        "rope_theta": cfg.rope_theta,
+        "tie_word_embeddings": cfg.tied_embeddings,
+    }
+    d.update(overrides)
+    return d
 
 
 def _write_sharded_checkpoint(tmp_path, cfg):
@@ -117,6 +137,94 @@ class TestShardedCheckpoint:
         cfg = get_config("llama", "tiny")
         with pytest.raises(FileNotFoundError, match="no \\*.safetensors"):
             load_hf_checkpoint(tmp_path, cfg, "llama")
+
+
+class TestPreflightConfig:
+    """The loader cross-checks the checkpoint's own config.json before
+    reading any tensor: a mis-registered alias must fail loudly with the
+    mismatched fields named, never load into garbage logits."""
+
+    def test_matching_config_json_loads(self, tmp_path):
+        cfg = get_config("llama", "tiny")
+        _write_sharded_checkpoint(tmp_path, cfg)
+        (tmp_path / "config.json").write_text(
+            json.dumps(_hf_config_json(cfg))
+        )
+        params = load_hf_checkpoint(tmp_path, cfg, "llama", dtype=jnp.float32)
+        assert "embed" in params
+
+    def test_absent_config_json_skips_check(self, tmp_path):
+        cfg = get_config("llama", "tiny")
+        preflight_config(tmp_path, cfg, "llama")  # no error
+
+    def test_misregistered_family_fails_loudly(self, tmp_path):
+        """Checkpoint dir holds a llama-1b-shaped config.json but the
+        alias was registered as llama-tiny: every differing field is
+        named and no tensor read is attempted (dir has none)."""
+        tiny = get_config("llama", "tiny")
+        big = get_config("llama", "1b")
+        (tmp_path / "config.json").write_text(
+            json.dumps(_hf_config_json(big))
+        )
+        with pytest.raises(CheckpointConfigError) as ei:
+            load_hf_checkpoint(tmp_path, tiny, "llama")
+        msg = str(ei.value)
+        assert "hidden_size" in msg
+        assert "num_hidden_layers" in msg
+        assert "re-register" in msg
+
+    def test_wrong_model_type_fails(self, tmp_path):
+        cfg = get_config("llama", "tiny")
+        (tmp_path / "config.json").write_text(
+            json.dumps(_hf_config_json(cfg, family="mistral"))
+        )
+        with pytest.raises(CheckpointConfigError, match="model_type"):
+            preflight_config(tmp_path, cfg, "llama")
+
+    def test_rope_theta_mismatch_fails(self, tmp_path):
+        """Same shapes, different rope base — the silent-garbage case the
+        preflight exists for (logits plausible, positions wrong)."""
+        cfg = get_config("llama", "tiny")
+        (tmp_path / "config.json").write_text(
+            json.dumps(_hf_config_json(cfg, rope_theta=10000.0))
+        )
+        with pytest.raises(CheckpointConfigError, match="rope_theta"):
+            preflight_config(tmp_path, cfg, "llama")
+
+    def test_unregistered_rope_scaling_fails(self, tmp_path):
+        """Checkpoint is llama3-rope-scaled but the registered config is
+        unscaled: long-context positions would silently be wrong."""
+        cfg = get_config("llama", "tiny")
+        (tmp_path / "config.json").write_text(
+            json.dumps(
+                _hf_config_json(
+                    cfg,
+                    rope_scaling={
+                        "rope_type": "llama3",
+                        "factor": 8.0,
+                        "low_freq_factor": 1.0,
+                        "high_freq_factor": 4.0,
+                        "original_max_position_embeddings": 8192,
+                    },
+                )
+            )
+        )
+        with pytest.raises(CheckpointConfigError, match="rope_scaling"):
+            preflight_config(tmp_path, cfg, "llama")
+
+    def test_tied_embeddings_mismatch_fails(self, tmp_path):
+        cfg = get_config("llama", "tiny")
+        (tmp_path / "config.json").write_text(
+            json.dumps(_hf_config_json(cfg, tie_word_embeddings=True))
+        )
+        with pytest.raises(CheckpointConfigError, match="tie_word_embeddings"):
+            preflight_config(tmp_path, cfg, "llama")
+
+    def test_corrupt_config_json_actionable(self, tmp_path):
+        cfg = get_config("llama", "tiny")
+        (tmp_path / "config.json").write_text("{not json")
+        with pytest.raises(CheckpointConfigError, match="unreadable"):
+            preflight_config(tmp_path, cfg, "llama")
 
     def test_materialize_random_is_deterministic(self):
         a, cfg_a = materialize_params("random", "llama", "tiny", seed=3)

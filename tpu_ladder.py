@@ -1,0 +1,360 @@
+"""Unattended TPU measurement ladder — converts a tunnel window into data.
+
+Rounds 2-3 lesson (VERDICT r3 item 1): TPU tunnel windows in this
+environment are scarce, short, and unpredictable; manual iteration wastes
+them. This script runs the FULL tuning ladder the moment a probe succeeds,
+with every measurement appended as one JSON line to a results file, so a
+45-minute window yields the complete dataset even if the tunnel dies
+mid-run.
+
+Wedge-safety (NOTES.md round 1): a timeout-killed TPU process wedges the
+tunnel for every later process. So every TPU-touching step runs in a
+DETACHED child (start_new_session=True) that is NEVER signaled; the
+orchestrator polls the results file and simply walks away on stall.
+
+Resumability: each measurement has a stable "step" id; a child skips steps
+already present in the results file, so re-running after a partial window
+finishes only the remainder.
+
+Ladder (phase A, one warm child process — single tunnel client, shared
+compile cache):
+  north_star cold+warm     bench shape: 4 opponents, 1024 prompt, 256 decode
+  crossover T x {kernel,xla}  ADVSPEC_PALLAS_MIN_T decision data
+                              (T in 1280/4096/8192/16384)
+  long_context_16k         16k-token chunked prefill
+  spec_on / spec_off       is self-speculation winning at temp 0.7?
+  int8_kv / paged          quantized-KV and paged-pool deltas
+  profile_trace            one traced warm run (jax.profiler)
+
+Phase B (one child per env setting — knobs read at import time):
+  ADVSPEC_DECODE_CHUNK in {64, 256}, ADVSPEC_DECODE_UNROLL in {1, 2}
+  (baselines chunk=128 / unroll=4 are phase A's north_star_warm).
+
+Usage:
+  python tpu_ladder.py --out tpu_results/r04.jsonl         # orchestrate
+  python tpu_ladder.py --child-main OUT                    # internal
+  python tpu_ladder.py --child-env OUT STEP                # internal
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+BENCH_B = 4
+BENCH_PROMPT = 1024
+BENCH_DECODE = 256
+CROSSOVER_T = (1280, 4096, 8192, 16384)
+LONG_CONTEXT = 16384
+
+
+# ----------------------------------------------------------------- utils
+
+
+def _done_steps(out_path: str) -> set[str]:
+    steps: set[str] = set()
+    if os.path.exists(out_path):
+        with open(out_path) as f:
+            for line in f:
+                try:
+                    steps.add(json.loads(line)["step"])
+                except Exception:
+                    pass
+    return steps
+
+
+def _append(out_path: str, payload: dict) -> None:
+    """Append one JSON line; line-buffered single write is atomic enough
+    for the single-writer-at-a-time discipline the orchestrator enforces."""
+    payload = dict(payload)
+    payload.setdefault("t_wall", round(time.time(), 1))
+    with open(out_path, "a") as f:
+        f.write(json.dumps(payload) + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+
+
+# ------------------------------------------------------------- phase A
+
+
+def _child_main(out_path: str) -> int:
+    from adversarial_spec_tpu.utils.jaxenv import configure_jax
+
+    configure_jax()
+    import jax
+    import jax.numpy as jnp
+
+    from adversarial_spec_tpu.engine.generate import generate
+    from adversarial_spec_tpu.models import transformer as T
+    from adversarial_spec_tpu.models.config import get_config
+
+    platform = jax.devices()[0].platform
+    done = _done_steps(out_path)
+    _append(
+        out_path,
+        {
+            "step": f"session_start_{int(time.time())}",
+            "platform": platform,
+            "n_devices": len(jax.devices()),
+            "chunk": os.environ.get("ADVSPEC_DECODE_CHUNK", "128"),
+            "unroll": os.environ.get("ADVSPEC_DECODE_UNROLL", "4"),
+        },
+    )
+    if platform == "cpu":
+        # Orchestrator only launches us after a TPU probe; a CPU backend
+        # here means the tunnel dropped between probe and init.
+        _append(out_path, {"step": "abort_cpu_backend"})
+        return 1
+
+    # One model instance serves every step: llama-1b bf16 with a 16k+
+    # window so the crossover sweep's longest context fits the cache.
+    cfg = get_config("llama", "1b", max_seq_len=LONG_CONTEXT + 512)
+    params = T.init_params(jax.random.key(0), cfg, dtype=jnp.bfloat16)
+    rng = __import__("random").Random(0)
+
+    def prompts(n_tokens: int, b: int = BENCH_B) -> list[list[int]]:
+        p = [rng.randrange(3, cfg.vocab_size) for _ in range(n_tokens)]
+        return [list(p) for _ in range(b)]
+
+    def run(step: str, n_prompt: int, extra: dict | None = None, **kw):
+        """One measurement: warmup call (compile), then a timed call."""
+        if step in done:
+            return
+        kw.setdefault("max_new_tokens", BENCH_DECODE)
+        kw.setdefault("eos_ids", [])
+        kw.setdefault("temperature", 0.7)
+        kw.setdefault("seed", 0)
+        p = prompts(n_prompt)
+        t0 = time.monotonic()
+        generate(params, cfg, p, **kw)  # warmup/compile
+        t_cold = time.monotonic() - t0
+        t0 = time.monotonic()
+        r = generate(params, cfg, p, **kw)
+        wall = time.monotonic() - t0
+        _append(
+            out_path,
+            {
+                "step": step,
+                "decode_tok_s": round(r.decode_tokens / r.decode_time_s, 1),
+                "decode_time_s": round(r.decode_time_s, 3),
+                "prefill_time_s": round(r.prefill_time_s, 3),
+                "wall_s": round(wall, 3),
+                "cold_wall_s": round(t_cold, 3),
+                "prompt_tokens": n_prompt,
+                **(extra or {}),
+            },
+        )
+        done.add(step)
+
+    # 1. North star: the shape BENCH_r files record. The cold/warm split
+    # tells us what the driver's bench.py (cold process, warm disk cache)
+    # will see.
+    run("north_star", BENCH_PROMPT)
+
+    # 2. MIN_T crossover: kernel vs XLA decode at each context length.
+    # Decides PALLAS_DECODE_MIN_T (generate.py) from data, not hope.
+    for t_ctx in CROSSOVER_T:
+        n_prompt = t_ctx - BENCH_DECODE
+        run(f"crossover_T{t_ctx}_kernel", n_prompt, use_pallas_decode=True,
+            speculative=False)
+        run(f"crossover_T{t_ctx}_xla", n_prompt, use_pallas_decode=False,
+            speculative=False)
+
+    # 3. Decode levers at the bench shape.
+    run("spec_off", BENCH_PROMPT, speculative=False)
+    run("spec_on", BENCH_PROMPT, speculative=True)
+    run("int8_kv", BENCH_PROMPT, kv_dtype="int8")
+    run("paged", BENCH_PROMPT, paged=True)
+    run("greedy", BENCH_PROMPT, greedy=True, temperature=0.0)
+
+    # 4. Long context: 16k chunked prefill (single chip: no sp mesh here).
+    if "long_context_16k" not in done:
+        p = prompts(LONG_CONTEXT, b=1)
+        kw = dict(max_new_tokens=8, eos_ids=[], greedy=True,
+                  speculative=False)
+        generate(params, cfg, p, **kw)
+        t0 = time.monotonic()
+        r = generate(params, cfg, p, **kw)
+        _append(
+            out_path,
+            {
+                "step": "long_context_16k",
+                "prefill_tok_s": round(LONG_CONTEXT / r.prefill_time_s, 1),
+                "prefill_time_s": round(r.prefill_time_s, 3),
+                "wall_s": round(time.monotonic() - t0, 3),
+            },
+        )
+        done.add("long_context_16k")
+
+    # 5. Profile trace: the step-gap evidence (in-loop vs device time,
+    # docs/perf.md) lives in this trace.
+    if "profile_trace" not in done:
+        trace_dir = os.path.join(
+            os.path.dirname(os.path.abspath(out_path)),
+            f"trace_{int(time.time())}",
+        )
+        jax.profiler.start_trace(trace_dir)
+        r = generate(
+            params, cfg, prompts(BENCH_PROMPT),
+            max_new_tokens=BENCH_DECODE, eos_ids=[], temperature=0.7,
+            seed=0,
+        )
+        jax.profiler.stop_trace()
+        _append(
+            out_path,
+            {
+                "step": "profile_trace",
+                "trace_dir": trace_dir,
+                "decode_tok_s": round(r.decode_tokens / r.decode_time_s, 1),
+            },
+        )
+        done.add("profile_trace")
+
+    _append(out_path, {"step": "phase_a_complete"})
+    return 0
+
+
+# ------------------------------------------------------------- phase B
+
+
+ENV_STEPS = {
+    "chunk64": {"ADVSPEC_DECODE_CHUNK": "64"},
+    "chunk256": {"ADVSPEC_DECODE_CHUNK": "256"},
+    "unroll1": {"ADVSPEC_DECODE_UNROLL": "1"},
+    "unroll2": {"ADVSPEC_DECODE_UNROLL": "2"},
+}
+
+
+def _child_env(out_path: str, step: str) -> int:
+    """Bench-shape warm measurement under one env-knob setting (the knob
+    was exported by the orchestrator before spawning us)."""
+    from adversarial_spec_tpu.utils.jaxenv import configure_jax
+
+    configure_jax()
+    import jax
+    import jax.numpy as jnp
+
+    from adversarial_spec_tpu.engine.generate import generate
+    from adversarial_spec_tpu.models import transformer as T
+    from adversarial_spec_tpu.models.config import get_config
+
+    if jax.devices()[0].platform == "cpu":
+        _append(out_path, {"step": f"{step}_abort_cpu"})
+        return 1
+    cfg = get_config("llama", "1b")
+    params = T.init_params(jax.random.key(0), cfg, dtype=jnp.bfloat16)
+    rng = __import__("random").Random(0)
+    p = [rng.randrange(3, cfg.vocab_size) for _ in range(BENCH_PROMPT)]
+    prompts = [list(p) for _ in range(BENCH_B)]
+    kw = dict(max_new_tokens=BENCH_DECODE, eos_ids=[], temperature=0.7,
+              seed=0)
+    generate(params, cfg, prompts, **kw)
+    t0 = time.monotonic()
+    r = generate(params, cfg, prompts, **kw)
+    _append(
+        out_path,
+        {
+            "step": step,
+            "decode_tok_s": round(r.decode_tokens / r.decode_time_s, 1),
+            "decode_time_s": round(r.decode_time_s, 3),
+            "wall_s": round(time.monotonic() - t0, 3),
+            "env": {k: os.environ[k] for k in ENV_STEPS[step]},
+        },
+    )
+    return 0
+
+
+# --------------------------------------------------------- orchestrator
+
+
+def _wait_progress(out_path: str, child: subprocess.Popen,
+                   stall_s: float) -> bool:
+    """Poll the results file until the child exits or makes no progress
+    for stall_s. Returns True iff the child exited on its own. On stall
+    the child is LEFT RUNNING (wedge-safety) and we walk away."""
+    last_size = -1
+    last_change = time.monotonic()
+    while True:
+        size = os.path.getsize(out_path) if os.path.exists(out_path) else 0
+        if size != last_size:
+            last_size = size
+            last_change = time.monotonic()
+        if child.poll() is not None:
+            return True
+        if time.monotonic() - last_change > stall_s:
+            return False
+        time.sleep(5.0)
+
+
+def orchestrate(out_path: str) -> int:
+    sys.path.insert(0, REPO)
+    from bench import _probe_tpu
+
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
+
+    if not _probe_tpu():
+        print("ladder: probe failed (no TPU); nothing run", file=sys.stderr)
+        return 3
+
+    print("ladder: TPU probe ok — phase A", file=sys.stderr)
+    env = dict(os.environ)
+    child = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child-main",
+         out_path],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        start_new_session=True, env=env, cwd=REPO,
+    )
+    # First step includes jax init + first 1b compile: be generous, but a
+    # 20-minute silence means the tunnel hung — walk away (never kill).
+    if not _wait_progress(out_path, child, stall_s=1200.0):
+        print("ladder: phase A stalled; abandoning child", file=sys.stderr)
+        return 2
+
+    done = _done_steps(out_path)
+    if "phase_a_complete" not in done:
+        print("ladder: phase A child exited incomplete", file=sys.stderr)
+        return 2
+
+    for step, knobs in ENV_STEPS.items():
+        if step in done:
+            continue
+        if not _probe_tpu(timeout_s=60.0):
+            print(f"ladder: tunnel gone before {step}", file=sys.stderr)
+            return 2
+        env = dict(os.environ)
+        env.update(knobs)
+        child = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child-env",
+             out_path, step],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True, env=env, cwd=REPO,
+        )
+        if not _wait_progress(out_path, child, stall_s=900.0):
+            print(f"ladder: {step} stalled; abandoning", file=sys.stderr)
+            return 2
+
+    _append(out_path, {"step": "ladder_complete"})
+    print("ladder: complete", file=sys.stderr)
+    return 0
+
+
+def main() -> int:
+    args = sys.argv[1:]
+    if "--child-main" in args:
+        return _child_main(args[args.index("--child-main") + 1])
+    if "--child-env" in args:
+        i = args.index("--child-env")
+        return _child_env(args[i + 1], args[i + 2])
+    out = "tpu_results/ladder.jsonl"
+    if "--out" in args:
+        out = args[args.index("--out") + 1]
+    return orchestrate(out)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

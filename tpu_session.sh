@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Background TPU-window harvester. Retries the measurement ladder every
+# 5 minutes until it completes once, then exits. Wedge-safe by
+# construction: tpu_ladder.py never signals a TPU-holding process.
+#
+#   nohup bash tpu_session.sh >> tpu_results/session.log 2>&1 &
+#
+# Results accumulate (resumably) in $OUT; "ladder_complete" marks done.
+set -u
+cd "$(dirname "$0")"
+OUT="${1:-tpu_results/r04.jsonl}"
+mkdir -p "$(dirname "$OUT")"
+
+while true; do
+  if grep -q '"step": "ladder_complete"' "$OUT" 2>/dev/null; then
+    echo "$(date -u +%FT%TZ) session: ladder complete — exiting"
+    exit 0
+  fi
+  echo "$(date -u +%FT%TZ) session: attempting ladder"
+  python tpu_ladder.py --out "$OUT"
+  rc=$?
+  echo "$(date -u +%FT%TZ) session: ladder rc=$rc"
+  if [ "$rc" = "0" ]; then
+    exit 0
+  fi
+  sleep 300
+done
