@@ -96,9 +96,15 @@ def preflight_config(
         ("intermediate_size", "ffn_dim", cfg.ffn_dim),
         ("vocab_size", "vocab_size", cfg.vocab_size),
         ("head_dim", "head_dim", cfg.head_dim),
-        ("sliding_window", "sliding_window", cfg.sliding_window),
         ("tie_word_embeddings", "tied_embeddings", cfg.tied_embeddings),
     ]
+    # Qwen2 configs ship "sliding_window": 131072 with
+    # "use_sliding_window": false — the declared window is inert, so
+    # only compare when the checkpoint actually uses it.
+    if hf.get("use_sliding_window", True):
+        scalar_checks.append(
+            ("sliding_window", "sliding_window", cfg.sliding_window)
+        )
     for hf_key, field, want in scalar_checks:
         got = hf.get(hf_key)
         if got is None:

@@ -220,6 +220,31 @@ class TestPreflightConfig:
         with pytest.raises(CheckpointConfigError, match="tie_word_embeddings"):
             preflight_config(tmp_path, cfg, "llama")
 
+    def test_inert_sliding_window_accepted(self, tmp_path):
+        """Qwen2 checkpoints declare sliding_window=131072 but
+        use_sliding_window=false — the inert window must not trip the
+        preflight against our (windowless) registered qwen2 config."""
+        cfg = get_config("qwen2", "tiny")
+        (tmp_path / "config.json").write_text(
+            json.dumps(
+                _hf_config_json(
+                    cfg,
+                    family="qwen2",
+                    sliding_window=131072,
+                    use_sliding_window=False,
+                )
+            )
+        )
+        preflight_config(tmp_path, cfg, "qwen2")  # no error
+
+    def test_active_sliding_window_mismatch_fails(self, tmp_path):
+        cfg = get_config("llama", "tiny")
+        (tmp_path / "config.json").write_text(
+            json.dumps(_hf_config_json(cfg, sliding_window=4096))
+        )
+        with pytest.raises(CheckpointConfigError, match="sliding_window"):
+            preflight_config(tmp_path, cfg, "llama")
+
     def test_corrupt_config_json_actionable(self, tmp_path):
         cfg = get_config("llama", "tiny")
         (tmp_path / "config.json").write_text("{not json")

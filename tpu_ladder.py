@@ -301,24 +301,28 @@ def orchestrate(out_path: str) -> int:
         print("ladder: probe failed (no TPU); nothing run", file=sys.stderr)
         return 3
 
-    print("ladder: TPU probe ok — phase A", file=sys.stderr)
-    env = dict(os.environ)
-    child = subprocess.Popen(
-        [sys.executable, os.path.abspath(__file__), "--child-main",
-         out_path],
-        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-        start_new_session=True, env=env, cwd=REPO,
-    )
-    # First step includes jax init + first 1b compile: be generous, but a
-    # 20-minute silence means the tunnel hung — walk away (never kill).
-    if not _wait_progress(out_path, child, stall_s=1200.0):
-        print("ladder: phase A stalled; abandoning child", file=sys.stderr)
-        return 2
-
     done = _done_steps(out_path)
     if "phase_a_complete" not in done:
-        print("ladder: phase A child exited incomplete", file=sys.stderr)
-        return 2
+        print("ladder: TPU probe ok — phase A", file=sys.stderr)
+        env = dict(os.environ)
+        child = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child-main",
+             out_path],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True, env=env, cwd=REPO,
+        )
+        # First step includes jax init + first 1b compile: be generous,
+        # but a 20-minute silence means the tunnel hung — walk away
+        # (never kill).
+        if not _wait_progress(out_path, child, stall_s=1200.0):
+            print("ladder: phase A stalled; abandoning child",
+                  file=sys.stderr)
+            return 2
+        done = _done_steps(out_path)
+        if "phase_a_complete" not in done:
+            print("ladder: phase A child exited incomplete",
+                  file=sys.stderr)
+            return 2
 
     for step, knobs in ENV_STEPS.items():
         if step in done:
@@ -338,6 +342,13 @@ def orchestrate(out_path: str) -> int:
             print(f"ladder: {step} stalled; abandoning", file=sys.stderr)
             return 2
 
+    done = _done_steps(out_path)
+    missing = [s for s in ENV_STEPS if s not in done]
+    if missing:
+        # A phase-B child exited without recording its step (crash or
+        # cpu-backend abort): not complete — the session loop retries.
+        print(f"ladder: phase B incomplete: {missing}", file=sys.stderr)
+        return 2
     _append(out_path, {"step": "ladder_complete"})
     print("ladder: complete", file=sys.stderr)
     return 0
