@@ -21,11 +21,17 @@ round.
 
 from __future__ import annotations
 
+import math
+import os
+import sys
+import threading
 import time
+from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from adversarial_spec_tpu.debate.usage import Usage
 from adversarial_spec_tpu.engine import registry as registry_mod
@@ -44,8 +50,55 @@ from adversarial_spec_tpu.parallel.mesh import (
 )
 from adversarial_spec_tpu.parallel.sharding import make_device_put
 
-# Loaded models kept resident before weight-swap eviction (LRU).
-MAX_RESIDENT_MODELS = 2
+_GIB = 1 << 30
+
+
+def hbm_budget_bytes() -> int:
+    """Per-chip byte budget for resident model weights.
+
+    Residency is BYTE-budgeted, not count-budgeted: two 8B bf16 models
+    (~32 GB) exceed a v5e chip's 16 GB HBM, so a fixed two-model LRU
+    would OOM on exactly the mix-families setup SKILL.md recommends.
+    The budget is the device's reported HBM limit (falling back to a
+    v5e-sized 16 GiB when the backend reports none, e.g. CPU) times a
+    0.75 headroom factor — the reserve covers KV cache, activations,
+    and the transient peak while a swap is in flight. Override with
+    ADVSPEC_HBM_BUDGET_BYTES (read per decision, so tests and operators
+    can retune a live engine).
+    """
+    env = os.environ.get("ADVSPEC_HBM_BUDGET_BYTES")
+    if env:
+        return int(env)
+    limit = 0
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        limit = int(stats.get("bytes_limit", 0))
+    except Exception:
+        limit = 0
+    if limit <= 0:
+        limit = 16 * _GIB
+    return int(limit * 0.75)
+
+
+def per_chip_param_bytes(params) -> int:
+    """Per-chip bytes a (possibly sharded) param pytree occupies.
+
+    Uses each leaf's sharding to count ONE device's shard — tp/sp-sharded
+    weights divide across the mesh, dp-replicated ones do not. Works on
+    concrete arrays and eval_shape/ShapeDtypeStruct trees alike; no data
+    is fetched.
+    """
+    total = 0
+    for leaf in jax.tree.leaves(params):
+        shape = leaf.shape
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None and hasattr(sharding, "shard_shape"):
+            try:
+                shape = sharding.shard_shape(shape)
+            except Exception:
+                pass
+        total += math.prod(shape) * np.dtype(leaf.dtype).itemsize
+    return total
 
 _DTYPES = {
     "bfloat16": jnp.bfloat16,
@@ -78,13 +131,27 @@ class LoadedModel:
     tokenizer: object
     mesh: object
     last_used: float = 0.0
+    bytes_per_chip: int = 0
+    prefetched: bool = False  # loaded ahead of use by _maybe_prefetch
 
 
 class TpuEngine:
-    """Serves every ``tpu://`` alias; caches loaded models (weight swap)."""
+    """Serves every ``tpu://`` alias; caches loaded models (weight swap).
+
+    Residency is byte-budgeted against per-chip HBM (hbm_budget_bytes),
+    and heterogeneous rounds overlap the NEXT group's weight load with
+    the CURRENT group's decode (one background loader thread): device
+    transfers are async, so the swap rides under compute instead of
+    serializing after it (SURVEY §7 hard part (b)).
+    """
 
     def __init__(self) -> None:
         self._models: dict[str, LoadedModel] = {}
+        self._lock = threading.Lock()
+        self._inflight: dict[str, Future] = {}
+        self._executor: ThreadPoolExecutor | None = None
+        self._pinned: set[str] = set()  # never evicted (mid-decode)
+        self.prefetch_hits = 0  # prefetched loads actually consumed
 
     def validate(self, model: str) -> str | None:
         return registry_mod.validate_tpu_model(model)
@@ -92,17 +159,45 @@ class TpuEngine:
     # -- model residency ---------------------------------------------------
 
     def _load(self, alias: str) -> LoadedModel:
-        if alias in self._models:
-            lm = self._models[alias]
+        with self._lock:
+            lm = self._models.get(alias)
+            if lm is not None:
+                # A completed prefetch pops its own _inflight entry
+                # under the same lock that publishes the model, but
+                # clear defensively on every hit so a stale future can
+                # never shadow (or resurrect) an evicted model.
+                self._inflight.pop(alias, None)
+            fut = self._inflight.get(alias)
+        if lm is not None:
+            if lm.prefetched:
+                self.prefetch_hits += 1
+                lm.prefetched = False
             lm.last_used = time.monotonic()
             return lm
+        if fut is not None:
+            try:
+                lm = fut.result()
+            except Exception:
+                lm = None  # prefetch died: retry on the caller's thread
+            with self._lock:
+                self._inflight.pop(alias, None)
+            if lm is not None:
+                self.prefetch_hits += 1
+                lm.prefetched = False
+                lm.last_used = time.monotonic()
+                return lm
+        return self._load_sync(alias)
+
+    def _load_sync(self, alias: str, prefetched: bool = False) -> LoadedModel:
         spec = registry_mod.resolve_model_spec(f"tpu://{alias}")
         dtype = _DTYPES.get(spec.dtype, jnp.bfloat16)
-        # Make room BEFORE materializing: otherwise N+1 full param sets
-        # coexist in HBM during the swap.
-        self._evict_to(MAX_RESIDENT_MODELS - 1)
         maybe_initialize_distributed()
         mesh = make_mesh(spec.mesh)
+        # Make room BEFORE materializing — otherwise both param sets
+        # coexist in HBM during the swap. The estimate comes from
+        # eval_shape + the real sharding rules, so it is exact.
+        estimate = self._estimate_per_chip_bytes(spec, dtype, mesh)
+        self._evict_for(estimate)
         params, cfg = self._materialize(spec, dtype, mesh)
         tokenizer = load_tokenizer(spec.tokenizer)
         lm = LoadedModel(
@@ -112,9 +207,132 @@ class TpuEngine:
             tokenizer=tokenizer,
             mesh=mesh,
             last_used=time.monotonic(),
+            bytes_per_chip=per_chip_param_bytes(params) or estimate,
+            prefetched=prefetched,
         )
-        self._models[alias] = lm
+        with self._lock:
+            # Publish and retire the in-flight marker atomically: a
+            # concurrent _load sees the alias in exactly one of
+            # _models / _inflight, never neither.
+            self._models[alias] = lm
+            self._inflight.pop(alias, None)
         return lm
+
+    def _estimate_per_chip_bytes(self, spec: ModelSpec, dtype, mesh) -> int:
+        """Per-chip weight bytes the alias WILL occupy, before loading.
+
+        eval_shape over the same builder _materialize uses (init +
+        optional int8 quantization), mapped through the real sharding
+        rules — no memory is touched.
+        """
+        from adversarial_spec_tpu.models.config import get_config
+        from adversarial_spec_tpu.models.transformer import init_params
+        from adversarial_spec_tpu.ops.quant import quantize_params
+        from adversarial_spec_tpu.parallel.sharding import param_shardings
+
+        cfg = get_config(spec.family, spec.size, max_seq_len=spec.max_seq_len)
+
+        def build():
+            p = init_params(jax.random.key(0), cfg, dtype)
+            return quantize_params(p) if spec.quant == "int8" else p
+
+        shapes = jax.eval_shape(build)
+        shardings = param_shardings(mesh, shapes)
+        abstract = jax.tree.map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            shapes,
+            shardings,
+        )
+        return per_chip_param_bytes(abstract)
+
+    def _evict_for(self, needed_bytes: int) -> None:
+        """Evict LRU models until ``needed_bytes`` fits in the budget.
+
+        Pinned aliases (mid-decode) are never victims. If everything
+        evictable is gone and the budget still doesn't fit, proceed and
+        let the device's own OOM surface as a transient error (the
+        debate core retries after backoff) — a hard refusal here would
+        also block single models legitimately larger than the estimate.
+        """
+        budget = hbm_budget_bytes()
+        with self._lock:
+            while self._models:
+                resident = sum(
+                    m.bytes_per_chip for m in self._models.values()
+                )
+                if resident + needed_bytes <= budget:
+                    return
+                victims = [
+                    a for a in self._models if a not in self._pinned
+                ]
+                if not victims:
+                    break
+                oldest = min(
+                    victims, key=lambda a: self._models[a].last_used
+                )
+                del self._models[oldest]
+            resident = sum(m.bytes_per_chip for m in self._models.values())
+        if resident + needed_bytes > budget:
+            print(
+                f"warning: model needs {needed_bytes >> 20} MiB with "
+                f"{resident >> 20} MiB pinned-resident, budget "
+                f"{budget >> 20} MiB — loading anyway (OOM will retry "
+                "as transient)",
+                file=sys.stderr,
+            )
+
+    def _maybe_prefetch(self, alias: str) -> None:
+        """Queue a background load of ``alias`` (non-blocking).
+
+        All real work — spec resolution, the eval_shape estimate, the
+        fit check, materialization — happens on the loader thread, so
+        the serving path pays only two dict probes. chat() calls this
+        AFTER the current group's model is loaded and pinned, so the
+        fit check sees the full resident set.
+        """
+        with self._lock:
+            if alias in self._models or alias in self._inflight:
+                return
+            if self._executor is None:
+                self._executor = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="advspec-prefetch"
+                )
+            self._inflight[alias] = self._executor.submit(
+                self._prefetch_task, alias
+            )
+
+    def _prefetch_task(self, alias: str) -> LoadedModel | None:
+        """Background half of _maybe_prefetch.
+
+        Prefetch never evicts (the active model is mid-decode and
+        pinned; evicting idle models during someone else's decode is a
+        policy decision the foreground loader makes with better
+        information): if the alias doesn't fit beside everything
+        resident, give up — the load then serializes at use time,
+        exactly as before prefetching existed. Exceptions stay in the
+        future; the foreground _load falls back to a sync load and owns
+        error reporting.
+        """
+        try:
+            spec = registry_mod.resolve_model_spec(f"tpu://{alias}")
+            dtype = _DTYPES.get(spec.dtype, jnp.bfloat16)
+            mesh = make_mesh(spec.mesh)
+            estimate = self._estimate_per_chip_bytes(spec, dtype, mesh)
+            with self._lock:
+                resident = sum(
+                    m.bytes_per_chip for m in self._models.values()
+                )
+                fits = resident + estimate <= hbm_budget_bytes()
+            if fits:
+                return self._load_sync(alias, prefetched=True)
+            return None
+        finally:
+            # _load_sync pops the marker when it publishes; pop here for
+            # the not-fits and exception exits so a dead future never
+            # blocks later prefetches or loads of this alias.
+            with self._lock:
+                if not isinstance(self._models.get(alias), LoadedModel):
+                    self._inflight.pop(alias, None)
 
     def _materialize(self, spec: ModelSpec, dtype, mesh):
         """Params via the fastest available source: native Orbax cache
@@ -198,11 +416,6 @@ class TpuEngine:
                 )
         return params, cfg
 
-    def _evict_to(self, keep: int) -> None:
-        while len(self._models) > keep:
-            oldest = min(self._models, key=lambda a: self._models[a].last_used)
-            del self._models[oldest]
-
     # -- serving -----------------------------------------------------------
 
     def chat(
@@ -214,11 +427,24 @@ class TpuEngine:
             alias = registry_mod.parse_tpu_model_id(req.model)
             groups.setdefault(alias, []).append(i)
 
+        aliases = list(groups)
         out: list[Completion | None] = [None] * len(requests)
-        for alias, indices in groups.items():
+        for gi, (alias, indices) in enumerate(groups.items()):
             batch = [requests[i] for i in indices]
             try:
-                completions = self._chat_one_model(alias, batch, params)
+                completions = self._chat_one_model(
+                    alias,
+                    batch,
+                    params,
+                    # Overlap the next group's weight load with this
+                    # group's decode (async transfers ride under
+                    # compute). Launched inside _chat_one_model, after
+                    # this group's model is loaded and pinned, so the
+                    # prefetch fit check sees the full resident set.
+                    prefetch_next=(
+                        aliases[gi + 1] if gi + 1 < len(aliases) else None
+                    ),
+                )
             except Exception as e:  # degrade, never raise (parity: ref)
                 msg = f"{type(e).__name__}: {e}"
                 transient = any(m in msg for m in _TRANSIENT_MARKERS)
@@ -231,9 +457,31 @@ class TpuEngine:
         return [c for c in out if c is not None]
 
     def _chat_one_model(
-        self, alias: str, batch: list[ChatRequest], params: SamplingParams
+        self,
+        alias: str,
+        batch: list[ChatRequest],
+        params: SamplingParams,
+        prefetch_next: str | None = None,
     ) -> list[Completion]:
-        lm = self._load(alias)
+        # Pin BEFORE loading: from the moment this model can be resident
+        # it must not be an eviction victim of a concurrent background
+        # load (eviction only drops the dict entry; a foreground
+        # reference would keep the bytes alive while the budget math
+        # believes them freed).
+        with self._lock:
+            self._pinned.add(alias)
+        try:
+            lm = self._load(alias)
+            if prefetch_next is not None:
+                self._maybe_prefetch(prefetch_next)
+            return self._chat_loaded(lm, batch, params)
+        finally:
+            with self._lock:
+                self._pinned.discard(alias)
+
+    def _chat_loaded(
+        self, lm: LoadedModel, batch: list[ChatRequest], params: SamplingParams
+    ) -> list[Completion]:
         tok = lm.tokenizer
         instruct = lm.spec.checkpoint != "random"
 

@@ -10,7 +10,11 @@ from adversarial_spec_tpu.engine.registry import (
     ModelSpec,
     save_registry_entry,
 )
-from adversarial_spec_tpu.engine.tpu import TpuEngine, MAX_RESIDENT_MODELS
+from adversarial_spec_tpu.engine.tpu import (
+    TpuEngine,
+    hbm_budget_bytes,
+    per_chip_param_bytes,
+)
 from adversarial_spec_tpu.engine.types import ChatRequest, SamplingParams
 
 PARAMS = SamplingParams(max_new_tokens=8, greedy=True)
@@ -71,10 +75,71 @@ class TestTpuEngine:
         assert not comp.ok
         assert "unknown tpu model alias" in comp.error
 
-    def test_lru_weight_swap(self, engine):
-        for alias in ("random-tiny", "random-mistral-tiny", "random-qwen-tiny"):
-            engine.chat([_req(f"tpu://{alias}")], PARAMS)
-        assert len(engine._models) <= MAX_RESIDENT_MODELS
+    def test_byte_budget_evicts_lru(self, monkeypatch):
+        """Residency is HBM-byte-budgeted: with a budget sized for ~1.5
+        tiny models, loading a second model evicts the first (LRU), and
+        the resident set's bytes stay within budget."""
+        eng = TpuEngine()
+        eng.chat([_req("tpu://random-tiny")], PARAMS)
+        one = eng._models["random-tiny"].bytes_per_chip
+        assert one > 0
+        monkeypatch.setenv("ADVSPEC_HBM_BUDGET_BYTES", str(int(one * 1.5)))
+        eng.chat([_req("tpu://random-mistral-tiny")], PARAMS)
+        assert "random-mistral-tiny" in eng._models
+        assert "random-tiny" not in eng._models
+        resident = sum(m.bytes_per_chip for m in eng._models.values())
+        assert resident <= hbm_budget_bytes()
+
+    def test_two_model_round_within_budget_stays_resident(self, engine):
+        """Two tiny models fit the default budget together, so a
+        heterogeneous round keeps BOTH resident — repeat rounds swap
+        nothing (the mix-families debate setup)."""
+        engine.chat(
+            [_req("tpu://random-tiny"), _req("tpu://random-mistral-tiny")],
+            PARAMS,
+        )
+        assert {"random-tiny", "random-mistral-tiny"} <= set(
+            engine._models
+        )
+        resident = sum(
+            m.bytes_per_chip for m in engine._models.values()
+        )
+        assert resident <= hbm_budget_bytes()
+
+    def test_heterogeneous_round_prefetches_next_group(self):
+        """The second group's weights load on the background thread
+        while the first group decodes (swap/compute overlap)."""
+        eng = TpuEngine()
+        comps = eng.chat(
+            [
+                _req("tpu://random-tiny"),
+                _req("tpu://random-mistral-tiny"),
+            ],
+            PARAMS,
+        )
+        assert all(c.ok for c in comps)
+        assert eng.prefetch_hits >= 1
+
+    def test_per_chip_param_bytes_counts_shards(self):
+        """Sharded leaves count one device's shard, replicated leaves the
+        whole array."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from adversarial_spec_tpu.parallel.mesh import make_mesh
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >1 device")
+        mesh = make_mesh({"tp": 2})
+        x = jax.device_put(
+            jnp.zeros((4, 8), jnp.float32),
+            NamedSharding(mesh, P(None, "tp")),
+        )
+        r = jax.device_put(
+            jnp.zeros((4,), jnp.float32), NamedSharding(mesh, P())
+        )
+        assert per_chip_param_bytes({"x": x, "r": r}) == 4 * 4 * 4 + 16
 
     def test_validate(self, engine):
         assert engine.validate("tpu://random-tiny") is None
