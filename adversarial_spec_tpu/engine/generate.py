@@ -1041,6 +1041,20 @@ def generate(
             # reached) — and the FIRST trailing check is free, because
             # the outer loop condition already fetched the entry step.
             while True:
+                # Deadline BEFORE dispatch (host clock only — no device
+                # sync on the fast path): once the deadline passes, no
+                # further chunk is dispatched, so a timeout overshoots
+                # by at most the chunk already in flight. At the
+                # deadline we DO sync on that in-flight chunk — if it
+                # completed the generation, this is a finished result
+                # that happens to end near the deadline, not a timeout.
+                if deadline is not None and time.monotonic() >= deadline:
+                    if not (
+                        int(step) >= max_new_tokens
+                        or bool(finished.all())
+                    ):
+                        timed_out = True
+                    break
                 prev_step, prev_finished = step, finished
                 cache, cur, finished, out_buf, step = decode_chunk_steps(
                     params,
@@ -1071,9 +1085,6 @@ def generate(
                 if int(prev_step) >= max_new_tokens or bool(
                     prev_finished.all()
                 ):
-                    break
-                if deadline is not None and time.monotonic() >= deadline:
-                    timed_out = True
                     break
             if steps_rows is not None:
                 # Synced again after a speculative phase + catch-up:

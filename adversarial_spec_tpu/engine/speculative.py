@@ -33,9 +33,15 @@ Because rows accept different draft counts, they desynchronize — after any
 speculative phase the tail must finish on ``rowwise_decode_steps`` (per-row
 cache slots), not the shared-slot loop in engine/generate.py.
 
-Scope: dense KV cache; single device, or a single-host dp-only mesh via
-the ``*_dp`` shard_mapped wrappers below (rows shard over dp, each
-device runs its own accept loop — per-row desync never crosses devices).
+Scope: dense KV cache, on any non-sp mesh — single device; dp-only
+meshes via the ``*_dp`` shard_mapped wrappers below (rows shard over
+dp, each device runs its own accept loop — per-row desync never
+crosses devices); tp and mixed dp×tp meshes via one GSPMD-partitioned
+accept loop (``mesh=`` on the entry points: heads shard over tp inside
+the verification forward, the compiler inserts the collectives).
+Multi-host dp meshes work too: generate()'s surrounding control flow
+only fetches replicated scalars. sp decode meshes are the one
+exclusion (ring-resharded caches; plain chunked decode serves them).
 On TPU the verification forward runs the MULTI-QUERY fused kernel
 (ops/pallas_decode.py:decode_attention_mq — the whole γ+1 span in one
 pass over the KV cache) and the tail loop the single-query kernel, so

@@ -188,16 +188,27 @@ class TpuEngine:
                 return lm
         return self._load_sync(alias)
 
-    def _load_sync(self, alias: str, prefetched: bool = False) -> LoadedModel:
+    def _load_sync(
+        self,
+        alias: str,
+        prefetched: bool = False,
+        estimate: int | None = None,
+        evict: bool = True,
+    ) -> LoadedModel:
         spec = registry_mod.resolve_model_spec(f"tpu://{alias}")
         dtype = _DTYPES.get(spec.dtype, jnp.bfloat16)
         maybe_initialize_distributed()
         mesh = make_mesh(spec.mesh)
         # Make room BEFORE materializing — otherwise both param sets
         # coexist in HBM during the swap. The estimate comes from
-        # eval_shape + the real sharding rules, so it is exact.
-        estimate = self._estimate_per_chip_bytes(spec, dtype, mesh)
-        self._evict_for(estimate)
+        # eval_shape + the real sharding rules, so it is exact. The
+        # prefetch path passes evict=False (it already fit-checked and
+        # must never evict on someone else's behalf) and its estimate
+        # (no duplicate eval_shape trace).
+        if estimate is None:
+            estimate = self._estimate_per_chip_bytes(spec, dtype, mesh)
+        if evict:
+            self._evict_for(estimate)
         params, cfg = self._materialize(spec, dtype, mesh)
         tokenizer = load_tokenizer(spec.tokenizer)
         lm = LoadedModel(
@@ -324,7 +335,9 @@ class TpuEngine:
                 )
                 fits = resident + estimate <= hbm_budget_bytes()
             if fits:
-                return self._load_sync(alias, prefetched=True)
+                return self._load_sync(
+                    alias, prefetched=True, estimate=estimate, evict=False
+                )
             return None
         finally:
             # _load_sync pops the marker when it publishes; pop here for

@@ -36,6 +36,13 @@ def flash_update_heads(
     big resident tile (the fold is what makes each DMA large enough to
     amortize); like ``flash_update`` itself, it must live in exactly one
     place so the dense and paged paths can never drift numerically.
+
+    Practical Hkv ceiling: the loop unrolls Hkv-fold in the kernel body
+    (Mosaic code size/compile time scale with it), and the (Hkv, G8, D)
+    f32 scratch plus double-buffered [Hkv, block_t, D] tiles share VMEM
+    — fine for the supported configs (Hkv ≤ 16; _pick_block_t shrinks
+    the tile as Hkv grows), but a many-KV-head config (Hkv ≥ 32) should
+    fold only a fixed head group and keep the remainder in the grid.
     """
     n_kv = q_ref.shape[1]
     for h in range(n_kv):

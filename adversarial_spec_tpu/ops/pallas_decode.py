@@ -55,13 +55,26 @@ _TILE_VMEM_BUDGET = 1 << 20
 
 def _pick_block_t(T: int, n_kv: int, D: int, itemsize: int) -> int:
     """Largest block that divides the (static) cache length AND keeps one
-    [Hkv, block_t, D] tile under the VMEM budget."""
+    [Hkv, block_t, D] tile under the VMEM budget.
+
+    T must be divisible by some candidate (generate() always passes a
+    power-of-two bucket ≥128, which 128 or smaller divides). Silently
+    falling back to block_t=T here would materialize an [Hkv, T, D]
+    tile — Hkv× the VMEM blowup of a normal tile, a silent OOM trap for
+    direct kernel callers — so refuse instead (ADVICE r3)."""
     fit = [
         c
         for c in (512, 256, 128, 64, 32, 16, 8)
         if n_kv * c * D * itemsize <= _TILE_VMEM_BUDGET
     ]
-    return next((c for c in fit if T % c == 0), T)
+    block = next((c for c in fit if T % c == 0), None)
+    if block is None:
+        raise ValueError(
+            f"cache length T={T} has no block_t divisor in {fit}: pad T "
+            "to a multiple of 8 (generate() buckets to powers of two "
+            "≥128, which never hits this)"
+        )
+    return block
 
 
 def _decode_attn_kernel(

@@ -16,6 +16,16 @@ from adversarial_spec_tpu.ops.pallas_decode import decode_attention
 from adversarial_spec_tpu.ops.pallas_paged import paged_decode_attention
 
 
+def test_pick_block_t_refuses_indivisible_T():
+    """No silent [Hkv, T, D] VMEM-exploding fallback for direct callers
+    with a non-8-multiple cache length (ADVICE r3)."""
+    from adversarial_spec_tpu.ops.pallas_decode import _pick_block_t
+
+    assert _pick_block_t(1280, 8, 64, 2) in (512, 256, 128)
+    with pytest.raises(ValueError, match="no block_t divisor"):
+        _pick_block_t(1283, 8, 64, 2)
+
+
 def _dense_ref(q, k, v, bounds, attn_softcap=0.0):
     B, Hq, D = q.shape
     Hkv, T_ = k.shape[1], k.shape[2]

@@ -1,0 +1,165 @@
+"""Stdlib line-coverage gate (PEP 669 ``sys.monitoring``).
+
+This container has no egress: pytest-cov/coverage.py are not
+installable, so for two rounds the CI coverage gate was claimed but
+never executed anywhere (CHANGELOG 0.2.0). This tool closes that gap
+with zero dependencies: the same gate line runs locally and in CI.
+
+Measurement basis matches coverage.py's: the denominator is the set of
+line numbers the compiled bytecode can attribute code to (``co_lines``
+over every code object, recursively), the numerator is the lines the
+interpreter actually ran (``sys.monitoring`` LINE events, interpreter-
+wide, all threads). Lines marked ``# pragma: no cover`` are excluded;
+when the pragma sits on a ``def``/``class``/``if`` header the whole
+block is excluded (ast body span).
+
+Usage:
+    python tools/coverage_run.py --fail-under 90 [pytest args...]
+    # default pytest args: tests/ -q
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+
+
+def executable_lines(path: str) -> set[int]:
+    """Line numbers the compiled module can execute (co_lines basis)."""
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    try:
+        code = compile(source, path, "exec")
+    except SyntaxError:
+        return set()
+    lines: set[int] = set()
+    stack = [code]
+    while stack:
+        co = stack.pop()
+        for const in co.co_consts:
+            if hasattr(const, "co_lines"):
+                stack.append(const)
+        for _, _, line in co.co_lines():
+            if line is not None and line > 0:
+                lines.add(line)
+    return lines - excluded_lines(source, path)
+
+
+def excluded_lines(source: str, path: str) -> set[int]:
+    """Lines under a ``# pragma: no cover`` marker.
+
+    A pragma on a block header (any ast node with a body) excludes the
+    node's whole span; elsewhere it excludes just its own line.
+    """
+    pragma_lines = {
+        i
+        for i, text in enumerate(source.splitlines(), start=1)
+        if "pragma: no cover" in text
+    }
+    if not pragma_lines:
+        return set()
+    excluded = set(pragma_lines)
+    try:
+        tree = ast.parse(source, path)
+    except SyntaxError:
+        return excluded
+    for node in ast.walk(tree):
+        lineno = getattr(node, "lineno", None)
+        end = getattr(node, "end_lineno", None)
+        if (
+            lineno in pragma_lines
+            and end is not None
+            and hasattr(node, "body")
+        ):
+            excluded.update(range(lineno, end + 1))
+    return excluded
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--fail-under", type=float, default=0.0)
+    parser.add_argument("--package", default="adversarial_spec_tpu")
+    parser.add_argument("--report-all", action="store_true",
+                        help="per-file table for every file, not worst-20")
+    args, pytest_args = parser.parse_known_args()
+    # Unrecognized args (and anything after --) pass through to pytest.
+
+    if not hasattr(sys, "monitoring"):  # pragma: no cover
+        print(
+            "coverage_run.py needs Python >= 3.12 (sys.monitoring); "
+            "run plain pytest on older interpreters",
+            file=sys.stderr,
+        )
+        return 2
+    args.pytest_args = pytest_args
+
+    package_root = os.path.abspath(args.package)
+    if not os.path.isdir(package_root):
+        print(f"no such package dir: {package_root}", file=sys.stderr)
+        return 2
+
+    executed: dict[str, set[int]] = {}
+    mon = sys.monitoring
+    prefix = package_root + os.sep
+
+    def on_line(code, line):
+        fn = code.co_filename
+        if fn.startswith(prefix):
+            executed.setdefault(fn, set()).add(line)
+        # Only set membership is needed: disable this (code, line)
+        # location after its first hit (what coverage.py's sysmon core
+        # does) so hot loops don't pay a Python callback per iteration.
+        return mon.DISABLE
+
+    mon.use_tool_id(mon.COVERAGE_ID, "advspec-cov")
+    mon.register_callback(mon.COVERAGE_ID, mon.events.LINE, on_line)
+    mon.set_events(mon.COVERAGE_ID, mon.events.LINE)
+    try:
+        import pytest
+
+        rc = pytest.main(args.pytest_args or ["tests/", "-q"])
+    finally:
+        mon.set_events(mon.COVERAGE_ID, 0)
+        mon.free_tool_id(mon.COVERAGE_ID)
+    if rc != 0:
+        print(f"pytest failed (rc={rc}); coverage not evaluated",
+              file=sys.stderr)
+        return int(rc)
+
+    rows = []
+    total_exec = total_hit = 0
+    for dirpath, _dirnames, filenames in os.walk(package_root):
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            lines = executable_lines(path)
+            hit = executed.get(path, set()) & lines
+            total_exec += len(lines)
+            total_hit += len(hit)
+            pct = 100.0 * len(hit) / len(lines) if lines else 100.0
+            rel = os.path.relpath(path, os.path.dirname(package_root))
+            rows.append((pct, rel, len(hit), len(lines)))
+
+    rows.sort()
+    shown = rows if args.report_all else rows[:20]
+    width = max(len(r[1]) for r in shown) if shown else 10
+    for pct, rel, hit, n in shown:
+        print(f"{rel:<{width}}  {hit:>5}/{n:<5}  {pct:6.1f}%")
+    if not args.report_all and len(rows) > 20:
+        print(f"... ({len(rows) - 20} better-covered files elided; "
+              "--report-all for the full table)")
+    total_pct = 100.0 * total_hit / total_exec if total_exec else 100.0
+    print(f"TOTAL  {total_hit}/{total_exec}  {total_pct:.2f}%")
+
+    if total_pct < args.fail_under:
+        print(f"FAIL: coverage {total_pct:.2f}% < {args.fail_under}%",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
