@@ -149,9 +149,19 @@ class TpuEngine:
         self._models: dict[str, LoadedModel] = {}
         self._lock = threading.Lock()
         self._inflight: dict[str, Future] = {}
+        # Estimated bytes of loads currently MATERIALIZING (foreground
+        # or prefetch): counted alongside _models in every budget sum so
+        # two concurrent loads can't each conclude they fit alone.
+        self._loading: dict[str, int] = {}
         self._executor: ThreadPoolExecutor | None = None
         self._pinned: set[str] = set()  # never evicted (mid-decode)
         self.prefetch_hits = 0  # prefetched loads actually consumed
+
+    def _committed_bytes_locked(self) -> int:
+        """Resident + materializing bytes. Caller holds self._lock."""
+        return sum(
+            m.bytes_per_chip for m in self._models.values()
+        ) + sum(self._loading.values())
 
     def validate(self, model: str) -> str | None:
         return registry_mod.validate_tpu_model(model)
@@ -209,25 +219,31 @@ class TpuEngine:
             estimate = self._estimate_per_chip_bytes(spec, dtype, mesh)
         if evict:
             self._evict_for(estimate)
-        params, cfg = self._materialize(spec, dtype, mesh)
-        tokenizer = load_tokenizer(spec.tokenizer)
-        lm = LoadedModel(
-            spec=spec,
-            cfg=cfg,
-            params=params,
-            tokenizer=tokenizer,
-            mesh=mesh,
-            last_used=time.monotonic(),
-            bytes_per_chip=per_chip_param_bytes(params) or estimate,
-            prefetched=prefetched,
-        )
         with self._lock:
-            # Publish and retire the in-flight marker atomically: a
-            # concurrent _load sees the alias in exactly one of
-            # _models / _inflight, never neither.
-            self._models[alias] = lm
-            self._inflight.pop(alias, None)
-        return lm
+            self._loading[alias] = estimate
+        try:
+            params, cfg = self._materialize(spec, dtype, mesh)
+            tokenizer = load_tokenizer(spec.tokenizer)
+            lm = LoadedModel(
+                spec=spec,
+                cfg=cfg,
+                params=params,
+                tokenizer=tokenizer,
+                mesh=mesh,
+                last_used=time.monotonic(),
+                bytes_per_chip=per_chip_param_bytes(params) or estimate,
+                prefetched=prefetched,
+            )
+            with self._lock:
+                # Publish and retire the in-flight marker atomically: a
+                # concurrent _load sees the alias in exactly one of
+                # _models / _inflight, never neither.
+                self._models[alias] = lm
+                self._inflight.pop(alias, None)
+            return lm
+        finally:
+            with self._lock:
+                self._loading.pop(alias, None)
 
     def _estimate_per_chip_bytes(self, spec: ModelSpec, dtype, mesh) -> int:
         """Per-chip weight bytes the alias WILL occupy, before loading.
@@ -268,9 +284,7 @@ class TpuEngine:
         budget = hbm_budget_bytes()
         with self._lock:
             while self._models:
-                resident = sum(
-                    m.bytes_per_chip for m in self._models.values()
-                )
+                resident = self._committed_bytes_locked()
                 if resident + needed_bytes <= budget:
                     return
                 victims = [
@@ -282,7 +296,7 @@ class TpuEngine:
                     victims, key=lambda a: self._models[a].last_used
                 )
                 del self._models[oldest]
-            resident = sum(m.bytes_per_chip for m in self._models.values())
+            resident = self._committed_bytes_locked()
         if resident + needed_bytes > budget:
             print(
                 f"warning: model needs {needed_bytes >> 20} MiB with "
@@ -330,10 +344,10 @@ class TpuEngine:
             mesh = make_mesh(spec.mesh)
             estimate = self._estimate_per_chip_bytes(spec, dtype, mesh)
             with self._lock:
-                resident = sum(
-                    m.bytes_per_chip for m in self._models.values()
+                fits = (
+                    self._committed_bytes_locked() + estimate
+                    <= hbm_budget_bytes()
                 )
-                fits = resident + estimate <= hbm_budget_bytes()
             if fits:
                 return self._load_sync(
                     alias, prefetched=True, estimate=estimate, evict=False

@@ -282,6 +282,43 @@ class TestSequenceParallelPrefill:
             out = generate(sharded, cfg, prompts, mesh=mesh, **kw)
         np.testing.assert_array_equal(ref.tokens, out.tokens)
 
+    def test_speculative_decode_on_sp_mesh_matches_dense(self):
+        """The 16k-context config's decode lever (VERDICT r3 item 9):
+        after sp prefill reshards the cache into the standard decode
+        layout, speculation runs as one GSPMD program (sp axis
+        replicated) and must reproduce single-device greedy tokens.
+        max_new > GAMMA+1 so the speculative path actually engages;
+        repetitive prompts so drafts actually accept."""
+        cfg = get_config("llama", "tiny")
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        base = [3, 7, 11, 5] * 4
+        prompts = [base + [9], base + [13]]
+        kw = dict(max_new_tokens=24, eos_ids=[], greedy=True)
+        ref = generate(params, cfg, prompts, speculative=False, **kw)
+        mesh = make_mesh({"sp": 4, "dp": 2})
+        sharded = shard_params(mesh, params)
+        with mesh:
+            out = generate(
+                sharded, cfg, prompts, mesh=mesh, speculative=True, **kw
+            )
+        np.testing.assert_array_equal(ref.tokens, out.tokens)
+
+    def test_speculative_decode_on_sp_tp_mesh_matches_dense(self):
+        """Speculation composes with sp×tp×dp (config-5 shape)."""
+        cfg = get_config("llama", "tiny")
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        base = [2, 6, 4, 8] * 4
+        prompts = [base, base[::-1]]
+        kw = dict(max_new_tokens=20, eos_ids=[], greedy=True)
+        ref = generate(params, cfg, prompts, speculative=False, **kw)
+        mesh = make_mesh({"sp": 2, "tp": 2, "dp": 2})
+        sharded = shard_params(mesh, params)
+        with mesh:
+            out = generate(
+                sharded, cfg, prompts, mesh=mesh, speculative=True, **kw
+            )
+        np.testing.assert_array_equal(ref.tokens, out.tokens)
+
     def test_sp_tp_indivisible_heads_raises(self):
         from adversarial_spec_tpu.parallel.sp import sp_prefill
 
