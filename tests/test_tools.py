@@ -1,0 +1,141 @@
+"""Harvest-analysis tools: MIN_T recommendation, tuned-env extraction,
+and bench.py's application of both."""
+
+import json
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from tools.crossover_report import (  # noqa: E402
+    load,
+    recommended_env,
+    recommended_min_t,
+)
+
+
+def _steps(rows):
+    return {r["step"]: r for r in rows}
+
+
+class TestRecommendedMinT:
+    def test_kernel_wins_everywhere(self):
+        steps = _steps(
+            [
+                {"step": f"crossover_T{t}_kernel", "decode_tok_s": 500},
+                {"step": f"crossover_T{t}_xla", "decode_tok_s": 400},
+            ][i]
+            for t in (1280, 4096)
+            for i in (0, 1)
+        )
+        assert recommended_min_t(steps) == 0
+
+    def test_clean_crossover(self):
+        steps = _steps(
+            [
+                {"step": "crossover_T1280_kernel", "decode_tok_s": 380},
+                {"step": "crossover_T1280_xla", "decode_tok_s": 490},
+                {"step": "crossover_T4096_kernel", "decode_tok_s": 400},
+                {"step": "crossover_T4096_xla", "decode_tok_s": 300},
+                {"step": "crossover_T8192_kernel", "decode_tok_s": 280},
+                {"step": "crossover_T8192_xla", "decode_tok_s": 150},
+            ]
+        )
+        assert recommended_min_t(steps) == 4096
+
+    def test_kernel_never_wins(self):
+        steps = _steps(
+            [
+                {"step": "crossover_T1280_kernel", "decode_tok_s": 300},
+                {"step": "crossover_T1280_xla", "decode_tok_s": 490},
+                {"step": "crossover_T4096_kernel", "decode_tok_s": 200},
+                {"step": "crossover_T4096_xla", "decode_tok_s": 300},
+            ]
+        )
+        assert recommended_min_t(steps) == 1 << 31  # kernel off
+
+    def test_mid_loss_resets_suffix(self):
+        """kernel wins at 1280, loses at 4096, wins at 8192 → floor is
+        8192 (the clean winning suffix), never 1280."""
+        steps = _steps(
+            [
+                {"step": "crossover_T1280_kernel", "decode_tok_s": 500},
+                {"step": "crossover_T1280_xla", "decode_tok_s": 400},
+                {"step": "crossover_T4096_kernel", "decode_tok_s": 200},
+                {"step": "crossover_T4096_xla", "decode_tok_s": 300},
+                {"step": "crossover_T8192_kernel", "decode_tok_s": 400},
+                {"step": "crossover_T8192_xla", "decode_tok_s": 300},
+            ]
+        )
+        assert recommended_min_t(steps) == 8192
+
+    def test_no_data(self):
+        assert recommended_min_t({}) is None
+
+
+class TestRecommendedEnv:
+    def test_sweep_beats_default(self):
+        steps = _steps(
+            [
+                {"step": "north_star", "decode_tok_s": 500},
+                {"step": "chunk64", "decode_tok_s": 450},
+                {"step": "chunk256", "decode_tok_s": 560},
+                {"step": "unroll1", "decode_tok_s": 480},
+                {"step": "unroll2", "decode_tok_s": 490},
+            ]
+        )
+        env = recommended_env(steps)
+        assert env["ADVSPEC_DECODE_CHUNK"] == "256"
+        assert "ADVSPEC_DECODE_UNROLL" not in env  # default 4 won
+
+    def test_defaults_win_yields_no_overrides(self):
+        steps = _steps(
+            [
+                {"step": "north_star", "decode_tok_s": 500},
+                {"step": "chunk64", "decode_tok_s": 450},
+                {"step": "unroll1", "decode_tok_s": 400},
+            ]
+        )
+        assert recommended_env(steps) == {}
+
+
+class TestBenchAppliesHarvest:
+    def test_harvested_tuning_reads_latest_jsonl(self, tmp_path,
+                                                 monkeypatch):
+        import bench
+
+        rows = [
+            {"step": "north_star", "decode_tok_s": 500},
+            {"step": "chunk256", "decode_tok_s": 600},
+            {"step": "crossover_T1280_kernel", "decode_tok_s": 380},
+            {"step": "crossover_T1280_xla", "decode_tok_s": 490},
+            {"step": "crossover_T4096_kernel", "decode_tok_s": 400},
+            {"step": "crossover_T4096_xla", "decode_tok_s": 300},
+        ]
+        results = tmp_path / "tpu_results"
+        results.mkdir()
+        (results / "r04.jsonl").write_text(
+            "\n".join(json.dumps(r) for r in rows)
+        )
+        # Point bench at the temp repo layout.
+        monkeypatch.setattr(
+            bench.os.path, "abspath", lambda p: str(tmp_path / "bench.py")
+        )
+        env = bench._harvested_tuning()
+        assert env["ADVSPEC_DECODE_CHUNK"] == "256"
+        assert env["ADVSPEC_PALLAS_MIN_T"] == "4096"
+
+    def test_no_harvest_is_empty(self, tmp_path, monkeypatch):
+        import bench
+
+        monkeypatch.setattr(
+            bench.os.path, "abspath", lambda p: str(tmp_path / "bench.py")
+        )
+        assert bench._harvested_tuning() == {}
+
+    def test_load_tolerates_junk_lines(self, tmp_path):
+        p = tmp_path / "r.jsonl"
+        p.write_text('not json\n{"step": "north_star", '
+                     '"decode_tok_s": 1}\n')
+        assert load(str(p))["north_star"]["decode_tok_s"] == 1

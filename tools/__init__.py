@@ -1,0 +1,1 @@
+"""Operational tools: coverage gate, TPU-harvest analysis, compile checks."""

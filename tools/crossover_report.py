@@ -1,0 +1,162 @@
+"""Turn a tpu_ladder results file into the MIN_T decision table.
+
+Reads the JSONL `tpu_session.sh` harvests (tpu_results/*.jsonl), prints
+the kernel-vs-XLA decode table per context length, and recommends the
+`ADVSPEC_PALLAS_MIN_T` default: 0 if the kernel wins everywhere, else
+the smallest measured T where the kernel starts winning (∞ if it never
+does). Also summarizes the lever deltas (spec/int8/paged/chunk/unroll)
+against the north-star baseline so the whole tuning story reads off one
+screen after a tunnel window.
+
+Usage: python tools/crossover_report.py [tpu_results/r04.jsonl]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def load(path: str) -> dict[str, dict]:
+    steps: dict[str, dict] = {}
+    with open(path) as f:
+        for line in f:
+            try:
+                d = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if "step" in d:
+                steps[d["step"]] = d  # last write wins (resumes)
+    return steps
+
+
+_NEVER = 1 << 31  # MIN_T sentinel: kernel off at any realistic context
+
+
+def _crossover_ts(steps: dict[str, dict]) -> list[int]:
+    """Context lengths with a kernel-side crossover measurement."""
+    return sorted(
+        int(k.split("_T")[1].split("_")[0])
+        for k in steps
+        if k.startswith("crossover_T") and k.endswith("_kernel")
+    )
+
+
+def recommended_min_t(steps: dict[str, dict]) -> int | None:
+    """ADVSPEC_PALLAS_MIN_T from crossover data: 0 if the kernel wins at
+    every measured T, the smallest T of a clean winning suffix
+    otherwise, the _NEVER sentinel (kernel off everywhere) if it never
+    wins. None when no complete pair was measured."""
+    ts = _crossover_ts(steps)
+    first_win = None
+    measured_any = False
+    for t in ts:
+        k = steps.get(f"crossover_T{t}_kernel", {}).get("decode_tok_s")
+        x = steps.get(f"crossover_T{t}_xla", {}).get("decode_tok_s")
+        if k is None or x is None:
+            continue
+        measured_any = True
+        if k >= x:
+            if first_win is None:
+                first_win = t
+        else:
+            first_win = None  # a loss resets: need a clean suffix
+    if not measured_any:
+        return None
+    if first_win == ts[0]:
+        return 0
+    if first_win is None:
+        return _NEVER  # losing at every measured T: keep the kernel off
+    return first_win
+
+
+def recommended_env(steps: dict[str, dict]) -> dict[str, str]:
+    """Env overrides justified by harvested data (empty if none).
+
+    The north-star step ran with chunk=128 / unroll=4 (the defaults);
+    the sweep steps vary one knob each. A knob is only overridden when
+    its best sweep value beats the default's measurement."""
+    env: dict[str, str] = {}
+    min_t = recommended_min_t(steps)
+    if min_t is not None:
+        env["ADVSPEC_PALLAS_MIN_T"] = str(min_t)
+    base = steps.get("north_star", {}).get("decode_tok_s")
+    if base:
+        for knob, default, options in (
+            ("ADVSPEC_DECODE_CHUNK", "128",
+             {"chunk64": "64", "chunk256": "256"}),
+            ("ADVSPEC_DECODE_UNROLL", "4",
+             {"unroll1": "1", "unroll2": "2"}),
+        ):
+            best_val, best_tok = default, base
+            for step_name, val in options.items():
+                tok = steps.get(step_name, {}).get("decode_tok_s")
+                if tok and tok > best_tok:
+                    best_val, best_tok = val, tok
+            if best_val != default:
+                env[knob] = best_val
+    return env
+
+
+def main() -> int:
+    path = sys.argv[1] if len(sys.argv) > 1 else "tpu_results/r04.jsonl"
+    try:
+        steps = load(path)
+    except FileNotFoundError:
+        print(f"no results file at {path}", file=sys.stderr)
+        return 2
+
+    ts = _crossover_ts(steps)
+    if ts:
+        print("T (ctx)   kernel tok/s   xla tok/s   winner")
+        for t in ts:
+            k = steps.get(f"crossover_T{t}_kernel", {}).get("decode_tok_s")
+            x = steps.get(f"crossover_T{t}_xla", {}).get("decode_tok_s")
+            if k is None or x is None:
+                print(f"{t:<9} (incomplete)")
+                continue
+            print(f"{t:<9} {k:<14} {x:<11} "
+                  f"{'kernel' if k >= x else 'xla'}")
+        min_t = recommended_min_t(steps)
+        if min_t == 0:
+            print("→ ADVSPEC_PALLAS_MIN_T=0 (kernel wins everywhere)")
+        elif min_t == _NEVER:
+            print("→ kernel never cleanly wins: ADVSPEC_PALLAS_MIN_T="
+                  f"{min_t} (kernel off) — investigate the grid")
+        elif min_t is not None:
+            print(f"→ ADVSPEC_PALLAS_MIN_T={min_t} (crossover; xla "
+                  "below it)")
+        env = recommended_env(steps)
+        if env:
+            print("→ tuned env: " +
+                  " ".join(f"{k}={v}" for k, v in sorted(env.items())))
+    else:
+        print("no crossover data yet")
+
+    base = steps.get("north_star", {}).get("decode_tok_s")
+    if base:
+        print(f"\nnorth_star: {base} tok/s "
+              f"(cold first-call {steps['north_star'].get('cold_wall_s')}s)")
+        for name in ("spec_on", "spec_off", "int8_kv", "paged", "greedy",
+                     "chunk64", "chunk256", "unroll1", "unroll2"):
+            v = steps.get(name, {}).get("decode_tok_s")
+            if v:
+                print(f"  {name:<9} {v:>8} tok/s  ({v / base - 1:+.1%} "
+                      "vs north_star)")
+    lc = steps.get("long_context_16k", {}).get("prefill_tok_s")
+    if lc:
+        print(f"long_context_16k prefill: {lc} tok/s")
+    tr = steps.get("profile_trace", {}).get("trace_dir")
+    if tr:
+        print(f"profile trace: {tr}")
+    if "ladder_complete" in steps:
+        print("\nladder: COMPLETE")
+    else:
+        missing = not ts or base is None
+        print("\nladder: partial" + (" (core steps missing)" if missing
+                                     else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
