@@ -57,6 +57,8 @@ from __future__ import annotations
 
 from functools import partial
 
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -67,7 +69,11 @@ from adversarial_spec_tpu.engine.sampling import (
 from adversarial_spec_tpu.models.config import ModelConfig
 from adversarial_spec_tpu.models.transformer import Cache, Params, forward
 
-GAMMA = 8  # draft length per step
+# Draft length per speculative step. Larger γ emits more tokens per
+# verification forward when drafts match (revision-heavy [SPEC] output)
+# but wastes a γ+1-wide forward when they miss; 8 is the prior, the
+# ladder's gamma sweep (tpu_ladder.py) measures the crossover on chip.
+GAMMA = int(os.environ.get("ADVSPEC_GAMMA", "8"))
 
 
 def _rowwise_slice(buf: jnp.ndarray, starts: jnp.ndarray, size: int):

@@ -62,10 +62,13 @@ from adversarial_spec_tpu.models.config import get_config
 from adversarial_spec_tpu.parallel.sharding import shard_params
 
 assert jax.process_count() == 2 and jax.device_count() == 4
+from adversarial_spec_tpu.engine.speculative import GAMMA
+
 cfg = get_config("llama", "tiny")
 params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
 prompts = [[5 + i, 7, 11 + i, 13] for i in range(4)]
-kw = dict(max_new_tokens=24, eos_ids=[], greedy=True)
+# Derived from GAMMA so an ADVSPEC_GAMMA override can't gate spec off.
+kw = dict(max_new_tokens=2 * GAMMA + 8, eos_ids=[], greedy=True)
 
 # Single-device reference (plain chunked decode, no mesh, no spec).
 ref = generate(params, cfg, prompts, speculative=False, **kw)

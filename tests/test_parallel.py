@@ -289,11 +289,15 @@ class TestSequenceParallelPrefill:
         replicated) and must reproduce single-device greedy tokens.
         max_new > GAMMA+1 so the speculative path actually engages;
         repetitive prompts so drafts actually accept."""
+        from adversarial_spec_tpu.engine.speculative import GAMMA
+
         cfg = get_config("llama", "tiny")
         params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
         base = [3, 7, 11, 5] * 4
         prompts = [base + [9], base + [13]]
-        kw = dict(max_new_tokens=24, eos_ids=[], greedy=True)
+        # Budget derived from GAMMA so an ADVSPEC_GAMMA override can't
+        # silently disable the speculative path under test.
+        kw = dict(max_new_tokens=2 * GAMMA + 8, eos_ids=[], greedy=True)
         ref = generate(params, cfg, prompts, speculative=False, **kw)
         mesh = make_mesh({"sp": 4, "dp": 2})
         sharded = shard_params(mesh, params)
@@ -305,11 +309,13 @@ class TestSequenceParallelPrefill:
 
     def test_speculative_decode_on_sp_tp_mesh_matches_dense(self):
         """Speculation composes with sp×tp×dp (config-5 shape)."""
+        from adversarial_spec_tpu.engine.speculative import GAMMA
+
         cfg = get_config("llama", "tiny")
         params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
         base = [2, 6, 4, 8] * 4
         prompts = [base, base[::-1]]
-        kw = dict(max_new_tokens=20, eos_ids=[], greedy=True)
+        kw = dict(max_new_tokens=2 * GAMMA + 4, eos_ids=[], greedy=True)
         ref = generate(params, cfg, prompts, speculative=False, **kw)
         mesh = make_mesh({"sp": 2, "tp": 2, "dp": 2})
         sharded = shard_params(mesh, params)

@@ -87,6 +87,8 @@ def recommended_env(steps: dict[str, dict]) -> dict[str, str]:
              {"chunk64": "64", "chunk256": "256"}),
             ("ADVSPEC_DECODE_UNROLL", "4",
              {"unroll1": "1", "unroll2": "2"}),
+            ("ADVSPEC_GAMMA", "8",
+             {"gamma4": "4", "gamma16": "16"}),
         ):
             best_val, best_tok = default, base
             for step_name, val in options.items():
@@ -138,7 +140,8 @@ def main() -> int:
         print(f"\nnorth_star: {base} tok/s "
               f"(cold first-call {steps['north_star'].get('cold_wall_s')}s)")
         for name in ("spec_on", "spec_off", "int8_kv", "paged", "greedy",
-                     "chunk64", "chunk256", "unroll1", "unroll2"):
+                     "chunk64", "chunk256", "unroll1", "unroll2",
+                     "gamma4", "gamma16"):
             v = steps.get(name, {}).get("decode_tok_s")
             if v:
                 print(f"  {name:<9} {v:>8} tok/s  ({v / base - 1:+.1%} "

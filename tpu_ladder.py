@@ -227,6 +227,8 @@ ENV_STEPS = {
     "chunk256": {"ADVSPEC_DECODE_CHUNK": "256"},
     "unroll1": {"ADVSPEC_DECODE_UNROLL": "1"},
     "unroll2": {"ADVSPEC_DECODE_UNROLL": "2"},
+    "gamma4": {"ADVSPEC_GAMMA": "4"},
+    "gamma16": {"ADVSPEC_GAMMA": "16"},
 }
 
 
