@@ -74,6 +74,14 @@ from adversarial_spec_tpu.models.transformer import Cache, Params, forward
 # but wastes a γ+1-wide forward when they miss; 8 is the prior, the
 # ladder's gamma sweep (tpu_ladder.py) measures the crossover on chip.
 GAMMA = int(os.environ.get("ADVSPEC_GAMMA", "8"))
+if GAMMA < 1:
+    # Fail at the knob, not deep inside a traced accept loop (γ=0 would
+    # index draft[:, -1] and run 1-wide verifies that are pure
+    # overhead). To disable speculation, pass speculative=False.
+    raise ValueError(
+        f"ADVSPEC_GAMMA must be >= 1, got {GAMMA}; use speculative=False "
+        "to turn speculation off"
+    )
 
 
 def _rowwise_slice(buf: jnp.ndarray, starts: jnp.ndarray, size: int):

@@ -1,0 +1,102 @@
+"""The TPU ladder's measurement code must be proven BEFORE a tunnel
+window: ADVSPEC_LADDER_SMOKE=1 runs the full phase-A path (and one
+phase-B env child) on CPU with tiny shapes, and the harvest must parse
+into recommendations. A bug here would otherwise meet its first
+execution during the scarce hardware session it exists to harvest."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from tools.crossover_report import load, recommended_min_t  # noqa: E402
+
+
+def _run_child(args, out_path, extra_env=None, timeout=600):
+    env = dict(os.environ)
+    env.update(
+        ADVSPEC_LADDER_SMOKE="1",
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=str(REPO_ROOT),
+    )
+    env.update(extra_env or {})
+    return subprocess.run(
+        [sys.executable, str(REPO_ROOT / "tpu_ladder.py")] + args,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=REPO_ROOT,
+    )
+
+
+@pytest.mark.slow
+def test_phase_a_smoke_records_every_step(tmp_path):
+    out = tmp_path / "smoke.jsonl"
+    proc = _run_child(["--child-main", str(out)], out)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    steps = load(str(out), include_smoke=True)
+    for required in (
+        "north_star",
+        "crossover_T256_kernel",
+        "crossover_T256_xla",
+        "spec_on",
+        "spec_off",
+        "int8_kv",
+        "paged",
+        "greedy",
+        "long_context_16k",
+        "profile_trace",
+        "phase_a_complete",
+    ):
+        assert required in steps, (required, sorted(steps))
+    assert steps["north_star"]["decode_tok_s"] > 0
+    # The harvest parses into a MIN_T recommendation (0 or the sentinel
+    # — either is fine on CPU; the point is the pipeline runs).
+    assert recommended_min_t(steps) is not None
+    # Real-harvest consumers must NOT see smoke rows.
+    assert load(str(out)) == {}
+    # The profiler trace directory materialized.
+    assert os.path.isdir(steps["profile_trace"]["trace_dir"])
+
+
+@pytest.mark.slow
+def test_phase_a_smoke_resumes_without_remeasuring(tmp_path):
+    """Steps already in the results file are skipped on re-run (the
+    resumability a flaky tunnel depends on)."""
+    out = tmp_path / "smoke.jsonl"
+    done = {
+        "step": "north_star",
+        "decode_tok_s": 123.0,
+        "sentinel": "preexisting",
+        "smoke": True,  # matches the smoke run's resumability domain
+    }
+    out.write_text(json.dumps(done) + "\n")
+    proc = _run_child(["--child-main", str(out)], out)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    north = [
+        json.loads(line)
+        for line in out.read_text().splitlines()
+        if '"north_star"' in line
+    ]
+    assert len(north) == 1 and north[0]["sentinel"] == "preexisting"
+
+
+@pytest.mark.slow
+def test_phase_b_env_child_smoke(tmp_path):
+    out = tmp_path / "smoke.jsonl"
+    proc = _run_child(
+        ["--child-env", str(out), "gamma4"],
+        out,
+        extra_env={"ADVSPEC_GAMMA": "4"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    steps = load(str(out), include_smoke=True)
+    assert steps["gamma4"]["decode_tok_s"] > 0
+    assert steps["gamma4"]["env"] == {"ADVSPEC_GAMMA": "4"}

@@ -3,10 +3,12 @@
 Reads the JSONL `tpu_session.sh` harvests (tpu_results/*.jsonl), prints
 the kernel-vs-XLA decode table per context length, and recommends the
 `ADVSPEC_PALLAS_MIN_T` default: 0 if the kernel wins everywhere, else
-the smallest measured T where the kernel starts winning (∞ if it never
-does). Also summarizes the lever deltas (spec/int8/paged/chunk/unroll)
-against the north-star baseline so the whole tuning story reads off one
-screen after a tunnel window.
+the smallest measured T where the kernel starts winning (a kernel-off
+sentinel if it never does). Also summarizes the lever deltas
+(spec/int8/paged/chunk/unroll/gamma) against the north-star baseline —
+`recommended_env` turns the sweeps into ADVSPEC_* overrides that
+bench.py applies automatically — so the whole tuning story reads off
+one screen after a tunnel window.
 
 Usage: python tools/crossover_report.py [tpu_results/r04.jsonl]
 """
@@ -17,7 +19,10 @@ import json
 import sys
 
 
-def load(path: str) -> dict[str, dict]:
+def load(path: str, include_smoke: bool = False) -> dict[str, dict]:
+    """Parse a ladder JSONL into {step: row}. Smoke rows (CPU-tiny dry
+    runs of the ladder code, tpu_ladder.py) are excluded by default —
+    they must never feed tuning recommendations."""
     steps: dict[str, dict] = {}
     with open(path) as f:
         for line in f:
@@ -25,7 +30,7 @@ def load(path: str) -> dict[str, dict]:
                 d = json.loads(line)
             except json.JSONDecodeError:
                 continue
-            if "step" in d:
+            if "step" in d and (include_smoke or not d.get("smoke")):
                 steps[d["step"]] = d  # last write wins (resumes)
     return steps
 

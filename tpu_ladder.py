@@ -27,8 +27,14 @@ compile cache):
   profile_trace            one traced warm run (jax.profiler)
 
 Phase B (one child per env setting — knobs read at import time):
-  ADVSPEC_DECODE_CHUNK in {64, 256}, ADVSPEC_DECODE_UNROLL in {1, 2}
-  (baselines chunk=128 / unroll=4 are phase A's north_star_warm).
+  ADVSPEC_DECODE_CHUNK in {64, 256}, ADVSPEC_DECODE_UNROLL in {1, 2},
+  ADVSPEC_GAMMA in {4, 16} (baselines chunk=128 / unroll=4 / gamma=8
+  are phase A's north_star).
+
+ADVSPEC_LADDER_SMOKE=1 dry-runs the whole ladder code path on CPU with
+tiny shapes (tests/test_ladder.py); smoke rows are stamped
+``"smoke": true`` and excluded from resumability and from every tuning
+consumer (tools/crossover_report.py, bench.py).
 
 Usage:
   python tpu_ladder.py --out tpu_results/r04.jsonl         # orchestrate
@@ -56,13 +62,23 @@ LONG_CONTEXT = 16384
 # ----------------------------------------------------------------- utils
 
 
+def _smoke() -> bool:
+    return os.environ.get("ADVSPEC_LADDER_SMOKE") == "1"
+
+
 def _done_steps(out_path: str) -> set[str]:
+    """Steps already recorded. Smoke rows only count as done for smoke
+    runs: a CPU smoke harvest must never satisfy (and thereby block) a
+    real hardware run's resumability check, and vice versa."""
     steps: set[str] = set()
+    want_smoke = _smoke()
     if os.path.exists(out_path):
         with open(out_path) as f:
             for line in f:
                 try:
-                    steps.add(json.loads(line)["step"])
+                    d = json.loads(line)
+                    if bool(d.get("smoke")) == want_smoke:
+                        steps.add(d["step"])
                 except Exception:
                     pass
     return steps
@@ -70,9 +86,13 @@ def _done_steps(out_path: str) -> set[str]:
 
 def _append(out_path: str, payload: dict) -> None:
     """Append one JSON line; line-buffered single write is atomic enough
-    for the single-writer-at-a-time discipline the orchestrator enforces."""
+    for the single-writer-at-a-time discipline the orchestrator enforces.
+    Smoke rows are stamped so real harvest consumers (crossover_report,
+    bench tuning, _done_steps) can exclude them."""
     payload = dict(payload)
     payload.setdefault("t_wall", round(time.time(), 1))
+    if _smoke():
+        payload["smoke"] = True
     with open(out_path, "a") as f:
         f.write(json.dumps(payload) + "\n")
         f.flush()
@@ -93,6 +113,11 @@ def _child_main(out_path: str) -> int:
     from adversarial_spec_tpu.models import transformer as T
     from adversarial_spec_tpu.models.config import get_config
 
+    # ADVSPEC_LADDER_SMOKE=1: run the WHOLE phase-A code path on CPU
+    # with a tiny config and shrunken shapes. The ladder's measurement
+    # code must never meet its first execution during a scarce tunnel
+    # window — the smoke test (tests/test_ladder.py) keeps it proven.
+    smoke = os.environ.get("ADVSPEC_LADDER_SMOKE") == "1"
     platform = jax.devices()[0].platform
     done = _done_steps(out_path)
     _append(
@@ -103,18 +128,26 @@ def _child_main(out_path: str) -> int:
             "n_devices": len(jax.devices()),
             "chunk": os.environ.get("ADVSPEC_DECODE_CHUNK", "128"),
             "unroll": os.environ.get("ADVSPEC_DECODE_UNROLL", "4"),
+            "smoke": smoke,
         },
     )
-    if platform == "cpu":
+    if platform == "cpu" and not smoke:
         # Orchestrator only launches us after a TPU probe; a CPU backend
         # here means the tunnel dropped between probe and init.
         _append(out_path, {"step": "abort_cpu_backend"})
         return 1
 
-    # One model instance serves every step: llama-1b bf16 with a 16k+
-    # window so the crossover sweep's longest context fits the cache.
-    cfg = get_config("llama", "1b", max_seq_len=LONG_CONTEXT + 512)
-    params = T.init_params(jax.random.key(0), cfg, dtype=jnp.bfloat16)
+    global BENCH_PROMPT, BENCH_DECODE, CROSSOVER_T, LONG_CONTEXT
+    if smoke:
+        BENCH_PROMPT, BENCH_DECODE = 32, 16
+        CROSSOVER_T, LONG_CONTEXT = (256,), 512
+        cfg = get_config("llama", "tiny", max_seq_len=LONG_CONTEXT + 128)
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+    else:
+        # One model instance serves every step: llama-1b bf16 with a
+        # 16k+ window so the crossover sweep's longest context fits.
+        cfg = get_config("llama", "1b", max_seq_len=LONG_CONTEXT + 512)
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.bfloat16)
     rng = __import__("random").Random(0)
 
     def prompts(n_tokens: int, b: int = BENCH_B) -> list[list[int]]:
@@ -245,15 +278,22 @@ def _child_env(out_path: str, step: str) -> int:
     from adversarial_spec_tpu.models import transformer as T
     from adversarial_spec_tpu.models.config import get_config
 
-    if jax.devices()[0].platform == "cpu":
+    smoke = os.environ.get("ADVSPEC_LADDER_SMOKE") == "1"
+    if jax.devices()[0].platform == "cpu" and not smoke:
         _append(out_path, {"step": f"{step}_abort_cpu"})
         return 1
-    cfg = get_config("llama", "1b")
-    params = T.init_params(jax.random.key(0), cfg, dtype=jnp.bfloat16)
+    if smoke:
+        cfg = get_config("llama", "tiny")
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        n_prompt, n_decode = 32, 16
+    else:
+        cfg = get_config("llama", "1b")
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.bfloat16)
+        n_prompt, n_decode = BENCH_PROMPT, BENCH_DECODE
     rng = __import__("random").Random(0)
-    p = [rng.randrange(3, cfg.vocab_size) for _ in range(BENCH_PROMPT)]
+    p = [rng.randrange(3, cfg.vocab_size) for _ in range(n_prompt)]
     prompts = [list(p) for _ in range(BENCH_B)]
-    kw = dict(max_new_tokens=BENCH_DECODE, eos_ids=[], temperature=0.7,
+    kw = dict(max_new_tokens=n_decode, eos_ids=[], temperature=0.7,
               seed=0)
     generate(params, cfg, prompts, **kw)
     t0 = time.monotonic()
