@@ -77,10 +77,12 @@ GAMMA = int(os.environ.get("ADVSPEC_GAMMA", "8"))
 if GAMMA < 1:
     # Fail at the knob, not deep inside a traced accept loop (γ=0 would
     # index draft[:, -1] and run 1-wide verifies that are pure
-    # overhead). To disable speculation, pass speculative=False.
+    # overhead). This fires at import (generate imports GAMMA on every
+    # path), so the remedy is to fix the env var, not a kwarg.
     raise ValueError(
-        f"ADVSPEC_GAMMA must be >= 1, got {GAMMA}; use speculative=False "
-        "to turn speculation off"
+        f"ADVSPEC_GAMMA must be >= 1, got {GAMMA}; unset ADVSPEC_GAMMA "
+        "(and pass speculative=False if the goal was disabling "
+        "speculation)"
     )
 
 

@@ -49,6 +49,8 @@ def test_phase_a_smoke_records_every_step(tmp_path):
         "spec_on",
         "spec_off",
         "int8_kv",
+        "int8_weights",
+        "int8_weights_kv",
         "paged",
         "greedy",
         "long_context_16k",

@@ -144,7 +144,8 @@ def main() -> int:
     if base:
         print(f"\nnorth_star: {base} tok/s "
               f"(cold first-call {steps['north_star'].get('cold_wall_s')}s)")
-        for name in ("spec_on", "spec_off", "int8_kv", "paged", "greedy",
+        for name in ("spec_on", "spec_off", "int8_kv", "int8_weights",
+                     "int8_weights_kv", "paged", "greedy",
                      "chunk64", "chunk256", "unroll1", "unroll2",
                      "gamma4", "gamma16"):
             v = steps.get(name, {}).get("decode_tok_s")

@@ -24,6 +24,7 @@ compile cache):
   long_context_16k         16k-token chunked prefill
   spec_on / spec_off       is self-speculation winning at temp 0.7?
   int8_kv / paged          quantized-KV and paged-pool deltas
+  int8_weights[_kv]        weight-bandwidth lever on the fixed pipeline
   profile_trace            one traced warm run (jax.profiler)
 
 Phase B (one child per env setting — knobs read at import time):
@@ -57,6 +58,10 @@ BENCH_PROMPT = 1024
 BENCH_DECODE = 256
 CROSSOVER_T = (1280, 4096, 8192, 16384)
 LONG_CONTEXT = 16384
+# CPU smoke-mode shapes (ADVSPEC_LADDER_SMOKE=1): one source of truth
+# for both children.
+SMOKE_PROMPT, SMOKE_DECODE = 32, 16
+SMOKE_CROSSOVER_T, SMOKE_LONG_CONTEXT = (256,), 512
 
 
 # ----------------------------------------------------------------- utils
@@ -117,7 +122,7 @@ def _child_main(out_path: str) -> int:
     # with a tiny config and shrunken shapes. The ladder's measurement
     # code must never meet its first execution during a scarce tunnel
     # window — the smoke test (tests/test_ladder.py) keeps it proven.
-    smoke = os.environ.get("ADVSPEC_LADDER_SMOKE") == "1"
+    smoke = _smoke()
     platform = jax.devices()[0].platform
     done = _done_steps(out_path)
     _append(
@@ -139,8 +144,8 @@ def _child_main(out_path: str) -> int:
 
     global BENCH_PROMPT, BENCH_DECODE, CROSSOVER_T, LONG_CONTEXT
     if smoke:
-        BENCH_PROMPT, BENCH_DECODE = 32, 16
-        CROSSOVER_T, LONG_CONTEXT = (256,), 512
+        BENCH_PROMPT, BENCH_DECODE = SMOKE_PROMPT, SMOKE_DECODE
+        CROSSOVER_T, LONG_CONTEXT = SMOKE_CROSSOVER_T, SMOKE_LONG_CONTEXT
         cfg = get_config("llama", "tiny", max_seq_len=LONG_CONTEXT + 128)
         params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
     else:
@@ -204,6 +209,23 @@ def _child_main(out_path: str) -> int:
     run("int8_kv", BENCH_PROMPT, kv_dtype="int8")
     run("paged", BENCH_PROMPT, paged=True)
     run("greedy", BENCH_PROMPT, greedy=True, temperature=0.0)
+
+    # int8 WEIGHTS: the largest single decode lever if the step is
+    # weight-bandwidth-bound (halves the bytes every step streams).
+    # Round 2 measured it neutral, but that was before the lm_head_t
+    # fix removed the ~3 ms relayout that dominated the step — re-judge
+    # it on the fixed pipeline, alone and composed with int8 KV.
+    if not {"int8_weights", "int8_weights_kv"} <= done:
+        from adversarial_spec_tpu.ops.quant import quantize_params
+
+        q_params = quantize_params(params)
+        saved, params = params, q_params
+        try:
+            run("int8_weights", BENCH_PROMPT)
+            run("int8_weights_kv", BENCH_PROMPT, kv_dtype="int8")
+        finally:
+            params = saved
+            del q_params
 
     # 4. Long context: 16k chunked prefill (single chip: no sp mesh here).
     if "long_context_16k" not in done:
@@ -278,14 +300,14 @@ def _child_env(out_path: str, step: str) -> int:
     from adversarial_spec_tpu.models import transformer as T
     from adversarial_spec_tpu.models.config import get_config
 
-    smoke = os.environ.get("ADVSPEC_LADDER_SMOKE") == "1"
+    smoke = _smoke()
     if jax.devices()[0].platform == "cpu" and not smoke:
         _append(out_path, {"step": f"{step}_abort_cpu"})
         return 1
     if smoke:
         cfg = get_config("llama", "tiny")
         params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
-        n_prompt, n_decode = 32, 16
+        n_prompt, n_decode = SMOKE_PROMPT, SMOKE_DECODE
     else:
         cfg = get_config("llama", "1b")
         params = T.init_params(jax.random.key(0), cfg, dtype=jnp.bfloat16)
