@@ -155,6 +155,25 @@ def _child_main(out_path: str) -> int:
         params = T.init_params(jax.random.key(0), cfg, dtype=jnp.bfloat16)
     rng = __import__("random").Random(0)
 
+    def hbm() -> dict:
+        """Device memory stats (bytes), {} where the backend has none —
+        the on-chip evidence for the residency-budget math
+        (engine/tpu.py:hbm_budget_bytes)."""
+        try:
+            s = jax.devices()[0].memory_stats() or {}
+            return {
+                k: s[k]
+                for k in ("bytes_in_use", "bytes_limit", "peak_bytes_in_use")
+                if k in s
+            }
+        except Exception:
+            return {}
+
+    jax.block_until_ready(params)
+    if "params_resident" not in done:
+        _append(out_path, {"step": "params_resident", **hbm()})
+        done.add("params_resident")
+
     def prompts(n_tokens: int, b: int = BENCH_B) -> list[list[int]]:
         p = [rng.randrange(3, cfg.vocab_size) for _ in range(n_tokens)]
         return [list(p) for _ in range(b)]
@@ -270,7 +289,7 @@ def _child_main(out_path: str) -> int:
         )
         done.add("profile_trace")
 
-    _append(out_path, {"step": "phase_a_complete"})
+    _append(out_path, {"step": "phase_a_complete", **hbm()})
     return 0
 
 
