@@ -11,17 +11,28 @@ cd "$(dirname "$0")"
 OUT="${1:-tpu_results/r04.jsonl}"
 mkdir -p "$(dirname "$OUT")"
 
+finish() {
+  # Post-harvest actions: decision report + a tuned bench record, so a
+  # window that opens while nobody is watching still leaves the full
+  # story (tpu_results/report.txt + bench_tuned.json) on disk.
+  echo "$(date -u +%FT%TZ) session: writing report + tuned bench"
+  python tools/crossover_report.py "$OUT" > tpu_results/report.txt 2>&1
+  python bench.py > tpu_results/bench_tuned.json 2>> tpu_results/report.txt
+  echo "$(date -u +%FT%TZ) session: done"
+  exit 0
+}
+
 while true; do
   if grep -q '"step": "ladder_complete"' "$OUT" 2>/dev/null; then
-    echo "$(date -u +%FT%TZ) session: ladder complete — exiting"
-    exit 0
+    echo "$(date -u +%FT%TZ) session: ladder complete"
+    finish
   fi
   echo "$(date -u +%FT%TZ) session: attempting ladder"
   python tpu_ladder.py --out "$OUT"
   rc=$?
   echo "$(date -u +%FT%TZ) session: ladder rc=$rc"
   if [ "$rc" = "0" ]; then
-    exit 0
+    finish
   fi
   sleep 300
 done
