@@ -19,6 +19,29 @@ from adversarial_spec_tpu.models import transformer as T
 from adversarial_spec_tpu.models.config import get_config
 
 
+def test_gamma_env_validated_at_import():
+    """ADVSPEC_GAMMA=0 must fail at the knob with an actionable message,
+    not deep inside a traced accept loop."""
+    import os
+    import subprocess
+    import sys
+    from pathlib import Path
+
+    env = dict(os.environ)
+    env.update(
+        ADVSPEC_GAMMA="0",
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=str(Path(__file__).resolve().parent.parent),
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import adversarial_spec_tpu.engine.speculative"],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode != 0
+    assert "ADVSPEC_GAMMA must be >= 1" in proc.stderr
+
+
 @pytest.fixture(scope="module")
 def tiny_model():
     cfg = get_config("llama", "tiny")

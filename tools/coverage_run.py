@@ -83,6 +83,9 @@ def main() -> int:
     parser.add_argument("--package", default="adversarial_spec_tpu")
     parser.add_argument("--report-all", action="store_true",
                         help="per-file table for every file, not worst-20")
+    parser.add_argument("--missing", metavar="SUBSTR",
+                        help="print uncovered line ranges for files whose "
+                             "path contains SUBSTR")
     args, pytest_args = parser.parse_known_args()
     # Unrecognized args (and anything after --) pass through to pytest.
 
@@ -142,6 +145,18 @@ def main() -> int:
             pct = 100.0 * len(hit) / len(lines) if lines else 100.0
             rel = os.path.relpath(path, os.path.dirname(package_root))
             rows.append((pct, rel, len(hit), len(lines)))
+            if args.missing and args.missing in path:
+                miss = sorted(lines - hit)
+                ranges, i = [], 0
+                while i < len(miss):
+                    j = i
+                    while j + 1 < len(miss) and miss[j + 1] == miss[j] + 1:
+                        j += 1
+                    ranges.append(
+                        str(miss[i]) if i == j else f"{miss[i]}-{miss[j]}"
+                    )
+                    i = j + 1
+                print(f"MISSING {rel}: {', '.join(ranges) or 'none'}")
 
     rows.sort()
     shown = rows if args.report_all else rows[:20]
