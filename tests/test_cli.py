@@ -473,3 +473,88 @@ class TestParser:
             capsys=capsys,
         )
         assert code == 0
+
+
+class TestHumanReadableOutputs:
+    """The non-JSON print branches of the informational actions: display
+    code crashes (bad f-string, missing key) must not hide behind the
+    --json-only test coverage."""
+
+    def test_providers_plain(self, monkeypatch, capsys):
+        code, out, _ = run_cli(
+            ["providers"], monkeypatch=monkeypatch, capsys=capsys
+        )
+        assert code == 0
+        assert "TPU models (local registry):" in out
+        assert "Mock models (always available):" in out
+        assert "mock://agree" in out
+
+    def test_focus_areas_plain(self, monkeypatch, capsys):
+        code, out, _ = run_cli(
+            ["focus-areas"], monkeypatch=monkeypatch, capsys=capsys
+        )
+        assert code == 0
+        assert "security" in out
+
+    def test_personas_plain(self, monkeypatch, capsys):
+        code, out, _ = run_cli(
+            ["personas"], monkeypatch=monkeypatch, capsys=capsys
+        )
+        assert code == 0
+        assert "security-engineer" in out
+
+    def test_profiles_plain_empty_and_populated(self, monkeypatch, capsys):
+        code, out, _ = run_cli(
+            ["profiles"], monkeypatch=monkeypatch, capsys=capsys
+        )
+        assert code == 0
+        code, _, _ = run_cli(
+            ["save-profile", "--name", "hr", "--models", "mock://agree"],
+            monkeypatch=monkeypatch,
+            capsys=capsys,
+        )
+        assert code == 0
+        code, out, _ = run_cli(
+            ["profiles"], monkeypatch=monkeypatch, capsys=capsys
+        )
+        assert code == 0
+        assert "hr:" in out
+
+    def test_sessions_plain(self, monkeypatch, capsys):
+        code, out, _ = run_cli(
+            ["sessions"], monkeypatch=monkeypatch, capsys=capsys
+        )
+        assert code == 0
+        run_cli(
+            ["critique", "--models", "mock://agree", "--session", "hrsess"],
+            stdin=SPEC,
+            monkeypatch=monkeypatch,
+            capsys=capsys,
+        )
+        code, out, _ = run_cli(
+            ["sessions"], monkeypatch=monkeypatch, capsys=capsys
+        )
+        assert code == 0
+        assert "hrsess" in out
+
+    def test_export_tasks_plain(self, monkeypatch, capsys):
+        code, out, _ = run_cli(
+            ["export-tasks", "--models", "mock://tasks"],
+            stdin=SPEC,
+            monkeypatch=monkeypatch,
+            capsys=capsys,
+        )
+        assert code == 0
+        assert "1. [" in out  # numbered, prioritized task lines
+
+    def test_default_models_message(self, monkeypatch, capsys):
+        """No --models: the fallback is announced on stderr and the
+        round still runs against it."""
+        code, out, err = run_cli(
+            ["critique"],
+            stdin=SPEC,
+            monkeypatch=monkeypatch,
+            capsys=capsys,
+        )
+        assert code == 0
+        assert "no --models given; defaulting to" in err
