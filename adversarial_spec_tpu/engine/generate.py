@@ -760,7 +760,12 @@ def generate(
     from adversarial_spec_tpu.engine.speculative import GAMMA
 
     if speculative is None:
-        speculative = True
+        # Unspecified → on, unless ADVSPEC_SPECULATIVE=0: the global
+        # kill-switch lets a harvested measurement (tpu_ladder spec_off
+        # vs spec_on) turn speculation off fleet-wide without touching
+        # call sites. The adaptive off-switch below still bounds the
+        # cost per call either way; this saves the one probe phase.
+        speculative = os.environ.get("ADVSPEC_SPECULATIVE", "1") != "0"
     spec_dp = 1
     spec_mesh = None
     if mesh is not None and mesh.size > 1:

@@ -99,6 +99,29 @@ class TestRecommendedEnv:
         )
         assert recommended_env(steps) == {}
 
+    def test_spec_off_beating_spec_on_sets_kill_switch(self):
+        """The comparison uses the PINNED spec_on/spec_off pair, not
+        north_star (whose speculation default is governed by the very
+        env var being recommended — a north_star baseline would flap)."""
+        steps = _steps(
+            [
+                {"step": "north_star", "decode_tok_s": 560},
+                {"step": "spec_on", "decode_tok_s": 500},
+                {"step": "spec_off", "decode_tok_s": 550},
+            ]
+        )
+        assert recommended_env(steps)["ADVSPEC_SPECULATIVE"] == "0"
+
+    def test_spec_off_losing_keeps_speculation(self):
+        steps = _steps(
+            [
+                {"step": "north_star", "decode_tok_s": 500},
+                {"step": "spec_on", "decode_tok_s": 500},
+                {"step": "spec_off", "decode_tok_s": 400},
+            ]
+        )
+        assert "ADVSPEC_SPECULATIVE" not in recommended_env(steps)
+
 
 class TestBenchAppliesHarvest:
     def test_harvested_tuning_reads_latest_jsonl(self, tmp_path,

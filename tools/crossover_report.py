@@ -102,6 +102,15 @@ def recommended_env(steps: dict[str, dict]) -> dict[str, str]:
                     best_val, best_tok = val, tok
             if best_val != default:
                 env[knob] = best_val
+        # Speculation on/off compares the two PINNED steps (spec_on
+        # passes speculative=True, spec_off False — tpu_ladder.py), not
+        # north_star: north_star's speculation default is itself
+        # governed by ADVSPEC_SPECULATIVE, so using it as the baseline
+        # would make the recommendation flap across harvest cycles.
+        spec_off = steps.get("spec_off", {}).get("decode_tok_s")
+        spec_on = steps.get("spec_on", {}).get("decode_tok_s")
+        if spec_off and spec_on and spec_off > spec_on:
+            env["ADVSPEC_SPECULATIVE"] = "0"
     return env
 
 

@@ -352,6 +352,24 @@ def _child_env(out_path: str, step: str) -> int:
     return 0
 
 
+
+def _clean_env(knobs: dict[str, str] | None = None) -> dict[str, str]:
+    """Child env for a measurement: ambient ADVSPEC_* tuning knobs are
+    stripped so the harvest records CANONICAL defaults (an operator's
+    exported kill-switch or chunk override would otherwise contaminate
+    every step, and a recommendation derived from contaminated data
+    flaps on the next cycle). The swept knobs come back via ``knobs``;
+    ADVSPEC_LADDER_SMOKE survives because it is a mode, not a tuning
+    knob."""
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if not k.startswith("ADVSPEC_") or k == "ADVSPEC_LADDER_SMOKE"
+    }
+    env.update(knobs or {})
+    return env
+
+
 # --------------------------------------------------------- orchestrator
 
 
@@ -387,7 +405,7 @@ def orchestrate(out_path: str) -> int:
     done = _done_steps(out_path)
     if "phase_a_complete" not in done:
         print("ladder: TPU probe ok — phase A", file=sys.stderr)
-        env = dict(os.environ)
+        env = _clean_env()
         child = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--child-main",
              out_path],
@@ -413,8 +431,7 @@ def orchestrate(out_path: str) -> int:
         if not _probe_tpu(timeout_s=60.0):
             print(f"ladder: tunnel gone before {step}", file=sys.stderr)
             return 2
-        env = dict(os.environ)
-        env.update(knobs)
+        env = _clean_env(knobs)
         child = subprocess.Popen(
             [sys.executable, os.path.abspath(__file__), "--child-env",
              out_path, step],
