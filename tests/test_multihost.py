@@ -133,6 +133,56 @@ def _run_two_process(probe_text, tmp_path, ok_marker, timeout=240):
         assert f"OK proc={pid} {ok_marker}" in out, out
 
 
+class TestLaunchContractErrors:
+    """maybe_initialize_distributed fails fast, with the missing piece
+    named, on a half-set launch contract — every branch raises BEFORE
+    touching jax.distributed.initialize, so these run in-process."""
+
+    def _call(self, monkeypatch, **env):
+        from adversarial_spec_tpu.parallel.mesh import (
+            maybe_initialize_distributed,
+        )
+
+        for k in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                  "JAX_PROCESS_ID"):
+            monkeypatch.delenv(k, raising=False)
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+        maybe_initialize_distributed()
+
+    def test_no_contract_is_noop(self, monkeypatch):
+        self._call(monkeypatch)  # no env: plain single-process, no error
+
+    def test_pieces_without_coordinator_fail(self, monkeypatch):
+        with pytest.raises(RuntimeError, match="JAX_COORDINATOR_ADDRESS"):
+            self._call(monkeypatch, JAX_NUM_PROCESSES="2")
+
+    def test_coordinator_without_pid_fails(self, monkeypatch):
+        with pytest.raises(RuntimeError, match="JAX_PROCESS_ID is not"):
+            self._call(
+                monkeypatch,
+                JAX_COORDINATOR_ADDRESS="127.0.0.1:1",
+                JAX_NUM_PROCESSES="2",
+            )
+
+    def test_coordinator_without_num_fails(self, monkeypatch):
+        with pytest.raises(RuntimeError, match="JAX_NUM_PROCESSES is not"):
+            self._call(
+                monkeypatch,
+                JAX_COORDINATOR_ADDRESS="127.0.0.1:1",
+                JAX_PROCESS_ID="0",
+            )
+
+    def test_non_integer_contract_fails(self, monkeypatch):
+        with pytest.raises(RuntimeError, match="must be integers"):
+            self._call(
+                monkeypatch,
+                JAX_COORDINATOR_ADDRESS="127.0.0.1:1",
+                JAX_NUM_PROCESSES="two",
+                JAX_PROCESS_ID="0",
+            )
+
+
 @pytest.mark.slow
 def test_two_process_distributed_psum(tmp_path):
     _run_two_process(_PROBE, tmp_path, "psum=6.0")
