@@ -55,6 +55,7 @@ def test_phase_a_smoke_records_every_step(tmp_path):
         "greedy",
         "long_context_16k",
         "profile_trace",
+        "config2_8b_int8_greedy",
         "phase_a_complete",
     ):
         assert required in steps, (required, sorted(steps))

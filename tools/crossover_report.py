@@ -164,6 +164,9 @@ def main() -> int:
     lc = steps.get("long_context_16k", {}).get("prefill_tok_s")
     if lc:
         print(f"long_context_16k prefill: {lc} tok/s")
+    c2 = steps.get("config2_8b_int8_greedy", {}).get("decode_tok_s")
+    if c2:
+        print(f"config2 (8B int8 greedy, 1 opponent): {c2} tok/s")
     tr = steps.get("profile_trace", {}).get("trace_dir")
     if tr:
         print(f"profile trace: {tr}")

@@ -26,6 +26,9 @@ compile cache):
   int8_kv / paged          quantized-KV and paged-pool deltas
   int8_weights[_kv]        weight-bandwidth lever on the fixed pipeline
   profile_trace            one traced warm run (jax.profiler)
+  config2_8b_int8_greedy   BASELINE config 2 shape: 8B-class int8
+                           single opponent, greedy, one chip (last —
+                           short windows bank the core steps first)
 
 Phase B (one child per env setting — knobs read at import time):
   ADVSPEC_DECODE_CHUNK in {64, 256}, ADVSPEC_DECODE_UNROLL in {1, 2},
@@ -288,6 +291,73 @@ def _child_main(out_path: str) -> int:
             },
         )
         done.add("profile_trace")
+
+    # 6. BASELINE config 2 shape, LAST in phase A (a short window should
+    # spend its minutes on the core steps above first): an 8B-class
+    # single opponent, greedy, one chip. bf16 8B (~16 GB weights) does
+    # not fit a v5e-1's HBM beside cache+activations, so the realistic
+    # single-chip serving mode is int8 weights (~8 GB). Params build
+    # LEAF-WISE — init one bf16 leaf, quantize, free — so peak HBM is
+    # the int8 total plus one bf16 leaf, never two full models. Random
+    # weights: a perf datum needs the shapes, not the logits.
+    if "config2_8b_int8_greedy" not in done:
+        from adversarial_spec_tpu.ops.quant import (
+            QUANTIZABLE,
+            quantize_int8,
+        )
+
+        del params  # free the phase-A model's HBM before the big build
+        cfg8 = get_config("llama", "tiny" if smoke else "8b")
+        shapes8 = jax.eval_shape(
+            lambda: T.init_params(jax.random.key(1), cfg8, dtype=jnp.bfloat16)
+        )
+        keyhole = [jax.random.key(7)]
+
+        def leaf8(name: str, s):
+            keyhole[0], k = jax.random.split(keyhole[0])
+            w = jax.random.normal(k, s.shape, jnp.bfloat16) * 0.02
+            out = quantize_int8(w) if name in QUANTIZABLE else w
+            # Sync per leaf: async dispatch would otherwise keep many
+            # bf16 leaves in flight and break the one-bf16-leaf peak
+            # bound this builder exists for.
+            return jax.block_until_ready(out)
+
+        def build8(tree):
+            return {
+                name: build8(v) if isinstance(v, dict) else leaf8(name, v)
+                for name, v in tree.items()
+            }
+
+        p8 = jax.block_until_ready(build8(shapes8))
+        _append(out_path, {"step": "config2_8b_params", **hbm()})
+        p1 = prompts(BENCH_PROMPT, b=1)
+        kw8 = dict(
+            max_new_tokens=BENCH_DECODE,
+            eos_ids=[],
+            greedy=True,
+            seed=0,
+            # Random weights accept ~no drafts; speculation overhead
+            # would pollute the plain-decode datum (crossover steps pin
+            # it off for the same reason).
+            speculative=False,
+        )
+        generate(p8, cfg8, p1, **kw8)  # warmup/compile
+        t0 = time.monotonic()
+        r8 = generate(p8, cfg8, p1, **kw8)
+        _append(
+            out_path,
+            {
+                "step": "config2_8b_int8_greedy",
+                "decode_tok_s": round(
+                    r8.decode_tokens / r8.decode_time_s, 1
+                ),
+                "decode_time_s": round(r8.decode_time_s, 3),
+                "prefill_time_s": round(r8.prefill_time_s, 3),
+                "wall_s": round(time.monotonic() - t0, 3),
+                **hbm(),
+            },
+        )
+        done.add("config2_8b_int8_greedy")
 
     _append(out_path, {"step": "phase_a_complete", **hbm()})
     return 0
