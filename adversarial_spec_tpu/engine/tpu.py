@@ -26,7 +26,7 @@ import os
 import sys
 import threading
 import time
-from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import Future
 from dataclasses import dataclass
 
 import jax
@@ -100,6 +100,7 @@ def per_chip_param_bytes(params) -> int:
         total += math.prod(shape) * np.dtype(leaf.dtype).itemsize
     return total
 
+
 _DTYPES = {
     "bfloat16": jnp.bfloat16,
     "float32": jnp.float32,
@@ -153,7 +154,6 @@ class TpuEngine:
         # or prefetch): counted alongside _models in every budget sum so
         # two concurrent loads can't each conclude they fit alone.
         self._loading: dict[str, int] = {}
-        self._executor: ThreadPoolExecutor | None = None
         self._pinned: set[str] = set()  # never evicted (mid-decode)
         self.prefetch_hits = 0  # prefetched loads actually consumed
 
@@ -318,13 +318,23 @@ class TpuEngine:
         with self._lock:
             if alias in self._models or alias in self._inflight:
                 return
-            if self._executor is None:
-                self._executor = ThreadPoolExecutor(
-                    max_workers=1, thread_name_prefix="advspec-prefetch"
-                )
-            self._inflight[alias] = self._executor.submit(
-                self._prefetch_task, alias
-            )
+            fut: Future = Future()
+            self._inflight[alias] = fut
+        # A DAEMON thread, not a ThreadPoolExecutor: pool threads are
+        # non-daemon and concurrent.futures joins them at interpreter
+        # exit, so a prefetch wedged on a dead TPU tunnel (this
+        # environment's signature failure mode) would hang the CLI at
+        # exit. A daemon thread dies with the process instead; the
+        # future carries results/exceptions exactly as before.
+        def _work() -> None:
+            try:
+                fut.set_result(self._prefetch_task(alias))
+            except BaseException as e:  # future owns error delivery
+                fut.set_exception(e)
+
+        threading.Thread(
+            target=_work, daemon=True, name=f"advspec-prefetch-{alias}"
+        ).start()
 
     def _prefetch_task(self, alias: str) -> LoadedModel | None:
         """Background half of _maybe_prefetch.
