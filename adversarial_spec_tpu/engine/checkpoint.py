@@ -69,6 +69,30 @@ def cache_dir_for(
     return Path(checkpoint) / ".native-cache" / fingerprint
 
 
+def _sweep_stale_tmp(cache_parent: Path, max_age_s: float = 86400.0) -> None:
+    """Remove abandoned writer tmp dirs (``*.tmp-<pid>-<hex>``).
+
+    A process killed mid-save (daemon prefetch thread at interpreter
+    exit, OOM-kill, tunnel wedge) leaves its multi-GB tmp dir behind —
+    its finally never runs. Each new writer sweeps siblings older than
+    a day: old enough that no live writer (saves take minutes, not
+    days) can be holding them. Best-effort; errors never block a save.
+    """
+    import time as _time
+
+    try:
+        now = _time.time()
+        for entry in cache_parent.iterdir():
+            if ".tmp-" in entry.name and entry.is_dir():
+                try:
+                    if now - entry.stat().st_mtime > max_age_s:
+                        shutil.rmtree(entry, ignore_errors=True)
+                except OSError:
+                    pass
+    except OSError:
+        pass
+
+
 def save_native(params, cache_dir: Path) -> None:
     """Write the converted pytree atomically.
 
@@ -80,6 +104,7 @@ def save_native(params, cache_dir: Path) -> None:
 
     cache_dir = Path(cache_dir)
     cache_dir.parent.mkdir(parents=True, exist_ok=True)
+    _sweep_stale_tmp(cache_dir.parent)
     tmp = cache_dir.with_name(
         f"{cache_dir.name}.tmp-{os.getpid()}-{uuid.uuid4().hex[:6]}"
     )

@@ -204,6 +204,7 @@ class TpuEngine:
         prefetched: bool = False,
         estimate: int | None = None,
         evict: bool = True,
+        reserved: bool = False,  # caller already put alias in _loading
     ) -> LoadedModel:
         spec = registry_mod.resolve_model_spec(f"tpu://{alias}")
         dtype = _DTYPES.get(spec.dtype, jnp.bfloat16)
@@ -218,9 +219,13 @@ class TpuEngine:
         if estimate is None:
             estimate = self._estimate_per_chip_bytes(spec, dtype, mesh)
         if evict:
-            self._evict_for(estimate)
-        with self._lock:
-            self._loading[alias] = estimate
+            # Eviction, the final fit check, and the reservation happen
+            # under ONE lock hold (reserve_as) so two concurrent loads
+            # can't both conclude they fit alone.
+            self._evict_for(estimate, reserve_as=alias)
+        elif not reserved:
+            with self._lock:
+                self._loading[alias] = estimate
         try:
             params, cfg = self._materialize(spec, dtype, mesh)
             tokenizer = load_tokenizer(spec.tokenizer)
@@ -272,7 +277,9 @@ class TpuEngine:
         )
         return per_chip_param_bytes(abstract)
 
-    def _evict_for(self, needed_bytes: int) -> None:
+    def _evict_for(
+        self, needed_bytes: int, reserve_as: str | None = None
+    ) -> None:
         """Evict LRU models until ``needed_bytes`` fits in the budget.
 
         Pinned aliases (mid-decode) are never victims. If everything
@@ -286,7 +293,7 @@ class TpuEngine:
             while self._models:
                 resident = self._committed_bytes_locked()
                 if resident + needed_bytes <= budget:
-                    return
+                    break
                 victims = [
                     a for a in self._models if a not in self._pinned
                 ]
@@ -297,6 +304,10 @@ class TpuEngine:
                 )
                 del self._models[oldest]
             resident = self._committed_bytes_locked()
+            if reserve_as is not None:
+                # Reserve atomically with the final fit check: a
+                # concurrent load's check now sees these bytes.
+                self._loading[reserve_as] = needed_bytes
         if resident + needed_bytes > budget:
             print(
                 f"warning: model needs {needed_bytes >> 20} MiB with "
@@ -332,9 +343,17 @@ class TpuEngine:
             except BaseException as e:  # future owns error delivery
                 fut.set_exception(e)
 
-        threading.Thread(
-            target=_work, daemon=True, name=f"advspec-prefetch-{alias}"
-        ).start()
+        try:
+            threading.Thread(
+                target=_work, daemon=True, name=f"advspec-prefetch-{alias}"
+            ).start()
+        except BaseException as e:
+            # start() failing (thread exhaustion) must not leave a
+            # forever-pending future registered — later loads would
+            # block on it without timeout.
+            with self._lock:
+                self._inflight.pop(alias, None)
+            fut.set_exception(e)
 
     def _prefetch_task(self, alias: str) -> LoadedModel | None:
         """Background half of _maybe_prefetch.
@@ -358,18 +377,29 @@ class TpuEngine:
                     self._committed_bytes_locked() + estimate
                     <= hbm_budget_bytes()
                 )
+                if fits:
+                    # Reserve atomically with the check: a concurrent
+                    # foreground load's budget math must see these
+                    # bytes before this thread starts materializing.
+                    self._loading[alias] = estimate
             if fits:
                 return self._load_sync(
-                    alias, prefetched=True, estimate=estimate, evict=False
+                    alias,
+                    prefetched=True,
+                    estimate=estimate,
+                    evict=False,
+                    reserved=True,
                 )
             return None
         finally:
-            # _load_sync pops the marker when it publishes; pop here for
-            # the not-fits and exception exits so a dead future never
-            # blocks later prefetches or loads of this alias.
+            # _load_sync pops the markers when it publishes; pop here
+            # for the not-fits and exception exits (including a raise
+            # before _load_sync's own try/finally) so a dead future or
+            # stale reservation never blocks later loads of this alias.
             with self._lock:
                 if not isinstance(self._models.get(alias), LoadedModel):
                     self._inflight.pop(alias, None)
+                    self._loading.pop(alias, None)
 
     def _materialize(self, spec: ModelSpec, dtype, mesh):
         """Params via the fastest available source: native Orbax cache

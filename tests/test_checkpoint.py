@@ -79,6 +79,28 @@ class TestNativeCacheRoundtrip:
         ckpt_mod.save_native(params, cache_dir)
         assert not (tmp_path / "n" / "fp.tmp").exists()
 
+    def test_save_sweeps_stale_abandoned_tmp(self, tmp_path):
+        """A writer killed mid-save (daemon thread at exit, OOM-kill)
+        leaves its tmp dir; the next save removes day-old orphans but
+        never a fresh sibling (a live concurrent writer's)."""
+        import os
+        import time
+
+        parent = tmp_path / "n"
+        parent.mkdir()
+        stale = parent / "fp.tmp-999-aaaaaa"
+        fresh = parent / "fp.tmp-998-bbbbbb"
+        stale.mkdir()
+        fresh.mkdir()
+        old = time.time() - 2 * 86400
+        os.utime(stale, (old, old))
+
+        cfg = get_config("llama", "tiny")
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        ckpt_mod.save_native(params, parent / "fp")
+        assert not stale.exists()
+        assert fresh.exists()
+
 
 class TestCacheRobustness:
     def test_fingerprint_changes_when_weights_replaced(self, tmp_path):
