@@ -109,13 +109,18 @@ def preflight_config(
         got = hf.get(hf_key)
         if got is None:
             continue
-        ok = (
-            abs(float(got) - float(want)) < 1e-6
-            if isinstance(want, float)
-            else bool(got) == want
-            if isinstance(want, bool)
-            else int(got) == want
-        )
+        try:
+            ok = (
+                abs(float(got) - float(want)) < 1e-6
+                if isinstance(want, float)
+                else bool(got) == want
+                if isinstance(want, bool)
+                else int(got) == want
+            )
+        except (TypeError, ValueError):
+            # A malformed value (string where a number belongs) is a
+            # mismatch to report, never a crash.
+            ok = False
         if not ok:
             problems.append(
                 f"{hf_key}: checkpoint has {got!r}, registered config "
@@ -123,13 +128,23 @@ def preflight_config(
             )
 
     theta = hf.get("rope_theta")
-    if theta is not None and abs(float(theta) - cfg.rope_theta) > 1e-3:
-        problems.append(
-            f"rope_theta: checkpoint has {theta!r}, registered config "
-            f"has {cfg.rope_theta!r}"
-        )
+    if theta is not None:
+        try:
+            theta_mismatch = abs(float(theta) - cfg.rope_theta) > 1e-3
+        except (TypeError, ValueError):
+            theta_mismatch = True
+        if theta_mismatch:
+            problems.append(
+                f"rope_theta: checkpoint has {theta!r}, registered config "
+                f"has {cfg.rope_theta!r}"
+            )
 
     rs = hf.get("rope_scaling")
+    if rs is not None and not isinstance(rs, dict):
+        problems.append(
+            f"rope_scaling: checkpoint value {rs!r} is not an object"
+        )
+        rs = None
     rs_type = (rs or {}).get("rope_type", (rs or {}).get("type"))
     if rs and rs_type == "llama3":
         if cfg.rope_scaling is None:
@@ -151,7 +166,13 @@ def preflight_config(
                 ),
             ]
             for key, got, want in pairs:
-                if got is not None and abs(float(got) - want) > 1e-6:
+                if got is None:
+                    continue
+                try:
+                    pair_mismatch = abs(float(got) - want) > 1e-6
+                except (TypeError, ValueError):
+                    pair_mismatch = True
+                if pair_mismatch:
                     problems.append(
                         f"rope_scaling.{key}: checkpoint has {got!r}, "
                         f"registered config has {want!r}"

@@ -251,6 +251,30 @@ class TestPreflightConfig:
         with pytest.raises(CheckpointConfigError, match="unreadable"):
             preflight_config(tmp_path, cfg, "llama")
 
+    def test_weird_typed_values_never_crash(self, tmp_path):
+        """Arbitrary JSON values (strings where numbers belong, objects,
+        lists) report as mismatches, never raise TypeError/ValueError."""
+        import random
+
+        cfg = get_config("llama", "tiny")
+        rng = random.Random(0)
+        weird = ["x", None, [], [1], {"a": 1}, "12abc", True, -3.5, 1e99]
+        keys = [
+            "hidden_size", "num_hidden_layers", "num_attention_heads",
+            "num_key_value_heads", "intermediate_size", "vocab_size",
+            "head_dim", "sliding_window", "tie_word_embeddings",
+            "rope_theta", "rope_scaling", "model_type",
+        ]
+        for trial in range(50):
+            conf = {
+                k: rng.choice(weird) for k in rng.sample(keys, 5)
+            }
+            (tmp_path / "config.json").write_text(json.dumps(conf))
+            try:
+                preflight_config(tmp_path, cfg, "llama")
+            except CheckpointConfigError:
+                pass  # mismatch report is the correct outcome
+
     def test_materialize_random_is_deterministic(self):
         a, cfg_a = materialize_params("random", "llama", "tiny", seed=3)
         b, _ = materialize_params("random", "llama", "tiny", seed=3)
