@@ -110,13 +110,17 @@ def preflight_config(
         if got is None:
             continue
         try:
-            ok = (
-                abs(float(got) - float(want)) < 1e-6
-                if isinstance(want, float)
-                else bool(got) == want
-                if isinstance(want, bool)
-                else int(got) == want
-            )
+            if isinstance(want, bool):
+                # Only a real JSON boolean (or 0/1) may match — bool([])
+                # style coercion would silently pass malformed values.
+                ok = (
+                    isinstance(got, bool)
+                    or (isinstance(got, int) and got in (0, 1))
+                ) and bool(got) == want
+            elif isinstance(want, float):
+                ok = abs(float(got) - float(want)) < 1e-6
+            else:
+                ok = int(got) == want
         except (TypeError, ValueError):
             # A malformed value (string where a number belongs) is a
             # mismatch to report, never a crash.

@@ -24,6 +24,8 @@ config, saying so in the "platform" field rather than hanging the driver.
 Modes:
   python bench.py                 # north-star decode bench (one JSON line)
   python bench.py --long-context  # 16k-token prefill bench (one JSON line)
+  python bench.py --round-loop    # BASELINE config 4 shape: 5 rounds,
+                                  # growing spec, 4 opponents (one line)
 """
 
 from __future__ import annotations
@@ -88,39 +90,24 @@ def _probe_tpu(timeout_s: float = 120.0) -> bool:
     return False  # timed out: leave the probe alone, fall back to CPU
 
 
-def _run_bench(platform: str) -> dict:
-    from adversarial_spec_tpu.utils.jaxenv import configure_jax
-
-    configure_jax()  # persistent compile cache: repeat runs skip XLA compiles
+def _bench_model(platform: str):
+    """Shared model setup for the decode benches (_run_bench and
+    _run_round_loop): size/dtype by platform, dp×tp mesh sharding on
+    multi-chip hosts — ONE copy so a mode can't silently drop the mesh
+    and misreport 'per chip'."""
     import jax
+    import jax.numpy as jnp
 
-    from adversarial_spec_tpu.engine.generate import generate
     from adversarial_spec_tpu.models import transformer as T
     from adversarial_spec_tpu.models.config import get_config
 
-    # Real-accelerator bench uses the 1b llama shape (fits one v5e chip in
-    # bf16 with cache headroom); CPU fallback uses the tiny config so the
-    # driver always gets a number instead of a multi-hour crawl.
     size = "1b" if platform != "cpu" else "tiny"
-    import jax.numpy as jnp
-
     cfg = get_config("llama", size)
     params = T.init_params(
         jax.random.key(0),
         cfg,
         dtype=jnp.bfloat16 if platform != "cpu" else jnp.float32,
     )
-
-    # The real debate-round shape: every opponent critiques the SAME spec
-    # prompt (shared-prefix prefill fires on one chip), and temperature
-    # sampling diverges the rows — exactly what a critique round does.
-    rng = __import__("random").Random(0)
-    prompt = [rng.randrange(3, cfg.vocab_size) for _ in range(PROMPT_TOKENS)]
-    prompts = [list(prompt) for _ in range(N_OPPONENTS)]
-
-    # Multi-chip: shard the round over a dp×tp mesh so every chip
-    # participates before dividing by chip count; single chip (the usual
-    # bench hardware) and CPU run unsharded and divide by 1.
     n_devices = len(jax.devices())
     mesh = None
     n_chips = 1
@@ -134,6 +121,27 @@ def _run_bench(platform: str) -> dict:
         mesh = make_mesh({"dp": dp, "tp": n_devices // dp})
         params = shard_params(mesh, params)
         n_chips = n_devices
+    return cfg, params, mesh, n_chips, size
+
+
+def _run_bench(platform: str) -> dict:
+    from adversarial_spec_tpu.utils.jaxenv import configure_jax
+
+    configure_jax()  # persistent compile cache: repeat runs skip XLA compiles
+    import jax
+
+    from adversarial_spec_tpu.engine.generate import generate
+
+    # Real-accelerator bench uses the 1b llama shape (fits one v5e chip
+    # in bf16 with cache headroom); CPU fallback uses the tiny config so
+    # the driver always gets a number instead of a multi-hour crawl.
+    # The real debate-round shape: every opponent critiques the SAME
+    # spec prompt (shared-prefix prefill fires on one chip), and
+    # temperature sampling diverges the rows.
+    cfg, params, mesh, n_chips, size = _bench_model(platform)
+    rng = __import__("random").Random(0)
+    prompt = [rng.randrange(3, cfg.vocab_size) for _ in range(PROMPT_TOKENS)]
+    prompts = [list(prompt) for _ in range(N_OPPONENTS)]
 
     kw = dict(
         max_new_tokens=DECODE_TOKENS,
@@ -248,6 +256,79 @@ def _run_long_context(platform: str) -> dict:
     }
 
 
+def _run_round_loop(platform: str) -> dict:
+    """BASELINE config 4's loop shape: 5 critique rounds over one spec,
+    4 opponents per round, the spec GROWING by one revision per round
+    (each round re-prefills the larger context — the part the one-round
+    bench cannot see). Decode throughput is the north-star metric; the
+    whole-loop wall time additionally covers the prefill regrowth."""
+    from adversarial_spec_tpu.utils.jaxenv import configure_jax
+
+    configure_jax()
+
+    from adversarial_spec_tpu.engine.generate import generate
+
+    n_rounds = 5
+    revision_tokens = 256  # per round: the synthesized revision delta
+
+    cfg, params, mesh, n_chips, size = _bench_model(platform)
+    rng = __import__("random").Random(0)
+    spec = [rng.randrange(3, cfg.vocab_size) for _ in range(PROMPT_TOKENS)]
+
+    kw = dict(
+        max_new_tokens=DECODE_TOKENS,
+        eos_ids=[],
+        temperature=0.7,
+        seed=0,
+        mesh=mesh,
+    )
+    # Warm up EVERY bucket the loop will hit (prompts pad to power-of-two
+    # buckets; round 1's 1024 bucket and rounds 2-5's 2048 bucket are
+    # different compiled programs) so the timed loop measures steady
+    # state, never an XLA compile.
+    largest = spec + [5] * (revision_tokens * (n_rounds - 1))
+    generate(params, cfg, [list(largest)] * N_OPPONENTS, **kw)
+    generate(params, cfg, [list(spec)] * N_OPPONENTS, **kw)
+
+    decode_tokens = 0
+    decode_time = prefill_time = 0.0
+    t0 = time.monotonic()
+    for _ in range(n_rounds):
+        r = generate(
+            params, cfg, [list(spec)] * N_OPPONENTS, **kw
+        )
+        decode_tokens += r.decode_tokens
+        decode_time += r.decode_time_s
+        prefill_time += r.prefill_time_s
+        # Synthesize: the spec grows by one revision's worth of tokens.
+        spec = spec + [
+            rng.randrange(3, cfg.vocab_size) for _ in range(revision_tokens)
+        ]
+    wall = time.monotonic() - t0
+
+    tok_s = decode_tokens / decode_time / n_chips
+    return {
+        "metric": "round_loop_critique_tokens_per_sec_per_chip",
+        "value": round(tok_s, 1),
+        "unit": "tok/s/chip",
+        "vs_baseline": (
+            round(tok_s / BASELINE_TOK_S_CHIP, 3)
+            if platform != "cpu"
+            else None
+        ),
+        "platform": platform,
+        "model": f"llama-{size}",
+        "rounds": n_rounds,
+        "opponents": N_OPPONENTS,
+        "spec_tokens_start": PROMPT_TOKENS,
+        "spec_tokens_end": PROMPT_TOKENS + revision_tokens * n_rounds,
+        "decode_tokens_total": decode_tokens,
+        "decode_time_s": round(decode_time, 3),
+        "prefill_time_s": round(prefill_time, 3),
+        "loop_wall_s": round(wall, 3),
+    }
+
+
 def _run_cpu_fallback(runner, note: str | None = None) -> dict:
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
@@ -340,8 +421,12 @@ def _run_tpu_in_child(mode_flag: str, timeout_s: float) -> dict | None:
 
 def main() -> int:
     args = sys.argv[1:]
-    long_context = "--long-context" in args
-    runner = _run_long_context if long_context else _run_bench
+    if "--long-context" in args:
+        mode_flag, runner = "--long-context", _run_long_context
+    elif "--round-loop" in args:
+        mode_flag, runner = "--round-loop", _run_round_loop
+    else:
+        mode_flag, runner = "", _run_bench
 
     if "--_tpu-child" in args:
         # Child mode: we own the tunnel; run on whatever backend jax finds
@@ -360,9 +445,7 @@ def main() -> int:
         payload = _run_cpu_fallback(runner)
     else:
         timeout_s = float(os.environ.get("BENCH_TPU_TIMEOUT_S", "1500"))
-        payload = _run_tpu_in_child(
-            "--long-context" if long_context else "", timeout_s
-        )
+        payload = _run_tpu_in_child(mode_flag, timeout_s)
         if payload is None:
             payload = _run_cpu_fallback(
                 runner,
