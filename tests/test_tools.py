@@ -5,6 +5,8 @@ import json
 import sys
 from pathlib import Path
 
+import pytest
+
 REPO_ROOT = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO_ROOT))
 
@@ -156,6 +158,18 @@ class TestBenchAppliesHarvest:
             bench.os.path, "abspath", lambda p: str(tmp_path / "bench.py")
         )
         assert bench._harvested_tuning() == {}
+
+    @pytest.mark.slow
+    def test_round_loop_mode_runs(self):
+        """The config-4-shaped bench mode produces a complete record
+        (driver-facing surface; pinned so the mode can't rot)."""
+        import bench
+
+        out = bench._run_round_loop("cpu")
+        assert out["rounds"] == 5
+        assert out["decode_tokens_total"] == 5 * 4 * 256
+        assert out["value"] > 0
+        assert out["vs_baseline"] is None  # cpu: no north-star ratio
 
     def test_load_tolerates_junk_lines(self, tmp_path):
         p = tmp_path / "r.jsonl"
