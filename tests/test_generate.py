@@ -382,3 +382,55 @@ class TestGenerate:
         )
         assert out.prefill_time_s > 0
         assert out.decode_time_s >= 0
+
+
+class TestDecodeStateMachineFuzz:
+    """Seeded mini-fuzz over the decode loop's state machine — mixed
+    prompt lengths, random EOS vocab, speculation on/off — pinning the
+    invariants that survive every path (sync, desync, catch-up, early
+    EOS): per-row counts within budget, zero-fill after each row's end,
+    and greedy speculation bit-identical to greedy plain decode."""
+
+    def test_invariants_over_random_shapes(self, tiny_model):
+        import random
+
+        import numpy as np
+
+        params, cfg = tiny_model
+        rng = random.Random(7)
+        for trial in range(8):
+            b = rng.choice([1, 2, 3, 5])
+            prompts = []
+            for _ in range(b):
+                n = rng.randrange(2, 24)
+                base = [rng.randrange(3, cfg.vocab_size) for _ in range(n)]
+                if rng.random() < 0.5:  # repetition helps drafts accept
+                    base = (base * 4)[:n * 2]
+                prompts.append(base)
+            max_new = rng.choice([4, 12, 24])
+            eos = (
+                [rng.randrange(3, cfg.vocab_size)]
+                if rng.random() < 0.5
+                else []
+            )
+            kw = dict(max_new_tokens=max_new, eos_ids=eos, greedy=True)
+            plain = generate(params, cfg, prompts, speculative=False, **kw)
+            spec = generate(params, cfg, prompts, speculative=True, **kw)
+
+            for r in (plain, spec):
+                assert r.tokens.shape == (b, max_new)
+                assert (r.n_generated >= 0).all()
+                assert (r.n_generated <= max_new).all()
+                for row in range(b):
+                    n = int(r.n_generated[row])
+                    # Zero-fill after each row's end (EOS contract).
+                    assert (r.tokens[row, n:] == 0).all(), (trial, row)
+                    if eos and n < max_new:
+                        # A short row must have stopped AT its EOS.
+                        assert r.tokens[row, n - 1] == eos[0], (trial, row)
+            np.testing.assert_array_equal(
+                plain.tokens, spec.tokens, err_msg=f"trial {trial}"
+            )
+            np.testing.assert_array_equal(
+                plain.n_generated, spec.n_generated, err_msg=f"trial {trial}"
+            )
