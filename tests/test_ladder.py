@@ -103,3 +103,102 @@ def test_phase_b_env_child_smoke(tmp_path):
     steps = load(str(out), include_smoke=True)
     assert steps["gamma4"]["decode_tok_s"] > 0
     assert steps["gamma4"]["env"] == {"ADVSPEC_GAMMA": "4"}
+
+
+class TestOrchestrator:
+    """The orchestrator's unattended branching: probe gating, skip of a
+    completed phase A, phase-B completeness, and the final marker."""
+
+    def _steps_file(self, tmp_path, steps):
+        out = tmp_path / "r.jsonl"
+        out.write_text(
+            "\n".join(json.dumps({"step": s, "decode_tok_s": 1.0})
+                      for s in steps) + "\n"
+        )
+        return out
+
+    def test_probe_failure_runs_nothing(self, tmp_path, monkeypatch):
+        import bench
+        import tpu_ladder
+
+        monkeypatch.delenv("ADVSPEC_LADDER_SMOKE", raising=False)
+        monkeypatch.setattr(bench, "_probe_tpu", lambda **kw: False)
+        monkeypatch.setattr(
+            tpu_ladder.subprocess, "Popen",
+            lambda *a, **k: pytest.fail("no child may launch"),
+        )
+        out = tmp_path / "r.jsonl"
+        assert tpu_ladder.orchestrate(str(out)) == 3
+        assert not out.exists() or "ladder_complete" not in out.read_text()
+
+    def test_fully_harvested_file_completes_without_children(
+        self, tmp_path, monkeypatch
+    ):
+        import bench
+        import tpu_ladder
+
+        monkeypatch.delenv("ADVSPEC_LADDER_SMOKE", raising=False)
+        out = self._steps_file(
+            tmp_path, ["phase_a_complete", *tpu_ladder.ENV_STEPS]
+        )
+        monkeypatch.setattr(bench, "_probe_tpu", lambda **kw: True)
+        monkeypatch.setattr(
+            tpu_ladder.subprocess, "Popen",
+            lambda *a, **k: pytest.fail("no child may launch"),
+        )
+        assert tpu_ladder.orchestrate(str(out)) == 0
+        assert "ladder_complete" in out.read_text()
+
+    def test_missing_env_step_launches_only_it(self, tmp_path, monkeypatch):
+        import bench
+        import tpu_ladder
+
+        monkeypatch.delenv("ADVSPEC_LADDER_SMOKE", raising=False)
+        done = [s for s in tpu_ladder.ENV_STEPS if s != "gamma16"]
+        out = self._steps_file(tmp_path, ["phase_a_complete", *done])
+        monkeypatch.setattr(bench, "_probe_tpu", lambda **kw: True)
+        launched = []
+
+        class FakeChild:
+            def __init__(self, cmd, **kw):
+                i = cmd.index("--child-env")
+                step = cmd[i + 2]
+                launched.append(step)
+                with open(cmd[i + 1], "a") as f:
+                    f.write(
+                        json.dumps({"step": step, "decode_tok_s": 1.0})
+                        + "\n"
+                    )
+
+            def poll(self):
+                return 0
+
+        monkeypatch.setattr(tpu_ladder.subprocess, "Popen", FakeChild)
+        assert tpu_ladder.orchestrate(str(out)) == 0
+        assert launched == ["gamma16"]
+        assert "ladder_complete" in out.read_text()
+
+    def test_env_child_without_record_is_incomplete(
+        self, tmp_path, monkeypatch
+    ):
+        """A phase-B child that exits without recording its step must
+        leave the ladder INCOMPLETE (rc=2, no ladder_complete) so the
+        session loop retries."""
+        import bench
+        import tpu_ladder
+
+        monkeypatch.delenv("ADVSPEC_LADDER_SMOKE", raising=False)
+        done = [s for s in tpu_ladder.ENV_STEPS if s != "gamma16"]
+        out = self._steps_file(tmp_path, ["phase_a_complete", *done])
+        monkeypatch.setattr(bench, "_probe_tpu", lambda **kw: True)
+
+        class SilentChild:
+            def __init__(self, *a, **k):
+                pass
+
+            def poll(self):
+                return 1  # died without writing its row
+
+        monkeypatch.setattr(tpu_ladder.subprocess, "Popen", SilentChild)
+        assert tpu_ladder.orchestrate(str(out)) == 2
+        assert "ladder_complete" not in out.read_text()
