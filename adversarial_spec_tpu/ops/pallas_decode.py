@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -53,6 +54,13 @@ _SUBLANE = 8
 _TILE_VMEM_BUDGET = 1 << 20
 
 
+# Operator/harvest override for the KV tile length: the VMEM-budget
+# heuristic below picks the largest fitting block, but the DMA-size vs
+# grid-parallelism balance is an empirical question the ladder's blockt
+# sweep (tpu_ladder.py) answers on chip. 0 = auto.
+_BLOCK_T_OVERRIDE = int(os.environ.get("ADVSPEC_BLOCK_T", "0"))
+
+
 def _pick_block_t(T: int, n_kv: int, D: int, itemsize: int) -> int:
     """Largest block that divides the (static) cache length AND keeps one
     [Hkv, block_t, D] tile under the VMEM budget.
@@ -62,6 +70,11 @@ def _pick_block_t(T: int, n_kv: int, D: int, itemsize: int) -> int:
     falling back to block_t=T here would materialize an [Hkv, T, D]
     tile — Hkv× the VMEM blowup of a normal tile, a silent OOM trap for
     direct kernel callers — so refuse instead (ADVICE r3)."""
+    if _BLOCK_T_OVERRIDE and T % _BLOCK_T_OVERRIDE == 0:
+        return _BLOCK_T_OVERRIDE
+    # A non-dividing override falls through to the auto pick (a sweep
+    # must stay valid across every shape the run touches); the auto
+    # path still refuses shapes with NO valid block below.
     fit = [
         c
         for c in (512, 256, 128, 64, 32, 16, 8)

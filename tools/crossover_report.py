@@ -94,6 +94,9 @@ def recommended_env(steps: dict[str, dict]) -> dict[str, str]:
              {"unroll1": "1", "unroll2": "2"}),
             ("ADVSPEC_GAMMA", "8",
              {"gamma4": "4", "gamma16": "16"}),
+            # Default "0" = auto (VMEM-budget largest-fit pick).
+            ("ADVSPEC_BLOCK_T", "0",
+             {"blockt128": "128", "blockt256": "256"}),
         ):
             best_val, best_tok = default, base
             for step_name, val in options.items():
@@ -156,7 +159,7 @@ def main() -> int:
         for name in ("spec_on", "spec_off", "int8_kv", "int8_weights",
                      "int8_weights_kv", "paged", "greedy",
                      "chunk64", "chunk256", "unroll1", "unroll2",
-                     "gamma4", "gamma16"):
+                     "gamma4", "gamma16", "blockt128", "blockt256"):
             v = steps.get(name, {}).get("decode_tok_s")
             if v:
                 print(f"  {name:<9} {v:>8} tok/s  ({v / base - 1:+.1%} "

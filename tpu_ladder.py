@@ -32,8 +32,9 @@ compile cache):
 
 Phase B (one child per env setting — knobs read at import time):
   ADVSPEC_DECODE_CHUNK in {64, 256}, ADVSPEC_DECODE_UNROLL in {1, 2},
-  ADVSPEC_GAMMA in {4, 16} (baselines chunk=128 / unroll=4 / gamma=8
-  are phase A's north_star).
+  ADVSPEC_GAMMA in {4, 16}, ADVSPEC_BLOCK_T in {128, 256} (baselines
+  chunk=128 / unroll=4 / gamma=8 / block_t=auto are phase A's
+  north_star).
 
 ADVSPEC_LADDER_SMOKE=1 dry-runs the whole ladder code path on CPU with
 tiny shapes (tests/test_ladder.py); smoke rows are stamped
@@ -373,6 +374,8 @@ ENV_STEPS = {
     "unroll2": {"ADVSPEC_DECODE_UNROLL": "2"},
     "gamma4": {"ADVSPEC_GAMMA": "4"},
     "gamma16": {"ADVSPEC_GAMMA": "16"},
+    "blockt128": {"ADVSPEC_BLOCK_T": "128"},
+    "blockt256": {"ADVSPEC_BLOCK_T": "256"},
 }
 
 
