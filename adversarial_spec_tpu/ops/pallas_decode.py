@@ -59,6 +59,24 @@ _TILE_VMEM_BUDGET = 1 << 20
 # grid-parallelism balance is an empirical question the ladder's blockt
 # sweep (tpu_ladder.py) answers on chip. 0 = auto.
 _BLOCK_T_OVERRIDE = int(os.environ.get("ADVSPEC_BLOCK_T", "0"))
+_warned_block_t: set[int] = set()
+
+
+def _warn_block_t_fallback(T: int) -> None:
+    """Say ONCE per cache length that the override was unusable there —
+    a silent fallback would let an operator attribute auto-pick timings
+    to the block_t they exported."""
+    if T not in _warned_block_t:
+        _warned_block_t.add(T)
+        import sys
+
+        print(
+            f"warning: ADVSPEC_BLOCK_T={_BLOCK_T_OVERRIDE} unusable at "
+            f"cache length T={T} (needs a positive multiple of "
+            f"{_SUBLANE} dividing T within 8x the VMEM budget); using "
+            "the auto pick for this shape",
+            file=sys.stderr,
+        )
 
 
 def _pick_block_t(T: int, n_kv: int, D: int, itemsize: int) -> int:
@@ -70,11 +88,24 @@ def _pick_block_t(T: int, n_kv: int, D: int, itemsize: int) -> int:
     falling back to block_t=T here would materialize an [Hkv, T, D]
     tile — Hkv× the VMEM blowup of a normal tile, a silent OOM trap for
     direct kernel callers — so refuse instead (ADVICE r3)."""
-    if _BLOCK_T_OVERRIDE and T % _BLOCK_T_OVERRIDE == 0:
-        return _BLOCK_T_OVERRIDE
-    # A non-dividing override falls through to the auto pick (a sweep
-    # must stay valid across every shape the run touches); the auto
-    # path still refuses shapes with NO valid block below.
+    if _BLOCK_T_OVERRIDE:
+        ok = (
+            _BLOCK_T_OVERRIDE > 0
+            and _BLOCK_T_OVERRIDE % _SUBLANE == 0
+            and T % _BLOCK_T_OVERRIDE == 0
+            # Generous ceiling (8× the auto heuristic's budget): an
+            # override may deliberately trade VMEM for DMA size, but an
+            # [Hkv, T, D]-scale tile is the OOM trap this function
+            # exists to refuse.
+            and n_kv * _BLOCK_T_OVERRIDE * D * itemsize
+            <= 8 * _TILE_VMEM_BUDGET
+        )
+        if ok:
+            return _BLOCK_T_OVERRIDE
+        _warn_block_t_fallback(T)
+    # An unusable override falls through to the auto pick (a sweep must
+    # stay valid across every shape the run touches); the auto path
+    # still refuses shapes with NO valid block below.
     fit = [
         c
         for c in (512, 256, 128, 64, 32, 16, 8)

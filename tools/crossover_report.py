@@ -156,14 +156,22 @@ def main() -> int:
     if base:
         print(f"\nnorth_star: {base} tok/s "
               f"(cold first-call {steps['north_star'].get('cold_wall_s')}s)")
-        for name in ("spec_on", "spec_off", "int8_kv", "int8_weights",
-                     "int8_weights_kv", "paged", "greedy",
-                     "chunk64", "chunk256", "unroll1", "unroll2",
-                     "gamma4", "gamma16", "blockt128", "blockt256"):
-            v = steps.get(name, {}).get("decode_tok_s")
-            if v:
-                print(f"  {name:<9} {v:>8} tok/s  ({v / base - 1:+.1%} "
-                      "vs north_star)")
+        # Derived from the harvest itself so a new ladder step can never
+        # be invisible here: every decode-rate row except the baseline,
+        # the crossover pairs, and the separately-printed specials.
+        lever_names = sorted(
+            k
+            for k, v in steps.items()
+            if isinstance(v.get("decode_tok_s"), (int, float))
+            and k != "north_star"
+            and not k.startswith("crossover_T")
+            and not k.startswith("config2")
+            and k != "profile_trace"
+        )
+        for name in lever_names:
+            v = steps[name]["decode_tok_s"]
+            print(f"  {name:<9} {v:>8} tok/s  ({v / base - 1:+.1%} "
+                  "vs north_star)")
     lc = steps.get("long_context_16k", {}).get("prefill_tok_s")
     if lc:
         print(f"long_context_16k prefill: {lc} tok/s")
