@@ -431,12 +431,19 @@ def generate(
     # device page traffic — engine/scheduler.py:
     # sharded_scheduler_decode_chunk), over tp-only meshes (global
     # pool, head axis tp-sharded, kernel under shard_map —
-    # ops/pallas_paged.py:paged_decode_attention_tp), and over mixed
+    # ops/pallas_paged.py:paged_decode_attention_tp), over mixed
     # dp×tp meshes (per-dp-slice pool layout, GSPMD chunk loop, kernel
-    # under the dp×tp wrapper). sp falls back to dense. Resolve now so
-    # the prefill cache can be sized to the prompt only.
+    # under the dp×tp wrapper), and over sp meshes (sp is a PREFILL
+    # axis — during decode it idles/replicates, exactly as the dense
+    # decode path behaves after reshard_cache_for_decode, so the
+    # global-pool and per-dp-slice layouts carry over unchanged with
+    # the sp axis simply unmentioned in the shard_map specs). Resolve
+    # now so the prefill cache can be sized to the prompt only.
     paged_dp = paged_tp = 1
     paged_mixed = False
+    paged_sp = False  # sp axis present: replicated during decode
+    paged_gspmd = False  # multi-device paged, not dp-only: the chunk
+    # loop runs under GSPMD and the kernel needs the mesh passed down
     if paged and mesh is not None and mesh.size > 1:
         from adversarial_spec_tpu.parallel.mesh import (
             DP as _DP,
@@ -446,32 +453,33 @@ def generate(
 
         if mesh.size == mesh.shape[_DP]:
             paged_dp = mesh.shape[_DP]
-        elif (
-            mesh.size == mesh.shape[_TP]
-            and cfg.n_kv_heads % mesh.shape[_TP] == 0
-        ):
-            paged_tp = mesh.shape[_TP]
-        elif (
-            mesh.shape[_SP] == 1
-            and cfg.n_kv_heads % mesh.shape[_TP] == 0
-        ):
-            # Mixed dp×tp (a v5e-8 at dp=4×tp=2): ONE GSPMD-partitioned
-            # chunk loop over a per-dp-slice pool layout — rows + page
-            # slabs shard over dp, heads over tp; the kernel runs under
-            # the dp×tp shard_map wrapper with global→local id shift
-            # (ops/pallas_paged.py:paged_decode_attention_dp_tp).
-            paged_tp = mesh.shape[_TP]
-            paged_mixed = True
-        else:
+        elif cfg.n_kv_heads % mesh.shape[_TP] != 0:
             import sys
 
             print(
-                f"warning: paged KV decode shards over dp/tp meshes "
-                f"with tp | n_kv_heads and no sp; falling back to the "
-                f"dense cache on this mesh ({dict(mesh.shape)})",
+                f"warning: paged KV decode requires tp | n_kv_heads "
+                f"({mesh.shape[_TP]} ∤ {cfg.n_kv_heads}); falling back "
+                f"to the dense cache on this mesh ({dict(mesh.shape)})",
                 file=sys.stderr,
             )
             paged = False
+        elif mesh.shape[_DP] == 1:
+            # tp-only, sp-only, or sp×tp: ONE global pool, heads
+            # tp-sharded (trivially so when tp == 1), sp replicated.
+            paged_tp = mesh.shape[_TP]
+            paged_sp = mesh.shape[_SP] > 1
+            paged_gspmd = True
+        else:
+            # Mixed dp×tp (a v5e-8 at dp=4×tp=2) — and dp×sp(×tp):
+            # ONE GSPMD-partitioned chunk loop over a per-dp-slice
+            # pool layout — rows + page slabs shard over dp, heads
+            # over tp; the kernel runs under the dp×tp shard_map
+            # wrapper with global→local id shift
+            # (ops/pallas_paged.py:paged_decode_attention_dp_tp).
+            paged_tp = mesh.shape[_TP]
+            paged_mixed = True
+            paged_sp = mesh.shape[_SP] > 1
+            paged_gspmd = True
 
     # Shared-prefix: identical rows prefill once and tile. Qualifies off-
     # mesh and on single-device meshes (the TpuEngine always passes a
@@ -515,12 +523,12 @@ def generate(
         last_logits, cache = sp_prefill(
             params, cfg, sp_tokens, prefill_pads, mesh
         )
-        # (paged cannot reach here: it is force-disabled on multi-device
-        # meshes above, and sp > 1 implies multi-device.) int8 KV
-        # quantizes at this reshard boundary — the ring itself ran on
-        # full-precision K/V.
+        # int8 KV quantizes at this reshard boundary — the ring itself
+        # ran on full-precision K/V. Paged runs migrate prompt KV into
+        # pages right below, so their resharded dense cache only needs
+        # the prompt slots, not the decode region.
         cache = reshard_cache_for_decode(
-            cache, mesh, total_len, kv_dtype=kv_dtype
+            cache, mesh, S if paged else total_len, kv_dtype=kv_dtype
         )
     else:
         # Paged runs drop the dense cache after migrating prompt KV, so
@@ -689,10 +697,12 @@ def generate(
                 ),
                 pool,
             )
-        elif paged_tp > 1:
+        elif paged_tp > 1 or paged_sp:
             # Global pool, head axis tp-sharded — each device holds every
             # page's slice of its own KV heads (same placement the dense
-            # tp cache uses).
+            # tp cache uses). On sp(-only) meshes tp may be 1: the spec
+            # then replicates the pool, matching the idle-sp decode
+            # semantics of the dense path.
             from jax.sharding import NamedSharding, PartitionSpec as P
             from adversarial_spec_tpu.parallel.mesh import TP as _TP
 
@@ -1023,13 +1033,14 @@ def generate(
                     mesh, *chunk_args, **static_kw
                 )
                 if paged_dp > 1
-                # tp-only meshes: the kernel runs under shard_map inside
-                # the GSPMD program (head-sharded pool); the dp path
-                # above shards whole per-device pools instead.
+                # tp/sp/mixed meshes: the kernel runs under shard_map
+                # inside the GSPMD program (head-sharded pool, sp
+                # replicated); the dp path above shards whole
+                # per-device pools instead.
                 else scheduler_decode_chunk(
                     *chunk_args,
                     **static_kw,
-                    mesh=mesh if paged_tp > 1 else None,
+                    mesh=mesh if paged_gspmd else None,
                 )
             )
             step = jnp.max(paged_n_emitted)

@@ -392,22 +392,122 @@ class TestPagedUnderTp:
             )
         np.testing.assert_array_equal(ref.tokens, out.tokens)
 
-    def test_paged_sp_mesh_falls_back_dense(self, tiny_model, capsys):
-        """sp meshes still warn + fall back to the dense cache."""
+    def test_paged_sp_only_matches_single_device(self, tiny_model):
+        """Paged decode on an sp-only mesh: sp is a prefill axis — during
+        decode it idles/replicates (pool replicated, same semantics as the
+        dense decode path after reshard_cache_for_decode) — so paged
+        tokens must reproduce single-device paged tokens. Exercises the
+        sp_prefill → reshard → page-migration handoff (the 16k-context
+        config's paged decode, VERDICT r4 item 9)."""
+        if len(jax.devices()) < 2:
+            pytest.skip("requires 2 virtual devices")
+        from adversarial_spec_tpu.parallel.mesh import make_mesh
+        from adversarial_spec_tpu.parallel.sharding import shard_params
+
+        params, cfg = tiny_model
+        prompts = [[1, 5, 9, 3, 7, 2], [4, 4, 8], [6, 1, 1, 2], [9, 9]]
+        kw = dict(
+            max_new_tokens=8, eos_ids=[], greedy=True,
+            paged=True, page_size=16, speculative=False,
+            share_prefix=False,
+        )
+        ref = generate(params, cfg, prompts, **kw)
+        mesh = make_mesh({"sp": 2, "tp": 1}, devices=jax.devices()[:2])
+        sharded = shard_params(mesh, params)
+        with mesh:
+            out = generate(
+                sharded, cfg, prompts, mesh=mesh,
+                use_pallas_decode=True, **kw
+            )
+        np.testing.assert_array_equal(ref.tokens, out.tokens)
+        with mesh:
+            out2 = generate(
+                sharded, cfg, prompts, mesh=mesh,
+                use_pallas_decode=False, **kw
+            )
+        np.testing.assert_array_equal(ref.tokens, out2.tokens)
+
+    def test_paged_sp_tp_int8_pool(self, tiny_model):
+        """Paged + int8 pages on an sp×tp mesh (heads over tp, pool
+        replicated over sp; int8 quantization happens at the sp→decode
+        reshard boundary before page migration)."""
+        if len(jax.devices()) < 4:
+            pytest.skip("requires 4 virtual devices")
+        from adversarial_spec_tpu.parallel.mesh import make_mesh
+        from adversarial_spec_tpu.parallel.sharding import shard_params
+
+        params, cfg = tiny_model  # n_kv_heads=2 → tp=2 divides
+        prompts = [[1, 5, 9, 3, 7, 2], [4, 4, 8]]
+        kw = dict(
+            max_new_tokens=6, eos_ids=[], greedy=True,
+            paged=True, page_size=16, speculative=False,
+            share_prefix=False, kv_dtype="int8",
+        )
+        ref = generate(params, cfg, prompts, **kw)
+        mesh = make_mesh({"sp": 2, "tp": 2}, devices=jax.devices()[:4])
+        sharded = shard_params(mesh, params)
+        with mesh:
+            out = generate(
+                sharded, cfg, prompts, mesh=mesh,
+                use_pallas_decode=True, **kw
+            )
+        np.testing.assert_array_equal(ref.tokens, out.tokens)
+
+    def test_paged_dp_sp_mixed_matches_single_device(self, tiny_model):
+        """Paged decode on a dp×sp mesh reuses the per-dp-slice mixed
+        layout (rows + page slabs over dp, sp replicated during decode)."""
         if len(jax.devices()) < 4:
             pytest.skip("requires 4 virtual devices")
         from adversarial_spec_tpu.parallel.mesh import make_mesh
         from adversarial_spec_tpu.parallel.sharding import shard_params
 
         params, cfg = tiny_model
-        prompts = [[1, 5, 9], [2, 6], [8, 8], [4]]
+        prompts = [[1, 5, 9, 3, 7, 2], [4, 4, 8], [6, 1, 1, 2], [9, 9]]
+        kw = dict(
+            max_new_tokens=8, eos_ids=[], greedy=True,
+            paged=True, page_size=16, speculative=False,
+            share_prefix=False,
+        )
+        ref = generate(params, cfg, prompts, **kw)
         mesh = make_mesh({"dp": 2, "sp": 2, "tp": 1})
         sharded = shard_params(mesh, params)
         with mesh:
             out = generate(
                 sharded, cfg, prompts, mesh=mesh,
-                max_new_tokens=4, eos_ids=[], greedy=True,
-                paged=True, speculative=False,
+                use_pallas_decode=True, **kw
             )
-        assert out.tokens.shape[0] == 4
+        np.testing.assert_array_equal(ref.tokens, out.tokens)
+        with mesh:
+            out2 = generate(
+                sharded, cfg, prompts, mesh=mesh,
+                use_pallas_decode=False, **kw
+            )
+        np.testing.assert_array_equal(ref.tokens, out2.tokens)
+
+    def test_paged_tp_not_dividing_heads_falls_back_dense(
+        self, tiny_model, capsys
+    ):
+        """tp ∤ n_kv_heads still warns + falls back to the dense cache
+        (the one remaining paged exclusion after sp support landed)."""
+        if len(jax.devices()) < 8:
+            pytest.skip("requires 8 virtual devices")
+        from adversarial_spec_tpu.parallel.mesh import make_mesh
+
+        params, cfg = tiny_model  # n_kv_heads=2; tp=8 does not divide
+        mesh = make_mesh({"dp": 1, "sp": 1, "tp": 8})
+        # Dense fallback can't head-shard 2 KV heads over tp=8 either, so
+        # only assert the warning fires and paged is refused — the
+        # eligibility check must reject BEFORE touching pool layout.
+        from adversarial_spec_tpu.engine import generate as G
+
+        prompts = [[1, 5, 9], [2, 6]]
+        try:
+            with mesh:
+                G.generate(
+                    params, cfg, prompts, mesh=mesh,
+                    max_new_tokens=2, eos_ids=[], greedy=True,
+                    paged=True, speculative=False,
+                )
+        except Exception:
+            pass  # dense path may legitimately refuse tp=8 over 2 heads
         assert "falling back to the dense cache" in capsys.readouterr().err
