@@ -47,7 +47,8 @@ def extract_spec(response: str) -> str | None:
     if start == -1:
         return None
     end = response.rfind(SPEC_CLOSE)
-    if end == -1 or end < start:
+    # start >= 0 here, so end < start also covers the not-found end == -1.
+    if end < start:
         return None
     return response[start + len(SPEC_OPEN) : end].strip()
 

@@ -24,8 +24,6 @@ from dataclasses import dataclass, field
 # their "cost" is device time, reported separately. The mock provider uses a
 # nonzero price so cost-path logic stays exercised in CPU-only CI.
 MODEL_COSTS: dict[str, tuple[float, float]] = {
-    "mock://agree": (1.0, 2.0),
-    "mock://critic": (1.0, 2.0),
     "mock://": (1.0, 2.0),
     "tpu://": (0.0, 0.0),
 }

@@ -1,10 +1,19 @@
 #!/usr/bin/env bash
 # Demo: a full adversarial spec debate on the mock engine (no TPU, no
 # downloads), then the synthetic-TPU path. Run from the repo root.
+#
+#   examples/demo.sh                 # everything (tpu:// leg compiles XLA:
+#                                    # ~1-3 min cold on a CPU box)
+#   examples/demo.sh --skip-tpu-leg  # mock-only, finishes in seconds
 set -euo pipefail
 # Uses whatever accelerator jax finds; set JAX_PLATFORMS=cpu to force CPU
 # (e.g. on a box whose TPU tunnel is unavailable).
 export JAX_PLATFORMS=${JAX_PLATFORMS:-cpu}
+
+RUN_TPU_LEG=1
+if [[ "${1:-}" == "--skip-tpu-leg" ]]; then
+  RUN_TPU_LEG=0
+fi
 
 SPEC='# Webhook Delivery Service
 
@@ -26,9 +35,13 @@ done
 echo; echo "=== Export the converged spec as tasks ==="
 echo "$SPEC" | python3 -m adversarial_spec_tpu.cli export-tasks --models mock://tasks
 
-echo; echo "=== Synthetic tpu:// opponent (random weights, real engine) ==="
-echo "$SPEC" | python3 -m adversarial_spec_tpu.cli critique \
-  --models tpu://random-tiny --greedy --max-new-tokens 32 2>/dev/null
+if [[ "$RUN_TPU_LEG" == "1" ]]; then
+  echo; echo "=== Synthetic tpu:// opponent (random weights, real engine) ==="
+  echo "$SPEC" | python3 -m adversarial_spec_tpu.cli critique \
+    --models tpu://random-tiny --greedy --max-new-tokens 32 2>/dev/null
+else
+  echo; echo "=== Synthetic tpu:// opponent: skipped (--skip-tpu-leg) ==="
+fi
 
 echo; echo "=== Cleanup ==="
 rm -f .adversarial-spec-checkpoints/demo-round-*.md

@@ -20,6 +20,7 @@ _SKIP_LINE_MARKERS = (
     "_err(",
     "description=",  # argparse help strings
     "help=",
+    "indent=",  # cosmetic JSON pretty-printing width
 )
 
 

@@ -2,6 +2,7 @@
 sections — mutation-hardened assertions on exact boundaries)."""
 
 from adversarial_spec_tpu.debate.parsing import (
+    Task,
     detect_agreement,
     extract_spec,
     extract_tasks,
@@ -143,3 +144,67 @@ class TestGenerateDiff:
         assert "--- previous_spec" in d
         assert "+++ revised_spec" in d
         assert "-b" in d and "+c" in d
+
+
+class TestMutationHardening:
+    """Pins that kill the round-5 mutation-sweep survivors
+    (tools/mutation_run.py; each assertion names the mutant it kills)."""
+
+    def test_close_without_open_is_none(self):
+        """Kills the find() sentinel mutant (-1 -> -2): a close tag with
+        no open tag must not slice garbage from the tail of the text."""
+        assert extract_spec("preamble [/SPEC] trailing") is None
+
+    def test_all_priority_levels_accepted_verbatim(self):
+        """Kills the _PRIORITIES member mutants."""
+        for level in ("critical", "high", "medium", "low"):
+            tasks = extract_tasks(
+                f"[TASK]title: t\npriority: {level}[/TASK]"
+            )
+            assert tasks[0].priority == level
+
+    def test_task_defaults_and_dict_schema(self):
+        """Kills Task default mutants and the to_dict key mutants (the
+        dict is export-tasks' JSON contract)."""
+        t = Task()
+        assert t.priority == "medium"
+        assert t.to_dict() == {
+            "title": "",
+            "description": "",
+            "priority": "medium",
+            "dependencies": [],
+            "estimate": "",
+        }
+
+    def test_unknown_field_not_title_like(self):
+        """Kills the lstrip("-* ") charset mutant: 'xtitle' must stay an
+        unknown field (only bullet markers are stripped), so the block
+        falls back to first-line-as-title."""
+        tasks = extract_tasks("[TASK]xtitle: foo[/TASK]")
+        assert tasks[0].title == "xtitle: foo"
+
+    def test_known_field_with_empty_value_is_skipped(self):
+        """Kills the `or` -> `and` mutant on the field filter: a known
+        key with an empty value must not count as a recognized field."""
+        tasks = extract_tasks("[TASK]priority:\nSome task text[/TASK]")
+        assert tasks[0].title == "priority:"
+        assert tasks[0].description == "Some task text"
+        assert tasks[0].priority == "medium"
+
+    def test_summary_truncates_to_exactly_max_chars(self):
+        """Kills the max_chars default mutant (200 -> 201)."""
+        out = get_critique_summary("x" * 250)
+        assert len(out) == 200
+        assert out.endswith("...")
+
+    def test_diff_labels_and_default_context(self):
+        """Kills the fromfile/tofile label mutants and the n_context
+        default mutant (3 -> 4): the hunk header pins 3 context lines."""
+        old = "\n".join(f"line {i}" for i in range(1, 10)) + "\n"
+        new = old.replace("line 5", "line five")
+        diff = generate_diff(old, new)
+        # Trailing \n: an exact-label pin (substring matching would let
+        # a mutated "previous_specXX" label survive).
+        assert "--- previous_spec\n" in diff
+        assert "+++ revised_spec\n" in diff
+        assert "@@ -2,7 +2,7 @@" in diff

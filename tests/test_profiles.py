@@ -101,3 +101,74 @@ class TestGlobalConfig:
         mod.GLOBAL_CONFIG_PATH.parent.mkdir(parents=True, exist_ok=True)
         mod.GLOBAL_CONFIG_PATH.write_text("{broken")
         assert load_global_config() == {}
+
+
+class TestMutationHardening:
+    """Pins that kill the round-5 mutation-sweep survivors
+    (tools/mutation_run.py; each assertion names the mutant it kills)."""
+
+    def test_profile_fields_pinned(self):
+        """Kills PROFILE_FIELDS member mutants: the field set is the
+        save-validation + load-filter contract."""
+        from adversarial_spec_tpu.debate.profiles import PROFILE_FIELDS
+
+        assert PROFILE_FIELDS == (
+            "models",
+            "doc_type",
+            "focus",
+            "persona",
+            "preserve_intent",
+            "timeout",
+            "max_new_tokens",
+            "temperature",
+        )
+
+    def test_config_paths_pinned(self):
+        """Kills path-component mutants (source-pinned: conftest patches
+        the live constants)."""
+        from pathlib import Path
+
+        from adversarial_spec_tpu.debate import profiles as mod
+
+        src = Path(mod.__file__).read_text()
+        assert (
+            'Path.home() / ".config" / "adversarial-spec-tpu" / "profiles"'
+            in src
+        )
+        assert '"adversarial-spec-tpu" / "config.json"' in src
+
+    def test_save_profile_nested_dir_and_return(self, tmp_path):
+        """Kills the mkdir flag flips and the `return path` -> None."""
+        nested = tmp_path / "deep" / "profiles"
+        p = save_profile("n", {"doc_type": "tech"}, profiles_dir=nested)
+        assert p is not None and p.is_file()
+        p2 = save_profile("n", {"doc_type": "prd"}, profiles_dir=nested)
+        assert p2 == p
+
+    def test_error_messages_name_the_problem(self, tmp_path):
+        with pytest.raises(ValueError, match="unknown profile fields"):
+            save_profile("bad", {"zzz": 1}, profiles_dir=tmp_path)
+        with pytest.raises(FileNotFoundError, match="not found at"):
+            load_profile("ghost", profiles_dir=tmp_path)
+
+    def test_explicit_list_flag_beats_profile(self):
+        """Kills the unset-detection mutants (`and` -> `or`, dropped
+        `not`): a NON-empty list is an explicit user choice and must
+        never be overridden; an empty one is unset and must be."""
+        args = argparse.Namespace(models=["tpu://chosen"], focus=None)
+        applied = apply_profile(
+            args, {"models": ["mock://p"], "focus": "security"}
+        )
+        assert args.models == ["tpu://chosen"]
+        assert args.focus == "security"
+        assert applied == ["focus"]
+        args2 = argparse.Namespace(models=[])
+        assert apply_profile(args2, {"models": ["mock://p"]}) == ["models"]
+        assert args2.models == ["mock://p"]
+
+    def test_save_global_config_nested_dir_and_return(self, tmp_path):
+        target = tmp_path / "cfg" / "dir" / "config.json"
+        p = save_global_config({"a": 1}, config_path=target)
+        assert p == target and p.is_file()
+        p2 = save_global_config({"a": 2}, config_path=target)
+        assert p2 == target

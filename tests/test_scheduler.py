@@ -511,3 +511,32 @@ class TestPagedUnderTp:
         except Exception:
             pass  # dense path may legitimately refuse tp=8 over 2 heads
         assert "falling back to the dense cache" in capsys.readouterr().err
+
+    @pytest.mark.slow
+    def test_paged_sp_long_prompt_multi_page(self, tiny_model):
+        """sp paged at a ~1.5k-token prompt: the page table spans ~100
+        pages per row and the sp_prefill → reshard → migration handoff
+        moves every prompt slot (gather path keeps CPU cost sane; the
+        kernel path is pinned at small scale above)."""
+        if len(jax.devices()) < 2:
+            pytest.skip("requires 2 virtual devices")
+        from adversarial_spec_tpu.parallel.mesh import make_mesh
+        from adversarial_spec_tpu.parallel.sharding import shard_params
+
+        params, cfg = tiny_model
+        rng = np.random.default_rng(7)
+        prompts = [
+            rng.integers(3, cfg.vocab_size, 1500).tolist(),
+            rng.integers(3, cfg.vocab_size, 900).tolist(),
+        ]
+        kw = dict(
+            max_new_tokens=4, eos_ids=[], greedy=True,
+            paged=True, page_size=16, speculative=False,
+            share_prefix=False, use_pallas_decode=False,
+        )
+        ref = generate(params, cfg, prompts, **kw)
+        mesh = make_mesh({"sp": 2, "tp": 1}, devices=jax.devices()[:2])
+        sharded = shard_params(mesh, params)
+        with mesh:
+            out = generate(sharded, cfg, prompts, mesh=mesh, **kw)
+        np.testing.assert_array_equal(ref.tokens, out.tokens)

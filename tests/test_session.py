@@ -106,3 +106,76 @@ class TestCheckpoints:
     def test_checkpoint_session_id_validated(self):
         with pytest.raises(InvalidSessionId):
             save_checkpoint("s", 1, session_id="../evil")
+
+
+class TestMutationHardening:
+    """Pins that kill the round-5 mutation-sweep survivors
+    (tools/mutation_run.py; each assertion names the mutant it kills)."""
+
+    def test_storage_constants_pinned(self):
+        """Kills path-component string mutants on SESSIONS_DIR /
+        CHECKPOINTS_DIR: on-disk locations are a compatibility contract
+        (a mutated path would orphan every existing session). Pinned via
+        source text because conftest patches the live constants to
+        tmp dirs for isolation."""
+        from pathlib import Path
+
+        src = Path(session_mod.__file__).read_text()
+        assert (
+            'Path.home() / ".config" / "adversarial-spec-tpu" / "sessions"'
+            in src
+        )
+        assert 'CHECKPOINTS_DIR = Path(".adversarial-spec-checkpoints")' in src
+
+    def test_fresh_session_defaults(self):
+        """Kills default mutants: round 1->2, doc_type XX,
+        preserve_intent flip."""
+        s = SessionState(session_id="d")
+        assert s.round == 1
+        assert s.doc_type == "generic"
+        assert s.preserve_intent is False
+        assert s.models == [] and s.history == []
+
+    def test_invalid_id_message_names_the_rules(self):
+        with pytest.raises(
+            InvalidSessionId, match="only letters, digits"
+        ):
+            SessionState(session_id="../evil").save()
+
+    def test_save_creates_nested_dirs_and_is_idempotent(self, tmp_path):
+        """Kills the mkdir(parents=..., exist_ok=...) flag flips."""
+        nested = tmp_path / "deep" / "nested" / "sessions"
+        s = SessionState(session_id="n")
+        p1 = s.save(sessions_dir=nested)
+        p2 = s.save(sessions_dir=nested)  # exist_ok must hold
+        assert p1 == p2 and p1.is_file()
+
+    def test_list_sessions_summary_schema(self, tmp_path):
+        """Kills the summary dict-key/default mutants: the schema is the
+        CLI `sessions` action's output contract, incl. fallbacks for
+        files written by hand or by older versions."""
+        (tmp_path / "bare.json").write_text("{}")
+        full = {
+            "session_id": "full",
+            "round": 7,
+            "doc_type": "prd",
+            "models": ["tpu://m"],
+            "updated_at": 99.5,
+        }
+        (tmp_path / "full.json").write_text(json.dumps(full))
+        out = SessionState.list_sessions(sessions_dir=tmp_path)
+        assert out[0] == full  # exact keys AND values
+        assert out[1] == {
+            "session_id": "bare",  # falls back to the file stem
+            "round": 1,
+            "doc_type": "generic",
+            "models": [],
+            "updated_at": 0.0,
+        }
+
+    def test_checkpoint_creates_nested_dirs_and_overwrites(self, tmp_path):
+        nested = tmp_path / "a" / "b"
+        p1 = save_checkpoint("v1", 1, checkpoints_dir=nested)
+        p2 = save_checkpoint("v2", 1, checkpoints_dir=nested)
+        assert p1 == p2
+        assert p2.read_text() == "v2"

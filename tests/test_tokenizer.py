@@ -2,6 +2,8 @@
 programmatically — zero downloads), chat templates, and the engine's
 context-budget truncation."""
 
+from pathlib import Path
+
 import pytest
 
 from adversarial_spec_tpu.engine.tokenizer import (
@@ -146,3 +148,81 @@ class TestPromptTruncation:
         assert comp.ok, comp.error
         # budget = 256 - 64 = 192 tokens max for the prompt.
         assert captured["prompt_lens"][0] <= 192
+
+
+class TestGoldenChatTemplates:
+    """Golden parity: the engine's ``.format``-string CHAT_TEMPLATES vs
+    the families' PUBLIC jinja chat templates rendered by transformers'
+    OWN machinery (``render_jinja_template`` — the exact code
+    ``PreTrainedTokenizer.apply_chat_template`` calls). VERDICT r4
+    item 6: a silent template mismatch on real instruct checkpoints
+    would degrade critique quality with no failing test — this pins it.
+
+    The vendored .jinja fixtures (tests/fixtures/chat_templates/) are
+    the templates shipped in the public tokenizer_config.json of
+    Llama-3-Instruct, Mistral-7B-Instruct-v0.2, gemma-2-it and
+    Qwen2-Instruct. String-identical prompts imply token-identical ids
+    under the family tokenizer (same text, same tokenizer); the BOS
+    token the jinja templates inline is added by ``encode(add_bos=True)``
+    on the engine side, so the assertion is
+    ``bos_token + engine_render == hf_render``.
+
+    Family conventions the engine must reproduce:
+    - mistral / gemma-2 have NO system role — the public convention
+      (mistral-common; gemma model card) folds the system prompt into
+      the first user turn separated by a blank line;
+    - qwen2 takes the system turn verbatim (no BOS token at all);
+    - the debate engine always sends a non-empty system prompt
+      (debate/prompts.py), so the empty-system default-injection path
+      of qwen2's template is out of scope.
+    """
+
+    FIXTURES = Path(__file__).parent / "fixtures" / "chat_templates"
+    SYSTEM = "You are a ruthless spec critic."
+    USER = "# PRD\nShip the thing.\n\nCritique this spec."
+
+    def _render_hf(self, fixture, messages, **special):
+        from transformers.utils.chat_template_utils import (
+            render_jinja_template,
+        )
+
+        template = (self.FIXTURES / fixture).read_text().rstrip("\n")
+        rendered, _ = render_jinja_template(
+            conversations=[messages],
+            chat_template=template,
+            add_generation_prompt=True,
+            **special,
+        )
+        return rendered[0] if isinstance(rendered, list) else rendered
+
+    @pytest.mark.parametrize(
+        "family,fixture,bos",
+        [
+            ("llama", "llama3.jinja", "<|begin_of_text|>"),
+            ("mistral", "mistral.jinja", "<s>"),
+            ("gemma2", "gemma2.jinja", "<bos>"),
+            ("qwen2", "qwen2.jinja", ""),
+        ],
+    )
+    def test_engine_matches_public_template(self, family, fixture, bos):
+        if family in ("mistral", "gemma2"):
+            # No system role in the public template: fold into the
+            # first user turn (the engine template does the same).
+            messages = [
+                {
+                    "role": "user",
+                    "content": f"{self.SYSTEM}\n\n{self.USER}",
+                }
+            ]
+        else:
+            messages = [
+                {"role": "system", "content": self.SYSTEM},
+                {"role": "user", "content": self.USER},
+            ]
+        hf = self._render_hf(
+            fixture, messages, bos_token=bos, eos_token="</s>"
+        )
+        engine = apply_chat_template(
+            family, self.SYSTEM, self.USER, instruct=True
+        )
+        assert bos + engine == hf

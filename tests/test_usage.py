@@ -83,3 +83,50 @@ class TestCostTracker:
         t.add("m", Usage(input_tokens=1, output_tokens=1))
         text = t.format_text()
         assert "TOTAL" in text and "m:" in text
+
+
+class TestMutationHardening:
+    """Pins that kill the round-5 mutation-sweep survivors
+    (tools/mutation_run.py; each assertion names the mutant it kills)."""
+
+    def test_price_table_prefixes(self):
+        """Kills MODEL_COSTS key mutants: the scheme prefixes are the
+        price-lookup contract (mock bills, tpu is free)."""
+        assert model_cost_rates("mock://anything?x=1") == (1.0, 2.0)
+        assert model_cost_rates("tpu://llama-8b") == (0.0, 0.0)
+        assert model_cost_rates("unknown://m") == (0.0, 0.0)
+
+    def test_to_dict_schema_and_rounding(self):
+        """Kills to_dict key mutants and the round(_, 4) digit mutant —
+        the dict is the per-model block of the --json cost report."""
+        u = Usage(input_tokens=3, output_tokens=5, device_time_s=0.123456)
+        assert u.to_dict() == {
+            "input_tokens": 3,
+            "output_tokens": 5,
+            "total_tokens": 8,
+            "device_time_s": 0.1235,
+        }
+
+    def test_report_device_time_rounding(self):
+        t = CostTracker()
+        t.add("tpu://m", Usage(device_time_s=0.123456))
+        assert t.report()["total_device_time_s"] == 0.1235
+
+    def test_tokens_per_sec_boundaries(self):
+        """Kills the L112 zero mutants: sub-second decode times count
+        (0 -> 1 in the guard) and the no-data answer is 0.0."""
+        t = CostTracker()
+        assert t.tokens_per_sec() == 0.0
+        t.add("m", Usage(decode_tokens=1, decode_time_s=0.5))
+        assert t.tokens_per_sec() == 2.0
+
+    def test_format_text_exact(self):
+        """Kills the summary-string mutants: the text block is the
+        --show-cost user surface."""
+        t = CostTracker()
+        t.add("mock://a", Usage(input_tokens=10, output_tokens=5))
+        assert t.format_text() == (
+            "Cost summary:\n"
+            "  mock://a: 10 in / 5 out tokens, $0.0000\n"
+            "  TOTAL: 15 tokens, $0.0000"
+        )

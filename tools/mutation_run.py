@@ -1,0 +1,538 @@
+"""Stdlib mutation-testing runner (mutmut is not installable here).
+
+Parity with the reference's mutation-hardened test practice
+(reference skills/adversarial-spec/scripts/mutmut_config.py:4-119 and the
+mutants documented in scripts/tests/test_models.py:88-95): generate small
+semantic mutants of the pure-Python debate modules, run each module's test
+file against every mutant, and report the kill score. A surviving mutant
+is a behavior the tests do not pin.
+
+Skip rules mirror mutmut_config.py: no mutants in prompt text, model-shape
+tables, tests, or logging/help-string lines — the score measures *logic*.
+
+Usage:
+    python tools/mutation_run.py                 # default target set
+    python tools/mutation_run.py --jobs 4 --out mutation_report.json
+    python tools/mutation_run.py --only parsing  # one module
+    python tools/mutation_run.py --show-survivors mutation_report.json
+
+Mutation operators (one mutant per site):
+    comparison flips    ==/!=, </<=, >/>=, in/not in, is/is not
+    boolean operators   and/or swap, `not X` -> `X`
+    arithmetic          +/-, * -> +
+    constants           True/False flip, int n -> n+1, non-docstring
+                        non-empty str s -> s + "XX"
+    returns             `return expr` -> `return None`
+
+Each worker process owns a disposable copy of the repo (package + tests),
+mutates the target file there, and runs pytest on the mapped test file.
+Exit code: 0 when the kill rate meets --fail-under (default 0 = report
+only), 2 on baseline failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import copy
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+from concurrent.futures import ProcessPoolExecutor
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+# module path (repo-relative) -> test files that must kill its mutants
+DEFAULT_TARGETS: dict[str, list[str]] = {
+    "adversarial_spec_tpu/debate/parsing.py": ["tests/test_parsing.py"],
+    "adversarial_spec_tpu/debate/usage.py": ["tests/test_usage.py"],
+    "adversarial_spec_tpu/debate/session.py": ["tests/test_session.py"],
+    "adversarial_spec_tpu/debate/profiles.py": ["tests/test_profiles.py"],
+    "adversarial_spec_tpu/debate/core.py": ["tests/test_engine_mock.py"],
+    "adversarial_spec_tpu/debate/telegram.py": ["tests/test_telegram.py"],
+    "adversarial_spec_tpu/debate/types.py": [
+        "tests/test_engine_mock.py",
+        "tests/test_parsing.py",
+    ],
+}
+
+# Lines containing these markers are not mutated (mutmut_config.py parity;
+# "indent=" covers cosmetic JSON pretty-printing width).
+SKIP_LINE_MARKERS = ("print(", "_err(", "description=", "help=", "indent=")
+
+_CMP_SWAP = {
+    ast.Eq: ast.NotEq,
+    ast.NotEq: ast.Eq,
+    ast.Lt: ast.LtE,
+    ast.LtE: ast.Lt,
+    ast.Gt: ast.GtE,
+    ast.GtE: ast.Gt,
+    ast.In: ast.NotIn,
+    ast.NotIn: ast.In,
+    ast.Is: ast.IsNot,
+    ast.IsNot: ast.Is,
+}
+_BIN_SWAP = {ast.Add: ast.Sub, ast.Sub: ast.Add, ast.Mult: ast.Add}
+
+
+def _annotation_positions(tree: ast.AST) -> set[tuple[int, int]]:
+    """(lineno, col) of constants inside annotations — runtime-inert under
+    ``from __future__ import annotations`` (every module here), so mutating
+    them can only produce equivalent mutants."""
+    out: set[tuple[int, int]] = set()
+
+    def mark(sub: ast.AST | None) -> None:
+        if sub is None:
+            return
+        for n in ast.walk(sub):
+            if isinstance(n, ast.Constant):
+                out.add((n.lineno, n.col_offset))
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mark(node.returns)
+            a = node.args
+            for p in a.posonlyargs + a.args + a.kwonlyargs:
+                mark(p.annotation)
+            if a.vararg:
+                mark(a.vararg.annotation)
+            if a.kwarg:
+                mark(a.kwarg.annotation)
+        elif isinstance(node, ast.AnnAssign):
+            mark(node.annotation)
+    return out
+
+
+def _docstring_positions(tree: ast.AST) -> set[int]:
+    """Line numbers of docstring constants (never mutated)."""
+    out: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(
+            node, (ast.Module, ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef)
+        ):
+            body = getattr(node, "body", [])
+            if (
+                body
+                and isinstance(body[0], ast.Expr)
+                and isinstance(body[0].value, ast.Constant)
+                and isinstance(body[0].value.value, str)
+            ):
+                out.add(body[0].value.lineno)
+    return out
+
+
+class _SiteCollector(ast.NodeVisitor):
+    """Enumerate mutation sites; each site is (kind, lineno, detail)."""
+
+    def __init__(
+        self,
+        skip_lines: set[int],
+        doc_lines: set[int],
+        ann_pos: set[tuple[int, int]] = frozenset(),
+    ):
+        self.sites: list[tuple[str, int, str]] = []
+        self.skip_lines = skip_lines
+        self.doc_lines = doc_lines
+        self.ann_pos = ann_pos
+
+    def _ok(self, node: ast.AST) -> bool:
+        return getattr(node, "lineno", 0) not in self.skip_lines
+
+    def visit_Compare(self, node: ast.Compare) -> None:
+        if self._ok(node):
+            for i, op in enumerate(node.ops):
+                if type(op) in _CMP_SWAP:
+                    self.sites.append(
+                        ("cmp", node.lineno, f"{type(op).__name__}@{i}")
+                    )
+        self.generic_visit(node)
+
+    def visit_BoolOp(self, node: ast.BoolOp) -> None:
+        if self._ok(node):
+            self.sites.append(("bool", node.lineno, type(node.op).__name__))
+        self.generic_visit(node)
+
+    def visit_UnaryOp(self, node: ast.UnaryOp) -> None:
+        if self._ok(node) and isinstance(node.op, ast.Not):
+            self.sites.append(("not", node.lineno, "Not"))
+        self.generic_visit(node)
+
+    def visit_BinOp(self, node: ast.BinOp) -> None:
+        if self._ok(node) and type(node.op) in _BIN_SWAP:
+            self.sites.append(("bin", node.lineno, type(node.op).__name__))
+        self.generic_visit(node)
+
+    def visit_Constant(self, node: ast.Constant) -> None:
+        if (
+            self._ok(node)
+            and node.lineno not in self.doc_lines
+            and (node.lineno, node.col_offset) not in self.ann_pos
+        ):
+            if node.value is True or node.value is False:
+                self.sites.append(("const-bool", node.lineno, str(node.value)))
+            elif isinstance(node.value, int) and not isinstance(
+                node.value, bool
+            ):
+                self.sites.append(("const-int", node.lineno, str(node.value)))
+            elif isinstance(node.value, str) and node.value:
+                self.sites.append(
+                    ("const-str", node.lineno, node.value[:20])
+                )
+        self.generic_visit(node)
+
+    def visit_Return(self, node: ast.Return) -> None:
+        if (
+            self._ok(node)
+            and node.value is not None
+            and not (
+                isinstance(node.value, ast.Constant)
+                and node.value.value is None
+            )
+        ):
+            self.sites.append(("return", node.lineno, "None"))
+        self.generic_visit(node)
+
+
+class _Mutator(ast.NodeTransformer):
+    """Apply exactly the site with index ``target`` (collector order)."""
+
+    def __init__(
+        self,
+        target: int,
+        skip_lines: set[int],
+        doc_lines: set[int],
+        ann_pos: set[tuple[int, int]] = frozenset(),
+    ):
+        self.target = target
+        self.counter = -1
+        self.applied: str | None = None
+        self.skip_lines = skip_lines
+        self.doc_lines = doc_lines
+        self.ann_pos = ann_pos
+
+    def _hit(self) -> bool:
+        self.counter += 1
+        return self.counter == self.target
+
+    def _ok(self, node: ast.AST) -> bool:
+        return getattr(node, "lineno", 0) not in self.skip_lines
+
+    def visit_Compare(self, node: ast.Compare) -> ast.AST:
+        if self._ok(node):
+            for i, op in enumerate(node.ops):
+                if type(op) in _CMP_SWAP:
+                    if self._hit():
+                        node = copy.deepcopy(node)
+                        node.ops[i] = _CMP_SWAP[type(op)]()
+                        self.applied = (
+                            f"L{node.lineno}: {type(op).__name__} -> "
+                            f"{type(node.ops[i]).__name__}"
+                        )
+                        return self.generic_visit(node)
+        return self.generic_visit(node)
+
+    def visit_BoolOp(self, node: ast.BoolOp) -> ast.AST:
+        if self._ok(node) and self._hit():
+            new_op = ast.Or() if isinstance(node.op, ast.And) else ast.And()
+            self.applied = (
+                f"L{node.lineno}: {type(node.op).__name__} -> "
+                f"{type(new_op).__name__}"
+            )
+            node.op = new_op
+        return self.generic_visit(node)
+
+    def visit_UnaryOp(self, node: ast.UnaryOp) -> ast.AST:
+        if self._ok(node) and isinstance(node.op, ast.Not):
+            if self._hit():
+                self.applied = f"L{node.lineno}: drop `not`"
+                return self.generic_visit(node.operand)
+        return self.generic_visit(node)
+
+    def visit_BinOp(self, node: ast.BinOp) -> ast.AST:
+        if self._ok(node) and type(node.op) in _BIN_SWAP:
+            if self._hit():
+                new_op = _BIN_SWAP[type(node.op)]()
+                self.applied = (
+                    f"L{node.lineno}: {type(node.op).__name__} -> "
+                    f"{type(new_op).__name__}"
+                )
+                node.op = new_op
+        return self.generic_visit(node)
+
+    def visit_Constant(self, node: ast.Constant) -> ast.AST:
+        if (
+            self._ok(node)
+            and node.lineno not in self.doc_lines
+            and (node.lineno, node.col_offset) not in self.ann_pos
+        ):
+            if node.value is True or node.value is False:
+                if self._hit():
+                    self.applied = f"L{node.lineno}: {node.value} flipped"
+                    return ast.copy_location(
+                        ast.Constant(value=not node.value), node
+                    )
+            elif isinstance(node.value, int) and not isinstance(
+                node.value, bool
+            ):
+                if self._hit():
+                    self.applied = (
+                        f"L{node.lineno}: {node.value} -> {node.value + 1}"
+                    )
+                    return ast.copy_location(
+                        ast.Constant(value=node.value + 1), node
+                    )
+            elif isinstance(node.value, str) and node.value:
+                if self._hit():
+                    self.applied = f"L{node.lineno}: str + 'XX'"
+                    return ast.copy_location(
+                        ast.Constant(value=node.value + "XX"), node
+                    )
+        return node
+
+    def visit_Return(self, node: ast.Return) -> ast.AST:
+        if (
+            self._ok(node)
+            and node.value is not None
+            and not (
+                isinstance(node.value, ast.Constant)
+                and node.value.value is None
+            )
+        ):
+            if self._hit():
+                self.applied = f"L{node.lineno}: return -> return None"
+                return ast.copy_location(
+                    ast.Return(value=None), node
+                )
+        return self.generic_visit(node)
+
+
+def enumerate_mutants(src: str) -> list[tuple[str, int, str]]:
+    tree = ast.parse(src)
+    skip = {
+        i + 1
+        for i, line in enumerate(src.splitlines())
+        if any(m in line for m in SKIP_LINE_MARKERS)
+    }
+    collector = _SiteCollector(
+        skip, _docstring_positions(tree), _annotation_positions(tree)
+    )
+    collector.visit(tree)
+    return collector.sites
+
+
+def make_mutant(src: str, index: int) -> tuple[str, str]:
+    """Return (mutated_source, description) for site ``index``."""
+    tree = ast.parse(src)
+    skip = {
+        i + 1
+        for i, line in enumerate(src.splitlines())
+        if any(m in line for m in SKIP_LINE_MARKERS)
+    }
+    m = _Mutator(
+        index, skip, _docstring_positions(tree), _annotation_positions(tree)
+    )
+    new_tree = ast.fix_missing_locations(m.visit(tree))
+    if m.applied is None:
+        raise IndexError(f"no mutation site {index}")
+    return ast.unparse(new_tree), m.applied
+
+
+# ----------------------------------------------------------------- runner
+
+_WORKER_TREE: Path | None = None
+
+
+def _worker_tree() -> Path:
+    """Per-process disposable repo copy (package + tests + conftest)."""
+    global _WORKER_TREE
+    if _WORKER_TREE is None:
+        import atexit
+
+        root = Path(tempfile.mkdtemp(prefix=f"mut-{os.getpid()}-"))
+        atexit.register(shutil.rmtree, root, ignore_errors=True)
+        for rel in ("adversarial_spec_tpu", "tests"):
+            shutil.copytree(
+                REPO / rel,
+                root / rel,
+                ignore=shutil.ignore_patterns("__pycache__"),
+            )
+        (root / "pyproject.toml").write_text(
+            "[tool.pytest.ini_options]\n", encoding="utf-8"
+        )
+        _WORKER_TREE = root
+    return _WORKER_TREE
+
+
+def _run_pytest(tree: Path, test_files: list[str], timeout: float) -> str:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(tree)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-m",
+                "pytest",
+                "-x",
+                "-q",
+                "--no-header",
+                "-p",
+                "no:cacheprovider",
+                *test_files,
+            ],
+            cwd=tree,
+            env=env,
+            capture_output=True,
+            timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return "timeout"
+    return "passed" if proc.returncode == 0 else "failed"
+
+
+def _eval_mutant(job: tuple) -> dict:
+    module_rel, index, test_files, timeout = job
+    tree = _worker_tree()
+    target = tree / module_rel
+    original = (REPO / module_rel).read_text(encoding="utf-8")
+    mutated, desc = make_mutant(original, index)
+    target.write_text(mutated, encoding="utf-8")
+    try:
+        t0 = time.monotonic()
+        status = _run_pytest(tree, test_files, timeout)
+        return {
+            "module": module_rel,
+            "index": index,
+            "mutation": desc,
+            # tests failed on the mutant => the mutant was KILLED
+            "status": {
+                "failed": "killed",
+                "timeout": "timeout-killed",
+                "passed": "survived",
+            }[status],
+            "seconds": round(time.monotonic() - t0, 2),
+        }
+    finally:
+        target.write_text(original, encoding="utf-8")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--jobs", type=int, default=4)
+    ap.add_argument("--only", help="substring filter on module path")
+    ap.add_argument("--max-mutants", type=int, default=0)
+    ap.add_argument("--out", default="mutation_report.json")
+    ap.add_argument(
+        "--fail-under",
+        type=float,
+        default=0.0,
+        help="minimum kill rate in percent (0 = report only)",
+    )
+    ap.add_argument(
+        "--show-survivors",
+        metavar="REPORT",
+        help="print survivors from an existing report and exit",
+    )
+    args = ap.parse_args(argv)
+
+    if args.show_survivors:
+        report = json.loads(Path(args.show_survivors).read_text())
+        for r in report["results"]:
+            if r["status"] == "survived":
+                print(f"{r['module']} #{r['index']:<4} {r['mutation']}")
+        return 0
+
+    targets = {
+        m: t
+        for m, t in DEFAULT_TARGETS.items()
+        if not args.only or args.only in m
+    }
+    if not targets:
+        print(f"no targets match --only {args.only!r}", file=sys.stderr)
+        return 2
+
+    # Baseline: unmutated tests must be green, and the runtime sets the
+    # per-mutant timeout (generous 5x + 30 s: a hung mutant counts killed).
+    timeouts: dict[str, float] = {}
+    for module_rel, test_files in targets.items():
+        t0 = time.monotonic()
+        status = _run_pytest(REPO, test_files, timeout=600)
+        base = time.monotonic() - t0
+        if status != "passed":
+            print(
+                f"baseline {status} for {test_files} — fix tests first",
+                file=sys.stderr,
+            )
+            return 2
+        timeouts[module_rel] = base * 5 + 30
+
+    jobs = []
+    for module_rel, test_files in targets.items():
+        src = (REPO / module_rel).read_text(encoding="utf-8")
+        sites = enumerate_mutants(src)
+        if args.max_mutants:
+            sites = sites[: args.max_mutants]
+        jobs += [
+            (module_rel, i, test_files, timeouts[module_rel])
+            for i in range(len(sites))
+        ]
+    print(f"{len(jobs)} mutants over {len(targets)} modules")
+
+    results = []
+    t0 = time.monotonic()
+    with ProcessPoolExecutor(max_workers=args.jobs) as pool:
+        for n, res in enumerate(pool.map(_eval_mutant, jobs), 1):
+            results.append(res)
+            if n % 25 == 0 or n == len(jobs):
+                killed = sum(
+                    r["status"] != "survived" for r in results
+                )
+                print(
+                    f"  {n}/{len(jobs)} evaluated, "
+                    f"{killed} killed, {n - killed} survived "
+                    f"({time.monotonic() - t0:.0f}s)"
+                )
+
+    by_module: dict[str, dict[str, int]] = {}
+    for r in results:
+        d = by_module.setdefault(
+            r["module"], {"killed": 0, "survived": 0}
+        )
+        d["killed" if r["status"] != "survived" else "survived"] += 1
+    total = len(results)
+    killed = sum(r["status"] != "survived" for r in results)
+    score = 100.0 * killed / total if total else 0.0
+
+    report = {
+        "score_percent": round(score, 1),
+        "killed": killed,
+        "survived": total - killed,
+        "total": total,
+        "by_module": by_module,
+        "results": results,
+    }
+    Path(args.out).write_text(json.dumps(report, indent=1), encoding="utf-8")
+    print(f"\nmutation score: {score:.1f}% ({killed}/{total} killed)")
+    for mod, d in sorted(by_module.items()):
+        sub = d["killed"] + d["survived"]
+        print(
+            f"  {mod}: {100.0 * d['killed'] / sub:.1f}% "
+            f"({d['killed']}/{sub})"
+        )
+    print(f"report: {args.out}")
+    if args.fail_under and score < args.fail_under:
+        print(
+            f"FAIL: score {score:.1f}% < --fail-under {args.fail_under}%",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
