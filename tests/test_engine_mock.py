@@ -152,3 +152,161 @@ class TestRunRound:
     def test_usage_populated(self):
         result = run_round(SPEC, ["mock://critic"], 1)
         assert result.total_usage.total_tokens > 0
+
+
+class TestMutationHardening:
+    """Pins that kill the round-5 mutation-sweep survivors in core.py
+    (tools/mutation_run.py; each assertion names the mutant it kills)."""
+
+    def test_round_config_defaults(self):
+        """Kills the RoundConfig default mutants (doc_type XX, press /
+        preserve_intent flips)."""
+        cfg = RoundConfig()
+        assert cfg.doc_type == "generic"
+        assert cfg.press is False
+        assert cfg.preserve_intent is False
+
+    def test_context_files_exact_format(self, tmp_path):
+        """Kills the context-block string mutants: the labeled-block
+        format is part of the prompt contract (reference
+        models.py:130-146)."""
+        from adversarial_spec_tpu.debate.core import load_context_files
+
+        (tmp_path / "a.txt").write_text("AAA")
+        (tmp_path / "b.txt").write_text("BBB")
+        out = load_context_files(
+            [str(tmp_path / "a.txt"), str(tmp_path / "b.txt")]
+        )
+        assert out == (
+            "--- CONTEXT FILE: a.txt ---\nAAA\n\n"
+            "--- CONTEXT FILE: b.txt ---\nBBB\n\n"
+        )
+        with pytest.raises(
+            FileNotFoundError, match="context file not found: "
+        ):
+            load_context_files([str(tmp_path / "ghost.txt")])
+
+    def test_malformed_spec_warning_text(self):
+        """Kills the warning-string mutant (the CLI surfaces this text)."""
+        from adversarial_spec_tpu.debate.core import _to_response
+        from adversarial_spec_tpu.engine.types import Completion
+
+        comp = Completion(text="critique [SPEC] never closed")
+        resp = _to_response("m", comp, 0.1)
+        assert resp.critique.endswith(
+            "\n\n[warning: unterminated [SPEC] tag in response]"
+        )
+
+    def test_exactly_three_attempts_and_last_error_kept(self, monkeypatch):
+        """Kills MAX_RETRIES 3->4, the deadline Add->Sub (a generous
+        budget must not cut retries), and the last-attempt filter
+        mutants (< -> <=, -1 -> +1): the final transient error text is
+        kept, not replaced by 'retries exhausted'."""
+        from adversarial_spec_tpu.engine.dispatch import get_engine
+        from adversarial_spec_tpu.engine.types import SamplingParams
+
+        model = "mock://flaky?fail=96"
+        eng = get_engine(model)
+        calls = []
+        orig = eng.chat
+
+        def counting_chat(batch, sampling):
+            calls.append(len(batch))
+            return orig(batch, sampling)
+
+        monkeypatch.setattr(eng, "chat", counting_chat)
+        monkeypatch.setattr(RoundConfig, "sleep", staticmethod(lambda _: None))
+        cfg = RoundConfig(sampling=SamplingParams(timeout_s=3600.0))
+        result = run_round(SPEC, [model], 1, cfg)
+        assert calls == [1, 1, 1]  # exactly MAX_RETRIES batched attempts
+        assert result.responses[0].error == "mock transient failure 3/96"
+
+    def test_expired_budget_stops_retries(self, monkeypatch):
+        """Kills the timeout_s guard mutant (> 0 -> > 1) and the
+        'retries exhausted' string mutant: a sub-second budget arms the
+        deadline, so only one attempt runs."""
+        from adversarial_spec_tpu.engine.dispatch import get_engine
+        from adversarial_spec_tpu.engine.types import SamplingParams
+
+        model = "mock://flaky?fail=95"
+        eng = get_engine(model)
+        calls = []
+        orig = eng.chat
+
+        def counting_chat(batch, sampling):
+            calls.append(len(batch))
+            return orig(batch, sampling)
+
+        monkeypatch.setattr(eng, "chat", counting_chat)
+        monkeypatch.setattr(RoundConfig, "sleep", staticmethod(lambda _: None))
+        cfg = RoundConfig(sampling=SamplingParams(timeout_s=1e-6))
+        result = run_round(SPEC, [model], 1, cfg)
+        assert calls == [1]
+        assert result.responses[0].error == "retries exhausted"
+
+    def test_latency_is_a_duration(self):
+        """Kills the latency Sub->Add mutant (t1 + t0 is ~2x the
+        monotonic clock, far above any sane round duration)."""
+        result = run_round(SPEC, ["mock://agree"], 1)
+        assert 0.0 <= result.responses[0].latency_s < 3600.0
+
+    def test_run_round_default_round_num(self):
+        """Kills the round_num default mutant (1 -> 2)."""
+        result = run_round(SPEC, ["mock://agree"])
+        assert result.round_num == 1
+
+
+class TestTypesMutationHardening:
+    """Pins for types.py survivors."""
+
+    def test_model_response_defaults(self):
+        from adversarial_spec_tpu.debate.types import ModelResponse
+
+        r = ModelResponse(model="m")
+        assert r.agreed is False
+        assert r.ok is True
+        assert r.critique == "" and r.revised_spec is None
+
+    def test_to_dict_schema_and_rounding(self):
+        """to_dict is the per-model block of the CLI --json output:
+        exact keys, exact latency rounding (3 digits)."""
+        from adversarial_spec_tpu.debate.types import ModelResponse
+        from adversarial_spec_tpu.debate.usage import Usage
+
+        r = ModelResponse(
+            model="m",
+            critique="c",
+            agreed=True,
+            revised_spec="s",
+            usage=Usage(input_tokens=1, output_tokens=2),
+            latency_s=0.123456,
+        )
+        assert r.to_dict() == {
+            "model": "m",
+            "agreed": True,
+            "critique": "c",
+            "revised_spec": "s",
+            "error": None,
+            "usage": {
+                "input_tokens": 1,
+                "output_tokens": 2,
+                "total_tokens": 3,
+                "device_time_s": 0.0,
+            },
+            "latency_s": 0.123,
+        }
+
+    def test_round_result_partitions(self):
+        """failed is the exact complement of successful (kills the
+        dropped `not`), and round_num defaults to 1."""
+        from adversarial_spec_tpu.debate.types import (
+            ModelResponse,
+            RoundResult,
+        )
+
+        ok = ModelResponse(model="a")
+        bad = ModelResponse(model="b", error="boom")
+        rr = RoundResult(responses=[ok, bad])
+        assert rr.round_num == 1
+        assert rr.successful == [ok]
+        assert rr.failed == [bad]

@@ -245,3 +245,372 @@ class TestRoundSummary:
             responses=[ModelResponse(model="a", agreed=True)], round_num=1
         )
         assert "All models agree!" in telegram.format_round_summary(result)
+
+
+class TestMutationHardening:
+    """Pins that kill the round-5 mutation-sweep survivors in
+    telegram.py (tools/mutation_run.py; assertions name their mutants)."""
+
+    def test_wire_constants_pinned(self):
+        """Bot API base, the 4096 hard limit, 30 s timeout, pacing and
+        poll-slice constants are protocol facts, not tunables."""
+        assert telegram.API_BASE == "https://api.telegram.org"
+        assert telegram.MAX_MESSAGE_LEN == 4096
+        assert telegram.API_TIMEOUT_S == 30
+        assert telegram.CHUNK_PACING_S == 0.5
+        assert telegram.POLL_SLICE_S == 25
+
+    def test_config_is_frozen(self):
+        with pytest.raises(Exception):  # dataclasses.FrozenInstanceError
+            CFG.token = "other"
+
+    def test_api_error_message_names_method(self):
+        with patch.object(
+            telegram.urllib.request,
+            "urlopen",
+            _mock_urlopen([{"ok": False, "description": "bad"}]),
+        ):
+            with pytest.raises(
+                RuntimeError, match=r"Telegram API getMe failed: "
+            ):
+                telegram.api_call("tok", "getMe")
+
+    def test_split_exact_limit_is_one_chunk(self):
+        """len == limit must NOT split (<= -> < mutant)."""
+        text = "x" * 4096
+        assert telegram.split_message(text) == [text]
+
+    def test_split_tail_keeps_trailing_newline(self):
+        """The final remainder is appended verbatim (the in-loop rstrip
+        must not apply to it; > -> >= mutant on the loop guard)."""
+        text = "a" * 4096 + "b" * 4095 + "\n"
+        chunks = telegram.split_message(text)
+        assert chunks == ["a" * 4096, "b" * 4095 + "\n"]
+
+    def test_split_break_preference_order(self):
+        """Paragraph beats line beats space (separator-string mutants)."""
+        text = "A" * 5 + "\n\n" + "B" * 3 + "\nC D" + "E" * 12
+        chunks = telegram.split_message(text, limit=12)
+        # "\n\n" at idx 5 (> 12//2=6? no, 5 < 6) → "\n" at 10 wins.
+        assert chunks[0] == "A" * 5 + "\n\n" + "B" * 3
+        # Pure-paragraph case: "\n\n" in the second half is taken.
+        t2 = "A" * 8 + "\n\n" + "B" * 8
+        assert telegram.split_message(t2, limit=12)[0] == "A" * 8
+
+    def test_split_break_only_in_second_half(self):
+        """A separator at exactly limit//2 is NOT taken (> -> >= and
+        //2 -> //3 mutants): the hard cut at limit wins."""
+        text = "01234\n6789AB"
+        chunks = telegram.split_message(text, limit=10)
+        assert chunks == ["01234\n6789", "AB"]
+
+    def test_split_rstrip_only_newlines(self):
+        """Chunk trailing content other than newlines survives the
+        rstrip (charset +XX mutant would eat literal X's)."""
+        text = "AAAAAAX\n\n" + "B" * 10
+        chunks = telegram.split_message(text, limit=12)
+        assert chunks[0] == "AAAAAAX"
+
+    def test_send_long_message_wire_format(self, monkeypatch):
+        """Method name and param keys are the Bot API contract; pacing
+        sleeps happen between chunks only."""
+        sent = []
+        sleeps = []
+        monkeypatch.setattr(
+            telegram,
+            "api_call",
+            lambda tok, method, params=None: sent.append(
+                (tok, method, params)
+            )
+            or {},
+        )
+        n = telegram.send_long_message(
+            CFG, "a" * 5000, sleep=sleeps.append
+        )
+        assert n == 2 and len(sent) == 2
+        for tok, method, params in sent:
+            assert tok == "tok"
+            assert method == "sendMessage"
+            assert set(params) == {"chat_id", "text"}
+            assert params["chat_id"] == "42"
+        assert sleeps == [telegram.CHUNK_PACING_S]
+
+    def test_get_last_update_id_wire_and_defaults(self, monkeypatch):
+        calls = []
+
+        def fake(tok, method, params=None):
+            calls.append((method, params))
+            return [{"update_id": 7}, {}]
+
+        monkeypatch.setattr(telegram, "api_call", fake)
+        assert telegram.get_last_update_id(CFG) == 7
+        assert calls == [("getUpdates", {"timeout": 0})]
+        # Missing update_id fields default to 0, empty list gives 0.
+        monkeypatch.setattr(
+            telegram, "api_call", lambda *a, **k: [{}]
+        )
+        assert telegram.get_last_update_id(CFG) == 0
+        monkeypatch.setattr(telegram, "api_call", lambda *a, **k: [])
+        assert telegram.get_last_update_id(CFG) == 0
+
+    def test_poll_zero_timeout_never_calls_api(self, monkeypatch):
+        """deadline uses a strict < (LtE mutant would fire one call)."""
+        monkeypatch.setattr(
+            telegram,
+            "api_call",
+            lambda *a, **k: pytest.fail("api_call with zero budget"),
+        )
+        t = 1000.0
+        assert (
+            telegram.poll_for_reply(CFG, 5, 0, clock=lambda: t) is None
+        )
+
+    def test_poll_offset_and_slice_wire(self, monkeypatch):
+        """offset starts at after_update_id + 1; the slice is
+        min(POLL_SLICE_S, remaining) and never below 1 s."""
+        seen = []
+
+        def fake(tok, method, params=None):
+            seen.append(dict(params))
+            return [
+                {
+                    "update_id": 11,
+                    "message": {"chat": {"id": 42}, "text": "yo"},
+                }
+            ]
+
+        monkeypatch.setattr(telegram, "api_call", fake)
+        out = telegram.poll_for_reply(CFG, 5, 100, clock=lambda: 1000.0)
+        assert out == "yo"
+        assert seen == [{"timeout": 25, "offset": 6}]
+        # Sub-second remaining budget clamps the slice UP to 1.
+        seen.clear()
+        telegram.poll_for_reply(CFG, 5, 0.5, clock=lambda: 1000.0)
+        assert seen[0]["timeout"] == 1
+
+    def test_poll_advances_offset_past_seen_updates(self, monkeypatch):
+        ticks = iter([0.0, 1.0, 2.0, 3.0, 4.0, 5.0])
+        responses = iter(
+            [
+                [
+                    {
+                        "update_id": 30,
+                        "message": {"chat": {"id": 99}, "text": "other"},
+                    }
+                ],
+                [
+                    {
+                        "update_id": 31,
+                        "message": {"chat": {"id": 42}, "text": "mine"},
+                    }
+                ],
+            ]
+        )
+        seen = []
+
+        def fake(tok, method, params=None):
+            seen.append(dict(params))
+            return next(responses)
+
+        monkeypatch.setattr(telegram, "api_call", fake)
+        out = telegram.poll_for_reply(
+            CFG, 5, 60, clock=lambda: next(ticks)
+        )
+        assert out == "mine"
+        assert [p["offset"] for p in seen] == [6, 31]
+
+    def test_discover_prefers_latest_and_skips_chatless(self, monkeypatch):
+        updates = [
+            {"message": None},
+            {"message": {"chat": {"id": 5}}},
+            {"message": {"chat": {}}},
+        ]
+        monkeypatch.setattr(
+            telegram, "api_call", lambda *a, **k: updates
+        )
+        assert telegram.discover_chat_id("tok") == "5"
+        monkeypatch.setattr(telegram, "api_call", lambda *a, **k: [])
+        assert telegram.discover_chat_id("tok") is None
+
+    def test_round_summary_exact_text(self):
+        long_critique = "c" * 200
+        result = RoundResult(
+            responses=[
+                ModelResponse(model="m1", agreed=True),
+                ModelResponse(model="m2", error="boom"),
+                ModelResponse(model="m3", critique=long_critique),
+            ],
+            round_num=4,
+        )
+        out = telegram.format_round_summary(result, total_cost=1.5)
+        lines = out.split("\n")
+        assert lines[0] == "Debate round 4:"
+        assert lines[1] == "  ✓ m1: AGREE"
+        assert lines[2] == "  ✗ m2: ERROR boom"
+        assert lines[3] == "  … m3: " + "c" * 117 + "..."
+        assert lines[4] == "Debate continues."
+        assert lines[5] == "Cost so far: $1.5000"
+        agreed = RoundResult(
+            responses=[ModelResponse(model="m1", agreed=True)]
+        )
+        assert "All models agree!" in telegram.format_round_summary(agreed)
+
+    def test_notify_round_no_feedback_skips_polling(self, monkeypatch):
+        sent = []
+        monkeypatch.setattr(
+            telegram,
+            "send_long_message",
+            lambda cfg, text: sent.append(text) or 1,
+        )
+        monkeypatch.setattr(
+            telegram,
+            "get_last_update_id",
+            lambda cfg: pytest.fail("polled with feedback_timeout=0"),
+        )
+        result = RoundResult(responses=[ModelResponse(model="m")])
+        assert telegram.notify_round(CFG, result) is None
+        assert len(sent) == 1
+
+    def test_notify_round_feedback_prompt_and_reply(self, monkeypatch):
+        prompts = []
+        monkeypatch.setattr(
+            telegram, "send_long_message", lambda cfg, text: 1
+        )
+        monkeypatch.setattr(
+            telegram,
+            "send_message",
+            lambda cfg, text: prompts.append(text),
+        )
+        monkeypatch.setattr(telegram, "get_last_update_id", lambda cfg: 9)
+        polled = []
+        monkeypatch.setattr(
+            telegram,
+            "poll_for_reply",
+            lambda cfg, after, t: polled.append((after, t)) or "fb",
+        )
+        result = RoundResult(responses=[ModelResponse(model="m")])
+        out = telegram.notify_round(CFG, result, feedback_timeout=1)
+        assert out == "fb"
+        assert polled == [(9, 1)]
+        assert prompts == [
+            "Reply within 1s to inject feedback into the next round."
+        ]
+
+
+class TestCliMutationHardening:
+    """_cli return codes, argument parsing, and user-facing strings."""
+
+    def _env(self, monkeypatch, token="tok", chat="42"):
+        monkeypatch.setenv("TELEGRAM_BOT_TOKEN", token)
+        monkeypatch.setenv("TELEGRAM_CHAT_ID", chat)
+
+    def test_no_args_usage(self, capsys):
+        assert telegram._cli([]) == 2
+        assert "usage: telegram" in capsys.readouterr().err
+
+    def test_setup_success(self, monkeypatch, capsys):
+        self._env(monkeypatch)
+        monkeypatch.setattr(
+            telegram, "discover_chat_id", lambda tok: "777"
+        )
+        assert telegram._cli(["setup"]) == 0
+        assert (
+            "export TELEGRAM_CHAT_ID=777" in capsys.readouterr().out
+        )
+
+    def test_setup_without_token(self, monkeypatch, capsys):
+        monkeypatch.delenv("TELEGRAM_BOT_TOKEN", raising=False)
+        assert telegram._cli(["setup"]) == 2
+        assert "set TELEGRAM_BOT_TOKEN" in capsys.readouterr().err
+
+    def test_setup_no_messages(self, monkeypatch, capsys):
+        self._env(monkeypatch)
+        monkeypatch.setattr(
+            telegram, "discover_chat_id", lambda tok: None
+        )
+        assert telegram._cli(["setup"]) == 1
+        assert "no messages found" in capsys.readouterr().err
+
+    def test_missing_config_error(self, monkeypatch, capsys):
+        monkeypatch.delenv("TELEGRAM_BOT_TOKEN", raising=False)
+        monkeypatch.delenv("TELEGRAM_CHAT_ID", raising=False)
+        assert telegram._cli(["send", "x"]) == 2
+        assert (
+            "set TELEGRAM_BOT_TOKEN and TELEGRAM_CHAT_ID"
+            in capsys.readouterr().err
+        )
+
+    def test_send_joins_args(self, monkeypatch):
+        self._env(monkeypatch)
+        sent = []
+        monkeypatch.setattr(
+            telegram,
+            "send_long_message",
+            lambda cfg, text: sent.append(text) or 1,
+        )
+        assert telegram._cli(["send", "hello", "world"]) == 0
+        assert sent == ["hello world"]
+
+    def test_poll_default_and_explicit_timeout(self, monkeypatch, capsys):
+        self._env(monkeypatch)
+        monkeypatch.setattr(telegram, "get_last_update_id", lambda cfg: 3)
+        polled = []
+        monkeypatch.setattr(
+            telegram,
+            "poll_for_reply",
+            lambda cfg, after, t: polled.append((after, t)) or "answer",
+        )
+        assert telegram._cli(["poll"]) == 0
+        assert telegram._cli(["poll", "5"]) == 0
+        assert polled == [(3, 60), (3, 5)]
+        assert capsys.readouterr().out == "answer\nanswer\n"
+
+    def test_poll_no_reply(self, monkeypatch, capsys):
+        self._env(monkeypatch)
+        monkeypatch.setattr(telegram, "get_last_update_id", lambda cfg: 3)
+        monkeypatch.setattr(
+            telegram, "poll_for_reply", lambda cfg, after, t: None
+        )
+        assert telegram._cli(["poll", "1"]) == 1
+        assert "(no reply)" in capsys.readouterr().err
+
+    def test_notify_text_only_never_polls(self, monkeypatch):
+        self._env(monkeypatch)
+        sent = []
+        monkeypatch.setattr(
+            telegram,
+            "send_long_message",
+            lambda cfg, text: sent.append(text) or 1,
+        )
+        monkeypatch.setattr(
+            telegram,
+            "get_last_update_id",
+            lambda cfg: pytest.fail("polled in text-only notify"),
+        )
+        assert telegram._cli(["notify", "plain", "text"]) == 0
+        assert sent == ["plain text"]
+
+    def test_notify_numeric_timeout_polls(self, monkeypatch, capsys):
+        self._env(monkeypatch)
+        monkeypatch.setattr(
+            telegram, "send_long_message", lambda cfg, text: 1
+        )
+        monkeypatch.setattr(telegram, "get_last_update_id", lambda cfg: 8)
+        polled = []
+        monkeypatch.setattr(
+            telegram,
+            "poll_for_reply",
+            lambda cfg, after, t: polled.append((after, t)) or "ok",
+        )
+        assert telegram._cli(["notify", "1", "msg"]) == 0
+        assert polled == [(8, 1)]
+        assert capsys.readouterr().out == "ok\n"
+        monkeypatch.setattr(
+            telegram, "poll_for_reply", lambda cfg, after, t: None
+        )
+        assert telegram._cli(["notify", "1", "msg"]) == 1
+
+    def test_unknown_subcommand(self, monkeypatch, capsys):
+        self._env(monkeypatch)
+        assert telegram._cli(["bogus"]) == 2
+        assert "unknown subcommand 'bogus'" in capsys.readouterr().err

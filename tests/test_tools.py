@@ -219,6 +219,21 @@ class TestAstLint:
             "takes_two(1, 2, 3)\n"
             "takes_two(1, 2, zz=9)\n"
             "x = good.nothing_here\n"
+            # A keyword hitting an OPTIONAL positional must not mask the
+            # missing required one (f(b=2) on f(a, b=1) raises at runtime).
+            "def opt(a, b=1):\n    return a\n"
+            "opt(b=2)\n"
+            # A parameter shadowing a module function must NOT be
+            # arity-checked against the module function.
+            "def uses(takes_two):\n    return takes_two(1, 2, 3, 4)\n"
+        )
+        sub = pkg / "sub"
+        sub.mkdir()
+        (sub / "leaf.py").write_text("def leaf_fn(x):\n    return x\n")
+        # Relative import from a nested-package __init__: level 1 is the
+        # package itself, and a bad name must be flagged there too.
+        (sub / "__init__.py").write_text(
+            "from .leaf import leaf_fn, leaf_missing\n"
         )
         monkeypatch.setattr(astlint, "REPO", tmp_path)
         findings: list[str] = []
@@ -241,6 +256,13 @@ class TestAstLint:
         assert "takes 2 positional args but 3 given" in text
         assert "unexpected keyword 'zz'" in text
         assert "no attribute 'nothing_here'" in text
+        # opt(b=2): the optional-positional keyword can't stand in for
+        # the missing required 'a'.
+        assert "opt() missing required args" in text
+        # Shadowed name: no finding may point at the `uses` body.
+        assert "takes 2 positional args but 4 given" not in text
+        # Nested __init__ relative import resolves to pkg.sub.leaf.
+        assert "'leaf_missing' is not defined in pkg.sub.leaf" in text
 
 
 class TestMutationRun:

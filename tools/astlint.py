@@ -74,8 +74,6 @@ class ClassInfo:
     name: str
     methods: dict[str, FuncSig] = field(default_factory=dict)
     bases: tuple[str, ...] = ()
-    is_dataclass: bool = False
-    class_fields: tuple[str, ...] = ()
 
 
 @dataclass
@@ -158,25 +156,10 @@ def _collect_module(path: Path, modname: str) -> ModuleInfo:
                     _decorator_name(b)
                     for b in node.bases
                 ),
-                is_dataclass=any(
-                    _decorator_name(d)
-                    in ("dataclass", "dataclasses.dataclass")
-                    for d in node.decorator_list
-                ),
             )
-            fields: list[str] = []
             for sub in node.body:
                 if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
                     ci.methods[sub.name] = _sig_of(sub, is_method=True)
-                elif isinstance(sub, ast.AnnAssign) and isinstance(
-                    sub.target, ast.Name
-                ):
-                    fields.append(sub.target.id)
-                elif isinstance(sub, ast.Assign):
-                    for t in sub.targets:
-                        if isinstance(t, ast.Name):
-                            fields.append(t.id)
-            ci.class_fields = tuple(fields)
             info.classes[node.name] = ci
         elif isinstance(node, ast.Assign):
             for t in node.targets:
@@ -254,7 +237,16 @@ class _Checker(ast.NodeVisitor):
 
     def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
         if node.level:
-            base = self.info.modname.rsplit(".", node.level)[0]
+            # Level 1 means "this package": for a package __init__ that is
+            # the module itself; for a plain module it is the parent.
+            drop = node.level - (
+                1 if self.info.path.name == "__init__.py" else 0
+            )
+            base = (
+                self.info.modname
+                if drop == 0
+                else self.info.modname.rsplit(".", drop)[0]
+            )
             target = f"{base}.{node.module}" if node.module else base
         else:
             target = node.module or ""
@@ -318,6 +310,40 @@ class _Checker(ast.NodeVisitor):
         self.generic_visit(node)
         self.current_class = prev
 
+    # ------------------------------------------------------------ scopes
+
+    def _shadowed_names(self, fn) -> set[str]:
+        """Names this function rebinds locally: params plus local
+        assignment/for/with/except targets (one level of flow analysis —
+        enough to avoid false positives, not a full scope model)."""
+        names = set()
+        a = fn.args
+        for p in a.posonlyargs + a.args + a.kwonlyargs:
+            names.add(p.arg)
+        if a.vararg:
+            names.add(a.vararg.arg)
+        if a.kwarg:
+            names.add(a.kwarg.arg)
+        return names
+
+    def _visit_function_scope(self, node) -> None:
+        shadowed = {
+            n: self.resolved.pop(n)
+            for n in self._shadowed_names(node)
+            if n in self.resolved
+        }
+        self.generic_visit(node)
+        self.resolved.update(shadowed)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_function_scope(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_function_scope(node)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._visit_function_scope(node)
+
     # ------------------------------------------------------- attributes
 
     def visit_Attribute(self, node: ast.Attribute) -> None:
@@ -369,10 +395,12 @@ class _Checker(ast.NodeVisitor):
                     self._warn(
                         node, f"{what} got unexpected keyword '{kw}'"
                     )
-        # missing required args
+        # missing required args: only keywords naming a REQUIRED
+        # positional cover one (a keyword hitting an optional positional
+        # must not mask a missing required arg, e.g. f(b=2) on f(a, b=1)).
         required_pos = sig.n_pos - sig.n_pos_defaults
         covered = n_pos_given + len(
-            kw_given & set(sig.pos_names[n_pos_given:])
+            kw_given & set(sig.pos_names[n_pos_given:required_pos])
         )
         if covered < required_pos:
             self._warn(
