@@ -310,3 +310,19 @@ class TestTypesMutationHardening:
         assert rr.round_num == 1
         assert rr.successful == [ok]
         assert rr.failed == [bad]
+
+
+class TestMutationHardeningRound2:
+    def test_context_error_message_exact(self, tmp_path):
+        """The missing path follows the label immediately (substring
+        pins let a mutated label tail survive)."""
+        import re
+
+        from adversarial_spec_tpu.debate.core import load_context_files
+
+        ghost = str(tmp_path / "ghost.txt")
+        with pytest.raises(
+            FileNotFoundError,
+            match=rf"context file not found: {re.escape(ghost)}$",
+        ):
+            load_context_files([ghost])

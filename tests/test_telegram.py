@@ -3,6 +3,7 @@ mocked urlopen, chunk-boundary assertions, stepped clocks for polling)."""
 
 import io
 import json
+import os
 from unittest.mock import MagicMock, patch
 
 import pytest
@@ -614,3 +615,117 @@ class TestCliMutationHardening:
         self._env(monkeypatch)
         assert telegram._cli(["bogus"]) == 2
         assert "unknown subcommand 'bogus'" in capsys.readouterr().err
+
+
+class TestMutationHardeningRound2:
+    """Second-pass pins: survivors whose first-pass assertions used
+    substring matches that `+XX` mutants slip past, plus wire params
+    the lambda mocks ignored."""
+
+    def test_api_error_message_exact_shape(self):
+        """The payload dict follows the labeled method immediately."""
+        with patch.object(
+            telegram.urllib.request,
+            "urlopen",
+            _mock_urlopen([{"ok": False, "description": "bad"}]),
+        ):
+            with pytest.raises(
+                RuntimeError, match=r"Telegram API getMe failed: \{"
+            ):
+                telegram.api_call("tok", "getMe")
+
+    def test_split_separator_strings_exact(self):
+        """Paragraph and space separators are the literal two-char/one-
+        char strings (a mutated separator silently degrades every break
+        to the hard cut)."""
+        # Paragraph break in the second half; a line break sits later,
+        # so a broken "\n\n" separator would cut at the "\n" instead.
+        text = "A" * 7 + "\n\n" + "B\n" + "C" * 10
+        assert telegram.split_message(text, limit=12)[0] == "A" * 7
+        # Space break: no newlines at all.
+        t2 = "A" * 7 + " " + "B" * 10
+        chunks = telegram.split_message(t2, limit=12)
+        assert chunks == ["A" * 7 + " ", "B" * 10]
+
+    def test_poll_method_name_and_unidentified_updates(self, monkeypatch):
+        """getUpdates is the method on every slice; an update missing
+        update_id must not advance the offset past 0+1."""
+        calls = []
+        responses = iter(
+            [
+                [{"message": {"chat": {"id": 99}, "text": "other"}}],
+                [
+                    {
+                        "update_id": 3,
+                        "message": {"chat": {"id": 42}, "text": "mine"},
+                    }
+                ],
+            ]
+        )
+
+        def fake(tok, method, params=None):
+            calls.append((method, dict(params)))
+            return next(responses)
+
+        monkeypatch.setattr(telegram, "api_call", fake)
+        import itertools
+
+        ticks = (float(i) for i in itertools.count())
+        out = telegram.poll_for_reply(CFG, 0, 60, clock=lambda: next(ticks))
+        assert out == "mine"
+        assert [m for m, _ in calls] == ["getUpdates", "getUpdates"]
+        assert [p["offset"] for _, p in calls] == [1, 1]
+
+    def test_discover_wire_params(self, monkeypatch):
+        calls = []
+
+        def fake(tok, method, params=None):
+            calls.append((tok, method, dict(params)))
+            return [{"message": {"chat": {"id": 5}}}]
+
+        monkeypatch.setattr(telegram, "api_call", fake)
+        assert telegram.discover_chat_id("tok") == "5"
+        assert calls == [("tok", "getUpdates", {"timeout": 0})]
+
+    def test_all_agree_line_exact(self):
+        agreed = RoundResult(
+            responses=[ModelResponse(model="m1", agreed=True)]
+        )
+        out = telegram.format_round_summary(agreed)
+        assert out.split("\n")[-1] == "All models agree!"
+
+    def test_cli_error_lines_exact(self, monkeypatch, capsys):
+        monkeypatch.setenv("TELEGRAM_BOT_TOKEN", "tok")
+        monkeypatch.setenv("TELEGRAM_CHAT_ID", "42")
+        monkeypatch.setattr(telegram, "discover_chat_id", lambda tok: None)
+        assert telegram._cli(["setup"]) == 1
+        assert capsys.readouterr().err == (
+            "no messages found — send your bot a message, then rerun\n"
+        )
+        monkeypatch.delenv("TELEGRAM_BOT_TOKEN", raising=False)
+        monkeypatch.delenv("TELEGRAM_CHAT_ID", raising=False)
+        assert telegram._cli(["send", "x"]) == 2
+        assert capsys.readouterr().err == (
+            "error: set TELEGRAM_BOT_TOKEN and TELEGRAM_CHAT_ID\n"
+        )
+
+    def test_module_entrypoint(self):
+        """python -m …telegram runs _cli on argv[1:] (pins the
+        __main__ guard and the argv slice)."""
+        import subprocess
+        import sys as _sys
+        from pathlib import Path
+
+        repo_root = str(Path(__file__).resolve().parent.parent)
+        r = subprocess.run(
+            [_sys.executable, "-m", "adversarial_spec_tpu.debate.telegram",
+             "bogus"],
+            capture_output=True,
+            text=True,
+            env={**os.environ, "TELEGRAM_BOT_TOKEN": "t",
+                 "TELEGRAM_CHAT_ID": "c", "JAX_PLATFORMS": "cpu",
+                 "PYTHONPATH": repo_root},
+            timeout=120,
+        )
+        assert r.returncode == 2
+        assert "unknown subcommand 'bogus'" in r.stderr
