@@ -558,3 +558,602 @@ class TestHumanReadableOutputs:
         )
         assert code == 0
         assert "no --models given; defaulting to" in err
+
+
+class TestMutationHardening:
+    """Pins that kill the cli.py mutation-sweep survivors
+    (tools/mutation_run.py; each block names the mutant class it kills)."""
+
+    def test_exit_codes_and_action_set(self):
+        """Exit codes are the documented 0/1/2 contract; the action list
+        and default opponent are the CLI's public surface."""
+        assert cli.EXIT_OK == 0
+        assert cli.EXIT_ERROR == 1
+        assert cli.EXIT_VALIDATION == 2
+        assert cli.ACTIONS == [
+            "critique",
+            "providers",
+            "send-final",
+            "diff",
+            "export-tasks",
+            "focus-areas",
+            "personas",
+            "profiles",
+            "save-profile",
+            "sessions",
+            "registry",
+        ]
+        assert cli.DEFAULT_MODELS == ["mock://critic?agree_after=3"]
+
+    def test_size_rank_table(self):
+        """Default-opponent auto-detection ranks by model size."""
+        assert cli._SIZE_RANK == {
+            "70b": 6, "9b": 5, "8b": 4, "7b": 3, "3b": 2, "1b": 1,
+            "tiny": 0,
+        }
+
+    def test_parser_accepts_every_flag(self):
+        """One full-vector parse: a mutated flag name, choice, or
+        default breaks this round-trip."""
+        p = cli.create_parser()
+        args = p.parse_args([
+            "critique",
+            "--models", "mock://agree", "--doc-type", "prd",
+            "--round", "3", "--focus", "security", "--persona", "qa",
+            "--preserve-intent", "--press",
+            "--context", "a.md", "--context", "b.md",
+            "--session", "s1", "--profile", "pr", "--name", "nm",
+            "--json", "--show-cost", "--previous", "p.md",
+            "--current", "c.md", "--notify", "--feedback-timeout", "9",
+            "--profile-dir", "/tmp/tr",
+            "--max-new-tokens", "64", "--temperature", "0.5", "--greedy",
+            "--seed", "7", "--timeout", "12.5",
+            "--checkpoint", "/ckpt", "--family", "qwen2", "--size", "8b",
+            "--tokenizer", "/tok", "--dtype", "bfloat16", "--tp", "2",
+            "--quant", "int8", "--kv", "paged", "--kv-dtype", "int8",
+        ])
+        assert args.models == "mock://agree" and args.doc_type == "prd"
+        assert args.round == 3 and args.focus == "security"
+        assert args.persona == "qa" and args.preserve_intent and args.press
+        assert args.context == ["a.md", "b.md"]
+        assert args.session == "s1" and args.profile == "pr"
+        assert args.name == "nm" and args.json and args.show_cost
+        assert args.previous == "p.md" and args.current == "c.md"
+        assert args.notify and args.feedback_timeout == 9
+        assert args.profile_dir == "/tmp/tr"
+        assert args.max_new_tokens == 64 and args.temperature == 0.5
+        assert args.greedy and args.seed == 7 and args.timeout == 12.5
+        assert args.checkpoint == "/ckpt" and args.family == "qwen2"
+        assert args.size == "8b" and args.tokenizer == "/tok"
+        assert args.dtype == "bfloat16" and args.tp == 2
+        assert args.quant == "int8" and args.kv == "paged"
+        assert args.kv_dtype == "int8"
+        # Short aliases and defaults.
+        d = p.parse_args(["critique", "-m", "x", "-j"])
+        assert d.models == "x" and d.json
+        assert d.round == 1 and d.feedback_timeout == 0
+        assert d.family == "llama" and d.size == "tiny"
+        assert d.kv == "dense" and d.quant == "" and d.kv_dtype == ""
+
+    def test_parse_models_splits_and_strips(self):
+        p = cli.create_parser()
+        args = p.parse_args(["critique", "--models", " a , b ,,c "])
+        assert cli.parse_models(args) == ["a", "b", "c"]
+
+    def test_sampling_defaults_and_explicit_zeros(self):
+        """max_new default 1024, temp default 0.7 — but an EXPLICIT
+        temperature 0.0 is the user's (is-None check, not truthiness);
+        timeout defaults 600 and clamps negatives to 0."""
+        p = cli.create_parser()
+        s = cli._sampling_from_args(p.parse_args(["critique"]))
+        assert s.max_new_tokens == 1024
+        assert s.temperature == 0.7
+        assert s.timeout_s == 600.0
+        s2 = cli._sampling_from_args(
+            p.parse_args(["critique", "--temperature", "0.0",
+                          "--timeout", "-5"])
+        )
+        assert s2.temperature == 0.0
+        assert s2.timeout_s == 0.0
+
+    def test_validation_error_format(self):
+        """Errors carry 'model: reason' and exit code 2."""
+        errs = cli.validate_models_before_run(["tpu://no-such-alias"])
+        assert len(errs) == 1
+        assert errs[0].startswith("tpu://no-such-alias: ")
+
+    def test_json_schema_exact_keys(self, monkeypatch, capsys):
+        """The --json contract: EXACT top-level and per-result key sets
+        (presence-only checks let renamed keys slip through)."""
+        code, out, _ = run_cli(
+            ["critique", "--models", "mock://agree", "--json"],
+            stdin=SPEC, monkeypatch=monkeypatch, capsys=capsys,
+        )
+        assert code == 0
+        data = json.loads(out)
+        assert set(data) == {
+            "all_agreed", "round", "doc_type", "models", "focus",
+            "persona", "preserve_intent", "session", "results", "cost",
+            "perf",
+        }
+        assert data["all_agreed"] is True
+        assert data["round"] == 1
+        assert data["doc_type"] == "generic"
+        assert data["preserve_intent"] is False
+        assert set(data["results"][0]) == {
+            "model", "agreed", "response", "spec", "error",
+            "input_tokens", "output_tokens", "cost",
+        }
+
+    def test_providers_json_schema(self, monkeypatch, capsys):
+        code, out, _ = run_cli(
+            ["providers", "--json"],
+            monkeypatch=monkeypatch, capsys=capsys,
+        )
+        assert code == 0
+        data = json.loads(out)
+        assert set(data) == {"tpu", "mock", "devices"}
+        assert [m["model"] for m in data["mock"]] == [
+            "mock://agree",
+            "mock://critic",
+            "mock://critic?agree_after=N",
+        ]
+        assert all(m["available"] is True for m in data["mock"])
+        assert set(data["devices"]) == {"platform", "device_count"}
+
+    def test_device_info_error_path(self, monkeypatch):
+        from adversarial_spec_tpu.utils import jaxenv
+
+        def boom():
+            raise RuntimeError("no backend")
+
+        monkeypatch.setattr(jaxenv, "configure_jax", boom)
+        info = cli._device_info()
+        assert info == {"platform": "unavailable", "error": "no backend"}
+
+    def test_resume_restores_joined_models(self, monkeypatch):
+        """Resume rebuilds --models as a comma join of the saved list."""
+        SessionState(
+            session_id="rj", spec="# S", models=["mock://a", "mock://b"]
+        ).save()
+        p = cli.create_parser()
+        args = p.parse_args(["critique", "--resume", "rj"])
+        spec, state = cli.load_or_resume_session(args)
+        assert spec == "# S"
+        assert args.models == "mock://a,mock://b"
+        assert args.session == "rj"
+
+    def test_new_session_doc_type_default(self, monkeypatch):
+        p = cli.create_parser()
+        args = p.parse_args(["critique", "--session", "nd"])
+        monkeypatch.setattr("sys.stdin", io.StringIO("# S"))
+        spec, state = cli.load_or_resume_session(args)
+        assert state.doc_type == "generic"
+        assert state.round == 1
+
+    def test_export_tasks_sampling_defaults(self, monkeypatch, capsys):
+        """export-tasks decodes at 2048 tokens / temp 0.3 by default
+        (an explicit 0.0 temperature again wins over the default)."""
+        captured = {}
+        real_get_engine = cli.get_engine
+
+        def spy(model):
+            eng = real_get_engine(model)
+            real_chat = eng.chat
+
+            def chat(batch, params):
+                captured["params"] = params
+                return real_chat(batch, params)
+
+            monkeypatch.setattr(eng, "chat", chat)
+            return eng
+
+        monkeypatch.setattr(cli, "get_engine", spy)
+        code, out, _ = run_cli(
+            ["export-tasks", "--models", "mock://tasks", "--json"],
+            stdin=SPEC, monkeypatch=monkeypatch, capsys=capsys,
+        )
+        assert code == 0
+        assert captured["params"].max_new_tokens == 2048
+        assert captured["params"].temperature == 0.3
+
+    def test_registry_status_line_format(self, monkeypatch, capsys):
+        """Text listing pins the alias/family/size/checkpoint line."""
+        code, _, _ = run_cli(
+            ["registry", "add-model", "pin-me", "--checkpoint", "random",
+             "--family", "gemma2", "--size", "9b"],
+            monkeypatch=monkeypatch, capsys=capsys,
+        )
+        assert code == 0
+        code, out, _ = run_cli(
+            ["registry", "status"],
+            monkeypatch=monkeypatch, capsys=capsys,
+        )
+        assert code == 0
+        assert (
+            f"  {'pin-me':24s} family={'gemma2':8s} size={'9b':5s} "
+            f"checkpoint=random"
+        ) in out
+
+
+class TestMutationHardeningRound2:
+    """Second-pass cli.py pins: dispatch strings, return-code sites,
+    default-resolution operators, and wire schemas the first pass
+    missed."""
+
+    def test_parser_prog_groups_and_short_flags(self):
+        p = cli.create_parser()
+        assert p.prog == "debate"
+        help_text = p.format_help()
+        for group in ("debate:", "session:", "output:", "decode:",
+                      "registry:"):
+            assert group in help_text
+        opts = {s for a in p._actions for s in a.option_strings}
+        assert {"-m", "--models", "-j", "--json"} <= opts
+
+    def test_every_choice_value_parses(self):
+        p = cli.create_parser()
+        for dt in ("prd", "tech", "generic"):
+            assert p.parse_args(["critique", "--doc-type", dt]).doc_type == dt
+        for fam in ("llama", "mistral", "gemma2", "qwen2"):
+            assert p.parse_args(["registry", "--family", fam]).family == fam
+        for kv in ("dense", "paged"):
+            assert p.parse_args(["registry", "--kv", kv]).kv == kv
+        for q in ("", "int8"):
+            assert p.parse_args(["registry", "--quant", q]).quant == q
+            assert p.parse_args(["registry", "--kv-dtype", q]).kv_dtype == q
+
+    def test_validate_uses_registry_path_once(self, monkeypatch):
+        """tpu:// models go through validate_tpu_model with ONE registry
+        load shared across models; the error text is the registry's own
+        message verbatim."""
+        from adversarial_spec_tpu.engine import registry as reg_mod
+
+        loads = []
+        real_load = reg_mod.load_registry
+
+        def counting_load(*a, **k):
+            loads.append(1)
+            return real_load(*a, **k)
+
+        monkeypatch.setattr(cli.model_registry, "load_registry", counting_load)
+        errs = cli.validate_models_before_run(
+            ["tpu://no-such-alias", "tpu://also-missing"]
+        )
+        assert len(loads) == 1
+        expected = reg_mod.validate_tpu_model(
+            "tpu://no-such-alias", registry=real_load()
+        )
+        assert errs[0] == f"tpu://no-such-alias: {expected}"
+
+    def test_perf_block_wiring(self, monkeypatch, capsys):
+        """Tracer span/counter names feed the perf block: spans must
+        carry validate/round/decode and the rate must be a nonzero
+        1-decimal number."""
+        code, out, _ = run_cli(
+            ["critique", "--models", "mock://critic?tps=1000", "--json"],
+            stdin=SPEC, monkeypatch=monkeypatch, capsys=capsys,
+        )
+        perf = json.loads(out)["perf"]
+        assert {"validate", "round", "decode"} <= set(perf["spans"])
+        tps = perf["decode_tokens_per_sec"]
+        assert tps > 0
+        assert tps == round(tps, 1)
+
+    def test_round_config_defaults_reach_run_round(self, monkeypatch, capsys):
+        """doc_type falls back to the string 'generic' and context_files
+        to an empty LIST on the cfg handed to run_round."""
+        seen = {}
+        real = cli.run_round
+
+        def spy(spec, models, round_num=1, cfg=None):
+            seen["cfg"] = cfg
+            return real(spec, models, round_num=round_num, cfg=cfg)
+
+        monkeypatch.setattr(cli, "run_round", spy)
+        run_cli(
+            ["critique", "--models", "mock://agree"],
+            stdin=SPEC, monkeypatch=monkeypatch, capsys=capsys,
+        )
+        assert seen["cfg"].doc_type == "generic"
+        assert seen["cfg"].context_files == []
+
+    def test_session_history_entry_exact(self, monkeypatch, capsys):
+        run_cli(
+            ["critique", "--models", "mock://agree", "--session", "hx"],
+            stdin=SPEC, monkeypatch=monkeypatch, capsys=capsys,
+        )
+        state = SessionState.load("hx")
+        assert state.history == [
+            {
+                "round": 1,
+                "all_agreed": True,
+                "models": {"mock://agree": True},
+            }
+        ]
+
+    def test_notify_unconfigured_warns(self, monkeypatch, capsys):
+        monkeypatch.delenv("TELEGRAM_BOT_TOKEN", raising=False)
+        monkeypatch.delenv("TELEGRAM_CHAT_ID", raising=False)
+        code, _, err = run_cli(
+            ["critique", "--models", "mock://agree", "--notify"],
+            stdin=SPEC, monkeypatch=monkeypatch, capsys=capsys,
+        )
+        assert code == 0
+        assert "Telegram not configured" in err
+
+    def test_notify_feedback_lands_in_json(self, monkeypatch, capsys):
+        from adversarial_spec_tpu.debate import telegram
+
+        monkeypatch.setenv("TELEGRAM_BOT_TOKEN", "t")
+        monkeypatch.setenv("TELEGRAM_CHAT_ID", "c")
+        monkeypatch.setattr(
+            telegram, "notify_round", lambda *a, **k: "use more retries"
+        )
+        code, out, _ = run_cli(
+            ["critique", "--models", "mock://agree", "--notify", "--json"],
+            stdin=SPEC, monkeypatch=monkeypatch, capsys=capsys,
+        )
+        data = json.loads(out)
+        assert data["user_feedback"] == "use more retries"
+
+    def test_text_header_names_doc_type(self, monkeypatch, capsys):
+        from adversarial_spec_tpu.debate import prompts
+
+        code, out, _ = run_cli(
+            ["critique", "--models", "mock://agree"],
+            stdin=SPEC, monkeypatch=monkeypatch, capsys=capsys,
+        )
+        name = prompts.get_doc_type_name("generic")
+        assert f"=== Round 1 Results ({name}) ===" in out
+
+    def test_export_tasks_validates_only_first_model(
+        self, monkeypatch, capsys
+    ):
+        code, out, _ = run_cli(
+            ["export-tasks", "--models", "mock://tasks,tpu://no-such",
+             "--json"],
+            stdin=SPEC, monkeypatch=monkeypatch, capsys=capsys,
+        )
+        assert code == 0  # only models[:1] is validated
+        code2, _, err2 = run_cli(
+            ["export-tasks", "--models", "tpu://no-such,mock://tasks"],
+            stdin=SPEC, monkeypatch=monkeypatch, capsys=capsys,
+        )
+        assert code2 == 2
+
+    def test_export_tasks_error_and_empty_paths(self, monkeypatch, capsys):
+        code, _, err = run_cli(
+            ["export-tasks", "--models", "mock://error"],
+            stdin=SPEC, monkeypatch=monkeypatch, capsys=capsys,
+        )
+        assert code == 1
+        code2, out2, _ = run_cli(
+            ["export-tasks", "--models", "mock://agree"],
+            stdin=SPEC, monkeypatch=monkeypatch, capsys=capsys,
+        )
+        assert code2 == 0
+        assert "No [TASK] blocks found" in out2
+
+    def test_diff_missing_flags_and_files(self, monkeypatch, capsys):
+        code, _, err = run_cli(
+            ["diff", "--previous", "only.md"],
+            monkeypatch=monkeypatch, capsys=capsys,
+        )
+        assert code == 2
+        code2, _, _ = run_cli(
+            ["diff", "--previous", "/no/a.md", "--current", "/no/b.md"],
+            monkeypatch=monkeypatch, capsys=capsys,
+        )
+        assert code2 == 2
+
+    def test_providers_entry_schema_and_status_text(
+        self, monkeypatch, capsys
+    ):
+        run_cli(
+            ["registry", "add-model", "broken", "--checkpoint",
+             "/no/such/ckpt"],
+            monkeypatch=monkeypatch, capsys=capsys,
+        )
+        code, out, _ = run_cli(
+            ["providers", "--json"], monkeypatch=monkeypatch, capsys=capsys
+        )
+        data = json.loads(out)
+        assert all(
+            set(e) == {"model", "family", "size", "checkpoint",
+                       "available", "error"}
+            for e in data["tpu"]
+        )
+        broken = next(
+            e for e in data["tpu"] if e["model"] == "tpu://broken"
+        )
+        assert broken["available"] is False
+        code, out, _ = run_cli(
+            ["providers"], monkeypatch=monkeypatch, capsys=capsys
+        )
+        assert "[ok]" in out
+        assert f"[UNAVAILABLE: {broken['error']}]" in out
+
+    def test_device_info_empty_devices(self, monkeypatch):
+        import jax
+
+        monkeypatch.setattr(jax, "devices", lambda: [])
+        assert cli._device_info() == {
+            "platform": "none",
+            "device_count": 0,
+        }
+
+    def test_registry_bare_action_is_status(self, monkeypatch, capsys):
+        code, out, _ = run_cli(
+            ["registry"], monkeypatch=monkeypatch, capsys=capsys
+        )
+        assert code == 0
+        assert "Registry:" in out
+
+    def test_registry_return_codes_and_defaults(self, monkeypatch, capsys):
+        code, _, err = run_cli(
+            ["registry", "add-model"], monkeypatch=monkeypatch, capsys=capsys
+        )
+        assert code == 2  # missing alias
+        code, _, _ = run_cli(
+            ["registry", "add-model", "dflt", "--tp", "2"],
+            monkeypatch=monkeypatch, capsys=capsys,
+        )
+        assert code == 0
+        code, out, _ = run_cli(
+            ["registry", "list-models", "--json"],
+            monkeypatch=monkeypatch, capsys=capsys,
+        )
+        entry = json.loads(out)["dflt"]
+        assert entry["checkpoint"] == "random"
+        assert entry["dtype"] == "bfloat16"
+        assert entry["mesh"] == {"tp": 2}
+        code, _, _ = run_cli(
+            ["registry", "remove-model"],
+            monkeypatch=monkeypatch, capsys=capsys,
+        )
+        assert code == 2
+        code, _, _ = run_cli(
+            ["registry", "remove-model", "ghost-entry"],
+            monkeypatch=monkeypatch, capsys=capsys,
+        )
+        assert code == 2
+        code, _, _ = run_cli(
+            ["registry", "alias", "only-two"],
+            monkeypatch=monkeypatch, capsys=capsys,
+        )
+        assert code == 2
+        code, _, _ = run_cli(
+            ["registry", "alias", "cp", "ghost-entry"],
+            monkeypatch=monkeypatch, capsys=capsys,
+        )
+        assert code == 2
+        code, _, _ = run_cli(
+            ["registry", "bogus-sub"],
+            monkeypatch=monkeypatch, capsys=capsys,
+        )
+        assert code == 2
+
+    def test_send_final_paths(self, monkeypatch, capsys):
+        from adversarial_spec_tpu.debate import telegram
+
+        monkeypatch.delenv("TELEGRAM_BOT_TOKEN", raising=False)
+        monkeypatch.delenv("TELEGRAM_CHAT_ID", raising=False)
+        code, _, err = run_cli(
+            ["send-final"], stdin="# Done",
+            monkeypatch=monkeypatch, capsys=capsys,
+        )
+        assert code == 2
+        monkeypatch.setenv("TELEGRAM_BOT_TOKEN", "t")
+        monkeypatch.setenv("TELEGRAM_CHAT_ID", "c")
+        sent = []
+        monkeypatch.setattr(
+            telegram,
+            "send_long_message",
+            lambda cfg, text: sent.append(text) or 1,
+        )
+        code, _, _ = run_cli(
+            ["send-final"], stdin="# Done",
+            monkeypatch=monkeypatch, capsys=capsys,
+        )
+        assert code == 0
+        assert sent == ["FINAL DOCUMENT\n\n# Done"]
+
+    def test_focus_areas_values_are_first_lines(self, monkeypatch, capsys):
+        from adversarial_spec_tpu.debate import prompts
+
+        code, out, _ = run_cli(
+            ["focus-areas", "--json"], monkeypatch=monkeypatch, capsys=capsys
+        )
+        assert code == 0
+        data = json.loads(out)
+        for k, v in data.items():
+            assert v == prompts.FOCUS_AREAS[k].strip().splitlines()[0]
+
+    def test_save_profile_settings_exact(self, monkeypatch, capsys):
+        from adversarial_spec_tpu.debate.profiles import load_profile
+
+        code, _, err = run_cli(
+            ["save-profile"], monkeypatch=monkeypatch, capsys=capsys
+        )
+        assert code == 2
+        code, _, _ = run_cli(
+            ["save-profile", "--name", "full", "--models", "a, b",
+             "--doc-type", "prd", "--focus", "security", "--persona", "qa",
+             "--preserve-intent", "--max-new-tokens", "64",
+             "--temperature", "0.0"],
+            monkeypatch=monkeypatch, capsys=capsys,
+        )
+        assert code == 0
+        assert load_profile("full") == {
+            "models": ["a", "b"],
+            "doc_type": "prd",
+            "focus": "security",
+            "persona": "qa",
+            "preserve_intent": True,
+            "max_new_tokens": 64,
+            "temperature": 0.0,
+        }
+        run_cli(
+            ["save-profile", "--name", "min"],
+            monkeypatch=monkeypatch, capsys=capsys,
+        )
+        assert load_profile("min") == {}
+
+    def test_profile_applies_to_critique_flags_win(self, monkeypatch, capsys):
+        from adversarial_spec_tpu.debate.profiles import save_profile
+
+        save_profile("opp", {"models": ["mock://agree", "mock://critic"]})
+        code, out, err = run_cli(
+            ["critique", "--profile", "opp", "--json"],
+            stdin=SPEC, monkeypatch=monkeypatch, capsys=capsys,
+        )
+        assert code == 0
+        assert json.loads(out)["models"] == [
+            "mock://agree", "mock://critic",
+        ]
+        assert "no --models given" not in err
+        code, out, _ = run_cli(
+            ["critique", "--profile", "opp", "--models", "mock://agree",
+             "--json"],
+            stdin=SPEC, monkeypatch=monkeypatch, capsys=capsys,
+        )
+        assert json.loads(out)["models"] == ["mock://agree"]
+
+    def test_main_exit_code_translation(self, monkeypatch, capsys):
+        """A bare SystemExit from a handler maps to 0 (e.code or 0);
+        handler crashes map to EXIT_ERROR with the exception named."""
+
+        def bail(args):
+            raise SystemExit  # code None -> 0
+
+        monkeypatch.setattr(cli, "run_critique", bail)
+        monkeypatch.setattr("sys.stdin", io.StringIO(SPEC))
+        assert cli.main(["critique", "--models", "mock://agree"]) == 0
+        capsys.readouterr()
+
+        def boom(args):
+            raise RuntimeError("kaput")
+
+        monkeypatch.setattr(cli, "run_critique", boom)
+        monkeypatch.setattr("sys.stdin", io.StringIO(SPEC))
+        assert cli.main(["critique", "--models", "mock://agree"]) == 1
+        assert "error: RuntimeError: kaput" in capsys.readouterr().err
+
+    def test_module_entrypoint(self):
+        import os
+        import subprocess
+        import sys as _sys
+        from pathlib import Path
+
+        if os.environ.get("ADVSPEC_MUTATION") == "1":
+            pytest.skip("interpreter boot per mutant; pinned outside sweeps")
+        repo_root = str(Path(__file__).resolve().parent.parent)
+        r = subprocess.run(
+            [_sys.executable, "-m", "adversarial_spec_tpu.cli"],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "PYTHONPATH": repo_root},
+        )
+        assert r.returncode == 2  # argparse: action is required
+        assert "usage:" in r.stderr

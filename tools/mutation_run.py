@@ -59,6 +59,7 @@ DEFAULT_TARGETS: dict[str, list[str]] = {
         "tests/test_engine_mock.py",
         "tests/test_parsing.py",
     ],
+    "adversarial_spec_tpu/cli.py": ["tests/test_cli.py"],
 }
 
 # Lines containing these markers are not mutated (mutmut_config.py parity;
@@ -78,6 +79,24 @@ _CMP_SWAP = {
     ast.IsNot: ast.Is,
 }
 _BIN_SWAP = {ast.Add: ast.Sub, ast.Sub: ast.Add, ast.Mult: ast.Add}
+
+
+_LOG_CALL_NAMES = {"print", "_err"}
+
+
+def _log_call_lines(tree: ast.AST) -> set[int]:
+    """Every line spanned by a print()/_err() call: the line-marker skip
+    misses multi-line logging calls, so mark their whole span (logging
+    text is excluded from mutation by design — mutmut_config.py)."""
+    out: set[int] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id in _LOG_CALL_NAMES
+        ):
+            out.update(range(node.lineno, (node.end_lineno or node.lineno) + 1))
+    return out
 
 
 def _annotation_positions(tree: ast.AST) -> set[tuple[int, int]]:
@@ -311,15 +330,40 @@ class _Mutator(ast.NodeTransformer):
         return self.generic_visit(node)
 
 
+def _main_guard_lines(tree: ast.AST) -> set[int]:
+    """Lines of ``if __name__ == "__main__":`` blocks — module-entry glue
+    (the entrypoints are pinned by suite-level subprocess tests, which
+    are skipped during sweeps for speed — see ADVSPEC_MUTATION)."""
+    out: set[int] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.If)
+            and isinstance(node.test, ast.Compare)
+            and isinstance(node.test.left, ast.Name)
+            and node.test.left.id == "__name__"
+        ):
+            out.update(range(node.lineno, (node.end_lineno or node.lineno) + 1))
+    return out
+
+
+def _skip_lines(src: str, tree: ast.AST) -> set[int]:
+    return (
+        {
+            i + 1
+            for i, line in enumerate(src.splitlines())
+            if any(m in line for m in SKIP_LINE_MARKERS)
+        }
+        | _log_call_lines(tree)
+        | _main_guard_lines(tree)
+    )
+
+
 def enumerate_mutants(src: str) -> list[tuple[str, int, str]]:
     tree = ast.parse(src)
-    skip = {
-        i + 1
-        for i, line in enumerate(src.splitlines())
-        if any(m in line for m in SKIP_LINE_MARKERS)
-    }
     collector = _SiteCollector(
-        skip, _docstring_positions(tree), _annotation_positions(tree)
+        _skip_lines(src, tree),
+        _docstring_positions(tree),
+        _annotation_positions(tree),
     )
     collector.visit(tree)
     return collector.sites
@@ -328,13 +372,11 @@ def enumerate_mutants(src: str) -> list[tuple[str, int, str]]:
 def make_mutant(src: str, index: int) -> tuple[str, str]:
     """Return (mutated_source, description) for site ``index``."""
     tree = ast.parse(src)
-    skip = {
-        i + 1
-        for i, line in enumerate(src.splitlines())
-        if any(m in line for m in SKIP_LINE_MARKERS)
-    }
     m = _Mutator(
-        index, skip, _docstring_positions(tree), _annotation_positions(tree)
+        index,
+        _skip_lines(src, tree),
+        _docstring_positions(tree),
+        _annotation_positions(tree),
     )
     new_tree = ast.fix_missing_locations(m.visit(tree))
     if m.applied is None:
@@ -372,6 +414,9 @@ def _run_pytest(tree: Path, test_files: list[str], timeout: float) -> str:
     env = dict(os.environ)
     env["PYTHONPATH"] = str(tree)
     env.setdefault("JAX_PLATFORMS", "cpu")
+    # Subprocess-spawning entrypoint tests skip under this flag: a fresh
+    # interpreter boot per mutant would dominate sweep wall-clock.
+    env["ADVSPEC_MUTATION"] = "1"
     try:
         proc = subprocess.run(
             [
