@@ -1157,3 +1157,84 @@ class TestMutationHardeningRound2:
         )
         assert r.returncode == 2  # argparse: action is required
         assert "usage:" in r.stderr
+
+
+class TestMutationHardeningRound3:
+    """Final cli.py pins (each names its mutant)."""
+
+    def test_engine_construction_failure_surfaces(self, monkeypatch):
+        """A registry-valid model whose engine refuses to build still
+        produces a validation error (the err-is-None gate)."""
+
+        def refuse(model):
+            raise ValueError("engine boom")
+
+        monkeypatch.setattr(cli, "get_engine", refuse)
+        errs = cli.validate_models_before_run(["tpu://random-tiny"])
+        assert errs == ["tpu://random-tiny: engine boom"]
+
+    def test_perf_rate_rounds_to_one_decimal(self, monkeypatch, capsys):
+        """tps=123.456 -> the reported rate is 123.5, not 123.46."""
+        code, out, _ = run_cli(
+            ["critique", "--models", "mock://critic?tps=123.456", "--json"],
+            stdin=SPEC, monkeypatch=monkeypatch, capsys=capsys,
+        )
+        tps = json.loads(out)["perf"]["decode_tokens_per_sec"]
+        assert tps == 123.5
+
+    def test_text_header_respects_explicit_doc_type(
+        self, monkeypatch, capsys
+    ):
+        from adversarial_spec_tpu.debate import prompts
+
+        code, out, _ = run_cli(
+            ["critique", "--models", "mock://agree", "--doc-type", "tech"],
+            stdin=SPEC, monkeypatch=monkeypatch, capsys=capsys,
+        )
+        name = prompts.get_doc_type_name("tech")
+        assert f"=== Round 1 Results ({name}) ===" in out
+
+    def test_diff_missing_flag_message(self, monkeypatch, capsys):
+        code, _, err = run_cli(
+            ["diff", "--previous", "only.md"],
+            monkeypatch=monkeypatch, capsys=capsys,
+        )
+        assert code == 2
+        assert "diff requires --previous and --current" in err
+
+    def test_device_info_single_device(self, monkeypatch):
+        import jax
+
+        class Dev:
+            platform = "tpu"
+
+        monkeypatch.setattr(jax, "devices", lambda: [Dev()])
+        assert cli._device_info() == {
+            "platform": "tpu",
+            "device_count": 1,
+        }
+
+    def test_alias_onto_existing_refused(self, monkeypatch, capsys):
+        for name in ("src-m", "dst-m"):
+            run_cli(
+                ["registry", "add-model", name],
+                monkeypatch=monkeypatch, capsys=capsys,
+            )
+        code, _, err = run_cli(
+            ["registry", "alias", "dst-m", "src-m"],
+            monkeypatch=monkeypatch, capsys=capsys,
+        )
+        assert code == 2
+        assert "already exists" in err
+
+    def test_profile_applies_to_export_tasks(self, monkeypatch, capsys):
+        from adversarial_spec_tpu.debate.profiles import save_profile
+
+        save_profile("tasks-opp", {"models": ["mock://tasks"]})
+        code, out, err = run_cli(
+            ["export-tasks", "--profile", "tasks-opp", "--json"],
+            stdin=SPEC, monkeypatch=monkeypatch, capsys=capsys,
+        )
+        assert code == 0
+        assert "no --models given" not in err
+        assert json.loads(out)  # mock://tasks yields at least one task
