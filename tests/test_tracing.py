@@ -68,3 +68,52 @@ class TestCliPerfBlock:
         assert "round" in data["perf"]["spans"]
         assert data["perf"]["decode_tokens_per_sec"] > 0
         assert "perf:" in err  # human line on stderr
+
+
+class TestMutationHardening:
+    """Pins that kill the tracing.py mutation survivors."""
+
+    def test_span_and_total_are_durations(self):
+        """now - start, not now + start (an Add mutant reports ~2x the
+        monotonic clock, absurdly larger than any real round)."""
+        import time as _time
+
+        t = Tracer()
+        with t.span("s"):
+            _time.sleep(0.02)
+        assert 0.01 < t.spans["s"] < 10.0
+        assert 0.0 <= t.report()["total_s"] < 10.0
+
+    def test_report_rounding_digits(self, monkeypatch):
+        """total_s/spans round to 4 digits, counters to 2."""
+        from adversarial_spec_tpu.utils import tracing as tr
+
+        monkeypatch.setattr(tr.time, "monotonic", lambda: 0.123456)
+        t = Tracer(_t0=0.0)
+        t.spans["k"] = 0.123456
+        t.count("c", 0.126)
+        rep = t.report()
+        assert rep["total_s"] == 0.1235
+        assert rep["spans"]["k"] == 0.1235
+        assert rep["counters"]["c"] == 0.13
+
+    def test_maybe_profile_gates_on_dir(self, monkeypatch, tmp_path):
+        """A trace dir engages jax.profiler; None must not."""
+        import contextlib
+
+        import jax
+
+        traced = []
+
+        @contextlib.contextmanager
+        def fake_trace(d):
+            traced.append(d)
+            yield
+
+        monkeypatch.setattr(jax.profiler, "trace", fake_trace)
+        with maybe_profile(None):
+            pass
+        assert traced == []
+        with maybe_profile(str(tmp_path)):
+            pass
+        assert traced == [str(tmp_path)]

@@ -60,6 +60,7 @@ DEFAULT_TARGETS: dict[str, list[str]] = {
         "tests/test_parsing.py",
     ],
     "adversarial_spec_tpu/cli.py": ["tests/test_cli.py"],
+    "adversarial_spec_tpu/utils/tracing.py": ["tests/test_tracing.py"],
 }
 
 # Lines containing these markers are not mutated (mutmut_config.py parity;
