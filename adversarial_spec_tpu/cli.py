@@ -173,6 +173,41 @@ def create_parser() -> argparse.ArgumentParser:
         help="Per-round wall-clock budget in seconds (default 600)",
     )
 
+    z = parser.add_argument_group("resilience")
+    z.add_argument(
+        "--chaos",
+        help=(
+            "Arm fault injection: kind@seam[:p=F][:after=N][:times=N]"
+            "[:slot=K], comma-separated (kinds: oom, device_lost, "
+            "preempted, timeout, bug; seams: generate, scheduler_chunk, "
+            "kv_alloc, checkpoint_load). Also via ADVSPEC_CHAOS"
+        ),
+    )
+    z.add_argument(
+        "--chaos-seed",
+        type=int,
+        default=None,
+        help="Seed for probabilistic chaos rules (reproducible runs)",
+    )
+    z.add_argument(
+        "--breaker-threshold",
+        type=int,
+        default=None,
+        help="Consecutive failures before a model's circuit opens (default 3)",
+    )
+    z.add_argument(
+        "--breaker-cooldown",
+        type=float,
+        default=None,
+        help="Seconds an open circuit waits before a half-open probe "
+        "(default 30)",
+    )
+    z.add_argument(
+        "--no-breaker",
+        action="store_true",
+        help="Disable circuit breakers (always query every model)",
+    )
+
     r = parser.add_argument_group("registry")
     r.add_argument("--checkpoint", help="HF checkpoint dir (registry add-model)")
     r.add_argument(
@@ -294,11 +329,45 @@ def load_or_resume_session(
     return spec, None
 
 
+def _configure_resilience(args: argparse.Namespace):
+    """Arm chaos injection and tune the breaker registry from flags.
+
+    Returns the breaker registry so the report can snapshot its states.
+    """
+    from adversarial_spec_tpu.resilience import breaker, faults, injector
+
+    if args.chaos:
+        injector.install(
+            injector.FaultInjector(
+                injector.parse_chaos_spec(args.chaos), seed=args.chaos_seed
+            )
+        )
+        _err(f"chaos armed: {args.chaos}")
+    else:
+        # Materialize (and thereby validate) any ADVSPEC_CHAOS env spec
+        # NOW: a typo'd spec must fail loudly at startup, not surface as
+        # a swallowed per-model BUG when the first seam hook fires.
+        injector.active()
+    breakers = breaker.default_registry()
+    breakers.configure(
+        threshold=args.breaker_threshold,
+        cooldown_s=args.breaker_cooldown,
+        enabled=not args.no_breaker,
+    )
+    faults.reset()  # per-round counts in the report
+    return breakers
+
+
 def run_critique(args: argparse.Namespace) -> int:
     from adversarial_spec_tpu.utils.tracing import Tracer, maybe_profile
 
     tracer = Tracer()
+    breakers = _configure_resilience(args)
     spec, session_state = load_or_resume_session(args)
+    if session_state is not None and session_state.breakers:
+        # One CLI invocation = one round: open circuits from earlier
+        # rounds of this session must survive the process boundary.
+        breakers.restore(session_state.breakers)
     models = parse_models(args)
     with tracer.span("validate"):
         errors = validate_models_before_run(models)
@@ -331,12 +400,29 @@ def run_critique(args: argparse.Namespace) -> int:
         tracker.add(r.model, r.usage)
     tracer.count("decode_tokens", result.total_usage.decode_tokens)
     tracer.spans["decode"] = result.total_usage.decode_time_s
+    # Resilience telemetry: classified fault counts + breaker transitions
+    # become tracer counters; the full snapshot rides on the JSON report.
+    from adversarial_spec_tpu.resilience import faults as faults_mod
+
+    fault_counts = faults_mod.snapshot()
+    tracer.count_many({f"fault.{k}": v for k, v in fault_counts.items()})
+    tracer.count_many(breakers.counters())
     perf = tracer.report()
     perf["decode_tokens_per_sec"] = round(tracer.rate("decode_tokens", "decode"), 1)
+    perf["resilience"] = {
+        "faults": fault_counts,
+        "breakers": breakers.states(),
+    }
     _err(
         f"perf: round {perf['spans'].get('round', 0):.2f}s, "
         f"decode {perf['decode_tokens_per_sec']} tok/s"
     )
+    if fault_counts:
+        total_faults = sum(fault_counts.values())
+        _err(
+            f"resilience: {total_faults} fault(s) classified and "
+            "contained; see the --json resilience section"
+        )
 
     # The revised spec for the next round: last successful revision wins
     # (the L5 agent synthesizes across critiques; this is the raw material).
@@ -360,6 +446,7 @@ def run_critique(args: argparse.Namespace) -> int:
                 "models": {r.model: r.agreed for r in result.successful},
             }
         )
+        session_state.breakers = breakers.snapshot_for_resume()
         session_state.save()
 
     user_feedback = None

@@ -10,6 +10,13 @@ single sharded decode over the mesh, not N threads. The retry loop survives
 with the reference's exact policy: 3 attempts, exponential backoff 1s/2s/4s
 (models.py:46-47), errors captured rather than raised, and rounds degrading
 gracefully when some opponents fail (debate.py:845-853).
+
+On top of that policy sits the per-model circuit breaker
+(resilience/breaker.py): every completion outcome feeds the model's
+breaker, and a model whose breaker is OPEN is degraded up front — zero
+engine calls, zero retry budget — until its cooldown elapses and a
+half-open probe re-admits it. Persistent failure costs one errored
+response per round instead of 3 retries x backoff.
 """
 
 from __future__ import annotations
@@ -27,6 +34,8 @@ from adversarial_spec_tpu.debate.parsing import (
 from adversarial_spec_tpu.debate.types import ModelResponse, RoundResult
 from adversarial_spec_tpu.engine.dispatch import get_engine
 from adversarial_spec_tpu.engine.types import ChatRequest, Completion, SamplingParams
+from adversarial_spec_tpu.resilience import breaker as breaker_mod
+from adversarial_spec_tpu.resilience.faults import classify_message
 
 MAX_RETRIES = 3
 RETRY_BASE_DELAY = 1.0
@@ -43,6 +52,9 @@ class RoundConfig:
     press: bool = False
     context_files: list[str] = field(default_factory=list)
     sampling: SamplingParams = field(default_factory=SamplingParams)
+    # Per-model circuit breakers; None = the process default registry.
+    # Tests pass their own (fake clock, tight thresholds).
+    breakers: breaker_mod.BreakerRegistry | None = None
     # Injected for tests; defaults to real sleep for backoff.
     sleep = staticmethod(time.sleep)
 
@@ -119,6 +131,11 @@ def run_round(
     past the deadline).
     """
     cfg = cfg or RoundConfig()
+    breakers = (
+        cfg.breakers
+        if cfg.breakers is not None
+        else breaker_mod.default_registry()
+    )
     deadline = (
         time.monotonic() + cfg.sampling.timeout_s
         if cfg.sampling.timeout_s > 0
@@ -126,13 +143,25 @@ def run_round(
     )
     requests = [build_request(m, spec, round_num, cfg) for m in models]
 
-    # Group indices by engine so co-resident models batch together.
+    # Group indices by engine so co-resident models batch together. A
+    # model whose circuit breaker is open degrades HERE — no engine call,
+    # no retry budget — and rejoins after its cooldown's half-open probe.
     groups: dict[int, tuple[object, list[int]]] = {}
+    results: list[ModelResponse | None] = [None] * len(requests)
     for i, req in enumerate(requests):
+        if not breakers.allow(req.model):
+            remaining = breakers.cooldown_remaining(req.model)
+            results[i] = ModelResponse(
+                model=req.model,
+                error=(
+                    "circuit open: skipped after repeated faults "
+                    f"(probe in {remaining:.0f}s)"
+                ),
+            )
+            continue
         engine = get_engine(req.model)
         groups.setdefault(id(engine), (engine, []))[1].append(i)
 
-    results: list[ModelResponse | None] = [None] * len(requests)
     for engine, indices in groups.values():
         pending = list(indices)
         for attempt in range(MAX_RETRIES):
@@ -142,7 +171,26 @@ def run_round(
             latency = time.monotonic() - t0
             still_pending = []
             for i, comp in zip(pending, completions):
-                if not comp.ok and comp.transient and attempt < MAX_RETRIES - 1:
+                # Every attempt's outcome feeds the model's breaker:
+                # threshold consecutive failures open it.
+                if comp.ok:
+                    breakers.record(requests[i].model, ok=True)
+                else:
+                    breakers.record(
+                        requests[i].model,
+                        ok=False,
+                        kind=classify_message(comp.error or ""),
+                    )
+                # Retry only while the breaker still allows the model: a
+                # failed half-open probe reopens the circuit and must
+                # cost ONE attempt, not the full 3x backoff budget it
+                # exists to avoid.
+                if (
+                    not comp.ok
+                    and comp.transient
+                    and attempt < MAX_RETRIES - 1
+                    and breakers.allow(requests[i].model)
+                ):
                     still_pending.append(i)
                 else:
                     results[i] = _to_response(requests[i].model, comp, latency)

@@ -57,6 +57,10 @@ class SessionState:
     updated_at: float = 0.0
     # Per-round history: [{"round", "all_agreed", "models": {name: agreed}}].
     history: list[dict] = field(default_factory=list)
+    # Circuit-breaker snapshot (resilience/breaker.py:snapshot_for_resume):
+    # one CLI invocation is one round, so open circuits must ride the
+    # session to skip persistently failing models on the NEXT round.
+    breakers: dict = field(default_factory=dict)
 
     def save(self, sessions_dir: Path | None = None) -> Path:
         directory = Path(sessions_dir or SESSIONS_DIR)

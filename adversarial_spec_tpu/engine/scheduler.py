@@ -19,6 +19,14 @@ a dead slot can never scribble into pages re-allocated to a newcomer.
 Trash/unmapped pages are never read: every row's valid window
 [pad, cur_len) ends before any unmapped logical slot.
 
+Fault isolation: a fault at the decode-chunk, admission-prefill, or
+page-allocation step evicts only the affected slot — its ``SchedResult``
+carries the partial tokens plus ``error``/``fault_kind`` — frees its
+pages, and leaves the rest of the batch decoding. Transient faults
+(resilience/faults.py taxonomy) get one requeue before the partial result
+is final, budgeted against the caller's existing deadline. The chaos
+injector's ``scheduler_chunk`` and ``kv_alloc`` seams live here.
+
 The round-synchronous debate path (engine/tpu.py) doesn't need this; it
 serves multi-session workloads (several debates sharing one model) and is
 exercised directly in tests/test_scheduler.py.
@@ -51,6 +59,7 @@ from adversarial_spec_tpu.models.transformer import (
     forward_paged_decode,
     init_cache,
 )
+from adversarial_spec_tpu.resilience import faults, injector
 
 TRASH_PAGE = 0
 # Admission prefill granularity — deliberately finer than generate.py's
@@ -88,6 +97,11 @@ class SchedResult:
     req_id: int
     tokens: np.ndarray  # generated ids (0 past the row's end)
     n_generated: int
+    # Set when a fault evicted this request: ``tokens`` then holds the
+    # PARTIAL decode up to the fault and ``fault_kind`` is the
+    # resilience-taxonomy value (resilience/faults.py). None = clean.
+    error: str | None = None
+    fault_kind: str | None = None
 
 
 @partial(
@@ -400,6 +414,10 @@ class ContinuousBatcher:
         self.capacity_tokens = n_pages * page_size
         self.queue: list[SchedRequest] = []
         self.results: list[SchedResult] = []
+        # req_ids that already consumed their one transient-fault requeue.
+        # (Fault COUNTS live in the process-wide resilience.faults store —
+        # one bookkeeping place, snapshotted by the CLI report.)
+        self._retried: set[int] = set()
         # Wall-clock telemetry: admission prefills vs decode chunks.
         # decode_time_s feeds the engine's per-row usage attribution
         # (engine/tpu.py:_chat_continuous); prefill_time_s is surfaced for
@@ -437,7 +455,11 @@ class ContinuousBatcher:
     def _start_admission(self, slot: int, req: SchedRequest) -> bool:
         """Reserve pages and set up the chunked prefill for ``slot``;
         False if the pool is momentarily full (the request stays queued
-        and retries after residents free pages)."""
+        and retries after residents free pages). Any other failure —
+        including an injected ``kv_alloc`` fault — propagates with the
+        allocator state rolled back; ``_admit`` isolates it to this
+        request."""
+        injector.fire("kv_alloc", slot)
         tokens_np, pads_np = pad_batch([req.prompt_ids], pad_id=0)
         S = tokens_np.shape[1]
         total = S + req.max_new_tokens
@@ -445,22 +467,25 @@ class ContinuousBatcher:
         self.allocator.new_sequence(seq_id)
         try:
             self.allocator.extend(seq_id, total)
+            self._admission = _Admission(
+                slot=slot,
+                req=req,
+                seq_id=seq_id,
+                tokens=jnp.asarray(tokens_np),
+                pads=jnp.asarray(pads_np),
+                cache=init_cache(
+                    self.cfg, 1, S, dtype=self._dtype, kv_dtype=self.kv_dtype
+                ),
+                pos=0,
+                S=S,
+            )
         except OutOfPages:
             self.allocator.free_sequence(seq_id)
             return False
+        except Exception:
+            self.allocator.free_sequence(seq_id)
+            raise
         self._seq_counter += 1
-        self._admission = _Admission(
-            slot=slot,
-            req=req,
-            seq_id=seq_id,
-            tokens=jnp.asarray(tokens_np),
-            pads=jnp.asarray(pads_np),
-            cache=init_cache(
-                self.cfg, 1, S, dtype=self._dtype, kv_dtype=self.kv_dtype
-            ),
-            pos=0,
-            S=S,
-        )
         return True
 
     def _advance_admission(self) -> None:
@@ -491,12 +516,17 @@ class ContinuousBatcher:
 
     def _finish_admission(self) -> None:
         """Prefill done: scatter the dense cache into this sequence's
-        pages (+1 shift: page 0 is trash) and activate the slot."""
+        pages (+1 shift: page 0 is trash) and activate the slot.
+
+        ``self._admission`` stays set until the slot takes ownership of
+        the sequence below: the pool scatter and first-token sampling are
+        real device work that can fault, and ``_abort_admission`` needs
+        the admission record to free its pages and resolve its request.
+        """
         import time
 
         t0 = time.monotonic()
         adm = self._admission
-        self._admission = None
         slot, req, seq_id, S = adm.slot, adm.req, adm.seq_id, adm.S
         cache, last_logits = adm.cache, adm.last_logits
         pads_np = np.asarray(adm.pads)
@@ -539,6 +569,9 @@ class ContinuousBatcher:
         self.active = self.active.at[slot].set(
             (req.max_new_tokens > 1) and not first_is_eos
         )
+        # Ownership handoff: from here the slot (not the admission)
+        # accounts for the sequence.
+        self._admission = None
         self._slot_req[slot] = req
         self._slot_seq[slot] = seq_id
         self.prefill_time_s += time.monotonic() - t0
@@ -556,13 +589,108 @@ class ContinuousBatcher:
             if self._admission is not None or not self.queue:
                 return
             if self._slot_req[slot] is None and not active_np[slot]:
-                if not self._start_admission(slot, self.queue[0]):
+                try:
+                    started = self._start_admission(slot, self.queue[0])
+                except Exception as e:
+                    # Fault isolation: only this request is affected —
+                    # the batch keeps decoding and admission continues
+                    # with the next queued request.
+                    self._fault_request(self.queue.pop(0), e, "kv_alloc")
+                    continue
+                if not started:
                     # Pool full right now — the request stays queued
                     # (FIFO) until residents free pages.
                     return
                 self.queue.pop(0)
                 if self._admission.S <= ADMISSION_CHUNK:
-                    self._advance_admission()  # completes in one chunk
+                    try:
+                        self._advance_admission()  # completes in one chunk
+                    except Exception as e:
+                        self._abort_admission(e)
+
+    # -- fault containment -------------------------------------------------
+
+    def _fault_request(
+        self,
+        req: SchedRequest,
+        exc: BaseException,
+        seam: str,
+        tokens: np.ndarray | None = None,
+        n: int = 0,
+    ) -> None:
+        """Resolve one faulted request: requeue once if the fault is
+        transient (OOM/device-loss/preemption/timeout) and this req_id
+        hasn't been retried yet — budgeted against the caller's existing
+        deadline, since the requeue drains through the same run_all loop
+        — else finalize with the partial tokens + fault metadata."""
+        kind = faults.classify(exc)
+        faults.record(kind, seam)
+        if kind.transient and req.req_id not in self._retried:
+            self._retried.add(req.req_id)
+            self.queue.append(req)
+            return
+        self.results.append(
+            SchedResult(
+                req_id=req.req_id,
+                tokens=(
+                    tokens if tokens is not None else np.zeros((0,), np.int32)
+                ),
+                n_generated=n,
+                error=f"{type(exc).__name__}: {exc}",
+                fault_kind=kind.value,
+            )
+        )
+
+    def _abort_admission(self, exc: BaseException) -> None:
+        """The in-flight admission's prefill faulted: free its pages and
+        resolve its request; resident rows are untouched."""
+        adm = self._admission
+        self._admission = None
+        if adm is None:
+            # The fault landed after the slot already took ownership
+            # (tail of _finish_admission): there is no admission record
+            # to unwind here, so don't mask the original fault.
+            raise exc
+        self.allocator.free_sequence(adm.seq_id)
+        self._fault_request(adm.req, exc, "admission")
+
+    def _handle_decode_fault(self, exc: BaseException) -> None:
+        """A decode chunk faulted: evict ONE slot, keep the rest.
+
+        The victim is the slot the fault names (injected faults carry
+        one), else the occupied slot with the longest resident sequence
+        — the best heuristic for a real OOM, since it owns the most
+        pages. If the fault destroyed the donated device state (a real
+        mid-execution abort invalidates the donated pool/out_buf), slot
+        surgery is impossible — re-raise and let the engine degrade the
+        whole group (the pre-isolation behavior).
+        """
+        try:
+            cur_len_np = np.asarray(self.cur_len)
+            np.asarray(self.out_buf[:, :1])  # probe the donated buffer
+        except Exception:
+            raise exc from None
+        slot = getattr(exc, "slot", None)
+        if (
+            slot is None
+            or not 0 <= slot < self.B
+            or self._slot_req[slot] is None
+        ):
+            occupied = [
+                s for s in range(self.B) if self._slot_req[s] is not None
+            ]
+            if not occupied:
+                raise exc
+            slot = max(occupied, key=lambda s: int(cur_len_np[s]))
+        req = self._slot_req[slot]
+        n = int(self.n_emitted[slot])
+        partial = np.asarray(self.out_buf[slot, :n])
+        self.allocator.free_sequence(self._slot_seq[slot])
+        self._slot_req[slot] = None
+        self._slot_seq[slot] = None
+        self.active = self.active.at[slot].set(False)
+        self.page_table = self.page_table.at[slot].set(0)
+        self._fault_request(req, exc, "scheduler_chunk", tokens=partial, n=n)
 
     # -- completion --------------------------------------------------------
 
@@ -591,6 +719,11 @@ class ContinuousBatcher:
         generate()'s deadline, checked between chunks): on expiry, resident
         rows finish with whatever they have emitted and queued requests
         return zero tokens rather than blocking the caller.
+
+        Fault isolation invariant: every submitted ``req_id`` gets exactly
+        one ``SchedResult`` — a fault on one slot evicts that slot only
+        (partial tokens + ``fault_kind`` on its result, one requeue first
+        when transient) while co-resident rows keep decoding.
         """
         import time
 
@@ -622,41 +755,49 @@ class ContinuousBatcher:
             if self._admission is not None:
                 # One prompt chunk, then fall through to a decode chunk —
                 # resident rows keep emitting while the newcomer prefills.
-                self._advance_admission()
+                try:
+                    self._advance_admission()
+                except Exception as e:
+                    self._abort_admission(e)
             if bool(self.active.any()):
                 t_dec = time.monotonic()
                 self._key, sub = jax.random.split(self._key)
-                (
-                    self.pool,
-                    self.cur_tok,
-                    self.cur_len,
-                    self.n_emitted,
-                    self.out_buf,
-                    self.active,
-                ) = scheduler_decode_chunk(
-                    self.params,
-                    self.cfg,
-                    self.pool,
-                    self.page_table,
-                    self.cur_tok,
-                    self.cur_len,
-                    self.pad_lens,
-                    self.n_emitted,
-                    self.max_new,
-                    self.active,
-                    self.out_buf,
-                    self._eos,
-                    sub,
-                    self._temp,
-                    self._top_p,
-                    chunk=self.chunk,
-                    greedy=self.greedy,
-                    top_k=self.top_k,
-                    use_top_p=self._use_top_p,
-                    use_pallas=self._use_pallas,
-                    pallas_interpret=self._pallas_interpret,
-                )
-                jax.block_until_ready(self.active)
-                self.decode_time_s += time.monotonic() - t_dec
+                try:
+                    injector.fire("scheduler_chunk")
+                    (
+                        self.pool,
+                        self.cur_tok,
+                        self.cur_len,
+                        self.n_emitted,
+                        self.out_buf,
+                        self.active,
+                    ) = scheduler_decode_chunk(
+                        self.params,
+                        self.cfg,
+                        self.pool,
+                        self.page_table,
+                        self.cur_tok,
+                        self.cur_len,
+                        self.pad_lens,
+                        self.n_emitted,
+                        self.max_new,
+                        self.active,
+                        self.out_buf,
+                        self._eos,
+                        sub,
+                        self._temp,
+                        self._top_p,
+                        chunk=self.chunk,
+                        greedy=self.greedy,
+                        top_k=self.top_k,
+                        use_top_p=self._use_top_p,
+                        use_pallas=self._use_pallas,
+                        pallas_interpret=self._pallas_interpret,
+                    )
+                    jax.block_until_ready(self.active)
+                except Exception as e:
+                    self._handle_decode_fault(e)
+                finally:
+                    self.decode_time_s += time.monotonic() - t_dec
             self._collect()
         return sorted(self.results, key=lambda r: r.req_id)

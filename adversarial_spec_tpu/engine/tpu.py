@@ -13,10 +13,12 @@ models (weight swap). Same-model opponents — the common debate setup —
 always batch.
 
 Failure semantics (parity with reference retry/degrade policy,
-models.py:46-47, 538-555): per-group exceptions are captured into
-``Completion.error``; OOM/transient device errors are marked transient so
-the debate core's backoff retries them; a failed group never kills the
-round.
+models.py:46-47, 538-555): per-group exceptions are classified through the
+resilience fault taxonomy (resilience/faults.py) and captured into
+``Completion.error``; OOM/device-loss/preemption/timeout are marked
+transient so the debate core's backoff retries them; a failed group never
+kills the round. The chaos injector's ``generate`` and ``checkpoint_load``
+seams live here.
 """
 
 from __future__ import annotations
@@ -49,6 +51,7 @@ from adversarial_spec_tpu.parallel.mesh import (
     maybe_initialize_distributed,
 )
 from adversarial_spec_tpu.parallel.sharding import make_device_put
+from adversarial_spec_tpu.resilience import faults, injector
 
 _GIB = 1 << 30
 
@@ -106,14 +109,6 @@ _DTYPES = {
     "float32": jnp.float32,
     "float16": jnp.float16,
 }
-
-_TRANSIENT_MARKERS = (
-    "RESOURCE_EXHAUSTED",
-    "OUT_OF_RANGE",
-    "UNAVAILABLE",
-    "DEADLINE_EXCEEDED",
-)
-
 
 def _trim_prompt(ids: list[int], limit: int) -> list[int]:
     """Trim to ``limit`` tokens keeping the first token (BOS/template
@@ -414,6 +409,7 @@ class TpuEngine:
         import shutil
         import sys
 
+        injector.fire("checkpoint_load")
         quantize = spec.quant == "int8"
         cfg = get_config(spec.family, spec.size, max_seq_len=spec.max_seq_len)
         cache_path = None
@@ -514,9 +510,12 @@ class TpuEngine:
                 )
             except Exception as e:  # degrade, never raise (parity: ref)
                 msg = f"{type(e).__name__}: {e}"
-                transient = any(m in msg for m in _TRANSIENT_MARKERS)
+                kind = faults.classify(e)
+                # Injected faults know their seam; real ones are counted
+                # where caught.
+                faults.record(kind, getattr(e, "seam", "generate"))
                 completions = [
-                    Completion(error=msg, transient=transient)
+                    Completion(error=msg, transient=kind.transient)
                     for _ in batch
                 ]
             for i, comp in zip(indices, completions):
@@ -541,6 +540,7 @@ class TpuEngine:
             lm = self._load(alias)
             if prefetch_next is not None:
                 self._maybe_prefetch(prefetch_next)
+            injector.fire("generate")
             return self._chat_loaded(lm, batch, params)
         finally:
             with self._lock:
@@ -720,7 +720,15 @@ class TpuEngine:
             decode_share = batcher.decode_time_s * frac
             completions.append(
                 Completion(
+                    # Fault-evicted rows keep their partial decode in
+                    # ``text`` (diagnostic value) but carry the error so
+                    # the debate core's retry/degrade policy applies.
                     text=tok.decode(r.tokens[: r.n_generated]),
+                    error=r.error,
+                    transient=(
+                        r.fault_kind is not None
+                        and faults.FaultKind(r.fault_kind).transient
+                    ),
                     usage=Usage(
                         input_tokens=len(prompts[r.req_id]),
                         output_tokens=r.n_generated,

@@ -42,6 +42,12 @@ class Tracer:
     def count(self, name: str, value: float) -> None:
         self.counters[name] = self.counters.get(name, 0.0) + value
 
+    def count_many(self, values: dict[str, float]) -> None:
+        """Merge a counter dict (e.g. the resilience subsystem's fault
+        counts or breaker transition totals) into this tracer."""
+        for name, value in values.items():
+            self.count(name, value)
+
     def rate(self, tokens_key: str, time_key: str) -> float:
         t = self.spans.get(time_key, 0.0)
         return self.counters.get(tokens_key, 0.0) / t if t > 0 else 0.0

@@ -55,6 +55,7 @@ def _isolate_state(tmp_path, monkeypatch):
     """Point every persistence dir at tmp and reset engine singletons."""
     from adversarial_spec_tpu.debate import session, profiles
     from adversarial_spec_tpu.engine import registry, dispatch
+    from adversarial_spec_tpu.resilience import breaker, faults, injector
 
     monkeypatch.setattr(session, "SESSIONS_DIR", tmp_path / "sessions")
     monkeypatch.setattr(session, "CHECKPOINTS_DIR", tmp_path / "checkpoints")
@@ -63,6 +64,19 @@ def _isolate_state(tmp_path, monkeypatch):
         profiles, "GLOBAL_CONFIG_PATH", tmp_path / "config.json"
     )
     monkeypatch.setattr(registry, "REGISTRY_PATH", tmp_path / "registry.json")
+    # Resilience state is process-global by design (breakers must outlive
+    # a round); between tests it must not leak. Chaos env vars from the
+    # invoking shell must not reach the suite either.
+    monkeypatch.delenv("ADVSPEC_CHAOS", raising=False)
+    monkeypatch.delenv("ADVSPEC_CHAOS_SEED", raising=False)
+    monkeypatch.delenv("ADVSPEC_BREAKER_THRESHOLD", raising=False)
+    monkeypatch.delenv("ADVSPEC_BREAKER_COOLDOWN", raising=False)
+    breaker.reset_default_registry()
+    faults.reset()
+    injector.reset()
     dispatch.clear_engine_cache()
     yield
     dispatch.clear_engine_cache()
+    breaker.reset_default_registry()
+    faults.reset()
+    injector.reset()

@@ -475,6 +475,129 @@ class TestParser:
         assert code == 0
 
 
+class TestResilienceFlags:
+    """--chaos / --breaker-* wiring plus the --json resilience report."""
+
+    def test_json_report_carries_resilience_section(
+        self, monkeypatch, capsys
+    ):
+        code, out, _ = run_cli(
+            ["critique", "--models", "mock://agree", "--json"],
+            stdin=SPEC,
+            monkeypatch=monkeypatch,
+            capsys=capsys,
+        )
+        assert code == 0
+        res = json.loads(out)["perf"]["resilience"]
+        assert res["faults"] == {}  # clean round: nothing classified
+        # The mock model's success was recorded into its breaker.
+        assert res["breakers"]["mock://agree"]["state"] == "closed"
+
+    def test_chaos_flag_arms_the_process_injector(self, monkeypatch):
+        from adversarial_spec_tpu.resilience import injector
+        from adversarial_spec_tpu.resilience.faults import FaultKind
+
+        args, _ = cli.create_parser().parse_known_args(
+            ["critique", "--chaos", "oom@scheduler_chunk:after=1:times=2",
+             "--chaos-seed", "7"]
+        )
+        cli._configure_resilience(args)
+        rules = injector.active().rules
+        assert len(rules) == 1
+        assert rules[0].kind is FaultKind.OOM
+        assert (rules[0].seam, rules[0].after, rules[0].times) == (
+            "scheduler_chunk", 1, 2,
+        )
+
+    def test_breaker_flags_tune_the_default_registry(self, monkeypatch):
+        from adversarial_spec_tpu.resilience import breaker
+
+        args, _ = cli.create_parser().parse_known_args(
+            ["critique", "--breaker-threshold", "5",
+             "--breaker-cooldown", "120"]
+        )
+        cli._configure_resilience(args)
+        reg = breaker.default_registry()
+        assert reg.threshold == 5 and reg.cooldown_s == 120.0
+        assert reg.enabled
+
+        args, _ = cli.create_parser().parse_known_args(
+            ["critique", "--no-breaker"]
+        )
+        cli._configure_resilience(args)
+        assert not breaker.default_registry().enabled
+
+    def test_breaker_state_persists_across_cli_invocations(
+        self, monkeypatch, capsys
+    ):
+        """One CLI invocation is one round: a circuit opened by round N
+        must skip the model in round N+1 via the session snapshot."""
+        code, out, _ = run_cli(
+            ["critique", "--models", "tpu://random-tiny", "--json",
+             "--session", "brk", "--greedy", "--max-new-tokens", "4",
+             "--chaos", "bug@generate", "--breaker-threshold", "1",
+             "--breaker-cooldown", "3600"],
+            stdin=SPEC,
+            monkeypatch=monkeypatch,
+            capsys=capsys,
+        )
+        assert code == 0
+        data = json.loads(out)
+        assert data["results"][0]["error"]  # injected bug degraded it
+        assert (
+            data["perf"]["resilience"]["breakers"]["tpu://random-tiny"][
+                "state"
+            ]
+            == "open"
+        )
+        saved = json.loads(
+            (session_mod.SESSIONS_DIR / "brk.json").read_text()
+        )
+        assert saved["breakers"]["tpu://random-tiny"]["state"] == "open"
+
+        # Next invocation (fresh process state: conftest reset the
+        # default registry; chaos no longer armed): still skipped, and
+        # crucially WITHOUT touching the engine at all.
+        from adversarial_spec_tpu.resilience import breaker, injector
+
+        breaker.reset_default_registry()
+        injector.reset()
+        code2, out2, _ = run_cli(
+            ["critique", "--resume", "brk", "--json"],
+            monkeypatch=monkeypatch,
+            capsys=capsys,
+        )
+        assert code2 == 0
+        err2 = json.loads(out2)["results"][0]["error"]
+        assert "circuit open" in err2
+
+    def test_bad_chaos_spec_is_a_loud_error(self, monkeypatch, capsys):
+        code, _, err = run_cli(
+            ["critique", "--models", "mock://agree",
+             "--chaos", "kaboom@generate"],
+            stdin=SPEC,
+            monkeypatch=monkeypatch,
+            capsys=capsys,
+        )
+        assert code == cli.EXIT_ERROR
+        assert "unknown fault kind" in err
+
+    def test_bad_chaos_env_spec_fails_at_startup_too(
+        self, monkeypatch, capsys
+    ):
+        """ADVSPEC_CHAOS typos must fail as loudly as --chaos typos —
+        not surface later as swallowed per-model BUG completions."""
+        monkeypatch.setenv("ADVSPEC_CHAOS", "kaboom@generate")
+        code, _, err = run_cli(
+            ["critique", "--models", "mock://agree"],
+            stdin=SPEC,
+            monkeypatch=monkeypatch,
+            capsys=capsys,
+        )
+        assert code == cli.EXIT_ERROR
+        assert "unknown fault kind" in err
+
+
 class TestHumanReadableOutputs:
     """The non-JSON print branches of the informational actions: display
     code crashes (bad f-string, missing key) must not hide behind the

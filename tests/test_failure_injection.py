@@ -2,17 +2,42 @@
 
 SURVEY §5: the reference's failure story is per-model retry with backoff,
 errors captured not raised, and graceful round degradation; its fault
-*injection* exists only as mock side_effects in tests. Same strategy here,
-but the faults injected are the TPU engine's real failure modes
-(RESOURCE_EXHAUSTED on OOM, transient device unavailability) at the
-generate seam inside the real TpuEngine.
+*injection* exists only as mock side_effects in tests. Two layers here:
+
+- the legacy monkeypatch tests (TestEngineFaults) exercise raw exception
+  classification at the generate seam inside the real TpuEngine;
+- everything below them drives the FIRST-CLASS chaos injector
+  (resilience/injector.py) — no monkeypatching — through the fault
+  taxonomy, circuit-breaker state machine, scheduler slot eviction with
+  partial-token results, and the full run_round breaker flow.
 """
+
+import numpy as np
+import pytest
 
 from adversarial_spec_tpu.debate.core import RoundConfig, run_round
 from adversarial_spec_tpu.engine import tpu as tpu_mod
 from adversarial_spec_tpu.engine.dispatch import _ENGINE_CACHE
 from adversarial_spec_tpu.engine.tpu import TpuEngine
 from adversarial_spec_tpu.engine.types import ChatRequest, SamplingParams
+from adversarial_spec_tpu.resilience import injector as injector_mod
+from adversarial_spec_tpu.resilience.breaker import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    BreakerRegistry,
+)
+from adversarial_spec_tpu.resilience import faults as faults_mod
+from adversarial_spec_tpu.resilience.faults import (
+    FaultKind,
+    classify,
+    classify_message,
+)
+from adversarial_spec_tpu.resilience.injector import (
+    FaultInjector,
+    InjectedFault,
+    parse_chaos_spec,
+)
 
 PARAMS = SamplingParams(max_new_tokens=8, greedy=True)
 
@@ -90,3 +115,511 @@ class TestEngineFaults:
         comp = TpuEngine().chat([_req()], PARAMS)[0]
         assert not comp.ok
         assert comp.transient
+
+
+@pytest.mark.chaos
+class TestFaultTaxonomy:
+    """One classify() for every seam (replaces per-site marker lists)."""
+
+    @pytest.mark.parametrize(
+        "msg,kind",
+        [
+            ("RESOURCE_EXHAUSTED: out of memory on TPU", FaultKind.OOM),
+            ("XlaRuntimeError: RESOURCE_EXHAUSTED: hbm", FaultKind.OOM),
+            ("UNAVAILABLE: device lost", FaultKind.DEVICE_LOST),
+            ("OUT_OF_RANGE: slice", FaultKind.DEVICE_LOST),
+            ("ABORTED: preempted by scheduler", FaultKind.PREEMPTED),
+            ("DEADLINE_EXCEEDED: step", FaultKind.TIMEOUT),
+            ("TypeError: bad argument", FaultKind.BUG),
+            ("something unrecognizable", FaultKind.BUG),
+        ],
+    )
+    def test_message_table(self, msg, kind):
+        assert classify_message(msg) is kind
+        assert classify(RuntimeError(msg)) is kind
+
+    def test_python_types_short_circuit(self):
+        assert classify(TimeoutError("anything")) is FaultKind.TIMEOUT
+        assert classify(MemoryError()) is FaultKind.OOM
+
+    def test_oom_matches_only_as_uppercase_token(self):
+        """'room'/'zoom' must not make a permanent bug retryable."""
+        assert classify_message("hit OOM on device") is FaultKind.OOM
+        assert classify_message("no room left for field") is FaultKind.BUG
+        assert classify_message("zoom level invalid") is FaultKind.BUG
+        assert classify_message("boom: oops") is FaultKind.BUG
+
+    def test_only_bug_is_permanent(self):
+        for kind in FaultKind:
+            assert kind.transient == (kind is not FaultKind.BUG)
+
+    def test_injected_faults_classify_exactly_and_textually(self):
+        for kind in FaultKind:
+            exc = InjectedFault(kind, "generate")
+            assert classify(exc) is kind
+            # String path must agree: engine boundaries stringify errors.
+            assert classify_message(str(exc)) is kind
+
+    def test_counters_accumulate(self):
+        from adversarial_spec_tpu.resilience import faults
+
+        faults.reset()
+        faults.record(FaultKind.OOM, "scheduler_chunk")
+        faults.record(FaultKind.OOM, "scheduler_chunk")
+        faults.record(FaultKind.BUG, "generate")
+        assert faults.snapshot() == {
+            "scheduler_chunk.oom": 2,
+            "generate.bug": 1,
+        }
+        faults.reset()
+        assert faults.snapshot() == {}
+
+
+@pytest.mark.chaos
+class TestChaosSpec:
+    def test_full_grammar(self):
+        rules = parse_chaos_spec(
+            "oom@scheduler_chunk:after=1:times=2:slot=1, "
+            "device_lost@generate:p=0.25"
+        )
+        assert rules[0].kind is FaultKind.OOM
+        assert (rules[0].after, rules[0].times, rules[0].slot) == (1, 2, 1)
+        assert rules[1].seam == "generate" and rules[1].p == 0.25
+
+    @pytest.mark.parametrize(
+        "bad",
+        ["oom", "oom@nowhere", "kaboom@generate", "oom@generate:p=x",
+         "oom@generate:frequency=2"],
+    )
+    def test_bad_specs_fail_loudly(self, bad):
+        with pytest.raises(ValueError):
+            parse_chaos_spec(bad)
+
+    def test_rule_arming(self):
+        inj = FaultInjector(parse_chaos_spec("oom@kv_alloc:after=2:times=1"))
+        inj.check("kv_alloc")
+        inj.check("kv_alloc")
+        with pytest.raises(InjectedFault):
+            inj.check("kv_alloc")
+        inj.check("kv_alloc")  # times=1: disarmed after one fire
+        assert inj.fired == {"kv_alloc.oom": 1}
+
+    def test_env_var_arms_process_injector(self, monkeypatch):
+        monkeypatch.setenv("ADVSPEC_CHAOS", "bug@checkpoint_load:times=1")
+        injector_mod.reset()
+        with pytest.raises(InjectedFault):
+            injector_mod.fire("checkpoint_load")
+        injector_mod.fire("checkpoint_load")  # disarmed
+        injector_mod.reset()
+
+
+@pytest.mark.chaos
+class TestCircuitBreaker:
+    """closed → open → half-open → closed/open, on a fake clock."""
+
+    def _registry(self, threshold=3, cooldown=30.0):
+        clock = [0.0]
+        reg = BreakerRegistry(
+            threshold=threshold, cooldown_s=cooldown, clock=lambda: clock[0]
+        )
+        return reg, clock
+
+    def test_opens_after_threshold_consecutive_failures(self):
+        reg, _ = self._registry(threshold=3)
+        for _ in range(2):
+            reg.record("m", ok=False, kind=FaultKind.OOM)
+        assert reg.breaker("m").state == CLOSED
+        reg.record("m", ok=False, kind=FaultKind.OOM)
+        assert reg.breaker("m").state == OPEN
+        assert not reg.allow("m")
+
+    def test_success_resets_the_streak(self):
+        reg, _ = self._registry(threshold=2)
+        reg.record("m", ok=False)
+        reg.record("m", ok=True)
+        reg.record("m", ok=False)
+        assert reg.breaker("m").state == CLOSED
+
+    def test_half_open_probe_recovers(self):
+        reg, clock = self._registry(threshold=1, cooldown=10.0)
+        reg.record("m", ok=False, kind=FaultKind.DEVICE_LOST)
+        assert not reg.allow("m")
+        clock[0] = 10.0
+        assert reg.allow("m")  # the probe
+        assert reg.breaker("m").state == HALF_OPEN
+        assert not reg.allow("m")  # one probe at a time
+        reg.record("m", ok=True)
+        assert reg.breaker("m").state == CLOSED
+        assert reg.allow("m")
+
+    def test_failed_probe_reopens_with_fresh_cooldown(self):
+        reg, clock = self._registry(threshold=1, cooldown=10.0)
+        reg.record("m", ok=False)
+        clock[0] = 10.0
+        assert reg.allow("m")
+        reg.record("m", ok=False)
+        assert reg.breaker("m").state == OPEN
+        clock[0] = 19.0  # 9s into the NEW cooldown
+        assert not reg.allow("m")
+        clock[0] = 20.0
+        assert reg.allow("m")
+
+    def test_transition_counters_and_states(self):
+        reg, clock = self._registry(threshold=1, cooldown=5.0)
+        reg.record("m", ok=False, kind=FaultKind.PREEMPTED)
+        clock[0] = 5.0
+        reg.allow("m")
+        reg.record("m", ok=True)
+        assert reg.counters() == {
+            "breaker.to_open": 1.0,
+            "breaker.to_half_open": 1.0,
+            "breaker.to_closed": 1.0,
+        }
+        snap = reg.states()["m"]
+        assert snap["state"] == CLOSED and snap["last_fault"] is None
+
+    def test_transition_counters_survive_heavy_flapping(self):
+        """Counters are monotonic, not derived from the bounded debug
+        log: 100 open/close cycles must report 100, not ~64."""
+        reg, clock = self._registry(threshold=1, cooldown=1.0)
+        for i in range(100):
+            reg.record("m", ok=False)
+            clock[0] += 1.0
+            assert reg.allow("m")  # half-open probe
+            reg.record("m", ok=True)
+        assert reg.counters()["breaker.to_open"] == 100.0
+        assert reg.counters()["breaker.to_closed"] == 100.0
+        assert len(reg.breaker("m").transitions) <= 64  # log stays bounded
+
+    def test_disabled_registry_always_allows(self):
+        reg, _ = self._registry(threshold=1)
+        reg.configure(enabled=False)
+        reg.record("m", ok=False)
+        assert reg.allow("m")
+        assert reg.breaker("m").state == CLOSED
+
+    def test_lost_probe_expires_after_one_cooldown(self):
+        """A half-open probe whose outcome is never recorded (the caller
+        died mid-round) must not ban the model forever."""
+        reg, clock = self._registry(threshold=1, cooldown=10.0)
+        reg.record("m", ok=False)
+        clock[0] = 10.0
+        assert reg.allow("m")  # probe granted, outcome never recorded
+        assert not reg.allow("m")
+        clock[0] = 20.0  # one full cooldown later: probe presumed lost
+        assert reg.allow("m")
+        reg.record("m", ok=True)
+        assert reg.breaker("m").state == CLOSED
+
+    def test_snapshot_restores_across_processes(self):
+        """One CLI invocation is one round: an OPEN circuit must survive
+        via the session snapshot, with the REMAINING cooldown (monotonic
+        timestamps don't cross processes)."""
+        reg, clock = self._registry(threshold=1, cooldown=30.0)
+        reg.record("m", ok=False, kind=FaultKind.OOM)
+        clock[0] = 10.0  # 20s of cooldown left at "process exit"
+        snap = reg.snapshot_for_resume()
+        assert snap["m"]["state"] == OPEN
+        assert snap["m"]["cooldown_remaining"] == 20.0
+        assert snap["m"]["last_fault"] == "oom"
+
+        # "Next process": fresh registry, fresh clock epoch.
+        reg2, clock2 = self._registry(threshold=1, cooldown=30.0)
+        reg2.restore(snap)
+        assert not reg2.allow("m")
+        clock2[0] = 19.0
+        assert not reg2.allow("m")
+        clock2[0] = 20.0
+        assert reg2.allow("m")  # half-open probe, right on schedule
+
+    def test_snapshot_skips_clean_breakers_and_maps_half_open(self):
+        reg, clock = self._registry(threshold=2, cooldown=10.0)
+        reg.record("clean", ok=True)
+        reg.record("failing", ok=False)  # 1 < threshold: still CLOSED
+        reg.record("probing", ok=False)
+        reg.record("probing", ok=False)
+        clock[0] = 10.0
+        assert reg.allow("probing")  # now HALF_OPEN, probe in flight
+        snap = reg.snapshot_for_resume()
+        assert "clean" not in snap
+        assert snap["failing"]["failures"] == 1
+        # Lost probe resumes as OPEN with nothing left to wait.
+        assert snap["probing"]["state"] == OPEN
+        assert snap["probing"]["cooldown_remaining"] == 0.0
+
+
+@pytest.mark.chaos
+class TestBreakerInRound:
+    """Acceptance: a model whose breaker is open is skipped in the next
+    run_round WITHOUT consuming its 3-retry budget, and recovers via the
+    half-open probe — chaos injected at the generate seam, no
+    monkeypatched engine internals."""
+
+    def test_open_skip_and_half_open_recovery(self, monkeypatch):
+        monkeypatch.setattr(
+            RoundConfig, "sleep", staticmethod(lambda s: None)
+        )
+        clock = [0.0]
+        reg = BreakerRegistry(
+            threshold=3, cooldown_s=60.0, clock=lambda: clock[0]
+        )
+        cfg = RoundConfig(sampling=PARAMS, breakers=reg)
+        model = "tpu://random-tiny"
+        inj = FaultInjector(parse_chaos_spec("oom@generate"))
+        injector_mod.install(inj)
+
+        r1 = run_round("# spec", [model], 1, cfg)
+        assert not r1.responses[0].ok
+        assert reg.breaker(model).state == OPEN
+        # Transient fault: the reference's full 3-attempt budget ran.
+        hits_r1 = inj.seam_hits["generate"]
+        assert hits_r1 == 3
+
+        r2 = run_round("# spec", [model], 2, cfg)
+        assert "circuit open" in r2.responses[0].error
+        # Skipped up front: ZERO engine calls, no retry budget consumed.
+        assert inj.seam_hits["generate"] == hits_r1
+
+        clock[0] = 61.0
+        injector_mod.reset()  # chaos off: the half-open probe can succeed
+        r3 = run_round("# spec", [model], 3, cfg)
+        assert r3.responses[0].ok
+        assert reg.breaker(model).state == CLOSED
+
+    def test_failed_probe_costs_one_attempt_not_three(self, monkeypatch):
+        """The half-open probe is ONE attempt: when it fails, the
+        reopened circuit must stop the remaining retry budget (the whole
+        point of the breaker) instead of backing off twice more."""
+        monkeypatch.setattr(
+            RoundConfig, "sleep", staticmethod(lambda s: None)
+        )
+        clock = [0.0]
+        reg = BreakerRegistry(
+            threshold=1, cooldown_s=30.0, clock=lambda: clock[0]
+        )
+        model = "tpu://random-tiny"
+        reg.record(model, ok=False, kind=FaultKind.OOM)  # circuit opens
+        clock[0] = 30.0  # cooldown elapsed: next round is a probe round
+        inj = FaultInjector(parse_chaos_spec("oom@generate"))
+        injector_mod.install(inj)
+        cfg = RoundConfig(sampling=PARAMS, breakers=reg)
+        result = run_round("# spec", [model], 1, cfg)
+        assert not result.responses[0].ok
+        assert "RESOURCE_EXHAUSTED" in result.responses[0].error
+        # Exactly one engine call: the failed probe reopened the circuit
+        # and the retry loop respected it.
+        assert inj.seam_hits["generate"] == 1
+        assert reg.breaker(model).state == OPEN
+
+    def test_open_breaker_does_not_block_other_models(self, monkeypatch):
+        monkeypatch.setattr(
+            RoundConfig, "sleep", staticmethod(lambda s: None)
+        )
+        reg = BreakerRegistry(threshold=1, cooldown_s=1e9)
+        reg.record("tpu://random-tiny", ok=False, kind=FaultKind.BUG)
+        cfg = RoundConfig(sampling=PARAMS, breakers=reg)
+        result = run_round(
+            "# spec",
+            ["tpu://random-tiny", "mock://agree"],
+            1,
+            cfg,
+        )
+        by_model = {r.model: r for r in result.responses}
+        assert "circuit open" in by_model["tpu://random-tiny"].error
+        assert by_model["mock://agree"].ok
+
+
+@pytest.mark.chaos
+class TestSchedulerFaultIsolation:
+    """Acceptance: an injected transient fault on one scheduler slot
+    mid-drain yields partial tokens for that request and unchanged,
+    complete results for all co-resident requests."""
+
+    @pytest.fixture(scope="class")
+    def tiny_model(self):
+        import jax
+        import jax.numpy as jnp
+
+        from adversarial_spec_tpu.models import transformer as T
+        from adversarial_spec_tpu.models.config import get_config
+
+        cfg = get_config("llama", "tiny")
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        return params, cfg
+
+    def _reference(self, params, cfg, prompt, max_new):
+        from adversarial_spec_tpu.engine.generate import generate
+
+        out = generate(
+            params, cfg, [prompt], max_new_tokens=max_new,
+            eos_ids=[], greedy=True, speculative=False,
+        )
+        return np.asarray(out.tokens[0, : out.n_generated[0]])
+
+    def _batcher(self, params, cfg, **kw):
+        from adversarial_spec_tpu.engine.scheduler import ContinuousBatcher
+
+        kw.setdefault("max_batch", 2)
+        kw.setdefault("max_new_cap", 16)
+        kw.setdefault("chunk", 4)
+        return ContinuousBatcher(params, cfg, **kw)
+
+    def test_persistent_fault_evicts_one_slot_with_partial_tokens(
+        self, tiny_model
+    ):
+        from adversarial_spec_tpu.engine.scheduler import SchedRequest
+
+        params, cfg = tiny_model
+        # times=2: the first eviction requeues (OOM is transient, one
+        # retry), the second fire on the retry finalizes the partial.
+        injector_mod.install(
+            FaultInjector(
+                parse_chaos_spec("oom@scheduler_chunk:after=1:times=2:slot=1")
+            )
+        )
+        b = self._batcher(params, cfg)
+        b.submit(SchedRequest(req_id=0, prompt_ids=[1, 5, 9],
+                              max_new_tokens=12))
+        b.submit(SchedRequest(req_id=1, prompt_ids=[2, 6],
+                              max_new_tokens=12))
+        free0 = b.allocator.free_pages
+        results = b.run_all()
+        assert [r.req_id for r in results] == [0, 1]
+        healthy, faulted = results
+        # Co-resident request: byte-identical to its solo reference.
+        assert healthy.error is None
+        np.testing.assert_array_equal(
+            healthy.tokens, self._reference(params, cfg, [1, 5, 9], 12)
+        )
+        # Faulted request: partial tokens + taxonomy metadata.
+        assert faulted.fault_kind == "oom"
+        assert faulted.error and "RESOURCE_EXHAUSTED" in faulted.error
+        assert 1 <= faulted.n_generated < 12
+        assert len(faulted.tokens) == faulted.n_generated
+        # Evicted slot's pages were freed (no leak).
+        assert b.allocator.free_pages == free0
+        # Both fires landed in the process-wide fault counters (the
+        # store the CLI's resilience report snapshots).
+        assert faults_mod.snapshot() == {"scheduler_chunk.oom": 2}
+
+    def test_transient_fault_retries_once_to_full_completion(
+        self, tiny_model
+    ):
+        from adversarial_spec_tpu.engine.scheduler import SchedRequest
+
+        params, cfg = tiny_model
+        injector_mod.install(
+            FaultInjector(
+                parse_chaos_spec(
+                    "device_lost@scheduler_chunk:after=1:times=1:slot=0"
+                )
+            )
+        )
+        b = self._batcher(params, cfg)
+        b.submit(SchedRequest(req_id=0, prompt_ids=[1, 5, 9],
+                              max_new_tokens=12))
+        b.submit(SchedRequest(req_id=1, prompt_ids=[2, 6],
+                              max_new_tokens=12))
+        results = b.run_all()
+        # Retry-once-on-transient: the evicted request re-admitted and
+        # completed in full; both rows match their solo references.
+        for r, prompt in zip(results, [[1, 5, 9], [2, 6]]):
+            assert r.error is None, r.error
+            np.testing.assert_array_equal(
+                r.tokens, self._reference(params, cfg, prompt, 12)
+            )
+        assert faults_mod.snapshot() == {"scheduler_chunk.device_lost": 1}
+
+    def test_permanent_admission_fault_isolated_to_one_request(
+        self, tiny_model
+    ):
+        from adversarial_spec_tpu.engine.scheduler import SchedRequest
+
+        params, cfg = tiny_model
+        injector_mod.install(
+            FaultInjector(parse_chaos_spec("bug@kv_alloc:times=1"))
+        )
+        b = self._batcher(params, cfg)
+        total_pages = b.allocator.free_pages
+        b.submit(SchedRequest(req_id=0, prompt_ids=[1, 5, 9],
+                              max_new_tokens=8))
+        b.submit(SchedRequest(req_id=1, prompt_ids=[2, 6],
+                              max_new_tokens=8))
+        results = b.run_all()
+        assert [r.req_id for r in results] == [0, 1]
+        assert results[0].fault_kind == "bug"  # BUG: no retry
+        assert results[0].n_generated == 0
+        assert results[1].error is None
+        np.testing.assert_array_equal(
+            results[1].tokens, self._reference(params, cfg, [2, 6], 8)
+        )
+        assert b.allocator.free_pages == total_pages
+
+    def test_fault_inside_finish_admission_is_isolated(
+        self, tiny_model, monkeypatch
+    ):
+        """A real fault during the admission's pool scatter (inside
+        _finish_admission, past the prefill) must abort ONLY that
+        admission — pages freed, request retried-once — not crash the
+        drain with the admission record already cleared."""
+        import adversarial_spec_tpu.engine.scheduler as sched_mod
+        from adversarial_spec_tpu.engine.scheduler import SchedRequest
+
+        params, cfg = tiny_model
+        real_write = sched_mod.write_tokens
+        fired = {"n": 0}
+
+        def oom_once(*a, **kw):
+            if fired["n"] == 0:
+                fired["n"] += 1
+                raise RuntimeError("RESOURCE_EXHAUSTED: pool scatter")
+            return real_write(*a, **kw)
+
+        monkeypatch.setattr(sched_mod, "write_tokens", oom_once)
+        b = self._batcher(params, cfg)
+        total_pages = b.allocator.free_pages
+        b.submit(SchedRequest(req_id=0, prompt_ids=[1, 5, 9],
+                              max_new_tokens=8))
+        b.submit(SchedRequest(req_id=1, prompt_ids=[2, 6],
+                              max_new_tokens=8))
+        results = b.run_all()
+        assert [r.req_id for r in results] == [0, 1]
+        # Transient: the aborted admission got its one requeue and
+        # completed; both rows match their solo references.
+        for r, prompt in zip(results, [[1, 5, 9], [2, 6]]):
+            assert r.error is None, r.error
+            np.testing.assert_array_equal(
+                r.tokens, self._reference(params, cfg, prompt, 8)
+            )
+        assert faults_mod.snapshot() == {"admission.oom": 1}
+        assert b.allocator.free_pages == total_pages
+
+    def test_engine_surfaces_slot_fault_as_transient_completion(self):
+        """Through the TpuEngine: a faulted slot becomes an errored,
+        transient Completion (the debate core's retry applies) while the
+        co-resident completion stays clean."""
+        from adversarial_spec_tpu.engine.registry import (
+            ModelSpec,
+            save_registry_entry,
+        )
+
+        save_registry_entry(
+            ModelSpec(alias="chaos-tiny", family="llama", size="tiny",
+                      kv="paged", dtype="float32", mesh={"dp": 1})
+        )
+        injector_mod.install(
+            FaultInjector(
+                parse_chaos_spec("oom@scheduler_chunk:after=1:times=2:slot=1")
+            )
+        )
+        # Budget > the batcher's 32-step chunk so the drain spans several
+        # chunks and the after=1 rule has a second chunk to fire on.
+        comps = TpuEngine().chat(
+            [_req("tpu://chaos-tiny"), _req("tpu://chaos-tiny")],
+            SamplingParams(max_new_tokens=80, greedy=True),
+        )
+        oks = [c for c in comps if c.ok]
+        bad = [c for c in comps if not c.ok]
+        assert len(oks) == 1 and len(bad) == 1
+        assert bad[0].transient  # OOM → debate core backs off and retries
+        assert "RESOURCE_EXHAUSTED" in bad[0].error

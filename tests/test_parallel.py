@@ -525,7 +525,10 @@ class TestWindowedRingEarlyOut:
         from jax.sharding import PartitionSpec as P
 
         from adversarial_spec_tpu.parallel import ring as ring_mod
-        from adversarial_spec_tpu.parallel.mesh import make_mesh
+        from adversarial_spec_tpu.parallel.mesh import (
+            compat_shard_map,
+            make_mesh,
+        )
 
         B, S, H, Hkv, D, W = 2, 64, 4, 2, 16, 7
         ks = jax.random.split(jax.random.key(21), 3)
@@ -541,10 +544,9 @@ class TestWindowedRingEarlyOut:
                     qb, kb, vb, 4, causal=True, window=window
                 )
 
-            return jax.shard_map(
+            return compat_shard_map(
                 local, mesh=mesh,
                 in_specs=(spec, spec, spec), out_specs=spec,
-                check_vma=False,
             )(q, k, v)
 
         early = run(W)  # static int window → shortened fori_loop

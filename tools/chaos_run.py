@@ -1,0 +1,79 @@
+"""Standalone chaos-suite runner.
+
+Runs the fault-injection / resilience tests (pytest marker ``chaos``)
+outside the main suite — the quick gate after touching scheduler, engine,
+or resilience code — and optionally sweeps extra randomized fuzz seeds by
+re-running the scheduler chaos fuzz under different
+``ADVSPEC_CHAOS_FUZZ_SEED`` values (the in-suite fuzz pins 3 fixed seeds;
+a sweep buys wider coverage when you want it, without slowing tier-1).
+Reproduce a failing sweep seed N with ``ADVSPEC_CHAOS_FUZZ_SEED=N
+pytest tests/test_fuzz.py -k ChaosFuzz``.
+
+Usage:
+    python tools/chaos_run.py                # pytest -m chaos
+    python tools/chaos_run.py --sweep 5      # + 5 extra fuzz seeds
+    python tools/chaos_run.py -- -x -k breaker   # extra pytest args
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _pytest(extra: list[str], env_overrides: dict[str, str]) -> int:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.update(env_overrides)
+    return subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "pytest",
+            "-q",
+            "-p",
+            "no:cacheprovider",
+            "-m",
+            "chaos",
+            *extra,
+        ],
+        cwd=REPO,
+        env=env,
+    ).returncode
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--sweep",
+        type=int,
+        default=0,
+        metavar="N",
+        help="after the marked suite, re-run the scheduler chaos fuzz "
+        "under N extra ADVSPEC_CHAOS_FUZZ_SEED values",
+    )
+    args, extra = ap.parse_known_args(argv)
+    if extra and extra[0] == "--":
+        extra = extra[1:]
+
+    rc = _pytest(extra, {})
+    if rc != 0:
+        return rc
+    for seed in range(3, 3 + args.sweep):  # tier-1 already pins 0..2
+        print(f"\n=== chaos sweep seed {seed} ===", flush=True)
+        rc = _pytest(
+            ["tests/test_fuzz.py", "-k", "ChaosFuzz"],
+            {"ADVSPEC_CHAOS_FUZZ_SEED": str(seed)},
+        )
+        if rc != 0:
+            return rc
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -63,9 +63,22 @@ DEFAULT_TARGETS: dict[str, list[str]] = {
     "adversarial_spec_tpu/utils/tracing.py": ["tests/test_tracing.py"],
 }
 
-# Lines containing these markers are not mutated (mutmut_config.py parity;
-# "indent=" covers cosmetic JSON pretty-printing width).
-SKIP_LINE_MARKERS = ("print(", "_err(", "description=", "help=", "indent=")
+# Lines containing these markers are not mutated. Imported from
+# mutmut_config.py (single source of truth — the two lists previously had
+# to be updated in lockstep by hand, ADVICE r5); loaded by file path so
+# `python tools/mutation_run.py` works without the repo root on sys.path.
+def _load_skip_markers() -> tuple[str, ...]:
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "mutmut_config", REPO / "mutmut_config.py"
+    )
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module._SKIP_LINE_MARKERS
+
+
+SKIP_LINE_MARKERS = _load_skip_markers()
 
 _CMP_SWAP = {
     ast.Eq: ast.NotEq,
