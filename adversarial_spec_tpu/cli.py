@@ -172,6 +172,21 @@ def create_parser() -> argparse.ArgumentParser:
         default=None,
         help="Per-round wall-clock budget in seconds (default 600)",
     )
+    d.add_argument(
+        "--prefix-cache",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="Cross-round prefix KV cache: shared spec/transcript "
+        "prefixes prefill once and are reused via ref-counted page "
+        "sharing (--no-prefix-cache disables)",
+    )
+    d.add_argument(
+        "--prefix-cache-pages",
+        type=int,
+        default=0,
+        help="Cap on KV pages the prefix cache may retain "
+        "(0 = bounded only by the pool, evicting LRU under pressure)",
+    )
 
     z = parser.add_argument_group("resilience")
     z.add_argument(
@@ -358,11 +373,28 @@ def _configure_resilience(args: argparse.Namespace):
     return breakers
 
 
+def _configure_prefix_cache(args: argparse.Namespace):
+    """Arm the prefix cache from flags; returns the module for reporting.
+
+    One CLI invocation is one round: stats reset here so the JSON
+    ``perf.prefix_cache`` block accounts exactly this round's prefills,
+    while the cache CONTENT itself persists wherever the engine lives.
+    """
+    from adversarial_spec_tpu.engine import prefix_cache
+
+    prefix_cache.configure(
+        enabled=args.prefix_cache, max_pages=args.prefix_cache_pages
+    )
+    prefix_cache.reset_stats()
+    return prefix_cache
+
+
 def run_critique(args: argparse.Namespace) -> int:
     from adversarial_spec_tpu.utils.tracing import Tracer, maybe_profile
 
     tracer = Tracer()
     breakers = _configure_resilience(args)
+    prefix_cache = _configure_prefix_cache(args)
     spec, session_state = load_or_resume_session(args)
     if session_state is not None and session_state.breakers:
         # One CLI invocation = one round: open circuits from earlier
@@ -407,16 +439,32 @@ def run_critique(args: argparse.Namespace) -> int:
     fault_counts = faults_mod.snapshot()
     tracer.count_many({f"fault.{k}": v for k, v in fault_counts.items()})
     tracer.count_many(breakers.counters())
+    # Prefix-cache telemetry: hit/miss/evict/tokens-saved counters ride
+    # the tracer (and the full snapshot lands on perf.prefix_cache).
+    prefix_snap = prefix_cache.snapshot()
+    tracer.count_many(
+        {
+            f"prefix_cache.{k}": float(v)
+            for k, v in prefix_snap.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+        }
+    )
     perf = tracer.report()
     perf["decode_tokens_per_sec"] = round(tracer.rate("decode_tokens", "decode"), 1)
     perf["resilience"] = {
         "faults": fault_counts,
         "breakers": breakers.states(),
     }
+    perf["prefix_cache"] = prefix_snap
     _err(
         f"perf: round {perf['spans'].get('round', 0):.2f}s, "
         f"decode {perf['decode_tokens_per_sec']} tok/s"
     )
+    if prefix_snap["enabled"] and prefix_snap["lookups"]:
+        _err(
+            f"prefix cache: {prefix_snap['hits']}/{prefix_snap['lookups']} "
+            f"hits, {prefix_snap['saved_tokens']} prefill tokens saved"
+        )
     if fault_counts:
         total_faults = sum(fault_counts.values())
         _err(
@@ -510,6 +558,9 @@ def output_results(
                     "error": r.error,
                     "input_tokens": r.usage.input_tokens,
                     "output_tokens": r.usage.output_tokens,
+                    "cached_tokens": r.usage.cached_tokens,
+                    "prefill_time_s": round(r.usage.prefill_time_s, 4),
+                    "decode_time_s": round(r.usage.decode_time_s, 4),
                     "cost": round(r.usage.cost_for(r.model), 6),
                 }
                 for r in result.responses
@@ -557,6 +608,7 @@ def handle_export_tasks(args: argparse.Namespace) -> int:
     Parity: reference handle_export_tasks (debate.py:688-736) — stdin spec,
     EXPORT_TASKS_PROMPT, low temperature, ``extract_tasks``, ``--json``.
     """
+    _configure_prefix_cache(args)
     spec = _read_spec_stdin()
     models = parse_models(args)
     errors = validate_models_before_run(models[:1])

@@ -180,17 +180,30 @@ actionable critique counts.
     + _RESPONSE_PROTOCOL
 )
 
-REVIEW_PROMPT_TEMPLATE = """Debate round {round}.
-
-Below is the current draft of the document under review. Apply your full
-critical attention and respond per the response protocol.
+# Round templates are PREFIX-STABLE by design: the document (which only
+# grows between rounds) comes first and everything round-varying — the
+# round number, per-round instructions — trails it. That ordering is what
+# lets the prefix KV cache (engine/prefix_cache.py) reuse round R's
+# prefill in round R+1: the shared system prompt + document head matches
+# block-for-block and only the small suffix re-prefills. Keep any new
+# round-varying text BELOW the document markers.
+REVIEW_PROMPT_TEMPLATE = """Below is the current draft of the document under review.
 
 --- DOCUMENT ---
 {spec}
 --- END DOCUMENT ---
+
+Debate round {round}. Apply your full critical attention and respond per
+the response protocol.
 """
 
-PRESS_PROMPT_TEMPLATE = """Debate round {round} — PRESS ROUND.
+PRESS_PROMPT_TEMPLATE = """Below is the current draft of the document under review.
+
+--- DOCUMENT ---
+{spec}
+--- END DOCUMENT ---
+
+Debate round {round} — PRESS ROUND.
 
 You (or other reviewers) accepted the previous draft quickly. Quick agreement
 in an adversarial review is a failure mode: it usually means the review went
@@ -202,10 +215,6 @@ you must actively try to break the document one more time:
 3. Only after that analysis, either provide critiques (numbered, with a
    revised version between [SPEC] and [/SPEC] if warranted) or reply
    [AGREE] if you genuinely found nothing that must change.
-
---- DOCUMENT ---
-{spec}
---- END DOCUMENT ---
 """
 
 EXPORT_TASKS_PROMPT = """Convert the following specification into an ordered

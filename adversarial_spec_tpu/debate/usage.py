@@ -51,6 +51,11 @@ class Usage:
     # Decode-only throughput bookkeeping for the north-star metric.
     decode_tokens: int = 0
     decode_time_s: float = 0.0
+    # Prompt tokens served from the prefix KV cache (subset of
+    # input_tokens) and this request's own prefill wall-clock — the
+    # per-request view of the cache's effect (engine/prefix_cache.py).
+    cached_tokens: int = 0
+    prefill_time_s: float = 0.0
 
     @property
     def total_tokens(self) -> int:
@@ -67,6 +72,8 @@ class Usage:
             device_time_s=self.device_time_s + other.device_time_s,
             decode_tokens=self.decode_tokens + other.decode_tokens,
             decode_time_s=self.decode_time_s + other.decode_time_s,
+            cached_tokens=self.cached_tokens + other.cached_tokens,
+            prefill_time_s=self.prefill_time_s + other.prefill_time_s,
         )
 
     def to_dict(self) -> dict:
@@ -74,7 +81,10 @@ class Usage:
             "input_tokens": self.input_tokens,
             "output_tokens": self.output_tokens,
             "total_tokens": self.total_tokens,
+            "cached_tokens": self.cached_tokens,
             "device_time_s": round(self.device_time_s, 4),
+            "prefill_time_s": round(self.prefill_time_s, 4),
+            "decode_time_s": round(self.decode_time_s, 4),
         }
 
 

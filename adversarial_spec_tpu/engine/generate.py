@@ -491,6 +491,29 @@ def generate(
         and B > 1
         and all(p == prompt_ids[0] for p in prompt_ids[1:])
     )
+    # PARTIAL sharing: equal-length rows that diverge only in a suffix
+    # (per-opponent personas over one spec) prefill their common prefix
+    # ONCE at B=1, tile the cache, and run only the divergent tail at
+    # full batch. Equal lengths ⇒ equal pads ⇒ the shared slots hold
+    # identical KV for every row. Granularity is the prefill chunk.
+    shared_until = 0
+    if (
+        share_prefix
+        and not shared
+        and (mesh is None or mesh.size == 1)
+        and B > 1
+        and all(len(p) == len(prompt_ids[0]) for p in prompt_ids[1:])
+    ):
+        p0 = prompt_ids[0]
+        common = len(p0)
+        for p in prompt_ids[1:]:
+            i = 0
+            while i < common and p[i] == p0[i]:
+                i += 1
+            common = i
+        chunk0 = min(S, PREFILL_CHUNK)
+        # Divergence slot in padded coordinates, floored to chunk grid.
+        shared_until = ((S - len(p0) + common) // chunk0) * chunk0
     prefill_tokens = tokens[:1] if shared else tokens
     prefill_pads = pad_lens[:1] if shared else pad_lens
 
@@ -535,7 +558,7 @@ def generate(
         # it only needs the prompt slots — not the decode region.
         cache = init_cache(
             cfg,
-            prefill_tokens.shape[0],
+            1 if shared_until else prefill_tokens.shape[0],
             S if paged else total_len,
             dtype=params["embed"].dtype,
             device=cache_device,
@@ -544,14 +567,27 @@ def generate(
         chunk_len = min(S, PREFILL_CHUNK)
         last_logits = None
         for ci in range(0, S, chunk_len):
+            if shared_until and ci == shared_until:
+                # Common prefix done: fan the 1-row cache out to B rows
+                # and finish the divergent tails at full batch.
+                cache = jax.tree.map(
+                    lambda x: jnp.repeat(x, B, axis=1), cache
+                )
+            one_row = bool(shared_until) and ci < shared_until
             cache, last_logits = prefill_chunk(
                 params,
                 cfg,
-                prefill_tokens[:, ci : ci + chunk_len],
-                prefill_pads,
+                (prefill_tokens[:1] if one_row else prefill_tokens)[
+                    :, ci : ci + chunk_len
+                ],
+                prefill_pads[:1] if one_row else prefill_pads,
                 cache,
                 jnp.int32(ci),
             )
+        if shared_until:
+            from adversarial_spec_tpu.engine import prefix_cache as _pc
+
+            _pc.stats.record_prefill(0, (B - 1) * shared_until)
     # Paged + identical prompts: rows can SHARE physical prompt pages
     # (never written after migration — decode slots start at S, which is
     # page-aligned when page_size divides the pow2 bucket), so skip the
@@ -561,6 +597,9 @@ def generate(
         if not share_prompt_pages:
             cache = jax.tree.map(lambda x: jnp.repeat(x, B, axis=1), cache)
         last_logits = jnp.repeat(last_logits, B, axis=0)
+        from adversarial_spec_tpu.engine import prefix_cache as _pc
+
+        _pc.stats.record_prefill(0, (B - 1) * S)
     first = sample_tokens(
         last_logits,
         prefill_key,

@@ -11,13 +11,24 @@ Sizing: a debate round's opponents share the pool; ``n_pages`` bounds
 total resident tokens across all rows, not per-row length — the property
 that lets a 16k-context judge coexist with short critics (SURVEY §5
 long-context obligation).
+
+Pages are REF-COUNTED: a page may back several sequences at once (a
+cached prefix adopted by every opponent in a round — engine/
+prefix_cache.py) plus one reference held by the prefix cache itself. A
+page returns to the free list only when its last reference drops.
+Sharing is copy-on-append rather than true copy-on-write: block content
+is immutable once a page is full, and a writer's positions always lie
+past its adopted prefix, so no write path ever touches a shared page.
+
+jax is imported lazily (inside the device-pool functions only): the
+host-side allocator must stay importable from jax-free flows (the mock
+engine routes its prefix-cache accounting through ``PageAllocator``).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
 
-import jax.numpy as jnp
 import numpy as np
 
 
@@ -39,7 +50,15 @@ class PagedCacheLayout:
 
 
 class PageAllocator:
-    """Free-list page allocator with per-sequence ordered page tables."""
+    """Free-list page allocator with per-sequence ordered page tables.
+
+    Every allocated page carries a reference count: 1 per sequence whose
+    table contains it plus 1 if the prefix cache holds it. ``extend``
+    allocates fresh pages at refcount 1; ``adopt`` appends already-
+    allocated (shared) pages to a new sequence's table, bumping their
+    counts; ``free_sequence`` / ``cache_unref`` drop references and a
+    page returns to the free list only at zero.
+    """
 
     def __init__(self, n_pages: int, page_size: int):
         self.n_pages = n_pages
@@ -47,16 +66,25 @@ class PageAllocator:
         self._free = list(range(n_pages - 1, -1, -1))  # pop() → page 0 first
         self._tables: dict[int, list[int]] = {}
         self._lengths: dict[int, int] = {}
+        self._refs: dict[int, int] = {}  # page -> reference count
 
     @property
     def free_pages(self) -> int:
         return len(self._free)
+
+    def refcount(self, page: int) -> int:
+        return self._refs.get(page, 0)
 
     def new_sequence(self, seq_id: int) -> None:
         if seq_id in self._tables:
             raise ValueError(f"sequence {seq_id} already allocated")
         self._tables[seq_id] = []
         self._lengths[seq_id] = 0
+
+    def pages_needed(self, seq_id: int, n_tokens: int) -> int:
+        """Fresh pages an ``extend(seq_id, n_tokens)`` would allocate."""
+        needed = -(-(self._lengths[seq_id] + n_tokens) // self.page_size)
+        return max(0, needed - len(self._tables[seq_id]))
 
     def extend(self, seq_id: int, n_tokens: int) -> list[int]:
         """Reserve room for n_tokens more; returns newly allocated pages."""
@@ -69,6 +97,7 @@ class PageAllocator:
                 # Roll back this call's allocations before failing.
                 for p in new_pages:
                     table.remove(p)
+                    del self._refs[p]
                     self._free.append(p)
                 raise OutOfPages(
                     f"paged KV cache exhausted: {self.n_pages} pages of "
@@ -76,9 +105,51 @@ class PageAllocator:
                 )
             p = self._free.pop()
             table.append(p)
+            self._refs[p] = 1
             new_pages.append(p)
         self._lengths[seq_id] = length + n_tokens
         return new_pages
+
+    def adopt(self, seq_id: int, pages: list[int], n_tokens: int) -> None:
+        """Share already-allocated ``pages`` (a cached prefix) into a fresh
+        sequence. Must precede any ``extend`` for the sequence — adopted
+        pages form its table head, exactly covering ``n_tokens``."""
+        if self._tables[seq_id] or self._lengths[seq_id]:
+            raise ValueError(
+                f"sequence {seq_id} already has pages; adopt must come first"
+            )
+        if n_tokens != len(pages) * self.page_size:
+            raise ValueError(
+                f"adopt of {len(pages)} pages must cover exactly "
+                f"{len(pages) * self.page_size} tokens, got {n_tokens}"
+            )
+        for p in pages:
+            if p not in self._refs:
+                raise ValueError(f"cannot adopt unallocated page {p}")
+        for p in pages:
+            self._refs[p] += 1
+        self._tables[seq_id].extend(pages)
+        self._lengths[seq_id] = n_tokens
+
+    def cache_ref(self, page: int) -> None:
+        """Take the prefix cache's reference on an allocated page."""
+        if page not in self._refs:
+            raise ValueError(f"cannot cache-ref unallocated page {page}")
+        self._refs[page] += 1
+
+    def cache_unref(self, page: int) -> None:
+        """Drop the prefix cache's reference (page frees at zero)."""
+        self._release(page)
+
+    def _release(self, page: int) -> None:
+        refs = self._refs.get(page, 0)
+        if refs <= 0:
+            raise RuntimeError(f"double free of page {page}")
+        if refs == 1:
+            del self._refs[page]
+            self._free.append(page)
+        else:
+            self._refs[page] = refs - 1
 
     def length(self, seq_id: int) -> int:
         return self._lengths[seq_id]
@@ -88,8 +159,57 @@ class PageAllocator:
 
     def free_sequence(self, seq_id: int) -> None:
         for p in self._tables.pop(seq_id):
-            self._free.append(p)
+            self._release(p)
         del self._lengths[seq_id]
+
+    def check_invariants(self) -> None:
+        """Raise RuntimeError on any bookkeeping violation: a page both
+        free and referenced, a duplicate free-list entry, a table entry
+        without a reference, a refcount below what the tables imply, or
+        pages leaked/conjured. Cheap (O(pages)); the fuzz harness calls
+        it after every operation."""
+        free = self._free
+        free_set = set(free)
+        if len(free_set) != len(free):
+            raise RuntimeError("free list contains duplicate pages")
+        if free_set & self._refs.keys():
+            raise RuntimeError(
+                f"pages both free and referenced: "
+                f"{sorted(free_set & self._refs.keys())}"
+            )
+        if len(free) + len(self._refs) != self.n_pages:
+            raise RuntimeError(
+                f"page conservation violated: {len(free)} free + "
+                f"{len(self._refs)} referenced != {self.n_pages}"
+            )
+        table_refs: dict[int, int] = {}
+        for seq_id, table in self._tables.items():
+            if len(set(table)) != len(table):
+                raise RuntimeError(f"sequence {seq_id} table has dup pages")
+            for p in table:
+                table_refs[p] = table_refs.get(p, 0) + 1
+        for p, n in table_refs.items():
+            if p in free_set:
+                raise RuntimeError(f"free page {p} is in a live table")
+            if self._refs.get(p, 0) < n:
+                raise RuntimeError(
+                    f"page {p}: {n} table refs exceed refcount "
+                    f"{self._refs.get(p, 0)}"
+                )
+        for p, r in self._refs.items():
+            if r < 1:
+                raise RuntimeError(f"page {p} has nonpositive refcount {r}")
+            # Leak check: a page's references are its table memberships
+            # plus AT MOST ONE prefix-cache hold (one cache per pool;
+            # PrefixCache._by_page is keyed by page, so it can never
+            # double-ref). Anything beyond that is a leaked reference
+            # that would keep the page out of the free list forever.
+            if r > table_refs.get(p, 0) + 1:
+                raise RuntimeError(
+                    f"page {p}: refcount {r} exceeds "
+                    f"{table_refs.get(p, 0)} table refs + 1 cache ref "
+                    "(leaked reference)"
+                )
 
     def table_array(self, seq_ids: list[int], max_pages: int) -> np.ndarray:
         """Batched page table [B, max_pages], -1-padded, for the kernel."""
@@ -105,8 +225,8 @@ class PageAllocator:
 
 
 def init_page_pool(
-    layout: PagedCacheLayout, dtype=jnp.bfloat16, kv_dtype: str = ""
-) -> dict[str, jnp.ndarray]:
+    layout: PagedCacheLayout, dtype=None, kv_dtype: str = ""
+) -> dict[str, "jnp.ndarray"]:
     """Device page pool: per-layer stacked K/V pages.
 
     ``kv_dtype="int8"``: pages store int8 K/V plus per-(token, head)
@@ -114,6 +234,10 @@ def init_page_pool(
     of the dense cache's int8 layout (models/transformer.py:init_cache).
     Presence of "ks" marks a quantized pool.
     """
+    import jax.numpy as jnp
+
+    if dtype is None:
+        dtype = jnp.bfloat16
     shape = (
         layout.n_layers,
         layout.n_pages,
@@ -146,6 +270,8 @@ def write_tokens(
     Quantized pools take the matching scale slices (both or neither) —
     the same [L, B, Hkv, S, 1] layout the dense int8 cache stores.
     """
+    import jax.numpy as jnp
+
     L, B, H, S, D = k_new.shape
     pid = jnp.asarray(page_ids).reshape(-1)  # [B*S]
     off = jnp.asarray(offsets).reshape(-1)
@@ -171,6 +297,37 @@ def write_tokens(
         out["ks"] = pool["ks"].at[:, pid, :, off].set(flat(ks_new))
         out["vs"] = pool["vs"].at[:, pid, :, off].set(flat(vs_new))
     return out
+
+
+def read_tokens(
+    pool: dict[str, "jnp.ndarray"],
+    page_ids: np.ndarray,  # [B, S] physical page per token
+    offsets: np.ndarray,  # [B, S] slot within page per token
+) -> dict[str, "jnp.ndarray"]:
+    """Gather per-token K/V (and scales) back out of their pages.
+
+    The exact inverse of ``write_tokens``: returns arrays in the
+    heads-major dense-cache layout [L, B, Hkv, S, *]. Used to materialize
+    a cached prefix's KV into a fresh admission's dense prefill cache
+    (engine/scheduler.py) so only the suffix runs through the model.
+    """
+    import jax.numpy as jnp
+
+    B, S = np.asarray(page_ids).shape
+    pid = jnp.asarray(page_ids).reshape(-1)  # [B*S]
+    off = jnp.asarray(offsets).reshape(-1)
+
+    def gather(x):
+        # x[l, pid[n], :, off[n]] → [B*S, L, H, *] (token axis in front,
+        # same advanced-indexing rule write_tokens relies on), then back
+        # to the cache layout [L, B, H, S, *].
+        g = x[:, pid, :, off]
+        L, H = x.shape[0], x.shape[2]
+        return jnp.transpose(
+            g.reshape(B, S, L, H, x.shape[-1]), (2, 0, 3, 1, 4)
+        )
+
+    return {k: gather(v) for k, v in pool.items()}
 
 
 def token_positions_to_pages(

@@ -23,6 +23,14 @@ Model-id grammar (query params configure behavior):
 The round number is recovered from the round template's "Debate round {N}"
 header (prompts.REVIEW_PROMPT_TEMPLATE), the same information a real opponent
 sees.
+
+Prefix-cache parity: every chat request is routed through the SAME
+``PageAllocator`` + ``PrefixCache`` machinery the TPU scheduler uses
+(engine/prefix_cache.py) — the mock "tokenizer" chunks the prompt text
+into fixed-width pieces, so hit-rates and tokens-saved are deterministic
+on CPU and tier-1 tests can pin them without a TPU. There is no device
+pool here: the cache tracks accounting only, and ``Usage.cached_tokens``
+/ the process-wide stats reflect what a real engine would have skipped.
 """
 
 from __future__ import annotations
@@ -34,6 +42,15 @@ from adversarial_spec_tpu.debate.usage import Usage
 from adversarial_spec_tpu.engine.types import ChatRequest, Completion, SamplingParams
 
 _ROUND_RE = re.compile(r"Debate round (\d+)")
+
+# Mock prefix-cache geometry. A "token" is _TOKEN_CHARS characters of
+# system+user text (matching _estimate_tokens' 4-chars-per-token rule, so
+# cached_tokens is on the same scale as input_tokens); a page is
+# _PAGE_TOKENS tokens — fine enough that a grown spec's unchanged head
+# mostly re-hits, coarse enough to keep the radix index small.
+_TOKEN_CHARS = 4
+_PAGE_TOKENS = 16
+_POOL_PAGES = 8192
 
 _CRITIQUES = [
     "The error-handling section does not define behavior when the backing "
@@ -63,11 +80,69 @@ class MockEngine:
         # Per-model-id call counter, for flaky/fail-N behaviors. Mutated
         # only from the (single-threaded) debate core.
         self._calls: dict[str, int] = {}
+        # Prefix-cache accounting (lazy: only when the cache is enabled).
+        self._allocator = None
+        self._prefix = None
+        self._seq = 0
 
     def validate(self, model: str) -> str | None:
         if not model.startswith("mock://"):
             return f"not a mock model id: {model}"
         return None
+
+    def _account_prefix(self, req: ChatRequest) -> int:
+        """Run this request's prompt through the real allocator + prefix
+        cache (accounting only — no KV exists here) and return the token
+        count served from cache. Counts prefilled/saved tokens into the
+        process-wide stats either way, so cache-on/off runs compare."""
+        from adversarial_spec_tpu.engine import prefix_cache as prefix_mod
+
+        text = req.system + "\x1f" + req.user
+        tokens = [
+            text[i : i + _TOKEN_CHARS]
+            for i in range(0, len(text), _TOKEN_CHARS)
+        ]
+        if not prefix_mod.config().enabled:
+            prefix_mod.stats.record_prefill(len(tokens), 0)
+            return 0
+        if self._prefix is None:
+            from adversarial_spec_tpu.engine.kvcache import PageAllocator
+
+            self._allocator = PageAllocator(_POOL_PAGES, _PAGE_TOKENS)
+            self._prefix = prefix_mod.PrefixCache(
+                self._allocator,
+                max_pages=prefix_mod.config().max_pages,
+            )
+        # The cap is per-round CLI config; follow it on a live cache.
+        self._prefix.max_pages = prefix_mod.config().max_pages
+        alloc, cache = self._allocator, self._prefix
+        matched, pages = cache.lookup(tokens)
+        seq = self._seq
+        self._seq += 1
+        alloc.new_sequence(seq)
+        try:
+            from adversarial_spec_tpu.engine.kvcache import OutOfPages
+
+            if matched:
+                alloc.adopt(seq, pages, matched)
+            try:
+                cache.extend_evicting(seq, len(tokens) - matched)
+            except OutOfPages:
+                # Genuinely full even with an empty cache: account a
+                # full prefill (a real engine would still serve the
+                # request; only the reuse bookkeeping is skipped).
+                prefix_mod.stats.record_prefill(len(tokens), 0)
+                return 0
+            n_full = len(tokens) // _PAGE_TOKENS
+            if n_full:
+                cache.insert(
+                    tokens[: n_full * _PAGE_TOKENS],
+                    alloc.table(seq)[:n_full],
+                )
+        finally:
+            alloc.free_sequence(seq)
+        prefix_mod.stats.record_prefill(len(tokens) - matched, matched)
+        return matched
 
     def chat(
         self, requests: list[ChatRequest], params: SamplingParams
@@ -85,6 +160,7 @@ class MockEngine:
         round_num = int(m.group(1)) if m else 1
 
         if behavior == "tasks":
+            cached = self._account_prefix(req)
             text = (
                 "[TASK]\ntitle: Define data model\ndescription: Schema and "
                 "migrations for the core entities.\npriority: critical\n"
@@ -100,9 +176,14 @@ class MockEngine:
             return Completion(
                 text=text,
                 usage=Usage(
-                    input_tokens=_estimate_tokens(req.user),
+                    # system + user, like the critic branch: the prefix
+                    # accounting covers both, and cached_tokens must
+                    # stay a subset of input_tokens.
+                    input_tokens=_estimate_tokens(req.system)
+                    + _estimate_tokens(req.user),
                     output_tokens=out_tokens,
                     decode_tokens=out_tokens,
+                    cached_tokens=cached,
                 ),
             )
         if behavior == "error":
@@ -119,6 +200,7 @@ class MockEngine:
             behavior = "critic"
 
         agree_after = int(opts.get("agree_after", "0"))
+        cached = self._account_prefix(req)
         if behavior == "agree" or (agree_after and round_num >= agree_after):
             text = "[AGREE]\nNo remaining objections; the document is ready."
         else:
@@ -136,6 +218,7 @@ class MockEngine:
             output_tokens=out_tokens,
             decode_tokens=out_tokens,
             decode_time_s=out_tokens / tps if tps > 0 else 0.0,
+            cached_tokens=cached,
         )
         return Completion(text=text, usage=usage)
 

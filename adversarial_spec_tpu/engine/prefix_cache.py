@@ -1,0 +1,299 @@
+"""Content-addressed cross-round prefix KV cache (host-side index).
+
+The debate loop's dominant compute is redundant prefill: every round all
+N opponents re-prefill the same spec+transcript prefix, and round R+1
+re-prefills everything round R already computed (the transcript only
+grows). This module is the host-side half of the fix — the device half
+is the ref-counted page pool in engine/kvcache.py:
+
+- Token streams are split into page-size-aligned BLOCKS and indexed in a
+  radix trie keyed by exact block content (a block's identity is the
+  chain ``(parent block, its tokens)``, i.e. a content-addressed chain
+  hash realized through Python's dict hashing with full-content
+  verification — no collision risk).
+- Each cached block points at the physical page holding its KV. The
+  cache holds one allocator reference per cached page; live sequences
+  that adopt a prefix hold their own. Pages free only at refcount zero.
+- ``lookup`` returns the longest cached prefix (whole blocks only);
+  ``insert`` registers a finished admission's full blocks; ``evict_pages``
+  drops least-recently-used LEAF blocks whose page no live sequence
+  references — middle blocks are never evicted, keeping every cached
+  chain contiguous.
+
+Sharing is safe without copies because blocks are immutable once full
+and every writer's positions lie strictly past its adopted prefix
+(copy-on-write degenerates to copy-on-append for an append-only
+transcript). A faulted slot merely drops its references; it can never
+scribble into a shared page.
+
+Process-wide config + stats live here too (the resilience/faults
+pattern): the CLI arms them per round (``--prefix-cache``,
+``--prefix-cache-pages``) and snapshots them into ``perf.prefix_cache``.
+This module deliberately imports neither jax nor the device pool — the
+mock engine uses it for deterministic CPU accounting.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+from adversarial_spec_tpu.engine.kvcache import OutOfPages, PageAllocator
+
+
+@dataclass
+class PrefixCacheConfig:
+    """Process-wide knobs, set once per CLI round (or by tests)."""
+
+    enabled: bool = True
+    # Max pages the cache itself may hold references to; 0 = bounded only
+    # by the pool (eviction then happens on allocation pressure alone).
+    max_pages: int = 0
+
+
+@dataclass
+class PrefixCacheStats:
+    """Process-wide counters, aggregated across every cache instance
+    (mock engine, each ContinuousBatcher, generate's shared-prefix
+    prefill). ``reset`` zeroes in place so engines holding a reference
+    keep counting into the same object."""
+
+    lookups: int = 0
+    hits: int = 0
+    misses: int = 0
+    cached_tokens: int = 0  # tokens matched by lookups
+    prefilled_tokens: int = 0  # tokens actually run through prefill
+    saved_tokens: int = 0  # forward tokens skipped thanks to reuse
+    inserted_blocks: int = 0
+    evicted_blocks: int = 0
+    evicted_pages: int = 0
+
+    def record_lookup(self, matched_tokens: int) -> None:
+        self.lookups += 1
+        if matched_tokens > 0:
+            self.hits += 1
+            self.cached_tokens += matched_tokens
+        else:
+            self.misses += 1
+
+    def record_prefill(self, computed_tokens: int, saved_tokens: int) -> None:
+        self.prefilled_tokens += computed_tokens
+        self.saved_tokens += saved_tokens
+
+    def reset(self) -> None:
+        for f in self.__dataclass_fields__:
+            setattr(self, f, 0)
+
+    def snapshot(self) -> dict:
+        out = {f: getattr(self, f) for f in self.__dataclass_fields__}
+        out["hit_rate"] = round(self.hits / self.lookups, 4) if self.lookups else 0.0
+        return out
+
+
+_config = PrefixCacheConfig(
+    enabled=os.environ.get("ADVSPEC_PREFIX_CACHE", "1") != "0"
+)
+stats = PrefixCacheStats()
+
+
+def config() -> PrefixCacheConfig:
+    return _config
+
+
+def configure(
+    enabled: bool | None = None, max_pages: int | None = None
+) -> PrefixCacheConfig:
+    if enabled is not None:
+        _config.enabled = bool(enabled)
+    if max_pages is not None:
+        _config.max_pages = int(max_pages)
+    return _config
+
+
+def reset_stats() -> None:
+    stats.reset()
+
+
+def snapshot() -> dict:
+    """Stats + config, the ``perf.prefix_cache`` payload."""
+    out = stats.snapshot()
+    out["enabled"] = _config.enabled
+    return out
+
+
+@dataclass
+class _Block:
+    """One cached page-size block of tokens; a radix-trie node."""
+
+    tokens: tuple
+    page: int
+    parent: "_Block | None"
+    children: dict = field(default_factory=dict)
+    last_used: int = 0
+
+
+class PrefixCache:
+    """Radix index of cached token blocks over one ``PageAllocator``.
+
+    All methods are O(blocks touched); the cache is host-side bookkeeping
+    only — page CONTENT lives wherever the caller keeps it (the device
+    pool for real engines, nowhere for the mock engine's accounting).
+    """
+
+    def __init__(
+        self,
+        allocator: PageAllocator,
+        page_size: int | None = None,
+        *,
+        max_pages: int = 0,
+        stats: PrefixCacheStats | None = None,
+    ):
+        self.allocator = allocator
+        self.page_size = page_size or allocator.page_size
+        self.max_pages = max_pages
+        self.stats = stats if stats is not None else globals()["stats"]
+        self._root: dict[tuple, _Block] = {}
+        self._by_page: dict[int, _Block] = {}
+        self._clock = 0
+
+    @property
+    def cached_pages(self) -> int:
+        return len(self._by_page)
+
+    def _blocks(self, tokens) -> list[tuple]:
+        ps = self.page_size
+        n = len(tokens) // ps
+        return [tuple(tokens[i * ps : (i + 1) * ps]) for i in range(n)]
+
+    def lookup(self, tokens, record: bool = True) -> tuple[int, list[int]]:
+        """Longest cached prefix of ``tokens``: (matched token count —
+        always a page multiple — and the pages backing it, in order).
+
+        ``record=False`` skips the stats (a caller that may DEFER the
+        admission — scheduler pool-full retries — records once, with the
+        actually-adopted count, when the admission really starts)."""
+        self._clock += 1
+        pages: list[int] = []
+        children = self._root
+        for key in self._blocks(tokens):
+            node = children.get(key)
+            if node is None:
+                break
+            node.last_used = self._clock
+            pages.append(node.page)
+            children = node.children
+        matched = len(pages) * self.page_size
+        if record:
+            self.stats.record_lookup(matched)
+        return matched, pages
+
+    def insert(self, tokens, pages: list[int]) -> int:
+        """Register the full blocks of ``tokens``; ``pages[i]`` is the
+        allocator page holding block i's KV. Blocks already cached keep
+        their existing page (first writer wins — content is identical by
+        construction). Returns the number of newly cached blocks."""
+        self._clock += 1
+        blocks = self._blocks(tokens)
+        if len(pages) < len(blocks):
+            blocks = blocks[: len(pages)]
+        added = 0
+        children = self._root
+        parent: _Block | None = None
+        for key, page in zip(blocks, pages):
+            node = children.get(key)
+            if node is None:
+                node = _Block(tokens=key, page=page, parent=parent)
+                self.allocator.cache_ref(page)
+                self._by_page[page] = node
+                children[key] = node
+                added += 1
+            node.last_used = self._clock
+            parent = node
+            children = node.children
+        self.stats.inserted_blocks += added
+        if self.max_pages > 0 and self.cached_pages > self.max_pages:
+            self._evict(self.cached_pages - self.max_pages, shared_ok=True)
+        return added
+
+    def _leaves(self) -> list[_Block]:
+        return [b for b in self._by_page.values() if not b.children]
+
+    def _drop(self, block: _Block) -> bool:
+        """Remove one leaf block from the index and release the cache's
+        page reference. Returns True if the page actually freed (no live
+        sequence was sharing it)."""
+        siblings = (
+            block.parent.children if block.parent is not None else self._root
+        )
+        del siblings[block.tokens]
+        del self._by_page[block.page]
+        freed = self.allocator.refcount(block.page) == 1
+        self.allocator.cache_unref(block.page)
+        self.stats.evicted_blocks += 1
+        if freed:
+            self.stats.evicted_pages += 1
+        return freed
+
+    def _evict(self, n_pages: int, shared_ok: bool) -> int:
+        """Evict LRU leaves until ``n_pages`` pages were released.
+        ``shared_ok=False`` (allocation pressure) only counts — and only
+        touches — blocks whose page frees immediately; ``shared_ok=True``
+        (cap enforcement) also drops blocks still referenced by live
+        sequences (their pages free later, when the sequence does).
+
+        One LRU-sorted pass per wave: dropping a leaf can turn its
+        parent into a leaf, so waves repeat only while the target is
+        short AND the previous wave made progress — O(blocks log blocks)
+        per wave instead of a full rescan per released page."""
+        released = 0
+        while released < n_pages:
+            wave = sorted(
+                (
+                    b
+                    for b in self._leaves()
+                    if shared_ok or self.allocator.refcount(b.page) == 1
+                ),
+                key=lambda b: b.last_used,
+            )
+            if not wave:
+                break
+            for victim in wave:
+                if released >= n_pages:
+                    break
+                if victim.children:  # no longer a leaf is impossible;
+                    continue  # defensive against future reentrancy
+                if self._drop(victim) or shared_ok:
+                    released += 1
+        return released
+
+    def evict_pages(self, n_pages: int) -> int:
+        """Free ≥ ``n_pages`` pages back to the allocator if possible
+        (called when an admission would otherwise hit OutOfPages).
+        Returns how many pages were actually freed."""
+        if n_pages <= 0:
+            return 0
+        return self._evict(n_pages, shared_ok=False)
+
+    def extend_evicting(self, seq_id: int, n_tokens: int) -> None:
+        """``allocator.extend`` with allocation pressure converted into
+        LRU eviction of unreferenced cached blocks: reclaim exactly the
+        shortfall and retry once, so the cache can never crowd out a
+        live admission. The one reclaim policy both real engines and the
+        mock's accounting share. Raises OutOfPages if the pool is full
+        even with every cold block evicted."""
+        try:
+            self.allocator.extend(seq_id, n_tokens)
+        except OutOfPages:
+            need = (
+                self.allocator.pages_needed(seq_id, n_tokens)
+                - self.allocator.free_pages
+            )
+            if self.evict_pages(need) < need:
+                raise
+            self.allocator.extend(seq_id, n_tokens)
+
+    def clear(self) -> None:
+        """Drop every cached block (releasing all cache references)."""
+        while self._by_page:
+            for b in self._leaves():
+                self._drop(b)

@@ -46,11 +46,13 @@ from adversarial_spec_tpu.engine.generate import (
     pad_batch,
     prefill_chunk,
 )
+from adversarial_spec_tpu.engine import prefix_cache as prefix_mod
 from adversarial_spec_tpu.engine.kvcache import (
     OutOfPages,
     PageAllocator,
     PagedCacheLayout,
     init_page_pool,
+    read_tokens,
     write_tokens,
 )
 from adversarial_spec_tpu.engine.sampling import sample_tokens
@@ -79,7 +81,18 @@ class SchedRequest:
 class _Admission:
     """An in-flight admission: its prompt prefills one chunk per scheduler
     iteration (interleaved with resident rows' decode chunks) instead of
-    stalling decode for the whole prompt."""
+    stalling decode for the whole prompt.
+
+    Two coordinate systems coexist (per admission, chosen at start):
+
+    - padded (prefix cache off): tokens left-padded to the bucket, the
+      original layout; KV slot = pad + logical position.
+    - canonical (prefix cache on): tokens at slot = logical position,
+      pad 0, right-padded to the bucket. The canonical layout is what
+      makes page content layout-independent and therefore shareable: a
+      token's K/V depends only on its logical position, so a block
+      cached by one admission drops into any later one.
+    """
 
     slot: int
     req: SchedRequest
@@ -88,8 +101,18 @@ class _Admission:
     pads: object  # [1]
     cache: object  # 1-row dense cache being prefilled
     pos: int  # next chunk start
-    S: int
+    S: int  # bucketed token-array length
     last_logits: object = None
+    # Canonical-layout (prefix cache) bookkeeping:
+    canonical: bool = False
+    S_real: int = 0  # true prompt length (== S when padded)
+    matched: int = 0  # tokens adopted from the cache (page multiple)
+    prefill_end: int = 0  # prefill covers [pos0, prefill_end)
+    prefill_s: float = 0.0  # this request's own prefill wall-clock
+
+    @property
+    def remaining(self) -> int:
+        return self.prefill_end - self.pos
 
 
 @dataclass
@@ -102,6 +125,25 @@ class SchedResult:
     # resilience-taxonomy value (resilience/faults.py). None = clean.
     error: str | None = None
     fault_kind: str | None = None
+    # Per-request perf split: prompt tokens served from the prefix cache
+    # and the wall-clock this request's own admission prefill took (the
+    # decode share is apportioned by the caller — engine/tpu.py).
+    cached_tokens: int = 0
+    prefill_time_s: float = 0.0
+
+
+def _next_chunk_len(remaining: int) -> int:
+    """Largest power-of-two chunk ≤ min(remaining, ADMISSION_CHUNK).
+
+    Keeps compiled prefill-chunk shapes to a small fixed set (powers of
+    two up to ADMISSION_CHUNK) while letting the canonical path start at
+    an arbitrary page-aligned offset — cache granularity stays one PAGE,
+    not one admission chunk.
+    """
+    c = ADMISSION_CHUNK
+    while c > remaining:
+        c //= 2
+    return max(c, 1)
 
 
 @partial(
@@ -359,6 +401,7 @@ class ContinuousBatcher:
         seed: int = 0,
         chunk: int = 32,
         kv_dtype: str = "",
+        prefix_cache: bool | None = None,
     ):
         self.params = params
         self.cfg = cfg
@@ -380,6 +423,21 @@ class ContinuousBatcher:
         n_pages = -(-capacity_tokens // page_size)
         # Physical page 0 is the trash page; allocator ids shift +1.
         self.allocator = PageAllocator(n_pages, page_size)
+        # Cross-round prefix KV cache over this pool (None = disabled).
+        # The batcher OWNS the cache: its lifetime is the pool's, so a
+        # batcher kept alive across rounds (engine/tpu.py) carries round
+        # R's spec+transcript blocks into round R+1's admissions.
+        if prefix_cache is None:
+            prefix_cache = prefix_mod.config().enabled
+        self.prefix_cache = (
+            prefix_mod.PrefixCache(
+                self.allocator,
+                page_size,
+                max_pages=prefix_mod.config().max_pages,
+            )
+            if prefix_cache
+            else None
+        )
         layout = PagedCacheLayout(
             n_pages=n_pages + 1,
             page_size=page_size,
@@ -409,6 +467,9 @@ class ContinuousBatcher:
 
         self._slot_req: list[SchedRequest | None] = [None] * B
         self._slot_seq: list[int | None] = [None] * B
+        # Per-slot request telemetry, stamped at admission handoff.
+        self._slot_cached: list[int] = [0] * B
+        self._slot_prefill_s: list[float] = [0.0] * B
         self._admission: _Admission | None = None
         self._seq_counter = 0
         self.capacity_tokens = n_pages * page_size
@@ -425,6 +486,30 @@ class ContinuousBatcher:
         # the number the chunked-prefill interleave work will shrink).
         self.prefill_time_s = 0.0
         self.decode_time_s = 0.0
+
+    def reconfigure_sampling(
+        self,
+        *,
+        greedy: bool | None = None,
+        temperature: float | None = None,
+        top_k: int | None = None,
+        top_p: float | None = None,
+        seed: int | None = None,
+    ) -> None:
+        """Retune sampling between rounds on a REUSED batcher (the pool,
+        allocator, and prefix cache survive; only sampling state moves).
+        Pass ``seed`` to reseed the PRNG stream for the new round."""
+        if greedy is not None:
+            self.greedy = greedy
+        if top_k is not None:
+            self.top_k = top_k
+        if temperature is not None:
+            self._temp = jnp.float32(temperature)
+        if top_p is not None:
+            self._top_p = jnp.float32(top_p)
+            self._use_top_p = float(top_p) < 1.0
+        if seed is not None:
+            self._key = jax.random.key(seed)
 
     # -- admission ---------------------------------------------------------
 
@@ -460,6 +545,8 @@ class ContinuousBatcher:
         allocator state rolled back; ``_admit`` isolates it to this
         request."""
         injector.fire("kv_alloc", slot)
+        if self.prefix_cache is not None:
+            return self._start_admission_cached(slot, req)
         tokens_np, pads_np = pad_batch([req.prompt_ids], pad_id=0)
         S = tokens_np.shape[1]
         total = S + req.max_new_tokens
@@ -478,6 +565,8 @@ class ContinuousBatcher:
                 ),
                 pos=0,
                 S=S,
+                S_real=S,
+                prefill_end=S,
             )
         except OutOfPages:
             self.allocator.free_sequence(seq_id)
@@ -488,6 +577,89 @@ class ContinuousBatcher:
         self._seq_counter += 1
         return True
 
+    def _extend_evicting(self, seq_id: int, n_tokens: int) -> None:
+        """``allocator.extend`` that converts allocation pressure into
+        prefix-cache LRU eviction before giving up (the shared reclaim
+        policy lives on PrefixCache — one implementation for the
+        scheduler and the mock engine's accounting alike)."""
+        if self.prefix_cache is None:
+            self.allocator.extend(seq_id, n_tokens)
+        else:
+            self.prefix_cache.extend_evicting(seq_id, n_tokens)
+
+    def _start_admission_cached(self, slot: int, req: SchedRequest) -> bool:
+        """Prefix-cache admission: adopt the longest cached prefix and
+        set up a CANONICAL-layout (pad 0, slot == logical position)
+        prefill of only the remainder.
+
+        The token array is right-padded to the usual power-of-two bucket
+        (compiled shapes unchanged) but prefill only covers
+        [matched, page_ceil(S_real)) — the bucket's garbage tail is never
+        computed or attended (forward's causal mask stops at
+        cache_index). The last prompt token is always re-run even on a
+        full-prefix hit: its logits seed sampling.
+        """
+        ids = req.prompt_ids
+        S_real = len(ids)
+        ps = self.page_size
+        # record=False: a pool-full deferral retries this whole method
+        # every scheduler iteration — stats count once, on success, with
+        # the clamped (actually adopted) match.
+        matched, pages = self.prefix_cache.lookup(ids, record=False)
+        # Keep at least the last token to prefill (logits source).
+        matched = min(matched, ((S_real - 1) // ps) * ps)
+        pages = pages[: matched // ps]
+        S = bucket_length(S_real)
+        prefill_end = min(-(-S_real // ps) * ps, S)
+        tokens_np = np.zeros((1, S), np.int32)
+        tokens_np[0, :S_real] = np.asarray(ids, np.int32)
+        seq_id = self._seq_counter
+        self.allocator.new_sequence(seq_id)
+        try:
+            if matched:
+                self.allocator.adopt(seq_id, pages, matched)
+            self._extend_evicting(
+                seq_id, (S_real - matched) + req.max_new_tokens
+            )
+            cache = init_cache(
+                self.cfg, 1, S, dtype=self._dtype, kv_dtype=self.kv_dtype
+            )
+            if matched:
+                # Materialize the adopted prefix KV into the dense
+                # admission cache so the delta's attention sees it.
+                table = np.asarray(pages, np.int32) + 1  # physical ids
+                slots = np.arange(matched, dtype=np.int32)[None, :]
+                gathered = read_tokens(
+                    self.pool, table[slots // ps], slots % ps
+                )
+                for k in cache:
+                    cache[k] = (
+                        cache[k].at[:, :, :, :matched, :].set(gathered[k])
+                    )
+            self._admission = _Admission(
+                slot=slot,
+                req=req,
+                seq_id=seq_id,
+                tokens=jnp.asarray(tokens_np),
+                pads=jnp.zeros((1,), jnp.int32),
+                cache=cache,
+                pos=matched,
+                S=S,
+                canonical=True,
+                S_real=S_real,
+                matched=matched,
+                prefill_end=prefill_end,
+            )
+        except OutOfPages:
+            self.allocator.free_sequence(seq_id)
+            return False
+        except Exception:
+            self.allocator.free_sequence(seq_id)
+            raise
+        self._seq_counter += 1
+        self.prefix_cache.stats.record_lookup(matched)
+        return True
+
     def _advance_admission(self) -> None:
         """One prefill chunk of the in-flight admission. Resident rows'
         decode chunks run between calls — admission no longer pauses the
@@ -496,7 +668,7 @@ class ContinuousBatcher:
 
         adm = self._admission
         t0 = time.monotonic()
-        chunk_len = min(adm.S, ADMISSION_CHUNK)
+        chunk_len = _next_chunk_len(adm.remaining)
         adm.cache, adm.last_logits = prefill_chunk(
             self.params,
             self.cfg,
@@ -510,8 +682,11 @@ class ContinuousBatcher:
         # chunk's device time into the NEXT decode chunk's blocked wait,
         # billing resident rows for the newcomer's prefill.
         jax.block_until_ready(adm.last_logits)
-        self.prefill_time_s += time.monotonic() - t0
-        if adm.pos >= adm.S:
+        elapsed = time.monotonic() - t0
+        self.prefill_time_s += elapsed
+        adm.prefill_s += elapsed
+        prefix_mod.stats.record_prefill(chunk_len, 0)
+        if adm.pos >= adm.prefill_end:
             self._finish_admission()
 
     def _finish_admission(self) -> None:
@@ -531,17 +706,37 @@ class ContinuousBatcher:
         cache, last_logits = adm.cache, adm.last_logits
         pads_np = np.asarray(adm.pads)
         table = np.asarray(self.allocator.table(seq_id), np.int32) + 1
-        slots = np.arange(S, dtype=np.int32)[None, :]
+        if adm.canonical:
+            if adm.prefill_end > adm.S_real:
+                # The final chunk's last slot is bucket garbage; re-run
+                # the last REAL token (identical KV rewrite — same token,
+                # position, and visible prefix) purely for its logits.
+                cache, last_logits = prefill_chunk(
+                    self.params,
+                    self.cfg,
+                    adm.tokens[:, adm.S_real - 1 : adm.S_real],
+                    adm.pads,
+                    cache,
+                    jnp.int32(adm.S_real - 1),
+                )
+            # Scatter only the delta: slots [matched, S_real). Adopted
+            # prefix pages already hold [0, matched) and must never be
+            # rewritten (shared, copy-on-append discipline).
+            scat = np.arange(adm.matched, adm.S_real, dtype=np.int32)
+        else:
+            scat = np.arange(S, dtype=np.int32)
+        slots = scat[None, :]
         page_ids = table[slots // self.page_size]
         offsets = slots % self.page_size
+        lo, hi = int(scat[0]), int(scat[-1]) + 1
         self.pool = write_tokens(
             self.pool,
-            cache["k"],
-            cache["v"],
+            cache["k"][..., lo:hi, :],
+            cache["v"][..., lo:hi, :],
             page_ids,
             offsets,
-            ks_new=cache.get("ks"),
-            vs_new=cache.get("vs"),
+            ks_new=cache["ks"][..., lo:hi, :] if "ks" in cache else None,
+            vs_new=cache["vs"][..., lo:hi, :] if "ks" in cache else None,
         )
 
         self._key, sub = jax.random.split(self._key)
@@ -559,8 +754,14 @@ class ContinuousBatcher:
         row_table[: len(table)] = table
         self.page_table = self.page_table.at[slot].set(jnp.asarray(row_table))
         self.cur_tok = self.cur_tok.at[slot].set(first)
-        self.cur_len = self.cur_len.at[slot].set(S + 1)
-        self.pad_lens = self.pad_lens.at[slot].set(int(pads_np[0]))
+        # Canonical rows live at pad 0 with their true length; padded
+        # rows keep the bucketed length + left pad. Per-row pad_lens and
+        # cur_len let both layouts coexist in one decode batch.
+        row_len = adm.S_real if adm.canonical else S
+        self.cur_len = self.cur_len.at[slot].set(row_len + 1)
+        self.pad_lens = self.pad_lens.at[slot].set(
+            0 if adm.canonical else int(pads_np[0])
+        )
         self.out_buf = self.out_buf.at[slot].set(0)
         self.out_buf = self.out_buf.at[slot, 0].set(first)
         first_is_eos = bool(np.isin(np.asarray(first), self._eos_np))
@@ -569,12 +770,25 @@ class ContinuousBatcher:
         self.active = self.active.at[slot].set(
             (req.max_new_tokens > 1) and not first_is_eos
         )
+        if adm.canonical and self.prefix_cache is not None:
+            # Cache this prompt's full blocks (the already-adopted prefix
+            # re-inserts as a no-op; only new tail blocks take refs).
+            n_full = adm.S_real // self.page_size
+            if n_full:
+                self.prefix_cache.insert(
+                    list(req.prompt_ids[: n_full * self.page_size]),
+                    self.allocator.table(seq_id)[:n_full],
+                )
+            prefix_mod.stats.record_prefill(0, adm.matched)
         # Ownership handoff: from here the slot (not the admission)
         # accounts for the sequence.
         self._admission = None
         self._slot_req[slot] = req
         self._slot_seq[slot] = seq_id
-        self.prefill_time_s += time.monotonic() - t0
+        self._slot_cached[slot] = adm.matched
+        elapsed = time.monotonic() - t0
+        self.prefill_time_s += elapsed
+        self._slot_prefill_s[slot] = adm.prefill_s + elapsed
         if not self.active[slot]:
             self._finish_slot(slot)
 
@@ -602,11 +816,18 @@ class ContinuousBatcher:
                     # (FIFO) until residents free pages.
                     return
                 self.queue.pop(0)
-                if self._admission.S <= ADMISSION_CHUNK:
-                    try:
-                        self._advance_admission()  # completes in one chunk
-                    except Exception as e:
-                        self._abort_admission(e)
+                try:
+                    # Short prefills (≤ one ADMISSION_CHUNK of work left —
+                    # possibly several sub-chunk pieces on the canonical
+                    # path) admit to completion immediately.
+                    while (
+                        self._admission is not None
+                        and self._admission.slot == slot
+                        and self._admission.remaining <= ADMISSION_CHUNK
+                    ):
+                        self._advance_admission()
+                except Exception as e:
+                    self._abort_admission(e)
 
     # -- fault containment -------------------------------------------------
 
@@ -617,6 +838,8 @@ class ContinuousBatcher:
         seam: str,
         tokens: np.ndarray | None = None,
         n: int = 0,
+        cached_tokens: int = 0,
+        prefill_time_s: float = 0.0,
     ) -> None:
         """Resolve one faulted request: requeue once if the fault is
         transient (OOM/device-loss/preemption/timeout) and this req_id
@@ -638,6 +861,8 @@ class ContinuousBatcher:
                 n_generated=n,
                 error=f"{type(exc).__name__}: {exc}",
                 fault_kind=kind.value,
+                cached_tokens=cached_tokens,
+                prefill_time_s=prefill_time_s,
             )
         )
 
@@ -652,7 +877,13 @@ class ContinuousBatcher:
             # to unwind here, so don't mask the original fault.
             raise exc
         self.allocator.free_sequence(adm.seq_id)
-        self._fault_request(adm.req, exc, "admission")
+        self._fault_request(
+            adm.req,
+            exc,
+            "admission",
+            cached_tokens=adm.matched,
+            prefill_time_s=adm.prefill_s,
+        )
 
     def _handle_decode_fault(self, exc: BaseException) -> None:
         """A decode chunk faulted: evict ONE slot, keep the rest.
@@ -685,12 +916,23 @@ class ContinuousBatcher:
         req = self._slot_req[slot]
         n = int(self.n_emitted[slot])
         partial = np.asarray(self.out_buf[slot, :n])
+        # Eviction only drops this slot's REFERENCES: pages shared with
+        # the prefix cache (or other admissions) survive untouched — a
+        # faulted slot can never invalidate co-residents' prefix blocks.
         self.allocator.free_sequence(self._slot_seq[slot])
         self._slot_req[slot] = None
         self._slot_seq[slot] = None
         self.active = self.active.at[slot].set(False)
         self.page_table = self.page_table.at[slot].set(0)
-        self._fault_request(req, exc, "scheduler_chunk", tokens=partial, n=n)
+        self._fault_request(
+            req,
+            exc,
+            "scheduler_chunk",
+            tokens=partial,
+            n=n,
+            cached_tokens=self._slot_cached[slot],
+            prefill_time_s=self._slot_prefill_s[slot],
+        )
 
     # -- completion --------------------------------------------------------
 
@@ -699,7 +941,13 @@ class ContinuousBatcher:
         n = int(self.n_emitted[slot])
         row = np.asarray(self.out_buf[slot, :n])
         self.results.append(
-            SchedResult(req_id=req.req_id, tokens=row, n_generated=n)
+            SchedResult(
+                req_id=req.req_id,
+                tokens=row,
+                n_generated=n,
+                cached_tokens=self._slot_cached[slot],
+                prefill_time_s=self._slot_prefill_s[slot],
+            )
         )
         self.allocator.free_sequence(self._slot_seq[slot])
         self._slot_req[slot] = None
@@ -800,4 +1048,9 @@ class ContinuousBatcher:
                 finally:
                     self.decode_time_s += time.monotonic() - t_dec
             self._collect()
-        return sorted(self.results, key=lambda r: r.req_id)
+        out = sorted(self.results, key=lambda r: r.req_id)
+        # Drain per-run state: a batcher kept alive across rounds (the
+        # prefix cache's raison d'être) must not replay old results.
+        self.results = []
+        self._retried.clear()
+        return out

@@ -129,6 +129,13 @@ class LoadedModel:
     last_used: float = 0.0
     bytes_per_chip: int = 0
     prefetched: bool = False  # loaded ahead of use by _maybe_prefetch
+    # Persistent ContinuousBatcher (paged single-device serving): kept
+    # alive ACROSS chat calls so its page pool + prefix cache carry one
+    # round's spec/transcript KV into the next round's admissions —
+    # the cross-round half of the prefix cache. Rebuilt when the shape
+    # key (slots, capacity, budget, kv dtype, cache enablement) changes.
+    batcher: object = None
+    batcher_key: tuple | None = None
 
 
 class TpuEngine:
@@ -151,6 +158,9 @@ class TpuEngine:
         self._loading: dict[str, int] = {}
         self._pinned: set[str] = set()  # never evicted (mid-decode)
         self.prefetch_hits = 0  # prefetched loads actually consumed
+        # decode_time_s watermark of the batcher drained by the most
+        # recent _run_batcher call (per-round delta on a reused batcher).
+        self._decode_t0 = 0.0
 
     def _committed_bytes_locked(self) -> int:
         """Resident + materializing bytes. Caller holds self._lock."""
@@ -623,6 +633,9 @@ class TpuEngine:
                         device_time_s=prefill_share + decode_share,
                         decode_tokens=n,
                         decode_time_s=decode_share,
+                        # Batch prefill is shared work; an even split is
+                        # the honest per-row attribution.
+                        prefill_time_s=result.prefill_time_s / len(batch),
                     ),
                 )
             )
@@ -638,10 +651,6 @@ class TpuEngine:
         jit constant).
         """
         from adversarial_spec_tpu.engine.generate import bucket_length
-        from adversarial_spec_tpu.engine.scheduler import (
-            ContinuousBatcher,
-            SchedRequest,
-        )
 
         import os
 
@@ -672,52 +681,48 @@ class TpuEngine:
         while capacity < need:
             capacity *= 2
 
+        from adversarial_spec_tpu.engine import prefix_cache as prefix_mod
+
+        seed = (
+            params.seed
+            if params.seed is not None
+            # seed=None means fresh entropy (as generate() does) —
+            # pinning 0 would make every unseeded round sample
+            # identically.
+            else int.from_bytes(os.urandom(4), "little")
+        )
+        batcher_key = (
+            n_slots,
+            capacity,
+            params.max_new_tokens,
+            lm.spec.kv_dtype,
+            prefix_mod.config().enabled,
+            prefix_mod.config().max_pages,
+        )
         t0 = time.monotonic()
-        with lm.mesh:
-            batcher = ContinuousBatcher(
-                lm.params,
-                lm.cfg,
-                max_batch=n_slots,
-                capacity_tokens=capacity,
-                max_new_cap=params.max_new_tokens,
-                eos_ids=list(tok.eos_ids),
-                greedy=params.greedy,
-                temperature=params.temperature,
-                top_k=params.top_k,
-                top_p=params.top_p,
-                # seed=None means fresh entropy (as generate() does) —
-                # pinning 0 would make every unseeded round sample
-                # identically.
-                seed=(
-                    params.seed
-                    if params.seed is not None
-                    else int.from_bytes(os.urandom(4), "little")
-                ),
-                # Same KV precision on both serving paths: the
-                # round-synchronous fallback passes spec.kv_dtype to
-                # generate(); the batcher must honor it too (int8
-                # pages + scale pages).
-                kv_dtype=lm.spec.kv_dtype,
-            )
-            for i, ids in enumerate(prompts):
-                batcher.submit(
-                    SchedRequest(
-                        req_id=i,
-                        prompt_ids=ids,
-                        max_new_tokens=params.max_new_tokens,
-                    )
-                )
-            results = batcher.run_all(timeout_s=params.timeout_s)
+        try:
+            results = self._run_batcher(lm, batcher_key, prompts, params, seed)
+        except BaseException:
+            # An escaping exception (decode fault whose donated-state
+            # probe failed, submit validation mid-loop, timeout plumbing)
+            # leaves the batcher mid-drain: stale results, occupied
+            # slots, leaked sequences. Reusing it next round would
+            # replay that corruption — drop it; the next call rebuilds.
+            lm.batcher = None
+            lm.batcher_key = None
+            raise
         total_time = time.monotonic() - t0
+        batcher = lm.batcher
+        decode_time = batcher.decode_time_s - self._decode_t0
 
         # Same attribution scheme as the dense path: decode time splits
         # by decoded tokens, the prefill/overhead remainder evenly.
         tok_total = float(sum(r.n_generated for r in results)) or 1.0
-        overhead = total_time - batcher.decode_time_s
+        overhead = total_time - decode_time
         completions = []
         for r in results:  # sorted by req_id == prompt order
             frac = r.n_generated / tok_total
-            decode_share = batcher.decode_time_s * frac
+            decode_share = decode_time * frac
             completions.append(
                 Completion(
                     # Fault-evicted rows keep their partial decode in
@@ -735,7 +740,67 @@ class TpuEngine:
                         device_time_s=overhead / len(results) + decode_share,
                         decode_tokens=r.n_generated,
                         decode_time_s=decode_share,
+                        cached_tokens=r.cached_tokens,
+                        prefill_time_s=r.prefill_time_s,
                     ),
                 )
             )
         return completions
+
+    def _run_batcher(self, lm, batcher_key, prompts, params, seed):
+        """Acquire (reuse or build) the model's persistent batcher and
+        drain this call's requests through it."""
+        from adversarial_spec_tpu.engine.scheduler import (
+            ContinuousBatcher,
+            SchedRequest,
+        )
+
+        tok = lm.tokenizer
+        n_slots, capacity = batcher_key[0], batcher_key[1]
+        with lm.mesh:
+            if lm.batcher is not None and lm.batcher_key == batcher_key:
+                # Round R+1 reuses round R's batcher: same compiled chunk
+                # programs AND a warm prefix cache (the shared
+                # spec+transcript prefix admits as a page-table adopt +
+                # delta prefill instead of a full re-prefill).
+                batcher = lm.batcher
+                batcher.reconfigure_sampling(
+                    greedy=params.greedy,
+                    temperature=params.temperature,
+                    top_k=params.top_k,
+                    top_p=params.top_p,
+                    seed=seed,
+                )
+            else:
+                batcher = ContinuousBatcher(
+                    lm.params,
+                    lm.cfg,
+                    max_batch=n_slots,
+                    capacity_tokens=capacity,
+                    max_new_cap=params.max_new_tokens,
+                    eos_ids=list(tok.eos_ids),
+                    greedy=params.greedy,
+                    temperature=params.temperature,
+                    top_k=params.top_k,
+                    top_p=params.top_p,
+                    seed=seed,
+                    # Same KV precision on both serving paths: the
+                    # round-synchronous fallback passes spec.kv_dtype to
+                    # generate(); the batcher must honor it too (int8
+                    # pages + scale pages).
+                    kv_dtype=lm.spec.kv_dtype,
+                )
+                lm.batcher = batcher
+                lm.batcher_key = batcher_key
+            # Per-round telemetry deltas: the persistent batcher's
+            # counters accumulate across rounds.
+            self._decode_t0 = batcher.decode_time_s
+            for i, ids in enumerate(prompts):
+                batcher.submit(
+                    SchedRequest(
+                        req_id=i,
+                        prompt_ids=ids,
+                        max_new_tokens=params.max_new_tokens,
+                    )
+                )
+            return batcher.run_all(timeout_s=params.timeout_s)

@@ -26,6 +26,10 @@ Modes:
   python bench.py --long-context  # 16k-token prefill bench (one JSON line)
   python bench.py --round-loop    # BASELINE config 4 shape: 5 rounds,
                                   # growing spec, 4 opponents (one line)
+  python bench.py --mode prefix   # prefix-KV-cache micro-bench: 3 rounds
+                                  # of a growing spec through the
+                                  # continuous batcher, cache on vs off;
+                                  # also writes BENCH_prefix.json
 """
 
 from __future__ import annotations
@@ -329,6 +333,105 @@ def _run_round_loop(platform: str) -> dict:
     }
 
 
+def _run_prefix(platform: str) -> dict:
+    """Prefix-KV-cache micro-bench: 3 debate-shaped rounds (2 opponents
+    sharing one growing spec) through the ContinuousBatcher, greedy, with
+    the prefix cache ON vs OFF. Reports per-round prefill tokens, the
+    hit rate, tokens saved, decode tok/s both ways, and whether the two
+    configurations produced identical tokens (they must)."""
+    from adversarial_spec_tpu.utils.jaxenv import configure_jax
+
+    configure_jax()
+    import random
+
+    import jax
+    import jax.numpy as jnp
+
+    from adversarial_spec_tpu.engine import prefix_cache as prefix_mod
+    from adversarial_spec_tpu.engine.scheduler import (
+        ContinuousBatcher,
+        SchedRequest,
+    )
+    from adversarial_spec_tpu.models import transformer as T
+    from adversarial_spec_tpu.models.config import get_config
+
+    size = "1b" if platform != "cpu" else "tiny"
+    cfg = get_config("llama", size)
+    params = T.init_params(
+        jax.random.key(0),
+        cfg,
+        dtype=jnp.bfloat16 if platform != "cpu" else jnp.float32,
+    )
+    n_rounds, n_opp = 3, 2
+    base_len, delta_len, max_new = (
+        (1024, 256, 64) if platform != "cpu" else (512, 64, 16)
+    )
+
+    def run(enabled):
+        prefix_mod.configure(enabled=enabled)
+        prefix_mod.reset_stats()
+        rng = random.Random(1)
+        spec = [rng.randrange(3, cfg.vocab_size) for _ in range(base_len)]
+        b = ContinuousBatcher(
+            params,
+            cfg,
+            max_batch=n_opp,
+            max_new_cap=max_new,
+            page_size=64,
+            capacity_tokens=1 << 15,
+            greedy=True,
+            prefix_cache=enabled,
+        )
+        per_round, toks = [], []
+        decode_tokens = 0
+        t0 = time.monotonic()
+        for _ in range(n_rounds):
+            before = prefix_mod.stats.prefilled_tokens
+            for i in range(n_opp):
+                b.submit(
+                    SchedRequest(
+                        req_id=i,
+                        prompt_ids=list(spec),
+                        max_new_tokens=max_new,
+                    )
+                )
+            results = b.run_all()
+            toks.append([r.tokens.tolist() for r in results])
+            decode_tokens += sum(r.n_generated for r in results)
+            per_round.append(prefix_mod.stats.prefilled_tokens - before)
+            spec = spec + [
+                rng.randrange(3, cfg.vocab_size) for _ in range(delta_len)
+            ]
+        wall = time.monotonic() - t0
+        return per_round, toks, wall, decode_tokens, prefix_mod.snapshot()
+
+    off_rounds, off_toks, off_wall, off_dec, _ = run(False)
+    on_rounds, on_toks, on_wall, on_dec, on_snap = run(True)
+    tail_saving = 1.0 - (sum(on_rounds[1:]) / max(sum(off_rounds[1:]), 1))
+    payload = {
+        "metric": "prefix_cache_tail_prefill_saving",
+        "value": round(tail_saving, 4),
+        "unit": "fraction of rounds-2+ prefill tokens avoided",
+        "vs_baseline": None,  # no published prefix-cache baseline yet
+        "platform": platform,
+        "model": f"llama-{size}",
+        "rounds": n_rounds,
+        "opponents": n_opp,
+        "spec_tokens_start": base_len,
+        "spec_tokens_delta_per_round": delta_len,
+        "prefill_tokens_per_round_cache_on": on_rounds,
+        "prefill_tokens_per_round_cache_off": off_rounds,
+        "hit_rate": on_snap["hit_rate"],
+        "cached_tokens": on_snap["cached_tokens"],
+        "saved_tokens": on_snap["saved_tokens"],
+        "tokens_identical": on_toks == off_toks,
+        "wall_s_cache_on": round(on_wall, 3),
+        "wall_s_cache_off": round(off_wall, 3),
+        "decode_tokens": on_dec,
+    }
+    return payload
+
+
 def _run_cpu_fallback(runner, note: str | None = None) -> dict:
     os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
@@ -421,10 +524,16 @@ def _run_tpu_in_child(mode_flag: str, timeout_s: float) -> dict | None:
 
 def main() -> int:
     args = sys.argv[1:]
+    prefix_mode = "--prefix" in args or (
+        "--mode" in args
+        and args[args.index("--mode") + 1 :][:1] == ["prefix"]
+    )
     if "--long-context" in args:
         mode_flag, runner = "--long-context", _run_long_context
     elif "--round-loop" in args:
         mode_flag, runner = "--round-loop", _run_round_loop
+    elif prefix_mode:
+        mode_flag, runner = "--prefix", _run_prefix
     else:
         mode_flag, runner = "", _run_bench
 
@@ -454,6 +563,14 @@ def main() -> int:
                     "(tunnel hang or compile error); CPU fallback"
                 ),
             )
+    if prefix_mode:
+        # Persist the perf trajectory point alongside the BENCH_r*
+        # series the driver records.
+        out = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_prefix.json"
+        )
+        with open(out, "w") as f:
+            json.dump(payload, f, indent=2)
     print(json.dumps(payload))
     return 0
 

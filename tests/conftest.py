@@ -75,8 +75,17 @@ def _isolate_state(tmp_path, monkeypatch):
     faults.reset()
     injector.reset()
     dispatch.clear_engine_cache()
+    # Prefix-cache config/stats are process-global by design (the cache
+    # outlives a round); tests must not leak a --no-prefix-cache or a
+    # page cap into each other.
+    from adversarial_spec_tpu.engine import prefix_cache
+
+    prefix_cache.configure(enabled=True, max_pages=0)
+    prefix_cache.reset_stats()
     yield
     dispatch.clear_engine_cache()
     breaker.reset_default_registry()
+    prefix_cache.configure(enabled=True, max_pages=0)
+    prefix_cache.reset_stats()
     faults.reset()
     injector.reset()

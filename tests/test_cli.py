@@ -805,7 +805,8 @@ class TestMutationHardening:
         assert data["preserve_intent"] is False
         assert set(data["results"][0]) == {
             "model", "agreed", "response", "spec", "error",
-            "input_tokens", "output_tokens", "cost",
+            "input_tokens", "output_tokens", "cached_tokens",
+            "prefill_time_s", "decode_time_s", "cost",
         }
 
     def test_providers_json_schema(self, monkeypatch, capsys):

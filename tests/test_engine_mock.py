@@ -82,9 +82,11 @@ class TestBuildRequest:
         cfg = RoundConfig(press=True)
         req = build_request("m", SPEC, 2, cfg)
         assert "PRESS ROUND" in req.user
-        assert PRESS_PROMPT_TEMPLATE.splitlines()[0].startswith(
-            "Debate round"
-        )
+        # Prefix-stable layout: the round-varying header trails the
+        # document so cross-round prefix caching can hit.
+        assert PRESS_PROMPT_TEMPLATE.index(
+            "--- END DOCUMENT ---"
+        ) < PRESS_PROMPT_TEMPLATE.index("Debate round")
 
     def test_round_number_embedded(self):
         req = _req("m", round_num=7)
@@ -291,7 +293,10 @@ class TestTypesMutationHardening:
                 "input_tokens": 1,
                 "output_tokens": 2,
                 "total_tokens": 3,
+                "cached_tokens": 0,
                 "device_time_s": 0.0,
+                "prefill_time_s": 0.0,
+                "decode_time_s": 0.0,
             },
             "latency_s": 0.123,
         }

@@ -99,12 +99,21 @@ class TestMutationHardening:
     def test_to_dict_schema_and_rounding(self):
         """Kills to_dict key mutants and the round(_, 4) digit mutant —
         the dict is the per-model block of the --json cost report."""
-        u = Usage(input_tokens=3, output_tokens=5, device_time_s=0.123456)
+        u = Usage(
+            input_tokens=3,
+            output_tokens=5,
+            device_time_s=0.123456,
+            cached_tokens=2,
+            prefill_time_s=0.05,
+        )
         assert u.to_dict() == {
             "input_tokens": 3,
             "output_tokens": 5,
             "total_tokens": 8,
+            "cached_tokens": 2,
             "device_time_s": 0.1235,
+            "prefill_time_s": 0.05,
+            "decode_time_s": 0.0,
         }
 
     def test_report_device_time_rounding(self):
