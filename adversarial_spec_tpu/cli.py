@@ -187,6 +187,22 @@ def create_parser() -> argparse.ArgumentParser:
         help="Cap on KV pages the prefix cache may retain "
         "(0 = bounded only by the pool, evicting LRU under pressure)",
     )
+    d.add_argument(
+        "--interleave",
+        action=argparse.BooleanOptionalAction,
+        default=None,  # None = inherit ADVSPEC_INTERLEAVE (default on)
+        help="Fused prefill+decode steps and the two-deep pipelined "
+        "scheduler drive loop (default on; --no-interleave restores "
+        "the legacy serialized loop, ADVSPEC_INTERLEAVE=0 sets the "
+        "process default)",
+    )
+    d.add_argument(
+        "--pipeline-depth",
+        type=int,
+        default=None,
+        help="Scheduler steps kept in flight (1-2; default 2; 1 = fused "
+        "but synchronous)",
+    )
 
     z = parser.add_argument_group("resilience")
     z.add_argument(
@@ -389,12 +405,27 @@ def _configure_prefix_cache(args: argparse.Namespace):
     return prefix_cache
 
 
+def _configure_interleave(args: argparse.Namespace):
+    """Arm the fused/pipelined drive loop from flags; returns the module
+    for reporting. Stats reset per invocation (one invocation = one
+    round) so ``perf.interleave`` accounts exactly this round's steps;
+    the batcher itself persists on the engine across rounds."""
+    from adversarial_spec_tpu.engine import interleave
+
+    interleave.configure(
+        enabled=args.interleave, pipeline_depth=args.pipeline_depth
+    )
+    interleave.reset_stats()
+    return interleave
+
+
 def run_critique(args: argparse.Namespace) -> int:
     from adversarial_spec_tpu.utils.tracing import Tracer, maybe_profile
 
     tracer = Tracer()
     breakers = _configure_resilience(args)
     prefix_cache = _configure_prefix_cache(args)
+    interleave = _configure_interleave(args)
     spec, session_state = load_or_resume_session(args)
     if session_state is not None and session_state.breakers:
         # One CLI invocation = one round: open circuits from earlier
@@ -456,6 +487,10 @@ def run_critique(args: argparse.Namespace) -> int:
         "breakers": breakers.states(),
     }
     perf["prefix_cache"] = prefix_snap
+    # Fused-step / pipeline telemetry: how much admission prefill hid
+    # under resident decode vs genuinely stalled the batch (their sum IS
+    # the round's prefill_time_s), plus step/sync counts.
+    perf["interleave"] = interleave.snapshot()
     _err(
         f"perf: round {perf['spans'].get('round', 0):.2f}s, "
         f"decode {perf['decode_tokens_per_sec']} tok/s"
@@ -609,6 +644,7 @@ def handle_export_tasks(args: argparse.Namespace) -> int:
     EXPORT_TASKS_PROMPT, low temperature, ``extract_tasks``, ``--json``.
     """
     _configure_prefix_cache(args)
+    _configure_interleave(args)
     spec = _read_spec_stdin()
     models = parse_models(args)
     errors = validate_models_before_run(models[:1])

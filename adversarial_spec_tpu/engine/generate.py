@@ -131,8 +131,7 @@ def _chunk_bound(start_step, chunk, stop_at, max_new):
     return jnp.minimum(jnp.minimum(start_step + chunk, stop_at), max_new)
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnames=("cache",))
-def prefill_chunk(
+def _prefill_chunk_impl(
     params: Params,
     cfg: ModelConfig,
     tokens: jnp.ndarray,  # [B, Sc] one left-padded prompt chunk
@@ -146,6 +145,13 @@ def prefill_chunk(
     sequence of fixed-size chunks: activation memory is O(chunk·dim)
     instead of O(S·dim), and every chunk reuses one compiled program.
     Returns (cache, last-position logits [B, vocab]).
+
+    ``prefill_chunk`` is this body jitted (with cache donation); it is
+    also inlined — alongside the decode-chunk body — into the
+    scheduler's fused prefill+decode program
+    (engine/scheduler.py:fused_prefill_decode_chunk), so the admission
+    prompt math exists exactly once whether it runs standalone or rides
+    a fused step.
     """
     B, Sc = tokens.shape
     T = cache["k"].shape[3]  # [L, B, Hkv, T, D]
@@ -166,6 +172,13 @@ def prefill_chunk(
         lm_head_last_only=True,
     )
     return cache, logits[:, -1]
+
+
+# The public jitted entry point — the same body, not a hand-forwarded
+# wrapper (see scheduler_decode_chunk for the rationale).
+prefill_chunk = partial(
+    jax.jit, static_argnames=("cfg",), donate_argnames=("cache",)
+)(_prefill_chunk_impl)
 
 
 @partial(

@@ -1,0 +1,141 @@
+"""Fused-step / pipelined-drive-loop config and telemetry (host side).
+
+The ContinuousBatcher's drive loop (engine/scheduler.py) can run in two
+modes:
+
+- **fused + pipelined** (default): each iteration issues ONE device
+  program that advances the in-flight admission's prompt chunk AND every
+  resident row's decode chunk together (Sarathi-style piggybacked
+  chunked prefill), and the host keeps up to two steps in flight —
+  inspecting step N-1's fetched ``active`` flags while step N runs, so
+  queue admission, prefix-cache lookups, page allocation, and result
+  collection all overlap device compute. The host syncs only at
+  admission handoff, slot completion, and fault/timeout decision points.
+- **legacy** (``--no-interleave`` / ``ADVSPEC_INTERLEAVE=0``): the
+  original serialized loop — prompt chunk, full host sync, decode chunk,
+  full host sync — kept as the escape hatch and the bench baseline.
+
+This module is the process-wide switchboard for that choice plus the
+telemetry both engines (TPU scheduler and the mock's deterministic CPU
+accounting) record into, à la ``resilience.faults`` / ``prefix_cache``:
+
+- ``stalled_prefill_s``: admission prefill wall-clock the batch actually
+  waited on (standalone chunks with nothing to overlap, and the
+  admission-handoff scatter+sample).
+- ``overlapped_prefill_s``: prefill wall-clock attributed to chunks that
+  rode inside a fused step — decode was running anyway, so this time was
+  hidden under it.
+
+``prefill_time_s`` is BY CONSTRUCTION the sum of the two buckets (the
+snapshot computes it), so ``stalled + overlapped == prefill`` holds
+exactly — the invariant tier-1 pins on the mock engine's deterministic
+numbers. Deliberately imports no jax: the mock engine uses it on CPU.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+# The drive loop keeps at most this many device steps in flight. Depth 1
+# degenerates to "fused but synchronous" (fetch each step right after
+# dispatch); depth 2 is the double buffer — deeper would only delay
+# fault/EOS detection by more chunks for no extra overlap.
+MAX_PIPELINE_DEPTH = 2
+
+
+@dataclass
+class InterleaveConfig:
+    """Process-wide knobs, set once per CLI round (or by tests)."""
+
+    enabled: bool = True
+    pipeline_depth: int = MAX_PIPELINE_DEPTH
+
+
+@dataclass
+class InterleaveStats:
+    """Process-wide counters, aggregated across every batcher (and the
+    mock engine's accounting). ``reset`` zeroes in place so engines
+    holding a reference keep counting into the same object."""
+
+    fused_steps: int = 0  # dispatches carrying prefill AND decode
+    decode_steps: int = 0  # decode-only dispatches
+    prefill_steps: int = 0  # standalone (stalled) prefill chunks
+    sync_points: int = 0  # sanctioned host syncs (handoff/fault/timeout)
+    stalled_prefill_s: float = 0.0
+    overlapped_prefill_s: float = 0.0
+
+    def record_step(self, *, fused: bool, prefill_only: bool = False) -> None:
+        if fused:
+            self.fused_steps += 1
+        elif prefill_only:
+            self.prefill_steps += 1
+        else:
+            self.decode_steps += 1
+
+    def record_prefill_time(self, seconds: float, *, overlapped: bool) -> None:
+        if overlapped:
+            self.overlapped_prefill_s += seconds
+        else:
+            self.stalled_prefill_s += seconds
+
+    def record_sync(self) -> None:
+        self.sync_points += 1
+
+    def reset(self) -> None:
+        for f in self.__dataclass_fields__:
+            setattr(self, f, type(getattr(self, f))())
+
+    def snapshot(self) -> dict:
+        out = {f: getattr(self, f) for f in self.__dataclass_fields__}
+        # The invariant the telemetry promises: total prefill time IS
+        # the two buckets — there is no third place prefill time can
+        # hide. Computed here (NOT rounded: rounding the addends would
+        # break the exact ``stalled + overlapped == prefill`` pin).
+        out["prefill_time_s"] = (
+            self.stalled_prefill_s + self.overlapped_prefill_s
+        )
+        return out
+
+
+def _depth_from_env() -> int:
+    try:
+        d = int(os.environ.get("ADVSPEC_PIPELINE_DEPTH", MAX_PIPELINE_DEPTH))
+    except ValueError:
+        d = MAX_PIPELINE_DEPTH
+    return max(1, min(d, MAX_PIPELINE_DEPTH))
+
+
+_config = InterleaveConfig(
+    enabled=os.environ.get("ADVSPEC_INTERLEAVE", "1") != "0",
+    pipeline_depth=_depth_from_env(),
+)
+stats = InterleaveStats()
+
+
+def config() -> InterleaveConfig:
+    return _config
+
+
+def configure(
+    enabled: bool | None = None, pipeline_depth: int | None = None
+) -> InterleaveConfig:
+    if enabled is not None:
+        _config.enabled = bool(enabled)
+    if pipeline_depth is not None:
+        _config.pipeline_depth = max(
+            1, min(int(pipeline_depth), MAX_PIPELINE_DEPTH)
+        )
+    return _config
+
+
+def reset_stats() -> None:
+    stats.reset()
+
+
+def snapshot() -> dict:
+    """Stats + config, the ``perf.interleave`` payload."""
+    out = stats.snapshot()
+    out["enabled"] = _config.enabled
+    out["pipeline_depth"] = _config.pipeline_depth
+    return out

@@ -31,6 +31,14 @@ into fixed-width pieces, so hit-rates and tokens-saved are deterministic
 on CPU and tier-1 tests can pin them without a TPU. There is no device
 pool here: the cache tracks accounting only, and ``Usage.cached_tokens``
 / the process-wide stats reflect what a real engine would have skipped.
+
+Interleave parity works the same way (engine/interleave.py): the first
+request of a ``chat`` batch prefills with nothing resident to overlap
+(stalled), every later request's prefill rides the residents' decode
+(overlapped, when the fused loop is enabled). Synthetic seconds are
+``tokens / 1024`` — exact binary fractions, so the stalled + overlapped
+== prefill invariant the CLI's ``perf.interleave`` block promises is
+pinnable with ``==`` on CPU.
 """
 
 from __future__ import annotations
@@ -90,7 +98,24 @@ class MockEngine:
             return f"not a mock model id: {model}"
         return None
 
-    def _account_prefix(self, req: ChatRequest) -> int:
+    @staticmethod
+    def _account_interleave(n_tokens: int, overlapped: bool) -> None:
+        """Deterministic CPU mirror of the scheduler's fused-step
+        telemetry: this request's prefill either stalled the (synthetic)
+        batch or rode an earlier resident's decode. Synthetic seconds
+        are tokens/1024 — exact in float, so perf.interleave's
+        ``stalled + overlapped == prefill`` invariant pins with ==."""
+        from adversarial_spec_tpu.engine import interleave as interleave_mod
+
+        overlapped = overlapped and interleave_mod.config().enabled
+        interleave_mod.stats.record_prefill_time(
+            n_tokens / 1024.0, overlapped=overlapped
+        )
+        interleave_mod.stats.record_step(
+            fused=overlapped, prefill_only=not overlapped
+        )
+
+    def _account_prefix(self, req: ChatRequest, overlapped: bool = False) -> int:
         """Run this request's prompt through the real allocator + prefix
         cache (accounting only — no KV exists here) and return the token
         count served from cache. Counts prefilled/saved tokens into the
@@ -104,6 +129,7 @@ class MockEngine:
         ]
         if not prefix_mod.config().enabled:
             prefix_mod.stats.record_prefill(len(tokens), 0)
+            self._account_interleave(len(tokens), overlapped)
             return 0
         if self._prefix is None:
             from adversarial_spec_tpu.engine.kvcache import PageAllocator
@@ -132,6 +158,7 @@ class MockEngine:
                 # full prefill (a real engine would still serve the
                 # request; only the reuse bookkeeping is skipped).
                 prefix_mod.stats.record_prefill(len(tokens), 0)
+                self._account_interleave(len(tokens), overlapped)
                 return 0
             n_full = len(tokens) // _PAGE_TOKENS
             if n_full:
@@ -142,14 +169,27 @@ class MockEngine:
         finally:
             alloc.free_sequence(seq)
         prefix_mod.stats.record_prefill(len(tokens) - matched, matched)
+        self._account_interleave(len(tokens) - matched, overlapped)
         return matched
 
     def chat(
         self, requests: list[ChatRequest], params: SamplingParams
     ) -> list[Completion]:
-        return [self._one(req, params) for req in requests]
+        # Request 0 prefills into an empty batch (stalled); every later
+        # request's prefill would ride the residents' decode in the
+        # fused scheduler loop (overlapped) — the deterministic CPU
+        # analog of admit-while-decoding.
+        return [
+            self._one(req, params, overlapped=i > 0)
+            for i, req in enumerate(requests)
+        ]
 
-    def _one(self, req: ChatRequest, params: SamplingParams) -> Completion:
+    def _one(
+        self,
+        req: ChatRequest,
+        params: SamplingParams,
+        overlapped: bool = False,
+    ) -> Completion:
         parsed = urlparse(req.model)
         behavior = parsed.netloc or parsed.path.lstrip("/")
         opts = {k: v[-1] for k, v in parse_qs(parsed.query).items()}
@@ -160,7 +200,7 @@ class MockEngine:
         round_num = int(m.group(1)) if m else 1
 
         if behavior == "tasks":
-            cached = self._account_prefix(req)
+            cached = self._account_prefix(req, overlapped)
             text = (
                 "[TASK]\ntitle: Define data model\ndescription: Schema and "
                 "migrations for the core entities.\npriority: critical\n"
@@ -200,7 +240,7 @@ class MockEngine:
             behavior = "critic"
 
         agree_after = int(opts.get("agree_after", "0"))
-        cached = self._account_prefix(req)
+        cached = self._account_prefix(req, overlapped)
         if behavior == "agree" or (agree_after and round_num >= agree_after):
             text = "[AGREE]\nNo remaining objections; the document is ready."
         else:

@@ -6,11 +6,26 @@ while sequences of different lengths join and leave it —
 
 - ``max_batch`` slots decode together as rows of one jitted program;
 - a finished row's pages free immediately and a queued request is admitted
-  into the empty slot at the next chunk boundary (its prompt prefills into
-  its own pages while the others wait one admission pause);
+  into the empty slot at the next chunk boundary — its prompt chunks ride
+  INSIDE the residents' decode program (``fused_prefill_decode_chunk``,
+  Sarathi-style piggybacked chunked prefill), so admission never pauses
+  the batch;
 - per-row lengths/budgets/EOS are tracked as device arrays, so rows at
   different positions coexist in the same while_loop (per-row ``q_pos``
   drives page writes, RoPE positions, and window bounds).
+
+Drive loop (engine/interleave.py holds the config + telemetry): the
+default loop keeps up to two fused steps in flight and never calls a
+blanket ``jax.block_until_ready`` — the host applies step N-1's fetched
+``active`` flags (async device→host copy) while step N runs, overlapping
+queue admission, prefix-cache radix lookups, page allocation, and result
+collection with device compute. Sanctioned sync points, and ONLY these
+(enforced by tools/astlint.py's sync-point rule): admission handoff
+(``_finish_admission``), slot completion (token fetch), fault decisions,
+and timeout expiry. ``interleave=False`` (CLI ``--no-interleave``,
+``ADVSPEC_INTERLEAVE=0``) restores the legacy serialized loop — one
+prefill dispatch, full sync, one decode dispatch, full sync — as the
+escape hatch and bench baseline.
 
 Inactive-slot safety: physical page 0 is a reserved TRASH page no
 sequence owns. Allocator ids are shifted +1, the -1 "unmapped" sentinel
@@ -42,10 +57,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from adversarial_spec_tpu.engine.generate import (
+    _prefill_chunk_impl,
     bucket_length,
     pad_batch,
     prefill_chunk,
 )
+from adversarial_spec_tpu.engine import interleave as interleave_mod
 from adversarial_spec_tpu.engine import prefix_cache as prefix_mod
 from adversarial_spec_tpu.engine.kvcache import (
     OutOfPages,
@@ -109,6 +126,12 @@ class _Admission:
     matched: int = 0  # tokens adopted from the cache (page multiple)
     prefill_end: int = 0  # prefill covers [pos0, prefill_end)
     prefill_s: float = 0.0  # this request's own prefill wall-clock
+    # Set when a fused dispatch carrying this admission faulted: the
+    # next chunk runs STANDALONE so a prefill-side error is attributed
+    # to the admission (_abort_admission) instead of evicting another
+    # resident every iteration; a decode-side fault already evicted its
+    # slot, and fusion resumes after one clean standalone chunk.
+    fuse_deferred: bool = False
 
     @property
     def remaining(self) -> int:
@@ -146,21 +169,7 @@ def _next_chunk_len(remaining: int) -> int:
     return max(c, 1)
 
 
-@partial(
-    jax.jit,
-    static_argnames=(
-        "cfg",
-        "chunk",
-        "greedy",
-        "top_k",
-        "use_top_p",
-        "use_pallas",
-        "pallas_interpret",
-        "mesh",
-    ),
-    donate_argnames=("pool", "out_buf"),
-)
-def scheduler_decode_chunk(
+def _decode_chunk_impl(
     params,
     cfg: ModelConfig,
     pool,
@@ -188,8 +197,12 @@ def scheduler_decode_chunk(
     """Up to ``chunk`` decode steps over whatever rows are active.
 
     This is THE paged decode loop — generate()'s round-synchronous paged
-    path calls it too (with uniform initial state), so the per-step
-    write-page lookup, bounds, and sampling glue exist exactly once.
+    path calls it too (with uniform initial state), and it is inlined
+    into ``fused_prefill_decode_chunk`` — so the per-step write-page
+    lookup, bounds, and sampling glue exist exactly once for the
+    standalone and fused programs alike. ``scheduler_decode_chunk`` is
+    this body jitted (with pool/out_buf donation); call the bare impl
+    only from inside another traced program.
     """
     B = cur_tok.shape[0]
     page_size = pool["k"].shape[3]
@@ -262,6 +275,126 @@ def scheduler_decode_chunk(
         cond, body, state
     )
     return pool, cur, cur_len, n_emitted, out_buf, active
+
+
+# The public jitted entry point — the same body, not a hand-forwarded
+# wrapper (a wrapper that forgot to thread a new kwarg would silently pin
+# its default on one path only and break fused/standalone token parity).
+scheduler_decode_chunk = partial(
+    jax.jit,
+    static_argnames=(
+        "cfg",
+        "chunk",
+        "greedy",
+        "top_k",
+        "use_top_p",
+        "use_pallas",
+        "pallas_interpret",
+        "mesh",
+    ),
+    donate_argnames=("pool", "out_buf"),
+)(_decode_chunk_impl)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "cfg",
+        "chunk",
+        "greedy",
+        "top_k",
+        "use_top_p",
+        "use_pallas",
+        "pallas_interpret",
+        "mesh",
+    ),
+    donate_argnames=("adm_cache", "pool", "out_buf"),
+)
+def fused_prefill_decode_chunk(
+    params,
+    cfg: ModelConfig,
+    adm_tokens: jnp.ndarray,  # [1, Sc] the admission's next prompt chunk
+    adm_pads: jnp.ndarray,  # [1]
+    adm_cache,  # 1-row dense cache being prefilled
+    adm_cache_index: jnp.ndarray,  # scalar: slot of the chunk's 1st token
+    pool,
+    page_table: jnp.ndarray,
+    cur_tok: jnp.ndarray,
+    cur_len: jnp.ndarray,
+    pad_lens: jnp.ndarray,
+    n_emitted: jnp.ndarray,
+    max_new: jnp.ndarray,
+    active: jnp.ndarray,
+    out_buf: jnp.ndarray,
+    eos_ids: jnp.ndarray,
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    top_p: jnp.ndarray,
+    *,
+    chunk: int,
+    greedy: bool,
+    top_k: int,
+    use_top_p: bool = True,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
+    mesh=None,
+):
+    """ONE device program per scheduler iteration: the in-flight
+    admission's prompt chunk AND every resident row's decode chunk
+    (Sarathi-style piggybacked chunked prefill).
+
+    The two halves touch disjoint state — the admission prefills into
+    its private 1-row dense cache while residents decode against the
+    paged pool (the admission's pages are only written at handoff, in
+    ``_finish_admission``) — so fusing them is pure overlap: the
+    newcomer's prompt math rides in the same dispatch instead of
+    stalling the batch behind a separate program + host sync, and XLA is
+    free to schedule the independent subgraphs together. Each half is
+    the SAME traced body as its standalone program
+    (``_prefill_chunk_impl`` / ``_decode_chunk_impl``), so greedy tokens
+    are byte-identical either way. On sharded meshes the decode half
+    carries the ``mesh`` down into ``forward_paged_decode`` exactly as
+    ``scheduler_decode_chunk`` does (the dp-sharded wrapper —
+    ``sharded_scheduler_decode_chunk`` — stays decode-only: admissions
+    are a single-device batcher concern today).
+    """
+    adm_cache, adm_logits = _prefill_chunk_impl(
+        params, cfg, adm_tokens, adm_pads, adm_cache, adm_cache_index
+    )
+    pool, cur, cur_len, n_emitted, out_buf, active = _decode_chunk_impl(
+        params,
+        cfg,
+        pool,
+        page_table,
+        cur_tok,
+        cur_len,
+        pad_lens,
+        n_emitted,
+        max_new,
+        active,
+        out_buf,
+        eos_ids,
+        key,
+        temperature,
+        top_p,
+        chunk=chunk,
+        greedy=greedy,
+        top_k=top_k,
+        use_top_p=use_top_p,
+        use_pallas=use_pallas,
+        pallas_interpret=pallas_interpret,
+        mesh=mesh,
+    )
+    return (
+        adm_cache,
+        adm_logits,
+        pool,
+        cur,
+        cur_len,
+        n_emitted,
+        out_buf,
+        active,
+    )
 
 
 def sharded_scheduler_decode_chunk(
@@ -402,6 +535,9 @@ class ContinuousBatcher:
         chunk: int = 32,
         kv_dtype: str = "",
         prefix_cache: bool | None = None,
+        interleave: bool | None = None,
+        pipeline_depth: int | None = None,
+        step_tokens: int = 0,
     ):
         self.params = params
         self.cfg = cfg
@@ -409,6 +545,28 @@ class ContinuousBatcher:
         self.page_size = page_size
         self.chunk = chunk
         self.kv_dtype = kv_dtype
+        # Fused-step + pipelined drive loop (None = process config,
+        # engine/interleave.py). ``step_tokens`` is the Sarathi-style
+        # shared per-step token budget: a fused step's prompt chunk
+        # shrinks so chunk_len + n_live·chunk stays under it. 0 = auto
+        # (ADMISSION_CHUNK + max_batch·chunk — full-size prompt chunks
+        # even with every slot decoding, i.e. legacy chunk sizes).
+        cfg_il = interleave_mod.config()
+        self.interleave = (
+            cfg_il.enabled if interleave is None else bool(interleave)
+        )
+        self.pipeline_depth = max(
+            1,
+            min(
+                cfg_il.pipeline_depth
+                if pipeline_depth is None
+                else int(pipeline_depth),
+                interleave_mod.MAX_PIPELINE_DEPTH,
+            ),
+        )
+        self.step_tokens = step_tokens or (
+            ADMISSION_CHUNK + max_batch * chunk
+        )
         self.greedy = greedy
         self.top_k = top_k
         self._temp = jnp.float32(temperature)
@@ -464,6 +622,18 @@ class ContinuousBatcher:
         self.max_new = jnp.zeros((B,), jnp.int32)
         self.active = jnp.zeros((B,), bool)
         self.out_buf = jnp.zeros((B, cap), jnp.int32)
+        # Host-trailing view of ``active``: the pipelined loop dispatches
+        # against this snapshot (updated at admission handoff, fault
+        # eviction, and step N-1's async fetch) instead of syncing on the
+        # in-flight device state. A stale True only costs one no-op
+        # dispatch whose while_loop exits immediately; fetches only ever
+        # DEACTIVATE slots, and only when the slot's OWNERSHIP GENERATION
+        # still matches the one recorded at dispatch — a slot freed and
+        # re-admitted while a step was in flight bumps the generation, so
+        # the old step's "this row finished" flag can never truncate the
+        # newcomer that now owns the slot.
+        self._active_np = np.zeros((B,), bool)
+        self._slot_gen = [0] * B
 
         self._slot_req: list[SchedRequest | None] = [None] * B
         self._slot_seq: list[int | None] = [None] * B
@@ -481,11 +651,32 @@ class ContinuousBatcher:
         self._retried: set[int] = set()
         # Wall-clock telemetry: admission prefills vs decode chunks.
         # decode_time_s feeds the engine's per-row usage attribution
-        # (engine/tpu.py:_chat_continuous); prefill_time_s is surfaced for
-        # perf diagnosis (how much of a round went to admission pauses —
-        # the number the chunked-prefill interleave work will shrink).
-        self.prefill_time_s = 0.0
+        # (engine/tpu.py:_chat_continuous). Prefill time is split into
+        # STALLED (the batch actually waited: standalone chunks with no
+        # residents to overlap, and the admission-handoff scatter) vs
+        # OVERLAPPED (the chunk rode inside a fused step while residents
+        # decoded — hidden under compute). ``prefill_time_s`` is their
+        # sum by construction; the same split feeds the process-wide
+        # ``perf.interleave`` stats (engine/interleave.py).
+        self.stalled_prefill_s = 0.0
+        self.overlapped_prefill_s = 0.0
         self.decode_time_s = 0.0
+
+    @property
+    def prefill_time_s(self) -> float:
+        """Total admission-prefill wall clock. Exactly the sum of the
+        stalled and overlapped buckets — there is no third place prefill
+        time can accumulate (the invariant ``perf.interleave`` pins)."""
+        return self.stalled_prefill_s + self.overlapped_prefill_s
+
+    def _record_prefill_time(self, seconds: float, *, overlapped: bool) -> None:
+        if overlapped:
+            self.overlapped_prefill_s += seconds
+        else:
+            self.stalled_prefill_s += seconds
+        interleave_mod.stats.record_prefill_time(
+            seconds, overlapped=overlapped
+        )
 
     def reconfigure_sampling(
         self,
@@ -661,9 +852,11 @@ class ContinuousBatcher:
         return True
 
     def _advance_admission(self) -> None:
-        """One prefill chunk of the in-flight admission. Resident rows'
-        decode chunks run between calls — admission no longer pauses the
-        batch for the whole prompt (the round-2 shortcut NOTES.md lists)."""
+        """One STANDALONE prefill chunk of the in-flight admission —
+        used when no resident row is decoding (nothing to fuse with) and
+        by the legacy serialized loop. The fused path dispatches through
+        ``_dispatch_fused`` instead, where the chunk rides the decode
+        program and its time lands in the OVERLAPPED bucket."""
         import time
 
         adm = self._admission
@@ -680,11 +873,14 @@ class ContinuousBatcher:
         adm.pos += chunk_len
         # Block before stamping: async dispatch would otherwise push this
         # chunk's device time into the NEXT decode chunk's blocked wait,
-        # billing resident rows for the newcomer's prefill.
+        # billing resident rows for the newcomer's prefill. A standalone
+        # chunk is a genuine stall, so this sync is sanctioned (astlint
+        # allowlists it).
         jax.block_until_ready(adm.last_logits)
         elapsed = time.monotonic() - t0
-        self.prefill_time_s += elapsed
+        self._record_prefill_time(elapsed, overlapped=False)
         adm.prefill_s += elapsed
+        interleave_mod.stats.record_step(fused=False, prefill_only=True)
         prefix_mod.stats.record_prefill(chunk_len, 0)
         if adm.pos >= adm.prefill_end:
             self._finish_admission()
@@ -764,12 +960,16 @@ class ContinuousBatcher:
         )
         self.out_buf = self.out_buf.at[slot].set(0)
         self.out_buf = self.out_buf.at[slot, 0].set(first)
+        # Admission handoff is a sanctioned sync point: ``first`` was
+        # fetched above, blocking on every step in flight.
+        interleave_mod.stats.record_sync()
         first_is_eos = bool(np.isin(np.asarray(first), self._eos_np))
         self.n_emitted = self.n_emitted.at[slot].set(1)
         self.max_new = self.max_new.at[slot].set(req.max_new_tokens)
-        self.active = self.active.at[slot].set(
-            (req.max_new_tokens > 1) and not first_is_eos
-        )
+        row_active = (req.max_new_tokens > 1) and not first_is_eos
+        self.active = self.active.at[slot].set(row_active)
+        self._active_np[slot] = row_active
+        self._slot_gen[slot] += 1  # new owner: expire in-flight flags
         if adm.canonical and self.prefix_cache is not None:
             # Cache this prompt's full blocks (the already-adopted prefix
             # re-inserts as a no-op; only new tail blocks take refs).
@@ -787,22 +987,32 @@ class ContinuousBatcher:
         self._slot_seq[slot] = seq_id
         self._slot_cached[slot] = adm.matched
         elapsed = time.monotonic() - t0
-        self.prefill_time_s += elapsed
+        # The handoff (pool scatter + first-token sample + sync) is time
+        # the batch genuinely waits on: stalled, in both loop modes.
+        self._record_prefill_time(elapsed, overlapped=False)
         self._slot_prefill_s[slot] = adm.prefill_s + elapsed
-        if not self.active[slot]:
+        if not row_active:
             self._finish_slot(slot)
 
     def _admit(self) -> None:
         """Fill free slots from the queue. Single-chunk (short) prompts
         admit to completion immediately so a burst of requests fills the
-        batch BEFORE the next decode chunk; the first MULTI-chunk prompt
-        stays in flight and its remaining chunks interleave with decode
-        (one chunked admission at a time)."""
-        active_np = np.asarray(self.active)
+        batch BEFORE the next decode chunk, and so a newcomer occupies
+        its slot within one scheduler iteration (slot-targeted fault
+        injection and eviction surgery rely on that timing). The stall
+        this costs is bounded by ONE admission chunk — the common
+        cross-round case is a prefix-cache-hit delta far under it. The
+        first MULTI-chunk prompt stays in flight and its remaining
+        chunks ride the residents' fused steps (one chunked admission at
+        a time)."""
+        # Host bookkeeping only — no device sync: a slot without an
+        # owner is never live (_finish_slot / fault eviction / timeout
+        # all clear the trailing view before releasing the slot), so the
+        # pipelined loop can admit while a step is still in flight.
         for slot in range(self.B):
             if self._admission is not None or not self.queue:
                 return
-            if self._slot_req[slot] is None and not active_np[slot]:
+            if self._slot_req[slot] is None and not self._active_np[slot]:
                 try:
                     started = self._start_admission(slot, self.queue[0])
                 except Exception as e:
@@ -923,6 +1133,8 @@ class ContinuousBatcher:
         self._slot_req[slot] = None
         self._slot_seq[slot] = None
         self.active = self.active.at[slot].set(False)
+        self._active_np[slot] = False
+        interleave_mod.stats.record_sync()  # fault decision point
         self.page_table = self.page_table.at[slot].set(0)
         self._fault_request(
             req,
@@ -937,6 +1149,11 @@ class ContinuousBatcher:
     # -- completion --------------------------------------------------------
 
     def _finish_slot(self, slot: int) -> None:
+        # Slot completion is a sanctioned sync point: the token fetch
+        # below blocks on the step in flight (the row itself is frozen —
+        # its values read identically from any later state).
+        interleave_mod.stats.record_sync()
+        self._active_np[slot] = False  # invariant: no owner ⇒ not live
         req = self._slot_req[slot]
         n = int(self.n_emitted[slot])
         row = np.asarray(self.out_buf[slot, :n])
@@ -952,8 +1169,15 @@ class ContinuousBatcher:
         self.allocator.free_sequence(self._slot_seq[slot])
         self._slot_req[slot] = None
 
-    def _collect(self) -> None:
-        active_np = np.asarray(self.active)
+    def _collect(self, active_np: np.ndarray | None = None) -> None:
+        """Resolve finished slots. The legacy loop passes nothing (full
+        device sync); the pipelined loop passes its trailing host
+        snapshot so collection never blocks on the step in flight — a
+        row inactive at step N-1 is frozen (masked writes, no count
+        advance), so its tokens/counters read the same from any later
+        state."""
+        if active_np is None:
+            active_np = np.asarray(self.active)
         for slot in range(self.B):
             if self._slot_req[slot] is not None and not active_np[slot]:
                 self._finish_slot(slot)
@@ -961,7 +1185,9 @@ class ContinuousBatcher:
     # -- main loop ---------------------------------------------------------
 
     def run_all(self, timeout_s: float = 0.0) -> list[SchedResult]:
-        """Drain the queue: admit, decode a chunk, collect, repeat.
+        """Drain the queue: admit, step (fused prefill+decode), collect,
+        repeat — pipelined two steps deep by default
+        (``interleave=False`` restores the legacy serialized loop).
 
         ``timeout_s`` > 0 is a best-effort wall-clock budget (parity with
         generate()'s deadline, checked between chunks): on expiry, resident
@@ -973,31 +1199,316 @@ class ContinuousBatcher:
         (partial tokens + ``fault_kind`` on its result, one requeue first
         when transient) while co-resident rows keep decoding.
         """
-        import time
+        if self.interleave:
+            self._drive_pipelined(timeout_s)
+        else:
+            self._drive_legacy(timeout_s)
+        out = sorted(self.results, key=lambda r: r.req_id)
+        # Drain per-run state: a batcher kept alive across rounds (the
+        # prefix cache's raison d'être) must not replay old results.
+        self.results = []
+        self._retried.clear()
+        return out
 
-        deadline = time.monotonic() + timeout_s if timeout_s > 0 else None
-        while (
+    def _has_work(self) -> bool:
+        return bool(
             self.queue
             or self._admission is not None
             or any(r is not None for r in self._slot_req)
-        ):
+        )
+
+    def _expire_timeout(self) -> None:
+        """Deadline hit: the in-flight admission unwinds (pages freed —
+        including dropping refs on any adopted cached prefix; its request
+        reports with the queue), resident rows finish with whatever the
+        chunk in flight emitted, and every queued request resolves with
+        zero tokens instead of blocking the caller."""
+        interleave_mod.stats.record_sync()  # timeout decision point
+        if self._admission is not None:
+            adm = self._admission
+            self._admission = None
+            self.allocator.free_sequence(adm.seq_id)
+            self.queue.insert(0, adm.req)  # report with the queue
+        self.active = jnp.zeros_like(self.active)
+        self._active_np[:] = False
+        self._collect()
+        for req in self.queue:
+            self.results.append(
+                SchedResult(
+                    req_id=req.req_id,
+                    tokens=np.zeros((0,), np.int32),
+                    n_generated=0,
+                )
+            )
+        self.queue.clear()
+
+    # -- pipelined drive loop ---------------------------------------------
+
+    def _fused_chunk_len(self, remaining: int, n_live: int) -> int:
+        """Prompt-chunk length for a fused step: largest power of two
+        that fits the shared per-step token budget after the live rows'
+        decode chunk is accounted (Sarathi-style — the newcomer's
+        prefill shrinks before resident latency does)."""
+        cap = min(ADMISSION_CHUNK, max(self.step_tokens - n_live * self.chunk, 1))
+        c = ADMISSION_CHUNK
+        while c > cap or c > remaining:
+            c //= 2
+        return max(c, 1)
+
+    def _dispatch_fused(self, adm: _Admission, chunk_len: int) -> None:
+        """Issue ONE device program advancing the admission's prompt
+        chunk and all live rows' decode chunk; no host sync."""
+        self._key, sub = jax.random.split(self._key)
+        injector.fire("scheduler_chunk")
+        (
+            adm_cache,
+            adm_logits,
+            self.pool,
+            self.cur_tok,
+            self.cur_len,
+            self.n_emitted,
+            self.out_buf,
+            self.active,
+        ) = fused_prefill_decode_chunk(
+            self.params,
+            self.cfg,
+            adm.tokens[:, adm.pos : adm.pos + chunk_len],
+            adm.pads,
+            adm.cache,
+            jnp.int32(adm.pos),
+            self.pool,
+            self.page_table,
+            self.cur_tok,
+            self.cur_len,
+            self.pad_lens,
+            self.n_emitted,
+            self.max_new,
+            self.active,
+            self.out_buf,
+            self._eos,
+            sub,
+            self._temp,
+            self._top_p,
+            chunk=self.chunk,
+            greedy=self.greedy,
+            top_k=self.top_k,
+            use_top_p=self._use_top_p,
+            use_pallas=self._use_pallas,
+            pallas_interpret=self._pallas_interpret,
+        )
+        adm.cache, adm.last_logits = adm_cache, adm_logits
+        adm.pos += chunk_len
+        interleave_mod.stats.record_step(fused=True)
+        prefix_mod.stats.record_prefill(chunk_len, 0)
+
+    def _dispatch_decode(self) -> None:
+        """Issue one decode-only chunk program; no host sync."""
+        self._key, sub = jax.random.split(self._key)
+        injector.fire("scheduler_chunk")
+        (
+            self.pool,
+            self.cur_tok,
+            self.cur_len,
+            self.n_emitted,
+            self.out_buf,
+            self.active,
+        ) = scheduler_decode_chunk(
+            self.params,
+            self.cfg,
+            self.pool,
+            self.page_table,
+            self.cur_tok,
+            self.cur_len,
+            self.pad_lens,
+            self.n_emitted,
+            self.max_new,
+            self.active,
+            self.out_buf,
+            self._eos,
+            sub,
+            self._temp,
+            self._top_p,
+            chunk=self.chunk,
+            greedy=self.greedy,
+            top_k=self.top_k,
+            use_top_p=self._use_top_p,
+            use_pallas=self._use_pallas,
+            pallas_interpret=self._pallas_interpret,
+        )
+        interleave_mod.stats.record_step(fused=False)
+
+    @staticmethod
+    def _entry_ready(entry: tuple) -> bool:
+        """True when a step's flags have already resolved on device —
+        fetching them is then free (no stall). Conservative False when
+        the runtime can't say."""
+        try:
+            return bool(entry[0].is_ready())
+        except Exception:
+            return False
+
+    def _fetch_entry(self, entry: tuple) -> None:
+        """Apply one completed step's flags to the trailing host view.
+        Fetches only DEACTIVATE, and only rows whose slot still belongs
+        to the request that was live at dispatch (generation match) — a
+        slot freed and re-admitted mid-flight must not have the old
+        row's completion flag truncate its new owner."""
+        active_ref, live_slots = entry
+        act = np.asarray(active_ref)
+        for s, gen in live_slots:
+            if gen == self._slot_gen[s] and not act[s]:
+                self._active_np[s] = False
+
+    def _drive_pipelined(self, timeout_s: float) -> None:
+        """Admit → dispatch (fused when an admission and live rows
+        coexist) → fetch the step before last → collect; the host's own
+        work (queue admission, radix lookups, page allocation,
+        collection) overlaps the step in flight. Host syncs happen only
+        at admission handoff, slot completion, fault decisions, and
+        timeout expiry — never as a blanket per-chunk barrier."""
+        import time
+        from collections import deque
+
+        deadline = time.monotonic() + timeout_s if timeout_s > 0 else None
+        inflight: deque[tuple] = deque()  # (active_ref, live_slots)
+        while self._has_work():
             if deadline is not None and time.monotonic() > deadline:
-                if self._admission is not None:
-                    adm = self._admission
-                    self._admission = None
-                    self.allocator.free_sequence(adm.seq_id)
-                    self.queue.insert(0, adm.req)  # report with the queue
-                self.active = jnp.zeros_like(self.active)
-                self._collect()
-                for req in self.queue:
-                    self.results.append(
-                        SchedResult(
-                            req_id=req.req_id,
-                            tokens=np.zeros((0,), np.int32),
-                            n_generated=0,
-                        )
+                # Entries in flight resolve through the same lazy arrays
+                # _collect reads; their per-step flags are moot now.
+                inflight.clear()
+                self._expire_timeout()
+                break
+            self._admit()
+            adm = self._admission
+            live = [s for s in range(self.B) if self._active_np[s]]
+            t0 = time.monotonic()
+            fused_share = 0.0
+            dispatched = False
+            # Fuse only the LEADING prefill chunks (strictly more work
+            # left after this chunk): the FINAL chunk runs standalone so
+            # the handoff happens before this iteration's decode chunk
+            # and the newcomer joins it immediately — fusing the last
+            # chunk would push the join one chunk later, fragmenting
+            # decode into extra programs for every admission (measured
+            # net-negative: the join lag costs more than the one
+            # remaining stall saves). Corollary: a fused step never
+            # finishes a prefill; every handoff happens inside
+            # _advance_admission.
+            chunk_len = (
+                self._fused_chunk_len(adm.remaining, len(live))
+                if adm is not None and live
+                else 0
+            )
+            ride = (
+                adm is not None
+                and live
+                and not adm.fuse_deferred
+                and chunk_len < adm.remaining
+            )
+            if ride:
+                try:
+                    self._dispatch_fused(adm, chunk_len)
+                    # Telemetry attribution for the fused program: the
+                    # halves aren't separately measurable without a
+                    # profiler, so split this iteration's wall clock by
+                    # token share (prompt tokens vs the decode chunk's
+                    # upper bound) — deterministic given host state.
+                    fused_share = chunk_len / (
+                        chunk_len + len(live) * self.chunk
                     )
-                self.queue.clear()
+                    dispatched = True
+                except Exception as e:
+                    # A dispatch-time fault (chaos seam, trace error) is
+                    # treated as decode-side surgery: the admission's
+                    # state refs still point at the step before and it
+                    # stays in flight; older in-flight entries stay
+                    # valid (they can only deactivate). Defer the NEXT
+                    # chunk to the standalone path so a fault that
+                    # actually originates in the prefill half aborts the
+                    # admission there instead of evicting another
+                    # innocent resident every iteration.
+                    adm.fuse_deferred = True
+                    self._handle_decode_fault(e)
+            else:
+                if adm is not None:
+                    # Final chunk, nothing live to ride, or the last
+                    # fused dispatch carrying this admission faulted: a
+                    # standalone (stalled) chunk, timed + recorded
+                    # inside _advance_admission — which also performs
+                    # the handoff when the prefill completes, so the
+                    # new row is live for the decode dispatch below.
+                    try:
+                        self._advance_admission()
+                        adm.fuse_deferred = False
+                    except Exception as e:
+                        self._abort_admission(e)
+                    live = [
+                        s for s in range(self.B) if self._active_np[s]
+                    ]
+                    # Restart the clock: the standalone chunk's seconds
+                    # are already in the stalled-prefill bucket — the
+                    # decode dt below must not re-count them (their sum
+                    # is what the engine subtracts from total wall).
+                    t0 = time.monotonic()
+                if live:
+                    try:
+                        self._dispatch_decode()
+                        dispatched = True
+                    except Exception as e:
+                        self._handle_decode_fault(e)
+            if dispatched:
+                entry = (
+                    self.active,
+                    tuple((s, self._slot_gen[s]) for s in live),
+                )
+                try:
+                    # Start the device→host copy now; the fetch one
+                    # iteration later should find it already resolved.
+                    entry[0].copy_to_host_async()
+                except Exception:
+                    pass  # optional fast path only
+                inflight.append(entry)
+                try:
+                    # Retire completed steps ADAPTIVELY: any entry whose
+                    # flags already resolved (is_ready — free to fetch)
+                    # applies now, so completions/slot-frees are seen
+                    # with zero lag whenever the device keeps up (CPU:
+                    # effectively every iteration). Only force a
+                    # blocking fetch at the depth bound — that is the
+                    # double buffer proper, and it only engages when the
+                    # device is genuinely still executing step N-1.
+                    while inflight and (
+                        len(inflight) >= self.pipeline_depth
+                        or self._entry_ready(inflight[0])
+                    ):
+                        self._fetch_entry(inflight.popleft())
+                except Exception as e:
+                    # An async device fault surfaces at the fetch, one
+                    # step late: same eviction surgery as dispatch-time.
+                    inflight.clear()
+                    self._handle_decode_fault(e)
+                dt = time.monotonic() - t0
+                if fused_share > 0.0:
+                    p = dt * fused_share
+                    self._record_prefill_time(p, overlapped=True)
+                    adm.prefill_s += p
+                    self.decode_time_s += dt - p
+                else:
+                    self.decode_time_s += dt
+            self._collect(self._active_np)
+
+    # -- legacy serialized loop -------------------------------------------
+
+    def _drive_legacy(self, timeout_s: float) -> None:
+        """The pre-fusion loop (escape hatch + bench baseline): one
+        prompt-chunk dispatch, full host sync, one decode dispatch, full
+        host sync, every iteration."""
+        import time
+
+        deadline = time.monotonic() + timeout_s if timeout_s > 0 else None
+        while self._has_work():
+            if deadline is not None and time.monotonic() > deadline:
+                self._expire_timeout()
                 break
             self._admit()
             if self._admission is not None:
@@ -1009,48 +1520,12 @@ class ContinuousBatcher:
                     self._abort_admission(e)
             if bool(self.active.any()):
                 t_dec = time.monotonic()
-                self._key, sub = jax.random.split(self._key)
                 try:
-                    injector.fire("scheduler_chunk")
-                    (
-                        self.pool,
-                        self.cur_tok,
-                        self.cur_len,
-                        self.n_emitted,
-                        self.out_buf,
-                        self.active,
-                    ) = scheduler_decode_chunk(
-                        self.params,
-                        self.cfg,
-                        self.pool,
-                        self.page_table,
-                        self.cur_tok,
-                        self.cur_len,
-                        self.pad_lens,
-                        self.n_emitted,
-                        self.max_new,
-                        self.active,
-                        self.out_buf,
-                        self._eos,
-                        sub,
-                        self._temp,
-                        self._top_p,
-                        chunk=self.chunk,
-                        greedy=self.greedy,
-                        top_k=self.top_k,
-                        use_top_p=self._use_top_p,
-                        use_pallas=self._use_pallas,
-                        pallas_interpret=self._pallas_interpret,
-                    )
+                    self._dispatch_decode()
                     jax.block_until_ready(self.active)
                 except Exception as e:
                     self._handle_decode_fault(e)
                 finally:
                     self.decode_time_s += time.monotonic() - t_dec
             self._collect()
-        out = sorted(self.results, key=lambda r: r.req_id)
-        # Drain per-run state: a batcher kept alive across rounds (the
-        # prefix cache's raison d'être) must not replay old results.
-        self.results = []
-        self._retried.clear()
-        return out
+        self._active_np[:] = np.asarray(self.active)

@@ -36,9 +36,19 @@ import jax.numpy as jnp
 import numpy as np
 
 from adversarial_spec_tpu.debate.usage import Usage
+from adversarial_spec_tpu.engine import interleave as interleave_mod
+from adversarial_spec_tpu.engine import prefix_cache as prefix_mod
 from adversarial_spec_tpu.engine import registry as registry_mod
-from adversarial_spec_tpu.engine.generate import generate
+from adversarial_spec_tpu.engine.generate import (
+    MIN_BUCKET,
+    bucket_length,
+    generate,
+)
 from adversarial_spec_tpu.engine.loader import materialize_params
+from adversarial_spec_tpu.engine.scheduler import (
+    ContinuousBatcher,
+    SchedRequest,
+)
 from adversarial_spec_tpu.engine.registry import ModelSpec
 from adversarial_spec_tpu.engine.tokenizer import (
     apply_chat_template,
@@ -158,9 +168,6 @@ class TpuEngine:
         self._loading: dict[str, int] = {}
         self._pinned: set[str] = set()  # never evicted (mid-decode)
         self.prefetch_hits = 0  # prefetched loads actually consumed
-        # decode_time_s watermark of the batcher drained by the most
-        # recent _run_batcher call (per-round delta on a reused batcher).
-        self._decode_t0 = 0.0
 
     def _committed_bytes_locked(self) -> int:
         """Resident + materializing bytes. Caller holds self._lock."""
@@ -582,8 +589,6 @@ class TpuEngine:
         # (its paged path shards the pool over dp), as do budgets so large
         # that no bucketed prompt passes the batcher's context check (the
         # dense path has no such check and still serves them).
-        from adversarial_spec_tpu.engine.generate import MIN_BUCKET
-
         fits_batcher = (
             lm.cfg.max_seq_len - params.max_new_tokens >= MIN_BUCKET
         )
@@ -650,10 +655,6 @@ class TpuEngine:
         similar size reuse the compiled chunk program (pool shape is a
         jit constant).
         """
-        from adversarial_spec_tpu.engine.generate import bucket_length
-
-        import os
-
         tok = lm.tokenizer
         # The batcher checks bucket_length(prompt) + budget against the
         # model context; the engine-level trim above only bounded the RAW
@@ -681,8 +682,6 @@ class TpuEngine:
         while capacity < need:
             capacity *= 2
 
-        from adversarial_spec_tpu.engine import prefix_cache as prefix_mod
-
         seed = (
             params.seed
             if params.seed is not None
@@ -698,10 +697,17 @@ class TpuEngine:
             lm.spec.kv_dtype,
             prefix_mod.config().enabled,
             prefix_mod.config().max_pages,
+            # The batcher snapshots these at construction: a persisted
+            # batcher must rebuild when the operator flips the drive
+            # loop (--no-interleave) or the pipeline depth per round.
+            interleave_mod.config().enabled,
+            interleave_mod.config().pipeline_depth,
         )
         t0 = time.monotonic()
         try:
-            results = self._run_batcher(lm, batcher_key, prompts, params, seed)
+            results, decode_time = self._run_batcher(
+                lm, batcher_key, prompts, params, seed
+            )
         except BaseException:
             # An escaping exception (decode fault whose donated-state
             # probe failed, submit validation mid-loop, timeout plumbing)
@@ -712,11 +718,15 @@ class TpuEngine:
             lm.batcher_key = None
             raise
         total_time = time.monotonic() - t0
-        batcher = lm.batcher
-        decode_time = batcher.decode_time_s - self._decode_t0
 
         # Same attribution scheme as the dense path: decode time splits
-        # by decoded tokens, the prefill/overhead remainder evenly.
+        # by decoded tokens, the prefill/overhead remainder evenly. No
+        # double-billing under the fused loop: the batcher PARTITIONS
+        # each fused step's wall clock between its decode counter and
+        # the riding admission's prefill_time_s (token-share split), so
+        # ``overhead`` (= total - decode) contains every prefill second
+        # exactly once and a row's decode_share never re-counts time
+        # already attributed to another row's admission.
         tok_total = float(sum(r.n_generated for r in results)) or 1.0
         overhead = total_time - decode_time
         completions = []
@@ -749,12 +759,13 @@ class TpuEngine:
 
     def _run_batcher(self, lm, batcher_key, prompts, params, seed):
         """Acquire (reuse or build) the model's persistent batcher and
-        drain this call's requests through it."""
-        from adversarial_spec_tpu.engine.scheduler import (
-            ContinuousBatcher,
-            SchedRequest,
-        )
+        drain this call's requests through it.
 
+        Returns ``(results, decode_time_s)`` where the decode time is
+        THIS call's delta on the (cumulative) batcher counter. The
+        watermark is per-call local state — engine-instance storage
+        would be shared mutable telemetry that misattributes decode time
+        whenever two drains interleave on one engine."""
         tok = lm.tokenizer
         n_slots, capacity = batcher_key[0], batcher_key[1]
         with lm.mesh:
@@ -792,9 +803,9 @@ class TpuEngine:
                 )
                 lm.batcher = batcher
                 lm.batcher_key = batcher_key
-            # Per-round telemetry deltas: the persistent batcher's
+            # Per-round telemetry delta: the persistent batcher's
             # counters accumulate across rounds.
-            self._decode_t0 = batcher.decode_time_s
+            decode_t0 = batcher.decode_time_s
             for i, ids in enumerate(prompts):
                 batcher.submit(
                     SchedRequest(
@@ -803,4 +814,5 @@ class TpuEngine:
                         max_new_tokens=params.max_new_tokens,
                     )
                 )
-            return batcher.run_all(timeout_s=params.timeout_s)
+            results = batcher.run_all(timeout_s=params.timeout_s)
+            return results, batcher.decode_time_s - decode_t0

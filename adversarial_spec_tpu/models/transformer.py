@@ -608,6 +608,15 @@ def forward_paged_decode(
     paged_decode_attention_tp); callers gate on tp | n_kv_heads. The
     non-kernel math (projections, scatter, gather path) partitions
     under GSPMD as usual.
+
+    Composition contract: this function and ``forward`` are pure
+    traceable graphs over disjoint state (the paged pool here, a dense
+    per-call cache there), so the scheduler's fused step traces BOTH
+    into one program (engine/scheduler.py:fused_prefill_decode_chunk —
+    a newcomer's prompt chunk riding the residents' decode chunk).
+    Nothing in either body may grow module-level state or host callbacks
+    that would make the fused composition diverge from the standalone
+    dispatches.
     """
     B = tokens.shape[0]
     page_size = pool["k"].shape[3]

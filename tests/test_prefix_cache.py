@@ -486,6 +486,65 @@ class TestSchedulerPrefixCache:
             b.allocator.check_invariants()
         assert prefix_mod.stats.evicted_pages > 0
 
+    def test_timeout_mid_prefill_admission_frees_pages_and_refs(
+        self, tiny_model
+    ):
+        """run_all timeout expiry with a cache-enabled, MID-PREFILL
+        admission: the admission's fresh pages free, its refs on the
+        adopted cached prefix drop (cache blocks themselves survive),
+        allocator invariants hold, and every queued request still gets
+        its zero-token SchedResult."""
+        from adversarial_spec_tpu.engine.scheduler import (
+            ContinuousBatcher,
+            SchedRequest,
+        )
+
+        params, cfg = tiny_model
+        b = ContinuousBatcher(
+            params, cfg, max_batch=1, max_new_cap=8, page_size=16,
+            prefix_cache=True,
+        )
+        # Round 1 populates the cache with this prompt's blocks.
+        head = [((i * 7) % 400) + 3 for i in range(96)]
+        b.submit(SchedRequest(req_id=0, prompt_ids=list(head),
+                              max_new_tokens=4))
+        [r1] = b.run_all()
+        assert r1.error is None
+        free0 = b.allocator.free_pages
+        cached0 = b.prefix_cache.cached_pages
+        # Round 2: a multi-chunk prompt that ADOPTS the cached head,
+        # plus a queued follower. _admit reserves pages and leaves the
+        # long admission mid-prefill (remaining > one admission chunk).
+        long_prompt = head + [((i * 5) % 400) + 3 for i in range(600)]
+        b.submit(SchedRequest(req_id=0, prompt_ids=long_prompt,
+                              max_new_tokens=8))
+        b.submit(SchedRequest(req_id=1, prompt_ids=[2, 6],
+                              max_new_tokens=8))
+        b._admit()
+        adm = b._admission
+        assert adm is not None and adm.matched == 96
+        assert adm.remaining > 0  # genuinely mid-prefill
+        assert b.allocator.free_pages < free0  # pages reserved
+        # Expired deadline at loop entry: the drain must unwind the
+        # admission, not decode it.
+        results = b.run_all(timeout_s=1e-9)
+        assert [r.req_id for r in results] == [0, 1]
+        assert all(r.n_generated == 0 and r.error is None for r in results)
+        # All of the admission's pages returned; the cache kept its own
+        # refs (blocks survive for the next drain to adopt).
+        assert b.allocator.free_pages == free0
+        assert b.prefix_cache.cached_pages == cached0
+        b.allocator.check_invariants()
+        # The cache is still warm: a fresh drain adopts the head again.
+        b.submit(SchedRequest(req_id=0, prompt_ids=list(head),
+                              max_new_tokens=4))
+        [r3] = b.run_all()
+        assert r3.error is None
+        assert r3.cached_tokens > 0
+        np.testing.assert_array_equal(
+            r3.tokens, _reference(params, cfg, head, 4)
+        )
+
 
 class TestGenerateSharedPrefix:
     def test_partial_share_parity_dense_and_paged(

@@ -186,57 +186,155 @@ class TestPagedUnderDp:
         np.testing.assert_array_equal(ref.n_generated, out.n_generated)
 
 
+def _spy_dispatches(sched_mod, calls):
+    """Wrap the three dispatch entry points with call-order spies;
+    returns the originals for restoration."""
+    real_prefill = sched_mod.prefill_chunk
+    real_decode = sched_mod.scheduler_decode_chunk
+    real_fused = sched_mod.fused_prefill_decode_chunk
+
+    def spy_prefill(*a, **kw):
+        calls.append("P")
+        return real_prefill(*a, **kw)
+
+    def spy_decode(*a, **kw):
+        calls.append("D")
+        return real_decode(*a, **kw)
+
+    def spy_fused(*a, **kw):
+        calls.append("F")
+        return real_fused(*a, **kw)
+
+    sched_mod.prefill_chunk = spy_prefill
+    sched_mod.scheduler_decode_chunk = spy_decode
+    sched_mod.fused_prefill_decode_chunk = spy_fused
+    return real_prefill, real_decode, real_fused
+
+
 class TestChunkedPrefillInterleave:
     """Admission prefill no longer pauses decode: a multi-chunk prompt's
-    prefill chunks interleave with resident rows' decode chunks (NOTES
-    round-2 shortcut 'scheduler admission pauses decode')."""
+    chunks ride INSIDE the residents' decode program (the fused step),
+    and the legacy --no-interleave loop still interleaves them as
+    separate serialized dispatches."""
 
-    def test_decode_runs_between_admission_chunks(self, tiny_model):
+    def _workload(self, params, cfg, **kw):
+        b = ContinuousBatcher(
+            params, cfg, max_batch=2, max_new_cap=64, chunk=8, **kw
+        )
+        long_prompt = [((i * 11) % 500) + 3 for i in range(600)]
+        b.submit(
+            SchedRequest(req_id=0, prompt_ids=[1, 5, 9],
+                         max_new_tokens=64)
+        )
+        b.submit(
+            SchedRequest(req_id=1, prompt_ids=long_prompt,
+                         max_new_tokens=8)
+        )
+        return b, long_prompt
+
+    def test_admission_chunks_ride_fused_with_decode(self, tiny_model):
         import adversarial_spec_tpu.engine.scheduler as sched_mod
 
         params, cfg = tiny_model
         calls = []
-        real_prefill = sched_mod.prefill_chunk
-        real_decode = sched_mod.scheduler_decode_chunk
-
-        def spy_prefill(*a, **kw):
-            calls.append("P")
-            return real_prefill(*a, **kw)
-
-        def spy_decode(*a, **kw):
-            calls.append("D")
-            return real_decode(*a, **kw)
-
-        sched_mod.prefill_chunk = spy_prefill
-        sched_mod.scheduler_decode_chunk = spy_decode
+        real = _spy_dispatches(sched_mod, calls)
         try:
-            b = ContinuousBatcher(
-                params, cfg, max_batch=2, max_new_cap=64, chunk=8
-            )
-            long_prompt = [((i * 11) % 500) + 3 for i in range(600)]
-            b.submit(
-                SchedRequest(req_id=0, prompt_ids=[1, 5, 9],
-                             max_new_tokens=64)
-            )
-            b.submit(
-                SchedRequest(req_id=1, prompt_ids=long_prompt,
-                             max_new_tokens=8)
-            )
+            b, long_prompt = self._workload(params, cfg, interleave=True)
             results = b.run_all()
         finally:
-            sched_mod.prefill_chunk = real_prefill
-            sched_mod.scheduler_decode_chunk = real_decode
+            (
+                sched_mod.prefill_chunk,
+                sched_mod.scheduler_decode_chunk,
+                sched_mod.fused_prefill_decode_chunk,
+            ) = real
 
         assert len(results) == 2
-        # The 600-token prompt buckets to 1024 → two 512-token prefill
-        # chunks; a decode chunk (row 0 emitting) must run between them.
         s = "".join(calls)
-        assert "PDP" in s, f"no decode between admission chunks: {s}"
-        # Interleaving must not change tokens (row independence).
+        # The 600-token prompt's multi-chunk prefill must ride the
+        # resident row's decode program — fused dispatches, not
+        # standalone prefills between decode chunks.
+        assert "F" in s, f"no fused prefill+decode step: {s}"
+        # The fused steps carry the admission: no standalone decode may
+        # run between two standalone prefills while it is in flight.
+        assert "PDP" not in s, f"admission stalled decode: {s}"
+        # Fusion must not change tokens (row independence).
         ref0 = _reference(params, cfg, [1, 5, 9], 64)
         ref1 = _reference(params, cfg, long_prompt, 8)
         np.testing.assert_array_equal(results[0].tokens, np.asarray(ref0))
         np.testing.assert_array_equal(results[1].tokens, np.asarray(ref1))
+        # Telemetry: the ride-along chunks were accounted as overlapped.
+        assert b.overlapped_prefill_s > 0
+        assert b.prefill_time_s == (
+            b.stalled_prefill_s + b.overlapped_prefill_s
+        )
+
+    def test_legacy_loop_interleaves_serialized_dispatches(self, tiny_model):
+        """--no-interleave escape hatch: the original loop — a decode
+        chunk between two standalone admission chunks, never a fused
+        dispatch — and identical greedy tokens."""
+        import adversarial_spec_tpu.engine.scheduler as sched_mod
+
+        params, cfg = tiny_model
+        calls = []
+        real = _spy_dispatches(sched_mod, calls)
+        try:
+            b, long_prompt = self._workload(params, cfg, interleave=False)
+            results = b.run_all()
+        finally:
+            (
+                sched_mod.prefill_chunk,
+                sched_mod.scheduler_decode_chunk,
+                sched_mod.fused_prefill_decode_chunk,
+            ) = real
+
+        s = "".join(calls)
+        assert "F" not in s, f"legacy loop dispatched a fused step: {s}"
+        assert "PDP" in s, f"no decode between admission chunks: {s}"
+        ref0 = _reference(params, cfg, [1, 5, 9], 64)
+        ref1 = _reference(params, cfg, long_prompt, 8)
+        np.testing.assert_array_equal(results[0].tokens, np.asarray(ref0))
+        np.testing.assert_array_equal(results[1].tokens, np.asarray(ref1))
+        # Legacy prefill is all stall: nothing rode a fused step.
+        assert b.overlapped_prefill_s == 0
+        assert b.stalled_prefill_s > 0
+
+    def test_fused_and_legacy_loops_token_identical(self, tiny_model):
+        """The bench's acceptance invariant, pinned in-tree: the same
+        mixed admit-while-decoding workload produces byte-identical
+        greedy tokens through both drive loops."""
+        params, cfg = tiny_model
+        outs = {}
+        for enabled in (True, False):
+            b, _ = self._workload(params, cfg, interleave=enabled)
+            outs[enabled] = [r.tokens.tolist() for r in b.run_all()]
+        assert outs[True] == outs[False]
+
+    def test_slot_reuse_mid_flight_does_not_truncate(self, tiny_model):
+        """Regression: a step dispatched while slot s ran request A,
+        fetched AFTER s was freed and re-admitted to request B, must not
+        apply A's completion flag to B (the per-slot generation guard).
+        Mixed lengths/budgets force exactly that slot churn; every row
+        must still emit its full reference output."""
+        params, cfg = tiny_model
+        prompts = [
+            [((i * 13 + j * 7) % 500) + 3 for j in range(600 if i % 2 == 0 else 5)]
+            for i in range(6)
+        ]
+        budgets = [8 if i % 2 == 0 else 24 for i in range(6)]
+        b = ContinuousBatcher(
+            params, cfg, max_batch=2, max_new_cap=32, chunk=8,
+            interleave=True, prefix_cache=False,
+        )
+        for i, (p, n) in enumerate(zip(prompts, budgets)):
+            b.submit(SchedRequest(req_id=i, prompt_ids=p, max_new_tokens=n))
+        results = b.run_all()
+        assert [r.req_id for r in results] == list(range(6))
+        for i, (p, n) in enumerate(zip(prompts, budgets)):
+            ref = _reference(params, cfg, p, n)
+            assert results[i].n_generated == len(ref), f"req {i} truncated"
+            np.testing.assert_array_equal(
+                results[i].tokens, np.asarray(ref), err_msg=f"req {i}"
+            )
 
     def test_prefill_time_telemetry_accumulates(self, tiny_model):
         params, cfg = tiny_model
@@ -246,6 +344,21 @@ class TestChunkedPrefillInterleave:
         b.run_all()
         assert b.prefill_time_s > 0
         assert b.decode_time_s > 0
+        assert b.prefill_time_s == (
+            b.stalled_prefill_s + b.overlapped_prefill_s
+        )
+
+    def test_pipeline_depth_one_matches_depth_two(self, tiny_model):
+        """Depth 1 (fused but synchronous) and depth 2 (double-buffered)
+        are scheduling choices only — tokens must be identical."""
+        params, cfg = tiny_model
+        outs = {}
+        for depth in (1, 2):
+            b, _ = self._workload(
+                params, cfg, interleave=True, pipeline_depth=depth
+            )
+            outs[depth] = [r.tokens.tolist() for r in b.run_all()]
+        assert outs[1] == outs[2]
 
 
 class TestBatcherInt8Pool:

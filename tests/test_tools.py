@@ -199,6 +199,34 @@ class TestAstLint:
         checked = int(r.stderr.rsplit("(", 1)[1].split()[0])
         assert checked > 400
 
+    def test_scheduler_sync_rule_can_fire(self, monkeypatch):
+        """The block_until_ready rule is a live gate: the real batcher
+        DOES sync inside its allowlisted methods, so emptying the
+        allowlist must produce findings — and the default allowlist must
+        produce none (the repo-clean test covers the latter end to end,
+        this pins that the rule is doing the exempting)."""
+        import tools.astlint as astlint
+
+        files = [
+            REPO_ROOT / "adversarial_spec_tpu" / "engine" / "scheduler.py"
+        ]
+        index = {
+            astlint._modname_for(f): astlint._collect_module(
+                f, astlint._modname_for(f)
+            )
+            for f in files
+        }
+        findings: list[str] = []
+        astlint.check_scheduler_sync(index, findings)
+        assert findings == []
+        monkeypatch.setattr(astlint, "_SCHEDULER_SYNC_ALLOWLIST", set())
+        astlint.check_scheduler_sync(index, findings)
+        assert findings, "emptied allowlist produced no findings"
+        assert all("block_until_ready" in f for f in findings)
+        # Both sanctioned sync points really are the ones syncing.
+        assert any("_advance_admission" in f for f in findings)
+        assert any("_drive_legacy" in f for f in findings)
+
     def test_detects_seeded_error_classes(self, tmp_path, monkeypatch):
         """Every advertised error class fires on a synthetic package —
         proof the gate can fail (a gate that can't fail is not a gate)."""

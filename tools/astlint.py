@@ -12,6 +12,11 @@ Checks, package-wide (no third-party deps, pure ast):
    function, class constructor, or ``self.method`` defined in this
    package: not enough / too many positional args, unknown keyword args,
    missing required keyword-only args.
+4. Scheduler sync discipline: ``jax.block_until_ready`` may not appear
+   inside ``ContinuousBatcher`` outside the allowlisted sanctioned sync
+   points (``_SCHEDULER_SYNC_ALLOWLIST``). The pipelined drive loop's
+   whole point is that the host never blanket-syncs between chunks —
+   this rule keeps the stall from silently creeping back in a refactor.
 
 Deliberately conservative: calls through *args/**kwargs, decorated
 functions whose decorator is not known signature-preserving, attribute
@@ -54,6 +59,15 @@ _SIG_PRESERVING = {
 }
 # functools.partial(jax.jit, static_argnames=...) — the common jit idiom
 # here — also preserves the wrapped signature for callers.
+
+# ContinuousBatcher methods allowed to call jax.block_until_ready: the
+# standalone (stalled) admission chunk — blocked deliberately so its
+# device time is billed to the newcomer, not the next decode chunk — and
+# the legacy serialized loop kept as the --no-interleave escape hatch.
+# Everything else must use targeted fetches (np.asarray / device_get on
+# the specific small arrays) at the sanctioned sync points only.
+_SCHEDULER_SYNC_CLASS = "ContinuousBatcher"
+_SCHEDULER_SYNC_ALLOWLIST = {"_advance_admission", "_drive_legacy"}
 
 
 @dataclass
@@ -458,6 +472,46 @@ class _Checker(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+def _is_block_until_ready(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "block_until_ready":
+        return True
+    return isinstance(f, ast.Name) and f.id == "block_until_ready"
+
+
+def check_scheduler_sync(index: dict[str, ModuleInfo], findings: list[str]) -> None:
+    """Rule 4: no blanket device sync inside the continuous batcher
+    outside the allowlisted sanctioned sync points."""
+    info = index.get(f"{PACKAGE}.engine.scheduler")
+    if info is None:
+        return
+    tree = ast.parse(info.path.read_text(encoding="utf-8"))
+    for node in tree.body:
+        if (
+            not isinstance(node, ast.ClassDef)
+            or node.name != _SCHEDULER_SYNC_CLASS
+        ):
+            continue
+        for method in node.body:
+            if not isinstance(
+                method, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            if method.name in _SCHEDULER_SYNC_ALLOWLIST:
+                continue
+            for sub in ast.walk(method):
+                if isinstance(sub, ast.Call) and _is_block_until_ready(sub):
+                    rel = info.path.relative_to(REPO)
+                    findings.append(
+                        f"{rel}:{sub.lineno}: jax.block_until_ready in "
+                        f"{_SCHEDULER_SYNC_CLASS}.{method.name} — not an "
+                        "allowlisted sync point "
+                        f"({', '.join(sorted(_SCHEDULER_SYNC_ALLOWLIST))}); "
+                        "use a targeted fetch at a sanctioned sync point "
+                        "or extend _SCHEDULER_SYNC_ALLOWLIST deliberately"
+                    )
+
+
 def main(argv: list[str]) -> int:
     roots = [Path(p).resolve() for p in argv] or [
         REPO / PACKAGE,
@@ -487,6 +541,7 @@ def main(argv: list[str]) -> int:
         _Checker(info, index, findings).visit(
             ast.parse(info.path.read_text(encoding="utf-8"))
         )
+    check_scheduler_sync(index, findings)
 
     for f in findings:
         print(f)
