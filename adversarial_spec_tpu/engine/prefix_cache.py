@@ -203,6 +203,7 @@ class PrefixCache:
             node = children.get(key)
             if node is None:
                 node = _Block(tokens=key, page=page, parent=parent)
+                # graftlint: disable=GL-REFCOUNT -- ownership transfer, not a leak: the ref is recorded in _by_page on the next line and released by _drop (LRU eviction / clear); nothing between can raise
                 self.allocator.cache_ref(page)
                 self._by_page[page] = node
                 children[key] = node

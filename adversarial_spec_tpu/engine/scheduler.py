@@ -20,7 +20,9 @@ blanket ``jax.block_until_ready`` — the host applies step N-1's fetched
 ``active`` flags (async device→host copy) while step N runs, overlapping
 queue admission, prefix-cache radix lookups, page allocation, and result
 collection with device compute. Sanctioned sync points, and ONLY these
-(enforced by tools/astlint.py's sync-point rule): admission handoff
+(enforced by graftlint's GL-SYNC rule, which catches implicit syncs —
+np.asarray/.item()/int()/truthiness on device values — as well as
+explicit block_until_ready; docs/static_analysis.md): admission handoff
 (``_finish_admission``), slot completion (token fetch), fault decisions,
 and timeout expiry. ``interleave=False`` (CLI ``--no-interleave``,
 ``ADVSPEC_INTERLEAVE=0``) restores the legacy serialized loop — one
@@ -874,8 +876,8 @@ class ContinuousBatcher:
         # Block before stamping: async dispatch would otherwise push this
         # chunk's device time into the NEXT decode chunk's blocked wait,
         # billing resident rows for the newcomer's prefill. A standalone
-        # chunk is a genuine stall, so this sync is sanctioned (astlint
-        # allowlists it).
+        # chunk is a genuine stall, so this sync is sanctioned (GL-SYNC
+        # allowlists this method in [tool.graftlint]).
         jax.block_until_ready(adm.last_logits)
         elapsed = time.monotonic() - t0
         self._record_prefill_time(elapsed, overlapped=False)
@@ -900,6 +902,7 @@ class ContinuousBatcher:
         adm = self._admission
         slot, req, seq_id, S = adm.slot, adm.req, adm.seq_id, adm.S
         cache, last_logits = adm.cache, adm.last_logits
+        # graftlint: disable=GL-SYNC -- admission handoff is a sanctioned sync point: the pool scatter below needs host pads
         pads_np = np.asarray(adm.pads)
         table = np.asarray(self.allocator.table(seq_id), np.int32) + 1
         if adm.canonical:
@@ -963,6 +966,7 @@ class ContinuousBatcher:
         # Admission handoff is a sanctioned sync point: ``first`` was
         # fetched above, blocking on every step in flight.
         interleave_mod.stats.record_sync()
+        # graftlint: disable=GL-SYNC -- admission handoff is a sanctioned sync point: the first sampled token decides slot activation
         first_is_eos = bool(np.isin(np.asarray(first), self._eos_np))
         self.n_emitted = self.n_emitted.at[slot].set(1)
         self.max_new = self.max_new.at[slot].set(req.max_new_tokens)
@@ -1107,7 +1111,9 @@ class ContinuousBatcher:
         whole group (the pre-isolation behavior).
         """
         try:
+            # graftlint: disable=GL-SYNC -- fault decision point: eviction surgery needs host lengths to pick the victim
             cur_len_np = np.asarray(self.cur_len)
+            # graftlint: disable=GL-SYNC -- fault decision point: probes whether the donated device state survived the fault
             np.asarray(self.out_buf[:, :1])  # probe the donated buffer
         except Exception:
             raise exc from None
@@ -1124,7 +1130,9 @@ class ContinuousBatcher:
                 raise exc
             slot = max(occupied, key=lambda s: int(cur_len_np[s]))
         req = self._slot_req[slot]
+        # graftlint: disable=GL-SYNC -- fault decision point: the victim's partial tokens must be rescued before the slot is freed
         n = int(self.n_emitted[slot])
+        # graftlint: disable=GL-SYNC -- fault decision point (partial-token rescue, same sanctioned sync as the count above)
         partial = np.asarray(self.out_buf[slot, :n])
         # Eviction only drops this slot's REFERENCES: pages shared with
         # the prefix cache (or other admissions) survive untouched — a
@@ -1155,7 +1163,9 @@ class ContinuousBatcher:
         interleave_mod.stats.record_sync()
         self._active_np[slot] = False  # invariant: no owner ⇒ not live
         req = self._slot_req[slot]
+        # graftlint: disable=GL-SYNC -- slot completion is a sanctioned sync point: the row is frozen, its count/tokens read identically from any later state
         n = int(self.n_emitted[slot])
+        # graftlint: disable=GL-SYNC -- slot completion token fetch (same sanctioned point as the count above)
         row = np.asarray(self.out_buf[slot, :n])
         self.results.append(
             SchedResult(
@@ -1177,6 +1187,7 @@ class ContinuousBatcher:
         advance), so its tokens/counters read the same from any later
         state."""
         if active_np is None:
+            # graftlint: disable=GL-SYNC -- full fetch only on the legacy loop and timeout-expiry paths (the pipelined loop always passes its trailing host snapshot)
             active_np = np.asarray(self.active)
         for slot in range(self.B):
             if self._slot_req[slot] is not None and not active_np[slot]:
@@ -1354,6 +1365,7 @@ class ContinuousBatcher:
         slot freed and re-admitted mid-flight must not have the old
         row's completion flag truncate its new owner."""
         active_ref, live_slots = entry
+        # graftlint: disable=GL-SYNC -- pipelined fetch: called only when the entry resolved (is_ready) or at the depth bound, the double buffer's one sanctioned blocking point
         act = np.asarray(active_ref)
         for s, gen in live_slots:
             if gen == self._slot_gen[s] and not act[s]:

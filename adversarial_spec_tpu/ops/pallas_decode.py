@@ -70,6 +70,7 @@ def _warn_block_t_fallback(T: int) -> None:
         _warned_block_t.add(T)
         import sys
 
+        # graftlint: disable=GL-TRACE -- deliberate trace-time warn-once: block_t is chosen at trace time (T is a static shape), so the fallback must report during tracing or never
         print(
             f"warning: ADVSPEC_BLOCK_T={_BLOCK_T_OVERRIDE} unusable at "
             f"cache length T={T} (needs a positive multiple of "

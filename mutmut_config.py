@@ -13,6 +13,11 @@ _SKIP_PATH_FRAGMENTS = (
     "/prompts.py",  # prompt text: every word is a mutable "constant"
     "/config.py",  # model-shape tables
     "/tests/",
+    # graftlint's embedded must-fail fixtures are deliberately-broken
+    # code: mutating them only produces "differently broken", and a
+    # mutant that ACCIDENTALLY fixes one breaks the self-test for the
+    # wrong reason. tools/lint_all.py asserts this entry stays.
+    "/tools/graftlint/",
 )
 
 _SKIP_LINE_MARKERS = (

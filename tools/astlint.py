@@ -1,557 +1,57 @@
-"""Stdlib static checker for the worst type-error classes (mypy is not
-installable in this environment; reference CI runs a real typecheck job —
-reference .github/workflows/ci.yml — and this is the executable stand-in).
+"""Thin compat entrypoint over tools/graftlint (the framework this
+script grew into — see docs/static_analysis.md).
 
-Checks, package-wide (no third-party deps, pure ast):
+``python tools/astlint.py [roots...]`` runs exactly the four checks the
+original flat script shipped, now as registered graftlint rules:
 
-1. ``from <package>.<module> import NAME`` — NAME must actually be bound
-   in the target module (def / class / assignment / re-export / __all__).
-2. ``<module>.NAME`` attribute access on package modules imported as a
-   module object — NAME must be bound in that module.
-3. Call arity + keyword validity for calls that statically resolve to a
-   function, class constructor, or ``self.method`` defined in this
-   package: not enough / too many positional args, unknown keyword args,
-   missing required keyword-only args.
-4. Scheduler sync discipline: ``jax.block_until_ready`` may not appear
-   inside ``ContinuousBatcher`` outside the allowlisted sanctioned sync
-   points (``_SCHEDULER_SYNC_ALLOWLIST``). The pipelined drive loop's
-   whole point is that the host never blanket-syncs between chunks —
-   this rule keeps the stall from silently creeping back in a refactor.
+1. bad from-imports            -> GL-IMPORT
+2. bad module-attribute access -> GL-ATTR
+3. call arity / keywords       -> GL-ARITY
+4. scheduler sync discipline   -> GL-SYNC (generalized: the original
+   only caught explicit ``jax.block_until_ready``; GL-SYNC also catches
+   the implicit syncs — np.asarray / .item() / int()/bool() /
+   device_get / truthiness on device values)
 
-Deliberately conservative: calls through *args/**kwargs, decorated
-functions whose decorator is not known signature-preserving, attribute
-chains through values, and anything not statically resolvable are
-skipped. Zero output = clean. Exit 1 on findings, 0 otherwise.
+The hardcoded ``_SCHEDULER_SYNC_ALLOWLIST`` / ``_SIG_PRESERVING`` sets
+moved to the ``[tool.graftlint]`` table in pyproject.toml. Output and
+exit-code behavior are preserved: findings on stdout, an
+"astlint: N finding(s) over M files (K call sites arity-checked)"
+summary on stderr, exit 1 iff findings.
 
-Usage:
-    python tools/astlint.py                # lint the package + tools
-    python tools/astlint.py path1 path2    # explicit roots
+For the full rule set (GL-TRACE, GL-RETRACE, GL-REFCOUNT, …),
+suppressions, baselines and JSON output use ``python -m tools.graftlint``.
 """
 
 from __future__ import annotations
 
-import ast
 import sys
-from dataclasses import dataclass, field
 from pathlib import Path
 
-REPO = Path(__file__).resolve().parent.parent
-PACKAGE = "adversarial_spec_tpu"
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-# Decorators that keep the wrapped function's calling convention.
-_SIG_PRESERVING = {
-    "jax.jit",
-    "jit",
-    "functools.lru_cache",
-    "lru_cache",
-    "functools.cache",
-    "functools.wraps",
-    "staticmethod",
-    "classmethod",
-    "contextmanager",
-    "contextlib.contextmanager",
-    "dataclass",
-    "dataclasses.dataclass",
-    "abstractmethod",
-    "abc.abstractmethod",
-    "pytest.fixture",
-    "override",
-}
-# functools.partial(jax.jit, static_argnames=...) — the common jit idiom
-# here — also preserves the wrapped signature for callers.
-
-# ContinuousBatcher methods allowed to call jax.block_until_ready: the
-# standalone (stalled) admission chunk — blocked deliberately so its
-# device time is billed to the newcomer, not the next decode chunk — and
-# the legacy serialized loop kept as the --no-interleave escape hatch.
-# Everything else must use targeted fetches (np.asarray / device_get on
-# the specific small arrays) at the sanctioned sync points only.
-_SCHEDULER_SYNC_CLASS = "ContinuousBatcher"
-_SCHEDULER_SYNC_ALLOWLIST = {"_advance_admission", "_drive_legacy"}
-
-
-@dataclass
-class FuncSig:
-    name: str
-    n_pos: int  # positional (posonly + args), excluding self for methods
-    n_pos_defaults: int
-    kwonly: tuple[str, ...] = ()
-    kwonly_required: tuple[str, ...] = ()
-    has_vararg: bool = False
-    has_kwarg: bool = False
-    pos_names: tuple[str, ...] = ()
-    checkable: bool = True  # False when a decorator may change the sig
-
-
-@dataclass
-class ClassInfo:
-    name: str
-    methods: dict[str, FuncSig] = field(default_factory=dict)
-    bases: tuple[str, ...] = ()
-
-
-@dataclass
-class ModuleInfo:
-    path: Path
-    modname: str
-    bindings: set[str] = field(default_factory=set)
-    functions: dict[str, FuncSig] = field(default_factory=dict)
-    classes: dict[str, ClassInfo] = field(default_factory=dict)
-
-
-def _decorator_name(dec: ast.expr) -> str:
-    if isinstance(dec, ast.Call):
-        # functools.partial(jax.jit, ...) preserves the signature; any
-        # other called decorator factory is treated as preserving too iff
-        # its name is in the allowlist (e.g. lru_cache(maxsize=...)).
-        inner = _decorator_name(dec.func)
-        if inner in ("functools.partial", "partial"):
-            if dec.args:
-                wrapped = _decorator_name(dec.args[0])
-                if wrapped in _SIG_PRESERVING:
-                    return wrapped
-            return "partial(?)"
-        return inner
-    if isinstance(dec, ast.Attribute):
-        base = _decorator_name(dec.value)
-        return f"{base}.{dec.attr}" if base else dec.attr
-    if isinstance(dec, ast.Name):
-        return dec.id
-    return "?"
-
-
-def _sig_of(fn: ast.FunctionDef | ast.AsyncFunctionDef, is_method: bool) -> FuncSig:
-    a = fn.args
-    pos = [p.arg for p in a.posonlyargs + a.args]
-    skip_self = 0
-    if is_method:
-        decs = {_decorator_name(d) for d in fn.decorator_list}
-        if "staticmethod" not in decs and pos:
-            skip_self = 1  # self / cls
-    pos = pos[skip_self:]
-    checkable = True
-    for d in fn.decorator_list:
-        name = _decorator_name(d)
-        if name not in _SIG_PRESERVING and not name.startswith(
-            ("jax.", "functools.", "pl.", "pytest.")
-        ):
-            checkable = False
-    kwonly = tuple(p.arg for p in a.kwonlyargs)
-    kwonly_required = tuple(
-        p.arg
-        for p, d in zip(a.kwonlyargs, a.kw_defaults)
-        if d is None
-    )
-    return FuncSig(
-        name=fn.name,
-        n_pos=len(pos),
-        n_pos_defaults=len(a.defaults),
-        kwonly=kwonly,
-        kwonly_required=kwonly_required,
-        has_vararg=a.vararg is not None,
-        has_kwarg=a.kwarg is not None,
-        pos_names=tuple(pos),
-        checkable=checkable,
-    )
-
-
-def _collect_module(path: Path, modname: str) -> ModuleInfo:
-    tree = ast.parse(path.read_text(encoding="utf-8"))
-    info = ModuleInfo(path=path, modname=modname)
-    for node in tree.body:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            info.bindings.add(node.name)
-            info.functions[node.name] = _sig_of(node, is_method=False)
-        elif isinstance(node, ast.ClassDef):
-            info.bindings.add(node.name)
-            ci = ClassInfo(
-                name=node.name,
-                bases=tuple(
-                    _decorator_name(b)
-                    for b in node.bases
-                ),
-            )
-            for sub in node.body:
-                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                    ci.methods[sub.name] = _sig_of(sub, is_method=True)
-            info.classes[node.name] = ci
-        elif isinstance(node, ast.Assign):
-            for t in node.targets:
-                if isinstance(t, ast.Name):
-                    info.bindings.add(t.id)
-                elif isinstance(t, (ast.Tuple, ast.List)):
-                    for e in t.elts:
-                        if isinstance(e, ast.Name):
-                            info.bindings.add(e.id)
-        elif isinstance(node, ast.AnnAssign) and isinstance(
-            node.target, ast.Name
-        ):
-            info.bindings.add(node.target.id)
-        elif isinstance(node, (ast.Import, ast.ImportFrom)):
-            for alias in node.names:
-                if alias.name == "*":
-                    continue
-                info.bindings.add(
-                    alias.asname or alias.name.split(".")[0]
-                )
-        elif isinstance(node, (ast.If, ast.Try)):
-            # Conditional top-level defs (TYPE_CHECKING, fallbacks):
-            # bind anything defined in any branch.
-            for sub in ast.walk(node):
-                if isinstance(
-                    sub, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
-                ):
-                    info.bindings.add(sub.name)
-                elif isinstance(sub, ast.Assign):
-                    for t in sub.targets:
-                        if isinstance(t, ast.Name):
-                            info.bindings.add(t.id)
-                elif isinstance(sub, (ast.Import, ast.ImportFrom)):
-                    for alias in sub.names:
-                        if alias.name != "*":
-                            info.bindings.add(
-                                alias.asname or alias.name.split(".")[0]
-                            )
-    return info
-
-
-def _modname_for(path: Path) -> str:
-    rel = path.relative_to(REPO).with_suffix("")
-    parts = list(rel.parts)
-    if parts[-1] == "__init__":
-        parts = parts[:-1]
-    return ".".join(parts)
-
-
-class _Checker(ast.NodeVisitor):
-    def __init__(
-        self,
-        info: ModuleInfo,
-        index: dict[str, ModuleInfo],
-        findings: list[str],
-    ):
-        self.info = info
-        self.index = index
-        self.findings = findings
-        # local name -> ("func", FuncSig) | ("class", ClassInfo)
-        #            | ("module", ModuleInfo)
-        self.resolved: dict[str, tuple[str, object]] = {}
-        self.local_overrides: set[str] = set()
-        self.current_class: ClassInfo | None = None
-        for name, sig in info.functions.items():
-            self.resolved[name] = ("func", sig)
-        for name, ci in info.classes.items():
-            self.resolved[name] = ("class", ci)
-
-    def _warn(self, node: ast.AST, msg: str) -> None:
-        rel = self.info.path.relative_to(REPO)
-        self.findings.append(f"{rel}:{node.lineno}: {msg}")
-
-    # ---------------------------------------------------------- imports
-
-    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
-        if node.level:
-            # Level 1 means "this package": for a package __init__ that is
-            # the module itself; for a plain module it is the parent.
-            drop = node.level - (
-                1 if self.info.path.name == "__init__.py" else 0
-            )
-            base = (
-                self.info.modname
-                if drop == 0
-                else self.info.modname.rsplit(".", drop)[0]
-            )
-            target = f"{base}.{node.module}" if node.module else base
-        else:
-            target = node.module or ""
-        tinfo = self.index.get(target)
-        if tinfo is not None:
-            for alias in node.names:
-                if alias.name == "*":
-                    continue
-                # Submodule import (from pkg import engine) counts.
-                if (
-                    alias.name not in tinfo.bindings
-                    and f"{target}.{alias.name}" not in self.index
-                ):
-                    self._warn(
-                        node,
-                        f"'{alias.name}' is not defined in {target}",
-                    )
-                local = alias.asname or alias.name
-                if alias.name in tinfo.functions:
-                    self.resolved[local] = (
-                        "func",
-                        tinfo.functions[alias.name],
-                    )
-                elif alias.name in tinfo.classes:
-                    self.resolved[local] = (
-                        "class",
-                        tinfo.classes[alias.name],
-                    )
-                elif f"{target}.{alias.name}" in self.index:
-                    self.resolved[local] = (
-                        "module",
-                        self.index[f"{target}.{alias.name}"],
-                    )
-        self.generic_visit(node)
-
-    def visit_Import(self, node: ast.Import) -> None:
-        for alias in node.names:
-            if alias.name in self.index:
-                local = alias.asname or alias.name.split(".")[0]
-                if alias.asname or "." not in alias.name:
-                    self.resolved[local] = (
-                        "module",
-                        self.index[alias.name],
-                    )
-        self.generic_visit(node)
-
-    # ------------------------------------------------------ assignments
-
-    def visit_Assign(self, node: ast.Assign) -> None:
-        # A local rebind shadows whatever we resolved — stop checking it.
-        for t in node.targets:
-            if isinstance(t, ast.Name) and t.id in self.resolved:
-                self.resolved.pop(t.id, None)
-        self.generic_visit(node)
-
-    # ---------------------------------------------------------- classes
-
-    def visit_ClassDef(self, node: ast.ClassDef) -> None:
-        prev = self.current_class
-        self.current_class = self.info.classes.get(node.name)
-        self.generic_visit(node)
-        self.current_class = prev
-
-    # ------------------------------------------------------------ scopes
-
-    def _shadowed_names(self, fn) -> set[str]:
-        """Names this function rebinds locally: params plus local
-        assignment/for/with/except targets (one level of flow analysis —
-        enough to avoid false positives, not a full scope model)."""
-        names = set()
-        a = fn.args
-        for p in a.posonlyargs + a.args + a.kwonlyargs:
-            names.add(p.arg)
-        if a.vararg:
-            names.add(a.vararg.arg)
-        if a.kwarg:
-            names.add(a.kwarg.arg)
-        return names
-
-    def _visit_function_scope(self, node) -> None:
-        shadowed = {
-            n: self.resolved.pop(n)
-            for n in self._shadowed_names(node)
-            if n in self.resolved
-        }
-        self.generic_visit(node)
-        self.resolved.update(shadowed)
-
-    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
-        self._visit_function_scope(node)
-
-    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
-        self._visit_function_scope(node)
-
-    def visit_Lambda(self, node: ast.Lambda) -> None:
-        self._visit_function_scope(node)
-
-    # ------------------------------------------------------- attributes
-
-    def visit_Attribute(self, node: ast.Attribute) -> None:
-        if isinstance(node.value, ast.Name):
-            entry = self.resolved.get(node.value.id)
-            if entry and entry[0] == "module":
-                minfo: ModuleInfo = entry[1]  # type: ignore[assignment]
-                if (
-                    node.attr not in minfo.bindings
-                    and f"{minfo.modname}.{node.attr}" not in self.index
-                    and not node.attr.startswith("__")
-                ):
-                    self._warn(
-                        node,
-                        f"module '{minfo.modname}' has no attribute "
-                        f"'{node.attr}'",
-                    )
-        self.generic_visit(node)
-
-    # ------------------------------------------------------------ calls
-
-    n_checked_calls = 0  # class-wide: how many call sites were verified
-
-    def _check_sig(
-        self, node: ast.Call, sig: FuncSig, what: str
-    ) -> None:
-        if not sig.checkable:
-            return
-        if any(isinstance(a, ast.Starred) for a in node.args) or any(
-            kw.arg is None for kw in node.keywords
-        ):
-            return  # *args / **kwargs at the call site: not statically known
-        _Checker.n_checked_calls += 1
-        n_pos_given = len(node.args)
-        kw_given = {kw.arg for kw in node.keywords}
-        # positional overflow
-        if not sig.has_vararg and n_pos_given > sig.n_pos:
-            self._warn(
-                node,
-                f"{what} takes {sig.n_pos} positional args "
-                f"but {n_pos_given} given",
-            )
-            return
-        # unknown keywords
-        if not sig.has_kwarg:
-            valid = set(sig.pos_names) | set(sig.kwonly)
-            for kw in kw_given:
-                if kw not in valid:
-                    self._warn(
-                        node, f"{what} got unexpected keyword '{kw}'"
-                    )
-        # missing required args: only keywords naming a REQUIRED
-        # positional cover one (a keyword hitting an optional positional
-        # must not mask a missing required arg, e.g. f(b=2) on f(a, b=1)).
-        required_pos = sig.n_pos - sig.n_pos_defaults
-        covered = n_pos_given + len(
-            kw_given & set(sig.pos_names[n_pos_given:required_pos])
-        )
-        if covered < required_pos:
-            self._warn(
-                node,
-                f"{what} missing required args "
-                f"({covered} of {required_pos} provided)",
-            )
-        for kw in sig.kwonly_required:
-            if kw not in kw_given:
-                self._warn(
-                    node, f"{what} missing required keyword-only '{kw}'"
-                )
-
-    def visit_Call(self, node: ast.Call) -> None:
-        func = node.func
-        if isinstance(func, ast.Name):
-            entry = self.resolved.get(func.id)
-            if entry:
-                kind, obj = entry
-                if kind == "func":
-                    self._check_sig(node, obj, f"{func.id}()")
-                elif kind == "class":
-                    ci: ClassInfo = obj  # type: ignore[assignment]
-                    init = ci.methods.get("__init__")
-                    # dataclasses synthesize __init__; bases may define
-                    # it — only check an explicit local __init__.
-                    if init is not None and not ci.bases:
-                        self._check_sig(node, init, f"{ci.name}()")
-        elif isinstance(func, ast.Attribute):
-            if (
-                isinstance(func.value, ast.Name)
-                and func.value.id == "self"
-                and self.current_class is not None
-            ):
-                sig = self.current_class.methods.get(func.attr)
-                # Inherited methods not indexed: only check when the
-                # class has no bases or defines the method itself.
-                if sig is not None:
-                    self._check_sig(
-                        node,
-                        sig,
-                        f"self.{func.attr}()",
-                    )
-            elif isinstance(func.value, ast.Name):
-                entry = self.resolved.get(func.value.id)
-                if entry and entry[0] == "module":
-                    minfo: ModuleInfo = entry[1]  # type: ignore
-                    sig = minfo.functions.get(func.attr)
-                    if sig is not None:
-                        self._check_sig(
-                            node,
-                            sig,
-                            f"{minfo.modname}.{func.attr}()",
-                        )
-        self.generic_visit(node)
-
-
-def _is_block_until_ready(call: ast.Call) -> bool:
-    f = call.func
-    if isinstance(f, ast.Attribute) and f.attr == "block_until_ready":
-        return True
-    return isinstance(f, ast.Name) and f.id == "block_until_ready"
-
-
-def check_scheduler_sync(index: dict[str, ModuleInfo], findings: list[str]) -> None:
-    """Rule 4: no blanket device sync inside the continuous batcher
-    outside the allowlisted sanctioned sync points."""
-    info = index.get(f"{PACKAGE}.engine.scheduler")
-    if info is None:
-        return
-    tree = ast.parse(info.path.read_text(encoding="utf-8"))
-    for node in tree.body:
-        if (
-            not isinstance(node, ast.ClassDef)
-            or node.name != _SCHEDULER_SYNC_CLASS
-        ):
-            continue
-        for method in node.body:
-            if not isinstance(
-                method, (ast.FunctionDef, ast.AsyncFunctionDef)
-            ):
-                continue
-            if method.name in _SCHEDULER_SYNC_ALLOWLIST:
-                continue
-            for sub in ast.walk(method):
-                if isinstance(sub, ast.Call) and _is_block_until_ready(sub):
-                    rel = info.path.relative_to(REPO)
-                    findings.append(
-                        f"{rel}:{sub.lineno}: jax.block_until_ready in "
-                        f"{_SCHEDULER_SYNC_CLASS}.{method.name} — not an "
-                        "allowlisted sync point "
-                        f"({', '.join(sorted(_SCHEDULER_SYNC_ALLOWLIST))}); "
-                        "use a targeted fetch at a sanctioned sync point "
-                        "or extend _SCHEDULER_SYNC_ALLOWLIST deliberately"
-                    )
+LEGACY_RULES = ["GL-IMPORT", "GL-ATTR", "GL-ARITY", "GL-SYNC"]
 
 
 def main(argv: list[str]) -> int:
-    roots = [Path(p).resolve() for p in argv] or [
-        REPO / PACKAGE,
-        REPO / "tools",
-        REPO / "tests",
-        REPO / "bench.py",
-        REPO / "__graft_entry__.py",
-        REPO / "tpu_ladder.py",
-    ]
-    files: list[Path] = []
-    for r in roots:
-        if r.is_dir():
-            files += sorted(r.rglob("*.py"))
-        elif r.suffix == ".py" and r.exists():
-            files.append(r)
+    from tools.graftlint import core
 
-    index: dict[str, ModuleInfo] = {}
-    for f in files:
-        try:
-            index[_modname_for(f)] = _collect_module(f, _modname_for(f))
-        except SyntaxError as e:
-            print(f"{f}: syntax error: {e}", file=sys.stderr)
-            return 1
-
-    findings: list[str] = []
-    for modname, info in index.items():
-        _Checker(info, index, findings).visit(
-            ast.parse(info.path.read_text(encoding="utf-8"))
-        )
-    check_scheduler_sync(index, findings)
-
-    for f in findings:
-        print(f)
-    n_files = len(files)
+    try:
+        result = core.run(argv or None, rules=LEGACY_RULES)
+    except SyntaxError as e:
+        print(f"syntax error: {e}", file=sys.stderr)
+        return 1
+    except ValueError as e:
+        print(f"config error: {e}", file=sys.stderr)
+        return 1
+    for f in result.findings:
+        print(f.render())
     print(
-        f"astlint: {len(findings)} finding(s) over {n_files} files "
-        f"({_Checker.n_checked_calls} call sites arity-checked)",
+        f"astlint: {len(result.findings)} finding(s) over "
+        f"{result.n_files} files "
+        f"({result.n_checked_calls} call sites arity-checked)",
         file=sys.stderr,
     )
-    return 1 if findings else 0
+    return result.exit_code
 
 
 if __name__ == "__main__":

@@ -1,0 +1,59 @@
+"""graftlint — rule-registry static analysis for JAX serving-path
+discipline (pure stdlib ``ast``; mypy/ruff are not installable here).
+
+The framework generalizes ``tools/astlint.py`` (kept as a thin compat
+entrypoint): a multi-pass linter with
+
+- a **rule registry** — every check is a ``Rule`` subclass with a stable
+  id (``GL-*``), a rationale, and an embedded must-fail fixture that the
+  self-test harness (``--self-test``) proves fires;
+- **inline suppressions** — ``# graftlint: disable=GL-SYNC -- reason``
+  on (or immediately above) the offending line; the reason is mandatory
+  and a reasonless disable is itself a finding (GL-SUPPRESS) that does
+  NOT suppress anything;
+- a **committed baseline** (``tools/graftlint/baseline.json``) for
+  grandfathered findings — new code must lint clean, old findings are
+  pinned so they can only shrink;
+- human and ``--json`` output, ``--list-rules`` / ``--rule`` selection;
+- configuration in one place: the ``[tool.graftlint]`` table in
+  pyproject.toml (sync allowlist, signature-preserving decorators,
+  device-value names, bucketer functions, refcount scope).
+
+Rule catalog (docs/static_analysis.md has the full rationale):
+
+=============  ========================================================
+GL-IMPORT      ``from pkg.mod import NAME`` — NAME must exist there
+GL-ATTR        ``mod.NAME`` on package modules — NAME must be bound
+GL-ARITY       call arity / keyword validity for resolvable calls
+GL-SYNC        no host sync (explicit OR implicit) in the continuous
+               batcher outside sanctioned sync points
+GL-TRACE       no Python side effects inside jit-traced bodies
+GL-RETRACE     jit call sites: static args bounded (pow2-bucketed),
+               traced args never bare host scalars
+GL-REFCOUNT    allocator acquires must reach a release on all paths
+GL-SUPPRESS    suppression hygiene (reason mandatory, ids must exist)
+=============  ========================================================
+
+Usage::
+
+    python -m tools.graftlint                  # lint the repo, exit 1 on findings
+    python -m tools.graftlint --list-rules
+    python -m tools.graftlint --rule GL-SYNC --json
+    python -m tools.graftlint --self-test      # every rule fires on its fixture
+"""
+
+from __future__ import annotations
+
+from tools.graftlint.core import (  # noqa: F401
+    Finding,
+    LintResult,
+    Rule,
+    all_rules,
+    get_rule,
+    register,
+    run,
+)
+from tools.graftlint.config import GraftlintConfig, load_config  # noqa: F401
+
+# Importing the rules package registers every rule.
+from tools.graftlint import rules as _rules  # noqa: E402,F401

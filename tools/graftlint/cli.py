@@ -1,0 +1,132 @@
+"""Command-line front end. ``python -m tools.graftlint --help``."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from tools.graftlint import core
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description=(
+            "rule-registry static analysis for JAX serving-path "
+            "discipline (stdlib ast; see docs/static_analysis.md)"
+        ),
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        help="files/dirs to lint (default: the repo's standard roots)",
+    )
+    ap.add_argument(
+        "--rule",
+        action="append",
+        metavar="ID",
+        help="run only this rule id (repeatable, comma-separable)",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog"
+    )
+    ap.add_argument(
+        "--json", action="store_true", help="machine-readable output"
+    )
+    ap.add_argument(
+        "--baseline",
+        default=str(core.BASELINE_PATH),
+        help="baseline file (grandfathered findings); 'none' disables",
+    )
+    ap.add_argument(
+        "--update-baseline",
+        action="store_true",
+        help="rewrite the baseline with the current findings and exit 0",
+    )
+    ap.add_argument(
+        "--self-test",
+        action="store_true",
+        help="prove every registered rule fires on its embedded fixture",
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        rules = core.all_rules()
+        width = max(len(r) for r in rules)
+        for rule_id in sorted(rules):
+            rule = rules[rule_id]
+            print(f"{rule_id:<{width}}  {rule.title}")
+        return 0
+
+    selected: list[str] | None = None
+    if args.rule:
+        selected = [
+            r.strip() for spec in args.rule for r in spec.split(",") if r.strip()
+        ]
+
+    if args.self_test:
+        try:
+            failures = core.self_test(selected)
+        except KeyError as e:
+            print(e.args[0], file=sys.stderr)
+            return 2
+        for f in failures:
+            print(f, file=sys.stderr)
+        print(
+            f"graftlint self-test: "
+            f"{len(core.all_rules() if selected is None else selected) - len(failures)}"
+            f" rule(s) live, {len(failures)} dead",
+            file=sys.stderr,
+        )
+        return 1 if failures else 0
+
+    baseline = (
+        None if args.baseline == "none" else Path(args.baseline)
+    )
+    try:
+        result = core.run(
+            args.paths or None, rules=selected, baseline=baseline
+        )
+    except SyntaxError as e:
+        print(f"syntax error: {e}", file=sys.stderr)
+        return 2
+    except (KeyError, ValueError) as e:
+        # Unknown rule ids, malformed [tool.graftlint] table, bad
+        # baseline version — configuration errors, exit 2.
+        print(e.args[0] if e.args else str(e), file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        if baseline is None:
+            print("--update-baseline needs a baseline path", file=sys.stderr)
+            return 2
+        core.write_baseline(
+            baseline, result.findings + result.baselined
+        )
+        print(
+            f"baseline: {len(result.findings) + len(result.baselined)} "
+            f"entr(y/ies) written to {baseline}",
+            file=sys.stderr,
+        )
+        return 0
+
+    if args.json:
+        print(json.dumps(result.to_json(), indent=1))
+    else:
+        for f in result.findings:
+            print(f.render())
+    print(
+        f"graftlint: {len(result.findings)} finding(s) over "
+        f"{result.n_files} files "
+        f"({result.n_checked_calls} call sites arity-checked, "
+        f"{len(result.suppressed)} suppressed, "
+        f"{len(result.baselined)} baselined)",
+        file=sys.stderr,
+    )
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

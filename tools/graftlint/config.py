@@ -1,0 +1,234 @@
+"""``[tool.graftlint]`` configuration, read from pyproject.toml.
+
+Python here is 3.10 (no stdlib ``tomllib``) and third-party TOML readers
+are not installable, so this module carries a deliberately small reader
+for the subset pyproject actually uses: ``key = value`` pairs inside one
+table, where value is a string, integer, boolean, or a (possibly
+multi-line) array of strings. That subset is a hard contract — the
+reader raises on anything it does not understand rather than guessing.
+
+Every knob has a code default equal to the committed pyproject value, so
+the linter still runs (e.g. on a fixture tree in a tempdir) when no
+pyproject is present.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+
+
+@dataclass
+class GraftlintConfig:
+    # Root package the domain rules reason about.
+    package: str = "adversarial_spec_tpu"
+    # Decorators that keep the wrapped function's calling convention
+    # (GL-ARITY skips functions under anything else). Hoisted from
+    # astlint's _SIG_PRESERVING.
+    sig_preserving_decorators: list[str] = field(
+        default_factory=lambda: [
+            "jax.jit",
+            "jit",
+            "functools.lru_cache",
+            "lru_cache",
+            "functools.cache",
+            "functools.wraps",
+            "staticmethod",
+            "classmethod",
+            "contextmanager",
+            "contextlib.contextmanager",
+            "dataclass",
+            "dataclasses.dataclass",
+            "abstractmethod",
+            "abc.abstractmethod",
+            "pytest.fixture",
+            "override",
+        ]
+    )
+    # --- GL-SYNC -----------------------------------------------------
+    # The class whose methods must not sync the host outside sanctioned
+    # points (every indexed module is scanned for it), and the methods
+    # allowed to sync blanket-style (hoisted from astlint's
+    # _SCHEDULER_SYNC_ALLOWLIST).
+    sync_class: str = "ContinuousBatcher"
+    sync_allowlist: list[str] = field(
+        default_factory=lambda: ["_advance_admission", "_drive_legacy"]
+    )
+    # Attribute names whose values live on device inside the sync class
+    # (``self.active``, ``adm.pads`` …): an np.asarray / int() / bool()
+    # / .item() touching any of these is an implicit host sync.
+    sync_device_attrs: list[str] = field(
+        default_factory=lambda: [
+            "pool",
+            "page_table",
+            "cur_tok",
+            "cur_len",
+            "pad_lens",
+            "n_emitted",
+            "max_new",
+            "active",
+            "out_buf",
+            "last_logits",
+            "pads",
+        ]
+    )
+    # Bare local names that hold device values in the sync class.
+    sync_device_names: list[str] = field(
+        default_factory=lambda: ["first", "active_ref", "adm_logits"]
+    )
+    # --- GL-TRACE ----------------------------------------------------
+    # Dotted-call prefixes that are host side effects inside a traced
+    # body (a trace-time call silently bakes a constant into the
+    # compiled program and never runs again).
+    trace_impure_calls: list[str] = field(
+        default_factory=lambda: [
+            "time.",
+            "print",
+            "input",
+            "open",
+            "os.environ",
+            "injector.fire",
+            "faults.record",
+            "interleave_mod.stats.",
+            "prefix_mod.stats.",
+            "stats.record_",
+            "random.random",
+            "random.randint",
+        ]
+    )
+    # Extra dotted function names (module.func) to treat as trace roots
+    # beyond what jit/pallas_call discovery finds.
+    trace_extra_roots: list[str] = field(default_factory=list)
+    # --- GL-RETRACE --------------------------------------------------
+    # Functions that bound a Python scalar to a small fixed set of
+    # values (pow2 buckets): their results may feed static args.
+    retrace_bucketers: list[str] = field(
+        default_factory=lambda: [
+            "bucket_length",
+            "_next_chunk_len",
+            "_fused_chunk_len",
+        ]
+    )
+    # --- GL-REFCOUNT -------------------------------------------------
+    # Modules whose PageAllocator call sites get path analysis, and the
+    # acquire->release pairs ("acquire=release").
+    refcount_modules: list[str] = field(
+        default_factory=lambda: [
+            "adversarial_spec_tpu.engine.scheduler",
+            "adversarial_spec_tpu.engine.prefix_cache",
+            "adversarial_spec_tpu.engine.tpu",
+            "adversarial_spec_tpu.engine.mock",
+        ]
+    )
+    refcount_pairs: list[str] = field(
+        default_factory=lambda: [
+            "new_sequence=free_sequence",
+            "adopt=free_sequence",
+            "cache_ref=cache_unref",
+        ]
+    )
+
+    def acquire_release(self) -> dict[str, str]:
+        out: dict[str, str] = {}
+        for pair in self.refcount_pairs:
+            acquire, _, release = pair.partition("=")
+            if not release:
+                raise ValueError(
+                    f"refcount_pairs entry {pair!r} is not 'acquire=release'"
+                )
+            out[acquire.strip()] = release.strip()
+        return out
+
+
+_STRING = re.compile(r'^"((?:[^"\\]|\\.)*)"$')
+
+
+def _parse_scalar(text: str, key: str):
+    text = text.strip()
+    m = _STRING.match(text)
+    if m:
+        return m.group(1).replace('\\"', '"').replace("\\\\", "\\")
+    if text in ("true", "false"):
+        return text == "true"
+    if re.fullmatch(r"-?\d+", text):
+        return int(text)
+    raise ValueError(f"[tool.graftlint] {key}: unsupported value {text!r}")
+
+
+def _parse_array(text: str, key: str) -> list:
+    inner = text.strip()
+    assert inner.startswith("[") and inner.endswith("]")
+    items = []
+    # Split on commas outside quotes — values are plain strings/ints.
+    for piece in re.findall(r'"(?:[^"\\]|\\.)*"|[^,\[\]\s]+', inner[1:-1]):
+        items.append(_parse_scalar(piece, key))
+    return items
+
+
+def _strip_comment(line: str) -> str:
+    """Drop a ``#`` comment that is OUTSIDE any double-quoted string
+    (valid TOML allows inline comments after values and whole comment
+    lines inside multi-line arrays)."""
+    out = []
+    in_string = False
+    escaped = False
+    for ch in line:
+        if escaped:
+            out.append(ch)
+            escaped = False
+            continue
+        if in_string and ch == "\\":
+            out.append(ch)
+            escaped = True
+            continue
+        if ch == '"':
+            in_string = not in_string
+        elif ch == "#" and not in_string:
+            break
+        out.append(ch)
+    return "".join(out)
+
+
+def read_graftlint_table(pyproject: Path) -> dict:
+    """The ``[tool.graftlint]`` table as a plain dict (subset reader)."""
+    raw: dict = {}
+    if not pyproject.exists():
+        return raw
+    in_table = False
+    pending_key: str | None = None
+    pending_val = ""
+    for line in pyproject.read_text(encoding="utf-8").splitlines():
+        stripped = _strip_comment(line).strip()
+        if pending_key is not None:
+            pending_val += " " + stripped
+            if pending_val.count("[") == pending_val.count("]"):
+                raw[pending_key] = _parse_array(pending_val, pending_key)
+                pending_key = None
+            continue
+        if stripped.startswith("["):
+            in_table = stripped == "[tool.graftlint]"
+            continue
+        if not in_table or not stripped or stripped.startswith("#"):
+            continue
+        key, _, value = stripped.partition("=")
+        key, value = key.strip(), value.strip()
+        if value.startswith("["):
+            if value.count("[") == value.count("]"):
+                raw[key] = _parse_array(value, key)
+            else:
+                pending_key, pending_val = key, value
+        else:
+            raw[key] = _parse_scalar(value, key)
+    return raw
+
+
+def load_config(repo: Path) -> GraftlintConfig:
+    cfg = GraftlintConfig()
+    raw = read_graftlint_table(repo / "pyproject.toml")
+    for key, value in raw.items():
+        attr = key.replace("-", "_")
+        if not hasattr(cfg, attr):
+            raise ValueError(f"[tool.graftlint] unknown key {key!r}")
+        setattr(cfg, attr, value)
+    return cfg

@@ -1,0 +1,323 @@
+"""Pass 1: parse every file once and index what the rules need.
+
+Ported from tools/astlint.py's collection phase and extended: each
+``ModuleInfo`` additionally keeps its parsed tree, its import maps
+(local name -> module / (module, original name)), the function AST nodes
+(GL-TRACE walks bodies), and the module's jit entry points with their
+``static_argnames`` (GL-RETRACE checks call sites against them).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+
+
+@dataclass
+class FuncSig:
+    name: str
+    n_pos: int  # positional (posonly + args), excluding self for methods
+    n_pos_defaults: int
+    kwonly: tuple[str, ...] = ()
+    kwonly_required: tuple[str, ...] = ()
+    has_vararg: bool = False
+    has_kwarg: bool = False
+    pos_names: tuple[str, ...] = ()
+    checkable: bool = True  # False when a decorator may change the sig
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    methods: dict[str, FuncSig] = field(default_factory=dict)
+    bases: tuple[str, ...] = ()
+
+
+@dataclass
+class JitEntry:
+    """A jit-compiled callable: calling it with an unbounded Python
+    scalar (static arg) or a bare host scalar (traced arg) retraces."""
+
+    name: str  # public callable name in its module
+    modname: str
+    impl: str  # the wrapped function's name (signature source)
+    static_argnames: tuple[str, ...] = ()
+
+
+@dataclass
+class ModuleInfo:
+    path: Path
+    modname: str
+    tree: ast.Module = None  # type: ignore[assignment]
+    bindings: set[str] = field(default_factory=set)
+    functions: dict[str, FuncSig] = field(default_factory=dict)
+    classes: dict[str, ClassInfo] = field(default_factory=dict)
+    func_nodes: dict[str, ast.FunctionDef] = field(default_factory=dict)
+    # local alias -> imported module name   (import x.y as z)
+    mod_imports: dict[str, str] = field(default_factory=dict)
+    # local alias -> (source module, original name)  (from m import n)
+    from_imports: dict[str, tuple[str, str]] = field(default_factory=dict)
+    jit_entries: dict[str, JitEntry] = field(default_factory=dict)
+
+
+def decorator_name(dec: ast.expr) -> str:
+    """Best-effort dotted name of a decorator / call / base expression."""
+    if isinstance(dec, ast.Call):
+        inner = decorator_name(dec.func)
+        if inner in ("functools.partial", "partial"):
+            if dec.args:
+                wrapped = decorator_name(dec.args[0])
+                return wrapped if wrapped != "?" else "partial(?)"
+            return "partial(?)"
+        return inner
+    if isinstance(dec, ast.Attribute):
+        base = decorator_name(dec.value)
+        return f"{base}.{dec.attr}" if base else dec.attr
+    if isinstance(dec, ast.Name):
+        return dec.id
+    return "?"
+
+
+def dotted_name(expr: ast.expr) -> str:
+    """Dotted form of a Name/Attribute chain ("" when not a chain)."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        base = dotted_name(expr.value)
+        return f"{base}.{expr.attr}" if base else ""
+    return ""
+
+
+def sig_of(
+    fn: ast.FunctionDef | ast.AsyncFunctionDef,
+    is_method: bool,
+    sig_preserving: set[str],
+) -> FuncSig:
+    a = fn.args
+    pos = [p.arg for p in a.posonlyargs + a.args]
+    skip_self = 0
+    if is_method:
+        decs = {decorator_name(d) for d in fn.decorator_list}
+        if "staticmethod" not in decs and pos:
+            skip_self = 1  # self / cls
+    pos = pos[skip_self:]
+    checkable = True
+    for d in fn.decorator_list:
+        name = decorator_name(d)
+        if name not in sig_preserving and not name.startswith(
+            ("jax.", "functools.", "pl.", "pytest.")
+        ):
+            checkable = False
+    kwonly = tuple(p.arg for p in a.kwonlyargs)
+    kwonly_required = tuple(
+        p.arg for p, d in zip(a.kwonlyargs, a.kw_defaults) if d is None
+    )
+    return FuncSig(
+        name=fn.name,
+        n_pos=len(pos),
+        n_pos_defaults=len(a.defaults),
+        kwonly=kwonly,
+        kwonly_required=kwonly_required,
+        has_vararg=a.vararg is not None,
+        has_kwarg=a.kwarg is not None,
+        pos_names=tuple(pos),
+        checkable=checkable,
+    )
+
+
+def _jit_static_argnames(call: ast.Call) -> tuple[str, ...]:
+    """static_argnames tuple from a jax.jit / partial(jax.jit, ...) call."""
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            v = kw.value
+            if isinstance(v, (ast.Tuple, ast.List)):
+                return tuple(
+                    e.value
+                    for e in v.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                )
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                return (v.value,)
+    return ()
+
+
+def _jit_call_info(expr: ast.expr) -> tuple[tuple[str, ...], str] | None:
+    """Recognize ``X = partial(jax.jit, ...)(impl)`` / ``jax.jit(impl)``
+    value expressions: returns (static_argnames, impl_name) or None."""
+    if not isinstance(expr, ast.Call):
+        return None
+    inner = expr.func
+    if isinstance(inner, ast.Call):
+        head = decorator_name(inner.func)
+        if head in ("functools.partial", "partial") and inner.args:
+            if decorator_name(inner.args[0]) in ("jax.jit", "jit"):
+                if expr.args and isinstance(expr.args[0], ast.Name):
+                    return _jit_static_argnames(inner), expr.args[0].id
+    elif decorator_name(inner) in ("jax.jit", "jit"):
+        if expr.args and isinstance(expr.args[0], ast.Name):
+            return _jit_static_argnames(expr), expr.args[0].id
+    return None
+
+
+def _jit_decoration(
+    fn: ast.FunctionDef | ast.AsyncFunctionDef,
+) -> tuple[str, ...] | None:
+    """static_argnames when ``fn`` is jit-decorated, else None."""
+    for dec in fn.decorator_list:
+        name = decorator_name(dec)
+        if name in ("jax.jit", "jit"):
+            if isinstance(dec, ast.Call):
+                return _jit_static_argnames(dec)
+            return ()
+        if isinstance(dec, ast.Call):
+            head = decorator_name(dec.func)
+            if head in ("functools.partial", "partial") and dec.args:
+                if decorator_name(dec.args[0]) in ("jax.jit", "jit"):
+                    return _jit_static_argnames(dec)
+    return None
+
+
+def collect_module(
+    path: Path,
+    modname: str,
+    sig_preserving: set[str] | None = None,
+) -> ModuleInfo:
+    sig_preserving = sig_preserving or set()
+    # filename= so a SyntaxError names the failing file, not <unknown>.
+    tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+    info = ModuleInfo(path=path, modname=modname, tree=tree)
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info.bindings.add(node.name)
+            info.functions[node.name] = sig_of(
+                node, is_method=False, sig_preserving=sig_preserving
+            )
+            info.func_nodes[node.name] = node
+            static = _jit_decoration(node)
+            if static is not None:
+                info.jit_entries[node.name] = JitEntry(
+                    name=node.name,
+                    modname=modname,
+                    impl=node.name,
+                    static_argnames=static,
+                )
+        elif isinstance(node, ast.ClassDef):
+            info.bindings.add(node.name)
+            ci = ClassInfo(
+                name=node.name,
+                bases=tuple(decorator_name(b) for b in node.bases),
+            )
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    ci.methods[sub.name] = sig_of(
+                        sub, is_method=True, sig_preserving=sig_preserving
+                    )
+            info.classes[node.name] = ci
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    info.bindings.add(t.id)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    for e in t.elts:
+                        if isinstance(e, ast.Name):
+                            info.bindings.add(e.id)
+            jit = _jit_call_info(node.value)
+            if jit is not None and isinstance(node.targets[0], ast.Name):
+                static, impl = jit
+                name = node.targets[0].id
+                info.jit_entries[name] = JitEntry(
+                    name=name,
+                    modname=modname,
+                    impl=impl,
+                    static_argnames=static,
+                )
+        elif isinstance(node, ast.AnnAssign) and isinstance(
+            node.target, ast.Name
+        ):
+            info.bindings.add(node.target.id)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            _collect_imports(info, node, top_level=True)
+        elif isinstance(node, (ast.If, ast.Try)):
+            # Conditional top-level defs (TYPE_CHECKING, fallbacks):
+            # bind anything defined in any branch.
+            for sub in ast.walk(node):
+                if isinstance(
+                    sub,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                ):
+                    info.bindings.add(sub.name)
+                elif isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            info.bindings.add(t.id)
+                elif isinstance(sub, (ast.Import, ast.ImportFrom)):
+                    for alias in sub.names:
+                        if alias.name != "*":
+                            info.bindings.add(
+                                alias.asname or alias.name.split(".")[0]
+                            )
+    # Function-local imports matter for cross-module resolution too
+    # (mid-function imports are idiomatic for lazy jax loading).
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            _collect_imports(info, node, top_level=False)
+    return info
+
+
+def _collect_imports(
+    info: ModuleInfo, node: ast.Import | ast.ImportFrom, top_level: bool
+) -> None:
+    if isinstance(node, ast.Import):
+        for alias in node.names:
+            local = alias.asname or alias.name.split(".")[0]
+            if top_level:
+                info.bindings.add(local)
+            if alias.asname or "." not in alias.name:
+                info.mod_imports.setdefault(local, alias.name)
+    else:
+        target = resolve_import_from(info, node)
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            local = alias.asname or alias.name
+            if top_level:
+                info.bindings.add(local)
+            if target:
+                info.from_imports.setdefault(local, (target, alias.name))
+
+
+def resolve_import_from(info: ModuleInfo, node: ast.ImportFrom) -> str:
+    """Absolute module a ``from X import ...`` pulls from ("" if the
+    relative import escapes the indexed tree)."""
+    if not node.level:
+        return node.module or ""
+    # Level 1 means "this package": for a package __init__ that is the
+    # module itself; for a plain module it is the parent.
+    drop = node.level - (1 if info.path.name == "__init__.py" else 0)
+    if drop == 0:
+        base = info.modname
+    else:
+        parts = info.modname.rsplit(".", drop)
+        if len(parts) <= drop:
+            return ""
+        base = parts[0]
+    return f"{base}.{node.module}" if node.module else base
+
+
+def modname_for(path: Path, repo: Path) -> str:
+    rel = path.relative_to(repo).with_suffix("")
+    parts = list(rel.parts)
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def build_index(
+    files: list[Path], repo: Path, sig_preserving: set[str]
+) -> dict[str, ModuleInfo]:
+    index: dict[str, ModuleInfo] = {}
+    for f in files:
+        modname = modname_for(f, repo)
+        index[modname] = collect_module(f, modname, sig_preserving)
+    return index

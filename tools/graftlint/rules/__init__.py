@@ -1,0 +1,40 @@
+"""Rule modules. Importing this package registers every rule; the
+registry is the single source of truth for ``--list-rules``, selection,
+and the self-test harness."""
+
+from __future__ import annotations
+
+from tools.graftlint.core import Context, Rule, register
+
+from tools.graftlint.rules import (  # noqa: E402,F401
+    refcount,
+    retrace,
+    sync,
+    trace,
+    typecheck,
+)
+
+
+@register
+class SuppressRule(Rule):
+    """Suppression hygiene. The findings are produced by the driver
+    (which owns suppression parsing); registering the id here gives it
+    a catalog entry, ``--rule`` selectability, and a self-test fixture
+    like every other rule."""
+
+    id = "GL-SUPPRESS"
+    title = "suppressions must carry a reason and name real rules"
+    rationale = (
+        "A reasonless disable is an unreviewable mute; a typo'd rule id "
+        "is a silently disarmed check. Both are findings, and a "
+        "reasonless disable does not suppress anything."
+    )
+    fixtures = {
+        "pkg/bad_suppress.py": (
+            "import os  # graftlint: disable=GL-SYNC\n"
+        ),
+    }
+
+    def check(self, ctx: Context) -> None:
+        # Driver-implemented (needs the post-rule findings list).
+        return None

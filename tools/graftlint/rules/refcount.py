@@ -1,0 +1,193 @@
+"""GL-REFCOUNT — allocator acquires must reach a release on all paths.
+
+The paged KV pool is ref-counted (engine/kvcache.py): a sequence's pages
+return to the free list only when every reference drops. A
+``new_sequence`` / ``adopt`` / ``cache_ref`` whose owner then raises
+before any ``free_sequence`` / ``cache_unref`` runs is a silent leak —
+the pool shrinks by a few pages per fault until admissions start
+deferring forever. PRs 1-3 made exception paths *routine* (chaos seams,
+fault isolation, timeout expiry), so "it only leaks when something
+throws" means "it leaks in production".
+
+Intraprocedural path check, per function in the configured modules
+(``refcount_modules``): every acquisition call must be covered by a
+``try`` whose ``except``/``finally`` bodies call the matching release —
+either the acquisition sits inside that try's body, or the try is the
+IMMEDIATELY NEXT statement after the acquisition's (the
+acquire-then-guard idiom ``_start_admission`` uses; any intervening
+statement is a window where a raise leaks, so it breaks the guard).
+Functions that only transfer ownership (registering the page/sequence
+in a structure another path releases) suppress with a reason naming the
+releasing path.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import Context, Rule, register
+
+
+def _method_name(call: ast.Call) -> str:
+    f = call.func
+    return f.attr if isinstance(f, ast.Attribute) else ""
+
+
+def _calls_release(body_nodes: list[ast.stmt], release: str) -> bool:
+    for stmt in body_nodes:
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Call) and _method_name(sub) == release:
+                return True
+    return False
+
+
+def _child_blocks(stmt: ast.stmt):
+    for field in ("body", "orelse", "finalbody"):
+        block = getattr(stmt, field, None)
+        if (
+            isinstance(block, list)
+            and block
+            and isinstance(block[0], ast.stmt)
+        ):
+            yield block
+    for handler in getattr(stmt, "handlers", []):
+        if handler.body:
+            yield handler.body
+
+
+_SIMPLE_STMTS = (ast.Expr, ast.Assign, ast.AugAssign, ast.AnnAssign)
+# Single-pass compounds: control leaving their last statement falls
+# straight through to the next sibling, so tail position propagates.
+# Loops do NOT qualify (a later iteration's raise leaks the earlier
+# iteration's acquire) and neither does a non-guard try (its handlers
+# run in between).
+_TAIL_TRANSPARENT = (ast.If, ast.With, ast.AsyncWith)
+
+
+def _scan_block(
+    block: list[ast.stmt], line: int, guard_ids: set[int]
+) -> tuple[bool, bool] | None:
+    """Locate the acquire at ``line`` within ``block`` (recursively) and
+    decide (protected, tail):
+
+    - protected: the acquire sits inside a guard try's BODY, or its
+      statement chain is immediately followed by a guard try with no
+      intervening statement (tail position all the way up);
+    - tail: nothing can execute between the acquire and this block's
+      fall-through — the parent may still find a guard as the next
+      sibling.
+
+    None when ``line`` is not in this block.
+    """
+    for i, stmt in enumerate(block):
+        lo = stmt.lineno
+        hi = getattr(stmt, "end_lineno", lo)
+        if not lo <= line <= hi:
+            continue
+        next_is_guard = (
+            i + 1 < len(block)
+            and isinstance(block[i + 1], ast.Try)
+            and id(block[i + 1]) in guard_ids
+        )
+        if isinstance(stmt, ast.Try) and id(stmt) in guard_ids:
+            body_lo = stmt.body[0].lineno
+            body_hi = getattr(
+                stmt.body[-1], "end_lineno", stmt.body[-1].lineno
+            )
+            if body_lo <= line <= body_hi:
+                return (True, False)
+        sub = None
+        for child in _child_blocks(stmt):
+            r = _scan_block(child, line, guard_ids)
+            if r is not None:
+                sub = r
+                break
+        if sub is None:
+            # The acquire sits directly in this statement — a simple
+            # statement, or a compound's header/test (never tail: the
+            # compound's own body runs before any sibling guard).
+            simple = isinstance(stmt, _SIMPLE_STMTS)
+            if simple and next_is_guard:
+                return (True, True)
+            return (False, simple and i == len(block) - 1)
+        protected, tail = sub
+        if protected:
+            return (True, False)
+        if tail and isinstance(stmt, _TAIL_TRANSPARENT):
+            if next_is_guard:
+                return (True, True)
+            return (False, i == len(block) - 1)
+        return (False, False)
+    return None
+
+
+@register
+class RefcountRule(Rule):
+    id = "GL-REFCOUNT"
+    title = "allocator acquires must be released on exception paths"
+    rationale = (
+        "A missed free on a raise path is an invisible leak in a "
+        "ref-counted pool: no crash, no wrong token, just a pool that "
+        "monotonically shrinks every fault until admission stalls."
+    )
+    fixtures = {
+        "pkg/leaky.py": (
+            "def admit(allocator, seq_id, tokens):\n"
+            "    allocator.new_sequence(seq_id)\n"
+            "    allocator.extend(seq_id, len(tokens))  # can raise\n"
+            "    return seq_id\n"
+        ),
+    }
+    fixture_config = {"refcount_modules": ["pkg.leaky"]}
+
+    def check(self, ctx: Context) -> None:
+        pairs = ctx.cfg.acquire_release()
+        for modname in ctx.cfg.refcount_modules:
+            info = ctx.index.get(modname)
+            if info is None:
+                continue
+            for node in ast.walk(info.tree):
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    self._check_function(ctx, info, node, pairs)
+
+    def _check_function(self, ctx, info, fn, pairs) -> None:
+        # Tries (anywhere in fn) whose handlers/finally release, per
+        # release method.
+        guards: dict[str, set[int]] = {}  # release -> guard-try ids
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Try):
+                continue
+            for release in set(pairs.values()):
+                handler_bodies: list[ast.stmt] = list(node.finalbody)
+                for h in node.handlers:
+                    handler_bodies += h.body
+                if _calls_release(handler_bodies, release):
+                    guards.setdefault(release, set()).add(id(node))
+
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            acquire = _method_name(node)
+            release = pairs.get(acquire)
+            if release is None:
+                continue
+            r = _scan_block(
+                fn.body, node.lineno, guards.get(release, set())
+            )
+            protected = r is not None and r[0]
+            if not protected:
+                ctx.report(
+                    "GL-REFCOUNT",
+                    info.path,
+                    node.lineno,
+                    f"{acquire}() in {fn.name} has no except/finally "
+                    f"path calling {release}() covering it — an "
+                    "exception between the acquire and the release "
+                    "leaks the reference; guard it (acquire "
+                    f"immediately followed by try/except: {release}; "
+                    "raise) or suppress with a reason naming the owner "
+                    "that releases it",
+                )
+

@@ -1,0 +1,279 @@
+"""GL-RETRACE — jit call sites must keep the compile-shape set bounded.
+
+Every distinct value of a ``static_argnames`` parameter — and every
+Python scalar that jit weak-types into the trace — is a separate
+compiled program. One stray dynamic scalar (a raw ``len(prompt)``, an
+unbucketed remaining-token count) turns the fixed pow2 program set
+PR 2 established into a retrace per request: the host-overhead-bound
+regime where TPU serving walls go to die.
+
+At every statically resolvable call to a known jit entry point
+(discovered from ``@jax.jit`` / ``partial(jax.jit, …)`` decorations and
+``name = partial(jax.jit, …)(impl)`` wrappings):
+
+- a **static** argument must be *bounded*: a literal, an attribute read
+  (``self.chunk`` — fixed per instance), a module-level constant, a
+  value derived from an array's ``.shape`` (already a compiled shape),
+  or a call to an approved bucketer (``retrace_bucketers`` config:
+  ``bucket_length`` & friends). Provably-dynamic expressions — direct
+  ``len()/int()/float()`` results, arithmetic on them, or locals
+  assigned from such — are findings.
+- a **traced** argument must not be a bare host-scalar call
+  (``int(x)``, ``len(x)`` …): wrap it (``jnp.int32(x)``) so it enters
+  the program as a device operand, or declare it static and bucket it.
+
+Names whose provenance is unknown (enclosing-function parameters,
+loop-carried state) are skipped — the rule is conservative by design;
+the discipline is enforced where the scalar is *produced*.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import Context, Rule, register
+from tools.graftlint.index import FuncSig, JitEntry, ModuleInfo, dotted_name
+
+_HOST_SCALAR_FNS = {"len", "int", "float", "bool", "ord", "round"}
+
+
+def _walk_own_scope(fn: ast.AST):
+    """ast.walk restricted to ``fn``'s own scope: does not descend into
+    nested FunctionDef/AsyncFunctionDef/Lambda bodies."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def _jit_callables(
+    ctx: Context,
+) -> dict[str, tuple[JitEntry, FuncSig | None]]:
+    """dotted name -> entry, plus per-module local/imported aliases are
+    resolved at the call site (see _resolve_entry)."""
+    out: dict[str, tuple[JitEntry, FuncSig | None]] = {}
+    for modname, info in ctx.index.items():
+        for entry in info.jit_entries.values():
+            sig = info.functions.get(entry.impl)
+            out[f"{modname}.{entry.name}"] = (entry, sig)
+    return out
+
+
+def _resolve_entry(
+    info: ModuleInfo,
+    func: ast.expr,
+    table: dict[str, tuple[JitEntry, FuncSig | None]],
+):
+    """The (entry, sig) a call's func expression statically names."""
+    if isinstance(func, ast.Name):
+        name = func.id
+        hit = table.get(f"{info.modname}.{name}")
+        if hit:
+            return hit
+        if name in info.from_imports:
+            src_mod, orig = info.from_imports[name]
+            return table.get(f"{src_mod}.{orig}")
+    elif isinstance(func, ast.Attribute) and isinstance(
+        func.value, ast.Name
+    ):
+        target = info.mod_imports.get(func.value.id)
+        if target is not None:
+            return table.get(f"{target}.{func.attr}")
+    return None
+
+
+class _LocalFlow:
+    """One-level provenance for locals of the enclosing function:
+    name -> "bounded" | "dynamic" | absent (unknown). Nested function
+    bodies have their own scope — their assignments must not poison a
+    same-named outer local — so the walk stops at inner defs."""
+
+    def __init__(self, fn: ast.AST | None, bucketers: set[str]):
+        self.kinds: dict[str, str] = {}
+        self.bucketers = bucketers
+        if fn is None:
+            return
+        for node in _walk_own_scope(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name):
+                    self._note(t.id, node.value)
+                elif isinstance(t, ast.Tuple) and all(
+                    isinstance(e, ast.Name) for e in t.elts
+                ):
+                    # B, S = tokens.shape — shape dims are existing
+                    # compile shapes, so all targets are bounded.
+                    if self._expr_kind(node.value) == "bounded":
+                        for e in t.elts:
+                            self.kinds[e.id] = "bounded"
+
+    def _note(self, name: str, value: ast.expr) -> None:
+        kind = self._expr_kind(value)
+        prev = self.kinds.get(name)
+        # A name rebound with mixed provenance degrades to unknown
+        # (flow-insensitive join), except dynamic which is sticky.
+        if prev == "dynamic" or kind == "dynamic":
+            self.kinds[name] = "dynamic"
+        elif prev is None:
+            self.kinds[name] = kind
+        elif prev != kind:
+            self.kinds.pop(name, None)
+
+    def _expr_kind(self, expr: ast.expr) -> str:
+        """"bounded" | "dynamic" | "unknown" for a value expression."""
+        if isinstance(expr, ast.Constant):
+            return "bounded"
+        if isinstance(expr, ast.UnaryOp):
+            return self._expr_kind(expr.operand)
+        if isinstance(expr, ast.Attribute):
+            # obj.attr reads: fixed per object (self.chunk, cfg.depth)
+            # or an array's .shape — both bounded.
+            return "bounded"
+        if isinstance(expr, ast.Subscript):
+            # x.shape[0], table[i] — bounded iff the base is.
+            return self._expr_kind(expr.value)
+        if isinstance(expr, ast.Call):
+            name = dotted_name(expr.func)
+            tail = name.rsplit(".", 1)[-1] if name else ""
+            if tail in self.bucketers:
+                return "bounded"
+            if name in _HOST_SCALAR_FNS:
+                return "dynamic"
+            return "unknown"
+        if isinstance(expr, ast.BinOp):
+            left = self._expr_kind(expr.left)
+            right = self._expr_kind(expr.right)
+            if "dynamic" in (left, right):
+                return "dynamic"
+            if left == right == "bounded":
+                return "bounded"
+            return "unknown"
+        return "unknown"
+
+    def kind_of(self, expr: ast.expr) -> str:
+        if isinstance(expr, ast.Name):
+            return self.kinds.get(expr.id, "unknown")
+        return self._expr_kind(expr)
+
+
+@register
+class RetraceRule(Rule):
+    id = "GL-RETRACE"
+    title = "jit static args bounded; traced args never bare host scalars"
+    rationale = (
+        "jit compiles one program per static-arg value and per weak-"
+        "typed Python scalar: an unbucketed dynamic length is a retrace "
+        "storm — compile time on the serving path, once per request."
+    )
+    fixtures = {
+        "pkg/calls.py": (
+            "from functools import partial\n"
+            "import jax\n"
+            "import jax.numpy as jnp\n"
+            "\n"
+            "def _impl(x, n, *, chunk):\n"
+            "    return x\n"
+            "\n"
+            "step = partial(jax.jit, static_argnames=('chunk',))(_impl)\n"
+            "\n"
+            "def drive(x, xs):\n"
+            "    step(x, jnp.int32(0), chunk=256)        # fine\n"
+            "    step(x, jnp.int32(0), chunk=len(xs))    # retrace storm\n"
+            "    step(x, len(xs), chunk=256)             # host scalar\n"
+        ),
+    }
+
+    def check(self, ctx: Context) -> None:
+        table = _jit_callables(ctx)
+        bucketers = set(ctx.cfg.retrace_bucketers)
+        for info in ctx.index.values():
+            self._check_module(ctx, info, table, bucketers)
+
+    def _check_module(self, ctx, info, table, bucketers) -> None:
+        # Map each call to its innermost enclosing function: visit defs
+        # outermost-first (ast.walk order by lineno) so nested defs
+        # overwrite their own calls and each call keeps its innermost
+        # owner for local-flow analysis.
+        enclosing: dict[int, ast.AST] = {}
+        defs = sorted(
+            (
+                n
+                for n in ast.walk(info.tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ),
+            key=lambda f: f.lineno,
+        )
+        for fn in defs:
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Call):
+                    enclosing[id(sub)] = fn
+
+        flows: dict[int, _LocalFlow] = {}
+        for node in ast.walk(info.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            hit = _resolve_entry(info, node.func, table)
+            if hit is None:
+                continue
+            entry, sig = hit
+            if sig is None:
+                continue
+            owner = enclosing.get(id(node))
+            key = id(owner) if owner is not None else 0
+            if key not in flows:
+                flows[key] = _LocalFlow(owner, bucketers)
+            flow = flows[key]
+            self._check_call(ctx, info, node, entry, sig, flow)
+
+    def _check_call(self, ctx, info, node, entry, sig, flow) -> None:
+        static = set(entry.static_argnames)
+
+        def warn(arg_node: ast.AST, param: str, msg: str) -> None:
+            ctx.report(
+                "GL-RETRACE",
+                info.path,
+                arg_node.lineno,
+                f"{entry.name}(... {param}=...) {msg}",
+            )
+
+        if any(isinstance(a, ast.Starred) for a in node.args) or any(
+            kw.arg is None for kw in node.keywords
+        ):
+            return  # *args/**kwargs: not statically resolvable
+        bound: list[tuple[str, ast.expr]] = []
+        for i, arg in enumerate(node.args):
+            if i < len(sig.pos_names):
+                bound.append((sig.pos_names[i], arg))
+        for kw in node.keywords:
+            bound.append((kw.arg, kw.value))
+
+        for param, value in bound:
+            kind = flow.kind_of(value)
+            if param in static:
+                if kind == "dynamic":
+                    warn(
+                        value,
+                        param,
+                        "passes a dynamic Python scalar to a static "
+                        "arg — every distinct value recompiles; bucket "
+                        "it (bucket_length & friends) or fix it per "
+                        "call site",
+                    )
+            else:
+                # Traced param: a direct host-scalar call weak-types a
+                # fresh Python scalar into the trace.
+                if (
+                    isinstance(value, ast.Call)
+                    and dotted_name(value.func) in _HOST_SCALAR_FNS
+                ):
+                    warn(
+                        value,
+                        param,
+                        "passes a bare host scalar to a traced arg — "
+                        "wrap it (jnp.int32/jnp.asarray) or declare it "
+                        "static and bucket it",
+                    )

@@ -1,0 +1,161 @@
+"""GL-SYNC — no host sync in the continuous batcher outside sanctioned
+sync points.
+
+The pipelined drive loop's whole contract (docs/perf.md) is that the
+host never blocks on the device between chunks: it dispatches against a
+trailing snapshot and syncs only at admission handoff, slot completion,
+fault decisions, and timeout expiry. astlint's rule 4 guarded the
+EXPLICIT sync (``jax.block_until_ready``); this rule also catches the
+implicit ones that stall identically but look innocent:
+
+- ``np.asarray(x)`` / ``numpy.asarray(x)`` on a device value
+- ``jax.device_get(x)``
+- ``x.item()`` on a device value
+- ``int(x)`` / ``float(x)`` / ``bool(x)`` on a device value
+- truthiness of a device value (``if x.any():`` blocks the host)
+
+"Device value" is decided by a configured taint set: attribute names
+that hold device arrays inside the sync class (``sync_device_attrs`` —
+``self.active``, ``adm.pads`` …) and bare local names known to be
+fetched device results (``sync_device_names``). Methods in
+``sync_allowlist`` (the sanctioned blanket-sync points) are exempt;
+individual sanctioned fetches elsewhere carry an inline
+``# graftlint: disable=GL-SYNC -- <why this point may sync>``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import Context, Rule, register
+
+_NUMPY_NAMES = {"np", "numpy"}
+
+
+def _is_device_tainted(
+    expr: ast.expr, device_attrs: set[str], device_names: set[str]
+) -> bool:
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Attribute) and sub.attr in device_attrs:
+            return True
+        if isinstance(sub, ast.Name) and sub.id in device_names:
+            return True
+    return False
+
+
+@register
+class SyncRule(Rule):
+    id = "GL-SYNC"
+    title = "no host sync in the batcher outside sanctioned points"
+    rationale = (
+        "One stray np.asarray/.item()/bool() on a device array inside "
+        "the drive loop serializes host and device again — the exact "
+        "host-overhead-bound stall the pipelined loop exists to remove. "
+        "The implicit forms don't say 'sync' anywhere, so only a "
+        "machine check keeps them out."
+    )
+    fixtures = {
+        "pkg/sched.py": (
+            "import jax\n"
+            "import numpy as np\n"
+            "\n"
+            "class ContinuousBatcher:\n"
+            "    def _advance_admission(self):\n"
+            "        jax.block_until_ready(self.active)  # allowlisted\n"
+            "    def _hot_loop(self):\n"
+            "        jax.block_until_ready(self.active)\n"
+            "        a = np.asarray(self.active)\n"
+            "        n = int(self.n_emitted[0])\n"
+            "        v = self.out_buf.item()\n"
+            "        g = jax.device_get(self.pool)\n"
+            "        if self.active.any():\n"
+            "            pass\n"
+            "        return a, n, v, g\n"
+        ),
+    }
+
+    def check(self, ctx: Context) -> None:
+        cfg = ctx.cfg
+        device_attrs = set(cfg.sync_device_attrs)
+        device_names = set(cfg.sync_device_names)
+        allow = set(cfg.sync_allowlist)
+        for info in ctx.index.values():
+            for node in info.tree.body:
+                if (
+                    not isinstance(node, ast.ClassDef)
+                    or node.name != cfg.sync_class
+                ):
+                    continue
+                for method in node.body:
+                    if not isinstance(
+                        method, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    ):
+                        continue
+                    if method.name in allow:
+                        continue
+                    self._check_method(
+                        ctx, info, method, device_attrs, device_names
+                    )
+
+    def _check_method(
+        self, ctx, info, method, device_attrs, device_names
+    ) -> None:
+        def tainted(expr: ast.expr) -> bool:
+            return _is_device_tainted(expr, device_attrs, device_names)
+
+        def warn(node: ast.AST, what: str) -> None:
+            ctx.report(
+                "GL-SYNC",
+                info.path,
+                node.lineno,
+                f"{what} in {ctx.cfg.sync_class}.{method.name} syncs the "
+                "host outside the sanctioned sync points "
+                f"({', '.join(sorted(ctx.cfg.sync_allowlist))}); fetch at "
+                "a sanctioned point or suppress with a reason",
+            )
+
+        for sub in ast.walk(method):
+            if isinstance(sub, ast.Call):
+                f = sub.func
+                # Explicit: jax.block_until_ready / block_until_ready.
+                if (
+                    isinstance(f, ast.Attribute)
+                    and f.attr == "block_until_ready"
+                ) or (
+                    isinstance(f, ast.Name) and f.id == "block_until_ready"
+                ):
+                    warn(sub, "jax.block_until_ready")
+                # jax.device_get(x): a fetch by definition.
+                elif isinstance(f, ast.Attribute) and f.attr == "device_get":
+                    warn(sub, "jax.device_get")
+                # np.asarray(device) — device→host copy blocks.
+                elif (
+                    isinstance(f, ast.Attribute)
+                    and f.attr == "asarray"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in _NUMPY_NAMES
+                    and sub.args
+                    and tainted(sub.args[0])
+                ):
+                    warn(sub, "np.asarray on a device value")
+                # int()/float()/bool() on a device value.
+                elif (
+                    isinstance(f, ast.Name)
+                    and f.id in ("int", "float", "bool")
+                    and sub.args
+                    and tainted(sub.args[0])
+                ):
+                    warn(sub, f"{f.id}() on a device value")
+                # x.item() on a device value.
+                elif (
+                    isinstance(f, ast.Attribute)
+                    and f.attr == "item"
+                    and not sub.args
+                    and tainted(f.value)
+                ):
+                    warn(sub, ".item() on a device value")
+            elif isinstance(sub, (ast.If, ast.While)) and tainted(sub.test):
+                # Truthiness of a device expression blocks the host.
+                # (int()/bool()/np.asarray inside the test are already
+                # reported above; this catches the bare `if x.any():`.)
+                warn(sub.test, "truthiness of a device value")

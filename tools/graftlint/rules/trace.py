@@ -1,0 +1,188 @@
+"""GL-TRACE — no Python side effects inside jit-traced bodies.
+
+A jit-traced function body runs ONCE per compile shape, at trace time.
+``time.monotonic()`` there stamps the trace, not the step; ``print``
+fires once and never again; mutating ``self``/globals/stats stores
+writes during tracing and then silently stops. All of these "work" on
+the first call and rot into wrong telemetry or stale constants.
+
+Trace roots are discovered, not declared: functions decorated with
+``jax.jit`` / ``partial(jax.jit, ...)``, impls wrapped via
+``name = partial(jax.jit, ...)(impl)``, and kernels passed to
+``pl.pallas_call``. The traced set is the transitive closure over
+statically resolvable calls into the linted tree (same-module names,
+from-imports, ``module.func``) — the fused program's shared bodies
+(``_prefill_chunk_impl`` / ``_decode_chunk_impl``) are reached from
+``fused_prefill_decode_chunk`` automatically.
+
+Flagged inside a traced body:
+- calls matching a configured impure prefix (``time.``, ``print``,
+  stats stores, ``injector.fire`` …);
+- assignment / augmented assignment to any attribute (``self.x = …``,
+  ``obj.n += 1`` — trace-time mutation);
+- ``global`` / ``nonlocal`` declarations.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import Context, Rule, register
+from tools.graftlint.index import ModuleInfo, dotted_name
+
+
+def _pallas_kernels(info: ModuleInfo) -> set[str]:
+    """Local function names passed as the first arg to pl.pallas_call."""
+    out: set[str] = set()
+    for node in ast.walk(info.tree):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name.endswith("pallas_call") and node.args:
+                first = node.args[0]
+                if isinstance(first, ast.Name):
+                    out.add(first.id)
+                elif isinstance(first, ast.Call):
+                    # functools.partial(kernel, ...) wrapping
+                    inner = dotted_name(first.func)
+                    if inner in ("functools.partial", "partial"):
+                        if first.args and isinstance(
+                            first.args[0], ast.Name
+                        ):
+                            out.add(first.args[0].id)
+    return out
+
+
+def traced_functions(ctx: Context) -> set[tuple[str, str]]:
+    """(modname, funcname) closure of everything that traces."""
+    roots: set[tuple[str, str]] = set()
+    for modname, info in ctx.index.items():
+        for entry in info.jit_entries.values():
+            if entry.impl in info.func_nodes:
+                roots.add((modname, entry.impl))
+        for kernel in _pallas_kernels(info):
+            if kernel in info.func_nodes:
+                roots.add((modname, kernel))
+    for dotted in ctx.cfg.trace_extra_roots:
+        mod, _, fn = dotted.rpartition(".")
+        if mod in ctx.index and fn in ctx.index[mod].func_nodes:
+            roots.add((mod, fn))
+
+    closure = set(roots)
+    work = list(roots)
+    while work:
+        modname, fname = work.pop()
+        info = ctx.index[modname]
+        node = info.func_nodes[fname]
+        for callee in _resolvable_callees(ctx, info, node):
+            if callee not in closure:
+                closure.add(callee)
+                work.append(callee)
+    return closure
+
+
+def _resolvable_callees(
+    ctx: Context, info: ModuleInfo, fn: ast.FunctionDef
+) -> list[tuple[str, str]]:
+    out: list[tuple[str, str]] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name):
+            name = f.id
+            if name in info.func_nodes and name != fn.name:
+                out.append((info.modname, name))
+            elif name in info.from_imports:
+                src_mod, orig = info.from_imports[name]
+                src = ctx.index.get(src_mod)
+                if src is not None and orig in src.func_nodes:
+                    out.append((src_mod, orig))
+        elif isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            target = info.mod_imports.get(f.value.id)
+            if target is not None:
+                src = ctx.index.get(target)
+                if src is not None and f.attr in src.func_nodes:
+                    out.append((target, f.attr))
+    return out
+
+
+@register
+class TraceRule(Rule):
+    id = "GL-TRACE"
+    title = "no Python side effects inside jit-traced bodies"
+    rationale = (
+        "A host call inside a traced body executes at trace time only: "
+        "timers stamp the compile, prints vanish after the first shape, "
+        "stats-store updates count shapes instead of steps, and "
+        "attribute writes bake one trace's value in forever."
+    )
+    fixtures = {
+        "pkg/kern.py": (
+            "import time\n"
+            "from functools import partial\n"
+            "import jax\n"
+            "\n"
+            "def _impl(x, counters):\n"
+            "    t0 = time.monotonic()\n"
+            "    print('tracing', t0)\n"
+            "    counters.n_steps += 1\n"
+            "    return x\n"
+            "\n"
+            "step = partial(jax.jit, donate_argnames=())(_impl)\n"
+        ),
+    }
+
+    def check(self, ctx: Context) -> None:
+        impure = list(ctx.cfg.trace_impure_calls)
+        for modname, fname in sorted(traced_functions(ctx)):
+            info = ctx.index[modname]
+            fn = info.func_nodes[fname]
+            self._check_body(ctx, info, fn, impure)
+
+    def _check_body(self, ctx, info, fn, impure) -> None:
+        def warn(node: ast.AST, what: str) -> None:
+            ctx.report(
+                "GL-TRACE",
+                info.path,
+                node.lineno,
+                f"{what} inside jit-traced '{fn.name}' runs at trace "
+                "time only (bakes a constant / fires once per compile "
+                "shape); hoist it to the host caller",
+            )
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name:
+                    for prefix in impure:
+                        # "time." / "stats.record_" are open prefixes;
+                        # bare names ("print") match exactly or at a
+                        # dotted boundary — never "print_report".
+                        if (
+                            name == prefix
+                            or (
+                                prefix[-1] in "._"
+                                and name.startswith(prefix)
+                            )
+                            or name.startswith(prefix + ".")
+                        ):
+                            warn(node, f"call to {name}()")
+                            break
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for t in targets:
+                    if isinstance(t, ast.Attribute):
+                        warn(node, f"attribute write to {dotted_name(t)}")
+                    elif isinstance(t, (ast.Tuple, ast.List)):
+                        for e in t.elts:
+                            if isinstance(e, ast.Attribute):
+                                warn(
+                                    node,
+                                    f"attribute write to {dotted_name(e)}",
+                                )
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                warn(node, f"{type(node).__name__.lower()} declaration")

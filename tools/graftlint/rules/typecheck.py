@@ -1,0 +1,366 @@
+"""astlint's original three type-error checks as registered rules.
+
+GL-IMPORT  ``from <package>.<module> import NAME`` — NAME must be bound
+           in the target module (def / class / assignment / re-export).
+GL-ATTR    ``<module>.NAME`` attribute access on package modules
+           imported as module objects — NAME must be bound there.
+GL-ARITY   call arity + keyword validity for calls that statically
+           resolve to a function, class constructor, or ``self.method``
+           defined in the linted tree.
+
+One visitor produces all three (the resolution state is shared); the
+driver filters findings to the selected rule ids, and an idempotence
+guard keeps ``--rule GL-IMPORT,GL-ARITY`` from double-walking.
+
+Deliberately conservative, exactly like astlint: calls through
+*args/**kwargs, decorated functions whose decorator is not known
+signature-preserving, attribute chains through values, and anything not
+statically resolvable are skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import Context, Rule, register
+from tools.graftlint.index import ClassInfo, FuncSig, ModuleInfo
+
+
+class _Checker(ast.NodeVisitor):
+    def __init__(self, info: ModuleInfo, ctx: Context):
+        self.info = info
+        self.ctx = ctx
+        self.index = ctx.index
+        # local name -> ("func", FuncSig) | ("class", ClassInfo)
+        #            | ("module", ModuleInfo)
+        self.resolved: dict[str, tuple[str, object]] = {}
+        self.current_class: ClassInfo | None = None
+        for name, sig in info.functions.items():
+            self.resolved[name] = ("func", sig)
+        for name, ci in info.classes.items():
+            self.resolved[name] = ("class", ci)
+
+    def _warn(self, rule: str, node: ast.AST, msg: str) -> None:
+        self.ctx.report(rule, self.info.path, node.lineno, msg)
+
+    # ---------------------------------------------------------- imports
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        from tools.graftlint.index import resolve_import_from
+
+        target = resolve_import_from(self.info, node)
+        tinfo = self.index.get(target)
+        if tinfo is not None:
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                # Submodule import (from pkg import engine) counts.
+                if (
+                    alias.name not in tinfo.bindings
+                    and f"{target}.{alias.name}" not in self.index
+                ):
+                    self._warn(
+                        "GL-IMPORT",
+                        node,
+                        f"'{alias.name}' is not defined in {target}",
+                    )
+                local = alias.asname or alias.name
+                if alias.name in tinfo.functions:
+                    self.resolved[local] = (
+                        "func",
+                        tinfo.functions[alias.name],
+                    )
+                elif alias.name in tinfo.classes:
+                    self.resolved[local] = (
+                        "class",
+                        tinfo.classes[alias.name],
+                    )
+                elif f"{target}.{alias.name}" in self.index:
+                    self.resolved[local] = (
+                        "module",
+                        self.index[f"{target}.{alias.name}"],
+                    )
+        self.generic_visit(node)
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            if alias.name in self.index:
+                local = alias.asname or alias.name.split(".")[0]
+                if alias.asname or "." not in alias.name:
+                    self.resolved[local] = (
+                        "module",
+                        self.index[alias.name],
+                    )
+        self.generic_visit(node)
+
+    # ------------------------------------------------------ assignments
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # A rebind shadows whatever we resolved — stop checking it.
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id in self.resolved:
+                self.resolved.pop(t.id, None)
+        self.generic_visit(node)
+
+    # ---------------------------------------------------------- classes
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        prev = self.current_class
+        self.current_class = self.info.classes.get(node.name)
+        self.generic_visit(node)
+        self.current_class = prev
+
+    # ------------------------------------------------------------ scopes
+
+    def _shadowed_names(self, fn) -> set[str]:
+        """Names this function rebinds locally: params plus local
+        assignment/for/with/except targets (one level of flow analysis —
+        enough to avoid false positives, not a full scope model)."""
+        names: set[str] = set()
+        a = fn.args
+        for p in a.posonlyargs + a.args + a.kwonlyargs:
+            names.add(p.arg)
+        if a.vararg:
+            names.add(a.vararg.arg)
+        if a.kwarg:
+            names.add(a.kwarg.arg)
+
+        def add_target(t: ast.expr) -> None:
+            if isinstance(t, ast.Name):
+                names.add(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    add_target(e)
+            elif isinstance(t, ast.Starred):
+                add_target(t.value)
+
+        body = getattr(fn, "body", [])
+        if isinstance(body, ast.expr):  # Lambda
+            body = [ast.Expr(body)]
+        for node in body:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        add_target(t)
+                elif isinstance(sub, (ast.AnnAssign, ast.AugAssign)):
+                    add_target(sub.target)
+                elif isinstance(sub, (ast.For, ast.AsyncFor)):
+                    add_target(sub.target)
+                elif isinstance(sub, ast.NamedExpr):
+                    add_target(sub.target)
+                elif isinstance(sub, ast.comprehension):
+                    add_target(sub.target)
+                elif isinstance(sub, (ast.With, ast.AsyncWith)):
+                    for item in sub.items:
+                        if item.optional_vars is not None:
+                            add_target(item.optional_vars)
+                elif isinstance(sub, ast.ExceptHandler):
+                    if sub.name:
+                        names.add(sub.name)
+                elif isinstance(
+                    sub,
+                    (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef),
+                ):
+                    names.add(sub.name)
+                elif isinstance(sub, (ast.Import, ast.ImportFrom)):
+                    for alias in sub.names:
+                        if alias.name != "*":
+                            names.add(
+                                alias.asname or alias.name.split(".")[0]
+                            )
+                elif isinstance(sub, (ast.Global, ast.Nonlocal)):
+                    # Declared non-local: the name is NOT shadowed.
+                    names.difference_update(sub.names)
+        return names
+
+    def _visit_function_scope(self, node) -> None:
+        shadowed = {
+            n: self.resolved.pop(n)
+            for n in self._shadowed_names(node)
+            if n in self.resolved
+        }
+        self.generic_visit(node)
+        self.resolved.update(shadowed)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_function_scope(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_function_scope(node)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._visit_function_scope(node)
+
+    # ------------------------------------------------------- attributes
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if isinstance(node.value, ast.Name):
+            entry = self.resolved.get(node.value.id)
+            if entry and entry[0] == "module":
+                minfo: ModuleInfo = entry[1]  # type: ignore[assignment]
+                if (
+                    node.attr not in minfo.bindings
+                    and f"{minfo.modname}.{node.attr}" not in self.index
+                    and not node.attr.startswith("__")
+                ):
+                    self._warn(
+                        "GL-ATTR",
+                        node,
+                        f"module '{minfo.modname}' has no attribute "
+                        f"'{node.attr}'",
+                    )
+        self.generic_visit(node)
+
+    # ------------------------------------------------------------ calls
+
+    def _check_sig(self, node: ast.Call, sig: FuncSig, what: str) -> None:
+        if not sig.checkable:
+            return
+        if any(isinstance(a, ast.Starred) for a in node.args) or any(
+            kw.arg is None for kw in node.keywords
+        ):
+            return  # *args / **kwargs at the call site: not statically known
+        self.ctx.n_checked_calls += 1
+        n_pos_given = len(node.args)
+        kw_given = {kw.arg for kw in node.keywords}
+        # positional overflow
+        if not sig.has_vararg and n_pos_given > sig.n_pos:
+            self._warn(
+                "GL-ARITY",
+                node,
+                f"{what} takes {sig.n_pos} positional args "
+                f"but {n_pos_given} given",
+            )
+            return
+        # unknown keywords
+        if not sig.has_kwarg:
+            valid = set(sig.pos_names) | set(sig.kwonly)
+            for kw in kw_given:
+                if kw not in valid:
+                    self._warn(
+                        "GL-ARITY",
+                        node,
+                        f"{what} got unexpected keyword '{kw}'",
+                    )
+        # missing required args: only keywords naming a REQUIRED
+        # positional cover one (a keyword hitting an optional positional
+        # must not mask a missing required arg, e.g. f(b=2) on f(a, b=1)).
+        required_pos = sig.n_pos - sig.n_pos_defaults
+        covered = n_pos_given + len(
+            kw_given & set(sig.pos_names[n_pos_given:required_pos])
+        )
+        if covered < required_pos:
+            self._warn(
+                "GL-ARITY",
+                node,
+                f"{what} missing required args "
+                f"({covered} of {required_pos} provided)",
+            )
+        for kw in sig.kwonly_required:
+            if kw not in kw_given:
+                self._warn(
+                    "GL-ARITY",
+                    node,
+                    f"{what} missing required keyword-only '{kw}'",
+                )
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Name):
+            entry = self.resolved.get(func.id)
+            if entry:
+                kind, obj = entry
+                if kind == "func":
+                    self._check_sig(node, obj, f"{func.id}()")
+                elif kind == "class":
+                    ci: ClassInfo = obj  # type: ignore[assignment]
+                    init = ci.methods.get("__init__")
+                    # dataclasses synthesize __init__; bases may define
+                    # it — only check an explicit local __init__.
+                    if init is not None and not ci.bases:
+                        self._check_sig(node, init, f"{ci.name}()")
+        elif isinstance(func, ast.Attribute):
+            if (
+                isinstance(func.value, ast.Name)
+                and func.value.id == "self"
+                and self.current_class is not None
+            ):
+                sig = self.current_class.methods.get(func.attr)
+                # Inherited methods not indexed: only check when the
+                # class has no bases or defines the method itself.
+                if sig is not None:
+                    self._check_sig(node, sig, f"self.{func.attr}()")
+            elif isinstance(func.value, ast.Name):
+                entry = self.resolved.get(func.value.id)
+                if entry and entry[0] == "module":
+                    minfo: ModuleInfo = entry[1]  # type: ignore
+                    sig = minfo.functions.get(func.attr)
+                    if sig is not None:
+                        self._check_sig(
+                            node,
+                            sig,
+                            f"{minfo.modname}.{func.attr}()",
+                        )
+        self.generic_visit(node)
+
+
+def _run_shared_pass(ctx: Context) -> None:
+    """Walk every module once, whichever of the three rules asked."""
+    if getattr(ctx, "_typecheck_ran", False):
+        return
+    ctx._typecheck_ran = True  # type: ignore[attr-defined]
+    for info in ctx.index.values():
+        _Checker(info, ctx).visit(info.tree)
+
+
+_IMPORT_FIXTURE = {
+    "pkg/good.py": "def takes_two(a, b, *, c=0):\n    return a\n",
+    "pkg/bad.py": (
+        "from pkg.good import takes_two, absent\n"
+        "from pkg import good\n"
+        "x = good.nothing_here\n"
+        "takes_two(1)\n"
+    ),
+}
+
+
+@register
+class ImportRule(Rule):
+    id = "GL-IMPORT"
+    title = "from-imports must name bindings that exist"
+    rationale = (
+        "A bad from-import raises at import time only on the paths that "
+        "reach it; lazily imported modules hide it until a TPU run."
+    )
+    fixtures = _IMPORT_FIXTURE
+
+    def check(self, ctx: Context) -> None:
+        _run_shared_pass(ctx)
+
+
+@register
+class AttrRule(Rule):
+    id = "GL-ATTR"
+    title = "module attribute access must name bindings that exist"
+    rationale = (
+        "mod.NAME on a package module object fails only when executed; "
+        "rarely-taken branches (fault paths) ship the AttributeError."
+    )
+    fixtures = _IMPORT_FIXTURE
+
+    def check(self, ctx: Context) -> None:
+        _run_shared_pass(ctx)
+
+
+@register
+class ArityRule(Rule):
+    id = "GL-ARITY"
+    title = "statically resolvable calls must match the signature"
+    rationale = (
+        "Wrong arity / unknown keywords on package-internal calls are "
+        "runtime TypeErrors on exactly the branches tests miss."
+    )
+    fixtures = _IMPORT_FIXTURE
+
+    def check(self, ctx: Context) -> None:
+        _run_shared_pass(ctx)

@@ -1,0 +1,141 @@
+"""Unified lint/QA runner with ONE exit code — the preflight gate
+tpu_session.sh runs before burning a TPU window on the ladder.
+
+Stages (each prints its own verdict; the runner exits nonzero if ANY
+stage failed):
+
+1. **graftlint** — the full rule set over the repo (tools/graftlint),
+   plus its self-test (every registered rule must fire on its fixture:
+   a silently dead rule is worse than no rule).
+2. **mutmut-config sanity** — the mutation-skip config both mutmut and
+   tools/mutation_run.py consume must stay importable and structurally
+   sound (non-empty marker tuples, tests + graftlint fixtures excluded
+   from mutation targets).
+3. **unroll compile check** (``--full`` only — it jit-compiles an
+   80-layer config three times, minutes of CPU) — the decode-scan
+   unroll cost measurement, tools/unroll_compile_check.py.
+
+Usage:
+    python tools/lint_all.py          # graftlint + mutmut sanity
+    python tools/lint_all.py --full   # + unroll compile check
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+def _stage_graftlint() -> bool:
+    from tools.graftlint import core
+
+    failures = core.self_test()
+    for f in failures:
+        print(f"lint_all: graftlint self-test: {f}", file=sys.stderr)
+    try:
+        result = core.run()
+    except (SyntaxError, ValueError) as e:
+        print(f"lint_all: graftlint: {e}", file=sys.stderr)
+        print("lint_all: graftlint FAILED", file=sys.stderr)
+        return False
+    for finding in result.findings:
+        print(finding.render())
+    ok = not failures and result.exit_code == 0
+    print(
+        f"lint_all: graftlint {'OK' if ok else 'FAILED'} "
+        f"({len(result.findings)} finding(s), "
+        f"{len(failures)} dead rule(s), {result.n_files} files)",
+        file=sys.stderr,
+    )
+    return ok
+
+
+def _stage_mutmut_sanity() -> bool:
+    ok = True
+
+    def fail(msg: str) -> None:
+        nonlocal ok
+        ok = False
+        print(f"lint_all: mutmut-config: {msg}", file=sys.stderr)
+
+    try:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "mutmut_config", REPO / "mutmut_config.py"
+        )
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+    except Exception as e:
+        fail(f"import failed: {e}")
+        print("lint_all: mutmut-config FAILED", file=sys.stderr)
+        return False
+    for name in ("_SKIP_LINE_MARKERS", "_SKIP_PATH_FRAGMENTS"):
+        val = getattr(module, name, None)
+        if not (
+            isinstance(val, tuple)
+            and val
+            and all(isinstance(m, str) and m for m in val)
+        ):
+            fail(f"{name} must be a non-empty tuple of strings")
+    if not callable(getattr(module, "pre_mutation", None)):
+        fail("pre_mutation hook missing")
+    frags = getattr(module, "_SKIP_PATH_FRAGMENTS", ())
+    for required in ("/tests/", "/tools/graftlint/"):
+        if required not in frags:
+            fail(f"_SKIP_PATH_FRAGMENTS must exclude {required!r}")
+    # mutation_run must agree (it imports the same markers by path) and
+    # must never target the self-test fixture package.
+    from tools.mutation_run import DEFAULT_TARGETS, SKIP_LINE_MARKERS
+
+    if SKIP_LINE_MARKERS != module._SKIP_LINE_MARKERS:
+        fail("mutation_run.SKIP_LINE_MARKERS diverged from mutmut_config")
+    for target in DEFAULT_TARGETS:
+        if "tools/graftlint" in target:
+            fail(f"graftlint fixtures are a mutation target: {target}")
+    print(
+        f"lint_all: mutmut-config {'OK' if ok else 'FAILED'}",
+        file=sys.stderr,
+    )
+    return ok
+
+
+def _stage_unroll() -> bool:
+    r = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "unroll_compile_check.py")],
+        cwd=REPO,
+    )
+    ok = r.returncode == 0
+    print(
+        f"lint_all: unroll-compile-check {'OK' if ok else 'FAILED'}",
+        file=sys.stderr,
+    )
+    return ok
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--full",
+        action="store_true",
+        help="also run the (slow) unroll compile check",
+    )
+    args = ap.parse_args(argv)
+    ok = _stage_graftlint()
+    ok = _stage_mutmut_sanity() and ok
+    if args.full:
+        ok = _stage_unroll() and ok
+    print(
+        f"lint_all: {'ALL OK' if ok else 'FAILURES'}",
+        file=sys.stderr,
+    )
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,17 @@ cd "$(dirname "$0")"
 OUT="${1:-tpu_results/r04.jsonl}"
 mkdir -p "$(dirname "$OUT")"
 
+# Preflight: static gates before burning a TPU window. graftlint +
+# mutmut-config sanity are seconds; --full adds the unroll compile
+# check (minutes of CPU — fine while waiting for a window). A failure
+# aborts the session: a repo that doesn't lint clean should not spend
+# accelerator time.
+echo "$(date -u +%FT%TZ) session: preflight (tools/lint_all.py --full)"
+if ! JAX_PLATFORMS=cpu python tools/lint_all.py --full; then
+  echo "$(date -u +%FT%TZ) session: preflight FAILED — aborting"
+  exit 1
+fi
+
 finish() {
   # Post-harvest actions: decision report + a tuned bench record, so a
   # window that opens while nobody is watching still leaves the full
