@@ -152,6 +152,35 @@ def create_parser() -> argparse.ArgumentParser:
         help="Write a jax.profiler trace for the round to this directory",
     )
 
+    b = parser.add_argument_group("observability")
+    b.add_argument(
+        "--metrics-out",
+        help="Write the round's metrics registry to this file in "
+        "Prometheus text exposition format",
+    )
+    b.add_argument(
+        "--events-out",
+        help="Write the flight recorder's event ring to this file as "
+        "JSONL at end of round; fault/timeout evictions auto-dump the "
+        "ring to a sibling <stem>.<trigger>.jsonl the moment they "
+        "happen",
+    )
+    b.add_argument(
+        "--flight-recorder-size",
+        type=int,
+        default=None,
+        help="Events the flight recorder ring retains (default 512; "
+        "ADVSPEC_FLIGHT_RECORDER_SIZE sets the process default)",
+    )
+    b.add_argument(
+        "--obs",
+        action=argparse.BooleanOptionalAction,
+        default=None,  # None = inherit ADVSPEC_OBS (default on)
+        help="Observability subsystem: metrics registry + flight "
+        "recorder + retrace watch (--no-obs disables every emit; "
+        "ADVSPEC_OBS=0 sets the process default)",
+    )
+
     d = parser.add_argument_group("decode")
     d.add_argument(
         "--max-new-tokens",
@@ -419,6 +448,31 @@ def _configure_interleave(args: argparse.Namespace):
     return interleave
 
 
+def _configure_obs(args: argparse.Namespace):
+    """Arm the observability subsystem from flags; returns the module
+    for reporting. One CLI invocation is one round: metrics zero, the
+    flight-recorder ring clears, and the retrace watch starts fresh, so
+    ``perf.obs`` / ``--metrics-out`` / ``--events-out`` account exactly
+    this round."""
+    from adversarial_spec_tpu import obs
+
+    # Every knob re-resolves to flag-else-env-default each invocation:
+    # one invocation's --no-obs / --flight-recorder-size / --events-out
+    # must not leak into the next round's (one process can run several
+    # invocations — tests, library callers).
+    obs.configure(
+        enabled=args.obs if args.obs is not None else obs.env_enabled(),
+        recorder_size=(
+            args.flight_recorder_size
+            if args.flight_recorder_size is not None
+            else obs.env_recorder_size()
+        ),
+        events_out=args.events_out or "",
+    )
+    obs.reset_stats()
+    return obs
+
+
 def run_critique(args: argparse.Namespace) -> int:
     from adversarial_spec_tpu.utils.tracing import Tracer, maybe_profile
 
@@ -426,6 +480,7 @@ def run_critique(args: argparse.Namespace) -> int:
     breakers = _configure_resilience(args)
     prefix_cache = _configure_prefix_cache(args)
     interleave = _configure_interleave(args)
+    obs = _configure_obs(args)
     spec, session_state = load_or_resume_session(args)
     if session_state is not None and session_state.breakers:
         # One CLI invocation = one round: open circuits from earlier
@@ -480,6 +535,9 @@ def run_critique(args: argparse.Namespace) -> int:
             if isinstance(v, (int, float)) and not isinstance(v, bool)
         }
     )
+    # Per-opponent spans from the debate layer graft under "debate/" —
+    # one report carries both layers' phase breakdowns (span_tree).
+    tracer.merge(result.tracer, prefix="debate")
     perf = tracer.report()
     perf["decode_tokens_per_sec"] = round(tracer.rate("decode_tokens", "decode"), 1)
     perf["resilience"] = {
@@ -491,6 +549,21 @@ def run_critique(args: argparse.Namespace) -> int:
     # under resident decode vs genuinely stalled the batch (their sum IS
     # the round's prefill_time_s), plus step/sync counts.
     perf["interleave"] = interleave.snapshot()
+    # Observability report: flight-recorder occupancy, event mix, host
+    # syncs by reason, retrace watch (unexpected recompiles flagged).
+    perf["obs"] = obs.snapshot()
+    if args.metrics_out:
+        obs.write_metrics(args.metrics_out)
+        _err(f"metrics written to {args.metrics_out}")
+    if args.events_out:
+        n = obs.dump_events(args.events_out)
+        _err(f"{n} flight-recorder event(s) written to {args.events_out}")
+    if perf["obs"]["retrace"]["unexpected_recompiles"]:
+        _err(
+            "warning: "
+            f"{perf['obs']['retrace']['unexpected_recompiles']} unexpected "
+            "jit recompile(s) detected — see perf.obs.retrace in --json"
+        )
     _err(
         f"perf: round {perf['spans'].get('round', 0):.2f}s, "
         f"decode {perf['decode_tokens_per_sec']} tok/s"
@@ -645,6 +718,7 @@ def handle_export_tasks(args: argparse.Namespace) -> int:
     """
     _configure_prefix_cache(args)
     _configure_interleave(args)
+    obs = _configure_obs(args)
     spec = _read_spec_stdin()
     models = parse_models(args)
     errors = validate_models_before_run(models[:1])
@@ -662,6 +736,10 @@ def handle_export_tasks(args: argparse.Namespace) -> int:
         seed=args.seed,
     )
     comp = get_engine(model).chat([req], params)[0]
+    if args.metrics_out:
+        obs.write_metrics(args.metrics_out)
+    if args.events_out:
+        obs.dump_events(args.events_out)
     if not comp.ok:
         _err(f"error: {model} failed: {comp.error}")
         return EXIT_ERROR

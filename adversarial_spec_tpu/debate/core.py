@@ -36,6 +36,7 @@ from adversarial_spec_tpu.engine.dispatch import get_engine
 from adversarial_spec_tpu.engine.types import ChatRequest, Completion, SamplingParams
 from adversarial_spec_tpu.resilience import breaker as breaker_mod
 from adversarial_spec_tpu.resilience.faults import classify_message
+from adversarial_spec_tpu.utils.tracing import Tracer
 
 MAX_RETRIES = 3
 RETRY_BASE_DELAY = 1.0
@@ -131,6 +132,10 @@ def run_round(
     past the deadline).
     """
     cfg = cfg or RoundConfig()
+    # The debate layer's own tracer: per-opponent chat walls + attempt
+    # counters, merged into the CLI's round tracer (Tracer.merge) so the
+    # engine-level and debate-level spans compose into one report.
+    tracer = Tracer()
     breakers = (
         cfg.breakers
         if cfg.breakers is not None
@@ -169,8 +174,13 @@ def run_round(
             t0 = time.monotonic()
             completions = engine.chat(batch, cfg.sampling)
             latency = time.monotonic() - t0
+            tracer.add_span("engine_chat", latency)
             still_pending = []
             for i, comp in zip(pending, completions):
+                # The group's wall IS each rider's wall: rows of one
+                # batched decode finish together from the caller's view.
+                tracer.add_span(f"opponent/{requests[i].model}", latency)
+                tracer.count(f"attempts.{requests[i].model}", 1)
                 # Every attempt's outcome feeds the model's breaker:
                 # threshold consecutive failures open it.
                 if comp.ok:
@@ -205,5 +215,8 @@ def run_round(
                 model=requests[i].model, error="retries exhausted"
             )
 
-    return RoundResult(responses=[r for r in results if r is not None],
-                       round_num=round_num)
+    return RoundResult(
+        responses=[r for r in results if r is not None],
+        round_num=round_num,
+        tracer=tracer,
+    )

@@ -14,6 +14,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from adversarial_spec_tpu.debate.usage import Usage
+from adversarial_spec_tpu.utils.tracing import Tracer
 
 
 @dataclass
@@ -55,6 +56,10 @@ class RoundResult:
 
     responses: list[ModelResponse]
     round_num: int = 1
+    # The debate layer's own span tracer (per-opponent chat walls,
+    # retry/backoff accounting); the CLI merges it into the round-level
+    # tracer via ``Tracer.merge`` so one report nests both layers.
+    tracer: Tracer = field(default_factory=Tracer)
 
     @property
     def successful(self) -> list[ModelResponse]:

@@ -46,6 +46,7 @@ from __future__ import annotations
 import re
 from urllib.parse import parse_qs, urlparse
 
+from adversarial_spec_tpu import obs as obs_mod
 from adversarial_spec_tpu.debate.usage import Usage
 from adversarial_spec_tpu.engine.types import ChatRequest, Completion, SamplingParams
 
@@ -99,23 +100,76 @@ class MockEngine:
         return None
 
     @staticmethod
-    def _account_interleave(n_tokens: int, overlapped: bool) -> None:
+    def _account_interleave(
+        n_tokens: int, overlapped: bool, req_index: int = 0
+    ) -> None:
         """Deterministic CPU mirror of the scheduler's fused-step
         telemetry: this request's prefill either stalled the (synthetic)
         batch or rode an earlier resident's decode. Synthetic seconds
         are tokens/1024 — exact in float, so perf.interleave's
-        ``stalled + overlapped == prefill`` invariant pins with ==."""
+        ``stalled + overlapped == prefill`` invariant pins with ==.
+
+        Emits the SAME observability schema the real scheduler does
+        (StepEvent + step/prefill/TTFT metrics), with the synthetic
+        seconds as the observed values — so the whole obs pipeline
+        (events JSONL, Prometheus text) pins byte-deterministically on
+        CPU without a TPU in the loop."""
         from adversarial_spec_tpu.engine import interleave as interleave_mod
 
         overlapped = overlapped and interleave_mod.config().enabled
+        synth_s = n_tokens / 1024.0
         interleave_mod.stats.record_prefill_time(
-            n_tokens / 1024.0, overlapped=overlapped
+            synth_s, overlapped=overlapped
         )
         interleave_mod.stats.record_step(
             fused=overlapped, prefill_only=not overlapped
         )
+        if obs_mod.config().enabled:
+            obs_mod.hot.prefill_chunk.observe(synth_s)
+            obs_mod.hot.ttft.observe(synth_s)
+            obs_mod.emit(
+                obs_mod.StepEvent(
+                    kind="fused" if overlapped else "prefill",
+                    n_live=req_index if overlapped else 0,
+                    admission_slot=req_index,
+                    prefill_tokens=n_tokens,
+                )
+            )
 
-    def _account_prefix(self, req: ChatRequest, overlapped: bool = False) -> int:
+    @staticmethod
+    def _emit_lifecycle(
+        req_index: int, in_tokens: int, cached: int, out_tokens: int
+    ) -> None:
+        """The scheduler's RequestEvent lifecycle, deterministically:
+        queued → admitted → prefill → decode → finished, one synthetic
+        slot per request. Same schema, pinnable bytes."""
+        if not obs_mod.config().enabled:
+            return
+        transitions = (
+            ("queued", in_tokens),
+            ("admitted", in_tokens),
+            ("prefill", in_tokens - cached),
+            ("decode", out_tokens),
+            ("finished", out_tokens),
+        )
+        for state, tokens in transitions:
+            obs_mod.emit(
+                obs_mod.RequestEvent(
+                    req_id=req_index,
+                    state=state,
+                    slot=req_index,
+                    tokens=tokens,
+                    cached_tokens=cached,
+                )
+            )
+        obs_mod.hot.req_finished.inc()
+
+    def _account_prefix(
+        self,
+        req: ChatRequest,
+        overlapped: bool = False,
+        req_index: int = 0,
+    ) -> int:
         """Run this request's prompt through the real allocator + prefix
         cache (accounting only — no KV exists here) and return the token
         count served from cache. Counts prefilled/saved tokens into the
@@ -129,7 +183,7 @@ class MockEngine:
         ]
         if not prefix_mod.config().enabled:
             prefix_mod.stats.record_prefill(len(tokens), 0)
-            self._account_interleave(len(tokens), overlapped)
+            self._account_interleave(len(tokens), overlapped, req_index)
             return 0
         if self._prefix is None:
             from adversarial_spec_tpu.engine.kvcache import PageAllocator
@@ -158,7 +212,7 @@ class MockEngine:
                 # full prefill (a real engine would still serve the
                 # request; only the reuse bookkeeping is skipped).
                 prefix_mod.stats.record_prefill(len(tokens), 0)
-                self._account_interleave(len(tokens), overlapped)
+                self._account_interleave(len(tokens), overlapped, req_index)
                 return 0
             n_full = len(tokens) // _PAGE_TOKENS
             if n_full:
@@ -169,7 +223,7 @@ class MockEngine:
         finally:
             alloc.free_sequence(seq)
         prefix_mod.stats.record_prefill(len(tokens) - matched, matched)
-        self._account_interleave(len(tokens) - matched, overlapped)
+        self._account_interleave(len(tokens) - matched, overlapped, req_index)
         return matched
 
     def chat(
@@ -179,8 +233,10 @@ class MockEngine:
         # request's prefill would ride the residents' decode in the
         # fused scheduler loop (overlapped) — the deterministic CPU
         # analog of admit-while-decoding.
+        if obs_mod.config().enabled:
+            obs_mod.hot.mock_chat_requests.inc(len(requests))
         return [
-            self._one(req, params, overlapped=i > 0)
+            self._one(req, params, overlapped=i > 0, req_index=i)
             for i, req in enumerate(requests)
         ]
 
@@ -189,6 +245,7 @@ class MockEngine:
         req: ChatRequest,
         params: SamplingParams,
         overlapped: bool = False,
+        req_index: int = 0,
     ) -> Completion:
         parsed = urlparse(req.model)
         behavior = parsed.netloc or parsed.path.lstrip("/")
@@ -200,7 +257,7 @@ class MockEngine:
         round_num = int(m.group(1)) if m else 1
 
         if behavior == "tasks":
-            cached = self._account_prefix(req, overlapped)
+            cached = self._account_prefix(req, overlapped, req_index)
             text = (
                 "[TASK]\ntitle: Define data model\ndescription: Schema and "
                 "migrations for the core entities.\npriority: critical\n"
@@ -213,14 +270,17 @@ class MockEngine:
                 "dependencies: Implement API\nestimate: 1d\n[/TASK]"
             )
             out_tokens = _estimate_tokens(text)
+            in_tokens = _estimate_tokens(req.system) + _estimate_tokens(
+                req.user
+            )
+            self._emit_lifecycle(req_index, in_tokens, cached, out_tokens)
             return Completion(
                 text=text,
                 usage=Usage(
                     # system + user, like the critic branch: the prefix
                     # accounting covers both, and cached_tokens must
                     # stay a subset of input_tokens.
-                    input_tokens=_estimate_tokens(req.system)
-                    + _estimate_tokens(req.user),
+                    input_tokens=in_tokens,
                     output_tokens=out_tokens,
                     decode_tokens=out_tokens,
                     cached_tokens=cached,
@@ -240,7 +300,7 @@ class MockEngine:
             behavior = "critic"
 
         agree_after = int(opts.get("agree_after", "0"))
-        cached = self._account_prefix(req, overlapped)
+        cached = self._account_prefix(req, overlapped, req_index)
         if behavior == "agree" or (agree_after and round_num >= agree_after):
             text = "[AGREE]\nNo remaining objections; the document is ready."
         else:
@@ -253,8 +313,10 @@ class MockEngine:
 
         out_tokens = min(_estimate_tokens(text), params.max_new_tokens)
         tps = float(opts.get("tps", "0"))
+        in_tokens = _estimate_tokens(req.system) + _estimate_tokens(req.user)
+        self._emit_lifecycle(req_index, in_tokens, cached, out_tokens)
         usage = Usage(
-            input_tokens=_estimate_tokens(req.system) + _estimate_tokens(req.user),
+            input_tokens=in_tokens,
             output_tokens=out_tokens,
             decode_tokens=out_tokens,
             decode_time_s=out_tokens / tps if tps > 0 else 0.0,

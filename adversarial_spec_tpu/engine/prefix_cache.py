@@ -39,6 +39,7 @@ import os
 from dataclasses import dataclass, field
 
 from adversarial_spec_tpu.engine.kvcache import OutOfPages, PageAllocator
+from adversarial_spec_tpu import obs as obs_mod
 
 
 @dataclass
@@ -75,6 +76,17 @@ class PrefixCacheStats:
             self.cached_tokens += matched_tokens
         else:
             self.misses += 1
+        # Every engine (TPU scheduler and the mock's CPU accounting)
+        # funnels lookups through here — ONE emit site covers both.
+        obs_mod.emit(
+            obs_mod.CacheEvent(
+                op="lookup",
+                matched_tokens=matched_tokens,
+                hit=matched_tokens > 0,
+            )
+        )
+        if obs_mod.config().enabled:
+            obs_mod.hot.hit_ratio.set(round(self.hits / self.lookups, 6))
 
     def record_prefill(self, computed_tokens: int, saved_tokens: int) -> None:
         self.prefilled_tokens += computed_tokens
@@ -212,6 +224,8 @@ class PrefixCache:
             parent = node
             children = node.children
         self.stats.inserted_blocks += added
+        if added:
+            obs_mod.emit(obs_mod.CacheEvent(op="insert", blocks=added))
         if self.max_pages > 0 and self.cached_pages > self.max_pages:
             self._evict(self.cached_pages - self.max_pages, shared_ok=True)
         return added
@@ -233,6 +247,9 @@ class PrefixCache:
         self.stats.evicted_blocks += 1
         if freed:
             self.stats.evicted_pages += 1
+        obs_mod.emit(
+            obs_mod.CacheEvent(op="evict", blocks=1, pages=int(freed))
+        )
         return freed
 
     def _evict(self, n_pages: int, shared_ok: bool) -> int:

@@ -66,6 +66,7 @@ from adversarial_spec_tpu.engine.generate import (
 )
 from adversarial_spec_tpu.engine import interleave as interleave_mod
 from adversarial_spec_tpu.engine import prefix_cache as prefix_mod
+from adversarial_spec_tpu import obs as obs_mod
 from adversarial_spec_tpu.engine.kvcache import (
     OutOfPages,
     PageAllocator,
@@ -544,6 +545,20 @@ class ContinuousBatcher:
         self.params = params
         self.cfg = cfg
         self.B = max_batch
+        # Replicated sharding of the params' mesh (None when params are
+        # not mesh-sharded, e.g. direct CPU tests). Fresh admission
+        # caches are committed to it at creation: an UNCOMMITTED fresh
+        # cache and chunk 1's committed output otherwise present two jit
+        # signatures for the same chunk length and XLA compiles the
+        # whole prefill program twice — a genuine double compile the
+        # retrace watch flagged on the first paged CLI drive.
+        leaf = jax.tree_util.tree_leaves(params)[0]
+        sh = getattr(leaf, "sharding", None)
+        self._replicated = (
+            jax.sharding.NamedSharding(sh.mesh, jax.sharding.PartitionSpec())
+            if isinstance(sh, jax.sharding.NamedSharding)
+            else None
+        )
         self.page_size = page_size
         self.chunk = chunk
         self.kv_dtype = kv_dtype
@@ -729,6 +744,21 @@ class ContinuousBatcher:
                 f"{self.capacity_tokens}; raise capacity_tokens"
             )
         self.queue.append(req)
+        obs_mod.emit(
+            obs_mod.RequestEvent(
+                req_id=req.req_id,
+                state="queued",
+                tokens=len(req.prompt_ids),
+            )
+        )
+
+    def _commit(self, cache: dict) -> dict:
+        """Commit a freshly created admission cache to the params'
+        replicated mesh sharding (see ``_replicated`` in __init__); a
+        no-op off-mesh."""
+        if self._replicated is None:
+            return cache
+        return jax.device_put(cache, self._replicated)
 
     def _start_admission(self, slot: int, req: SchedRequest) -> bool:
         """Reserve pages and set up the chunked prefill for ``slot``;
@@ -753,8 +783,11 @@ class ContinuousBatcher:
                 seq_id=seq_id,
                 tokens=jnp.asarray(tokens_np),
                 pads=jnp.asarray(pads_np),
-                cache=init_cache(
-                    self.cfg, 1, S, dtype=self._dtype, kv_dtype=self.kv_dtype
+                cache=self._commit(
+                    init_cache(
+                        self.cfg, 1, S,
+                        dtype=self._dtype, kv_dtype=self.kv_dtype,
+                    )
                 ),
                 pos=0,
                 S=S,
@@ -768,6 +801,11 @@ class ContinuousBatcher:
             self.allocator.free_sequence(seq_id)
             raise
         self._seq_counter += 1
+        obs_mod.emit(
+            obs_mod.RequestEvent(
+                req_id=req.req_id, state="admitted", slot=slot, tokens=S
+            )
+        )
         return True
 
     def _extend_evicting(self, seq_id: int, n_tokens: int) -> None:
@@ -814,8 +852,10 @@ class ContinuousBatcher:
             self._extend_evicting(
                 seq_id, (S_real - matched) + req.max_new_tokens
             )
-            cache = init_cache(
-                self.cfg, 1, S, dtype=self._dtype, kv_dtype=self.kv_dtype
+            cache = self._commit(
+                init_cache(
+                    self.cfg, 1, S, dtype=self._dtype, kv_dtype=self.kv_dtype
+                )
             )
             if matched:
                 # Materialize the adopted prefix KV into the dense
@@ -851,6 +891,15 @@ class ContinuousBatcher:
             raise
         self._seq_counter += 1
         self.prefix_cache.stats.record_lookup(matched)
+        obs_mod.emit(
+            obs_mod.RequestEvent(
+                req_id=req.req_id,
+                state="admitted",
+                slot=slot,
+                tokens=S_real,
+                cached_tokens=matched,
+            )
+        )
         return True
 
     def _advance_admission(self) -> None:
@@ -884,6 +933,28 @@ class ContinuousBatcher:
         adm.prefill_s += elapsed
         interleave_mod.stats.record_step(fused=False, prefill_only=True)
         prefix_mod.stats.record_prefill(chunk_len, 0)
+        if obs_mod.config().enabled:
+            obs_mod.retrace.observe(
+                "prefill_chunk", ("prefill", chunk_len, adm.S),
+                fn=prefill_chunk,
+            )
+            obs_mod.hot.prefill_chunk.observe(elapsed)
+            obs_mod.emit(
+                obs_mod.StepEvent(
+                    kind="prefill",
+                    n_live=int(sum(self._active_np)),
+                    admission_slot=adm.slot,
+                    prefill_tokens=chunk_len,
+                )
+            )
+            obs_mod.emit(
+                obs_mod.RequestEvent(
+                    req_id=adm.req.req_id,
+                    state="prefill",
+                    slot=adm.slot,
+                    tokens=chunk_len,
+                )
+            )
         if adm.pos >= adm.prefill_end:
             self._finish_admission()
 
@@ -918,6 +989,15 @@ class ContinuousBatcher:
                     cache,
                     jnp.int32(adm.S_real - 1),
                 )
+                if obs_mod.config().enabled:
+                    # Same jitted callable as the chunked-prefill site:
+                    # every dispatch must be observed or the cache-size
+                    # probe misattributes this site's compiles to the
+                    # other as phantom "unexpected recompiles".
+                    obs_mod.retrace.observe(
+                        "prefill_chunk", ("prefill", 1, adm.S),
+                        fn=prefill_chunk,
+                    )
             # Scatter only the delta: slots [matched, S_real). Adopted
             # prefix pages already hold [0, matched) and must never be
             # rewritten (shared, copy-on-append discipline).
@@ -966,6 +1046,7 @@ class ContinuousBatcher:
         # Admission handoff is a sanctioned sync point: ``first`` was
         # fetched above, blocking on every step in flight.
         interleave_mod.stats.record_sync()
+        obs_mod.record_sync("admission_handoff")
         # graftlint: disable=GL-SYNC -- admission handoff is a sanctioned sync point: the first sampled token decides slot activation
         first_is_eos = bool(np.isin(np.asarray(first), self._eos_np))
         self.n_emitted = self.n_emitted.at[slot].set(1)
@@ -995,6 +1076,27 @@ class ContinuousBatcher:
         # the batch genuinely waits on: stalled, in both loop modes.
         self._record_prefill_time(elapsed, overlapped=False)
         self._slot_prefill_s[slot] = adm.prefill_s + elapsed
+        if obs_mod.config().enabled:
+            # TTFT as the batcher sees it: this request's own prefill
+            # wall (stalled + overlapped chunks) through the handoff
+            # that produced its first sampled token.
+            obs_mod.hot.ttft.observe(self._slot_prefill_s[slot])
+            obs_mod.hot.pool_util.set(
+                round(
+                    1.0
+                    - self.allocator.free_pages / self.allocator.n_pages,
+                    6,
+                )
+            )
+            obs_mod.emit(
+                obs_mod.RequestEvent(
+                    req_id=req.req_id,
+                    state="decode",
+                    slot=slot,
+                    tokens=1,
+                    cached_tokens=adm.matched,
+                )
+            )
         if not row_active:
             self._finish_slot(slot)
 
@@ -1023,7 +1125,9 @@ class ContinuousBatcher:
                     # Fault isolation: only this request is affected —
                     # the batch keeps decoding and admission continues
                     # with the next queued request.
-                    self._fault_request(self.queue.pop(0), e, "kv_alloc")
+                    self._fault_request(
+                        self.queue.pop(0), e, "kv_alloc", slot=slot
+                    )
                     continue
                 if not started:
                     # Pool full right now — the request stays queued
@@ -1054,6 +1158,8 @@ class ContinuousBatcher:
         n: int = 0,
         cached_tokens: int = 0,
         prefill_time_s: float = 0.0,
+        slot: int = -1,
+        pages_freed: int = 0,
     ) -> None:
         """Resolve one faulted request: requeue once if the fault is
         transient (OOM/device-loss/preemption/timeout) and this req_id
@@ -1062,10 +1168,44 @@ class ContinuousBatcher:
         — else finalize with the partial tokens + fault metadata."""
         kind = faults.classify(exc)
         faults.record(kind, seam)
-        if kind.transient and req.req_id not in self._retried:
+        requeued = kind.transient and req.req_id not in self._retried
+        obs_mod.emit(
+            obs_mod.FaultEvent(
+                seam=seam,
+                kind=kind.value,
+                slot=slot,
+                req_id=req.req_id,
+                pages_freed=pages_freed,
+                requeued=requeued,
+                error=f"{type(exc).__name__}: {exc}",
+            )
+        )
+        if requeued:
             self._retried.add(req.req_id)
             self.queue.append(req)
+            obs_mod.emit(
+                obs_mod.RequestEvent(
+                    req_id=req.req_id,
+                    state="queued",
+                    tokens=len(req.prompt_ids),
+                )
+            )
             return
+        obs_mod.emit(
+            obs_mod.RequestEvent(
+                req_id=req.req_id,
+                state="evicted",
+                slot=slot,
+                tokens=n,
+                cached_tokens=cached_tokens,
+            )
+        )
+        if obs_mod.config().enabled:
+            obs_mod.hot.req_evicted.inc()
+        # The whole point of the flight recorder: when a fault evicts,
+        # the last N events (reconstructing what the batcher was doing)
+        # land on disk IMMEDIATELY, before any further unwind.
+        obs_mod.autodump("fault")
         self.results.append(
             SchedResult(
                 req_id=req.req_id,
@@ -1090,6 +1230,7 @@ class ContinuousBatcher:
             # (tail of _finish_admission): there is no admission record
             # to unwind here, so don't mask the original fault.
             raise exc
+        free0 = self.allocator.free_pages
         self.allocator.free_sequence(adm.seq_id)
         self._fault_request(
             adm.req,
@@ -1097,6 +1238,8 @@ class ContinuousBatcher:
             "admission",
             cached_tokens=adm.matched,
             prefill_time_s=adm.prefill_s,
+            slot=adm.slot,
+            pages_freed=self.allocator.free_pages - free0,
         )
 
     def _handle_decode_fault(self, exc: BaseException) -> None:
@@ -1137,12 +1280,14 @@ class ContinuousBatcher:
         # Eviction only drops this slot's REFERENCES: pages shared with
         # the prefix cache (or other admissions) survive untouched — a
         # faulted slot can never invalidate co-residents' prefix blocks.
+        free0 = self.allocator.free_pages
         self.allocator.free_sequence(self._slot_seq[slot])
         self._slot_req[slot] = None
         self._slot_seq[slot] = None
         self.active = self.active.at[slot].set(False)
         self._active_np[slot] = False
         interleave_mod.stats.record_sync()  # fault decision point
+        obs_mod.record_sync("fault")
         self.page_table = self.page_table.at[slot].set(0)
         self._fault_request(
             req,
@@ -1152,6 +1297,8 @@ class ContinuousBatcher:
             n=n,
             cached_tokens=self._slot_cached[slot],
             prefill_time_s=self._slot_prefill_s[slot],
+            slot=slot,
+            pages_freed=self.allocator.free_pages - free0,
         )
 
     # -- completion --------------------------------------------------------
@@ -1161,6 +1308,7 @@ class ContinuousBatcher:
         # below blocks on the step in flight (the row itself is frozen —
         # its values read identically from any later state).
         interleave_mod.stats.record_sync()
+        obs_mod.record_sync("slot_complete")
         self._active_np[slot] = False  # invariant: no owner ⇒ not live
         req = self._slot_req[slot]
         # graftlint: disable=GL-SYNC -- slot completion is a sanctioned sync point: the row is frozen, its count/tokens read identically from any later state
@@ -1178,6 +1326,24 @@ class ContinuousBatcher:
         )
         self.allocator.free_sequence(self._slot_seq[slot])
         self._slot_req[slot] = None
+        if obs_mod.config().enabled:
+            obs_mod.hot.req_finished.inc()
+            obs_mod.hot.pool_util.set(
+                round(
+                    1.0
+                    - self.allocator.free_pages / self.allocator.n_pages,
+                    6,
+                )
+            )
+            obs_mod.emit(
+                obs_mod.RequestEvent(
+                    req_id=req.req_id,
+                    state="finished",
+                    slot=slot,
+                    tokens=n,
+                    cached_tokens=self._slot_cached[slot],
+                )
+            )
 
     def _collect(self, active_np: np.ndarray | None = None) -> None:
         """Resolve finished slots. The legacy loop passes nothing (full
@@ -1235,6 +1401,7 @@ class ContinuousBatcher:
         chunk in flight emitted, and every queued request resolves with
         zero tokens instead of blocking the caller."""
         interleave_mod.stats.record_sync()  # timeout decision point
+        obs_mod.record_sync("timeout")
         if self._admission is not None:
             adm = self._admission
             self._admission = None
@@ -1251,7 +1418,15 @@ class ContinuousBatcher:
                     n_generated=0,
                 )
             )
+            obs_mod.emit(
+                obs_mod.RequestEvent(req_id=req.req_id, state="timeout")
+            )
+            if obs_mod.config().enabled:
+                obs_mod.hot.req_timeout.inc()
         self.queue.clear()
+        # Deadline evictions are triage material exactly like faults:
+        # dump what the batcher was doing when the budget ran out.
+        obs_mod.autodump("timeout")
 
     # -- pipelined drive loop ---------------------------------------------
 
@@ -1311,6 +1486,12 @@ class ContinuousBatcher:
         adm.pos += chunk_len
         interleave_mod.stats.record_step(fused=True)
         prefix_mod.stats.record_prefill(chunk_len, 0)
+        if obs_mod.config().enabled:
+            obs_mod.retrace.observe(
+                "fused_prefill_decode_chunk",
+                ("fused", chunk_len, adm.S, self.B, self.cap, self.chunk),
+                fn=fused_prefill_decode_chunk,
+            )
 
     def _dispatch_decode(self) -> None:
         """Issue one decode-only chunk program; no host sync."""
@@ -1347,6 +1528,12 @@ class ContinuousBatcher:
             pallas_interpret=self._pallas_interpret,
         )
         interleave_mod.stats.record_step(fused=False)
+        if obs_mod.config().enabled:
+            obs_mod.retrace.observe(
+                "scheduler_decode_chunk",
+                ("decode", self.B, self.cap, self.chunk, self.greedy),
+                fn=scheduler_decode_chunk,
+            )
 
     @staticmethod
     def _entry_ready(entry: tuple) -> bool:
@@ -1480,6 +1667,8 @@ class ContinuousBatcher:
                 except Exception:
                     pass  # optional fast path only
                 inflight.append(entry)
+                depth = len(inflight)
+                step_sync = ""
                 try:
                     # Retire completed steps ADAPTIVELY: any entry whose
                     # flags already resolved (is_ready — free to fetch)
@@ -1493,6 +1682,12 @@ class ContinuousBatcher:
                         len(inflight) >= self.pipeline_depth
                         or self._entry_ready(inflight[0])
                     ):
+                        if not self._entry_ready(inflight[0]):
+                            # Depth bound forced a genuinely blocking
+                            # fetch — the double buffer's one sanctioned
+                            # blocking point, made runtime-visible.
+                            obs_mod.record_sync("depth_fetch")
+                            step_sync = "depth_fetch"
                         self._fetch_entry(inflight.popleft())
                 except Exception as e:
                     # An async device fault surfaces at the fetch, one
@@ -1507,6 +1702,25 @@ class ContinuousBatcher:
                     self.decode_time_s += dt - p
                 else:
                     self.decode_time_s += dt
+                if obs_mod.config().enabled:
+                    obs_mod.hot.step_wall.observe(dt)
+                    if live:
+                        obs_mod.hot.inter_token.observe(dt / self.chunk)
+                    obs_mod.emit(
+                        obs_mod.StepEvent(
+                            kind="fused" if fused_share > 0.0 else "decode",
+                            n_live=len(live),
+                            admission_slot=(
+                                adm.slot if fused_share > 0.0 else -1
+                            ),
+                            prefill_tokens=(
+                                chunk_len if fused_share > 0.0 else 0
+                            ),
+                            decode_chunk=self.chunk,
+                            pipeline_depth=depth,
+                            sync_reason=step_sync,
+                        )
+                    )
             self._collect(self._active_np)
 
     # -- legacy serialized loop -------------------------------------------
@@ -1538,6 +1752,19 @@ class ContinuousBatcher:
                 except Exception as e:
                     self._handle_decode_fault(e)
                 finally:
-                    self.decode_time_s += time.monotonic() - t_dec
+                    dt = time.monotonic() - t_dec
+                    self.decode_time_s += dt
+                    if obs_mod.config().enabled:
+                        obs_mod.record_sync("legacy_step")
+                        obs_mod.hot.step_wall.observe(dt)
+                        obs_mod.hot.inter_token.observe(dt / self.chunk)
+                        obs_mod.emit(
+                            obs_mod.StepEvent(
+                                kind="decode",
+                                n_live=int(sum(self._active_np)),
+                                decode_chunk=self.chunk,
+                                sync_reason="legacy_step",
+                            )
+                        )
             self._collect()
         self._active_np[:] = np.asarray(self.active)

@@ -35,6 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from adversarial_spec_tpu import obs as obs_mod
 from adversarial_spec_tpu.debate.usage import Usage
 from adversarial_spec_tpu.engine import interleave as interleave_mod
 from adversarial_spec_tpu.engine import prefix_cache as prefix_mod
@@ -239,8 +240,18 @@ class TpuEngine:
             with self._lock:
                 self._loading[alias] = estimate
         try:
+            t_load = time.monotonic()
             params, cfg = self._materialize(spec, dtype, mesh)
             tokenizer = load_tokenizer(spec.tokenizer)
+            if obs_mod.config().enabled:
+                obs_mod.metrics.counter(
+                    "advspec_model_loads_total",
+                    help="model materializations (foreground + prefetch)",
+                ).inc()
+                obs_mod.metrics.histogram(
+                    "advspec_model_load_seconds",
+                    help="checkpoint materialization + tokenizer wall",
+                ).observe(time.monotonic() - t_load)
             lm = LoadedModel(
                 spec=spec,
                 cfg=cfg,
@@ -501,6 +512,12 @@ class TpuEngine:
     def chat(
         self, requests: list[ChatRequest], params: SamplingParams
     ) -> list[Completion]:
+        if obs_mod.config().enabled:
+            obs_mod.metrics.counter(
+                "advspec_engine_chat_requests_total",
+                help="chat requests by serving engine",
+                engine="tpu",
+            ).inc(len(requests))
         # Group by alias: same-model opponents batch into one decode.
         groups: dict[str, list[int]] = {}
         for i, req in enumerate(requests):
@@ -531,6 +548,14 @@ class TpuEngine:
                 # Injected faults know their seam; real ones are counted
                 # where caught.
                 faults.record(kind, getattr(e, "seam", "generate"))
+                obs_mod.emit(
+                    obs_mod.FaultEvent(
+                        seam=getattr(e, "seam", "generate"),
+                        kind=kind.value,
+                        error=msg,
+                    )
+                )
+                obs_mod.autodump("fault")
                 completions = [
                     Completion(error=msg, transient=kind.transient)
                     for _ in batch

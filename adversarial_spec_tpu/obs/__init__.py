@@ -1,0 +1,313 @@
+"""Observability subsystem: metrics registry + flight recorder + retrace watch.
+
+After PRs 1-4 every subsystem kept private counters; this package is the
+shared substrate (the north-star metric — per-round wall / tokens/sec/chip
+— needs ONE place the next perf PRs read from):
+
+- ``metrics`` — the process-wide :class:`MetricsRegistry` (counters,
+  gauges, fixed-bucket histograms; ``snapshot()`` + ``render_prometheus()``).
+- ``recorder`` — the process-wide :class:`FlightRecorder` ring of typed
+  events (Step/Request/Fault/Breaker/Cache/Compile); dumped as JSONL on
+  demand (``--events-out``) and automatically on fault/timeout eviction.
+- ``retrace`` — the :class:`RetraceWatch` counting jit compiles per
+  program and flagging unexpected recompiles in the report.
+
+Process-wide config + reset semantics follow the established
+``resilience.faults`` / ``prefix_cache`` / ``interleave`` pattern: the
+CLI arms per round (``--events-out``, ``--metrics-out``,
+``--flight-recorder-size``), stats reset per invocation, engines keep
+live handles. Pure stdlib, imports no jax and nothing from engine/ or
+resilience/ (they all import obs; cycles are impossible this way).
+
+The one hot-path concession: every emit goes through module-level
+``emit()`` / ``record_sync()`` which check ``enabled`` first — when obs
+is off the serving path pays a single attribute load per site.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from adversarial_spec_tpu.obs.events import (  # noqa: F401 (re-export)
+    BreakerEvent,
+    CacheEvent,
+    CompileEvent,
+    EVENT_FIELDS,
+    FaultEvent,
+    FlightRecorder,
+    RequestEvent,
+    StepEvent,
+    validate_event,
+)
+from adversarial_spec_tpu.obs.metrics import (  # noqa: F401 (re-export)
+    LATENCY_BUCKETS_S,
+    RATIO_BUCKETS,
+    MetricsRegistry,
+)
+from adversarial_spec_tpu.obs.retrace import RetraceWatch
+
+DEFAULT_RECORDER_SIZE = 512
+
+
+@dataclass
+class ObsConfig:
+    """Process-wide knobs, set once per CLI round (or by tests)."""
+
+    enabled: bool = True
+    recorder_size: int = DEFAULT_RECORDER_SIZE
+    # Where the end-of-round event JSONL lands. Armed by --events-out;
+    # fault/timeout auto-dumps write to a sibling path derived from it
+    # (``<stem>.<trigger>.jsonl``) so the final dump can never clobber
+    # the fault-time snapshot (no path = no auto-dump).
+    events_out: str | None = None
+    dump_on_fault: bool = True
+
+
+def env_enabled() -> bool:
+    """The process default for the master switch (``ADVSPEC_OBS``)."""
+    return os.environ.get("ADVSPEC_OBS", "1") != "0"
+
+
+def env_recorder_size() -> int:
+    """The process default ring size (``ADVSPEC_FLIGHT_RECORDER_SIZE``)."""
+    try:
+        n = int(
+            os.environ.get(
+                "ADVSPEC_FLIGHT_RECORDER_SIZE", DEFAULT_RECORDER_SIZE
+            )
+        )
+    except ValueError:
+        n = DEFAULT_RECORDER_SIZE
+    return max(1, n)
+
+
+_config = ObsConfig(
+    enabled=env_enabled(),
+    recorder_size=env_recorder_size(),
+    events_out=os.environ.get("ADVSPEC_EVENTS_OUT") or None,
+)
+
+metrics = MetricsRegistry()
+recorder = FlightRecorder(
+    size=_config.recorder_size, enabled=_config.enabled
+)
+retrace = RetraceWatch(emit=lambda ev: recorder.append(ev))
+
+
+class HotMetrics:
+    """Cached handles into the fixed serving-path metric catalog.
+
+    The registry returns the same object for the same name+labels and
+    ``reset()`` zeroes in place, so handles cached once at import stay
+    live for the life of the process — hot emit sites (the drive loops,
+    the mock's per-request accounting) pay one attribute load per
+    observation instead of a lock acquire + label-key build per call.
+    Label-dynamic families (sync reasons, fault seam/kind, breaker
+    target states) get small per-label dicts, filled on first use.
+    """
+
+    __slots__ = (
+        "ttft",
+        "step_wall",
+        "inter_token",
+        "prefill_chunk",
+        "pool_util",
+        "hit_ratio",
+        "req_finished",
+        "req_evicted",
+        "req_timeout",
+        "mock_chat_requests",
+        "_m",
+        "_sync",
+        "_fault",
+        "_breaker",
+    )
+
+    def __init__(self, m: MetricsRegistry) -> None:
+        self._m = m
+        self.ttft = m.histogram(
+            "advspec_ttft_seconds",
+            help="admission prefill through first sampled token",
+        )
+        self.step_wall = m.histogram(
+            "advspec_step_wall_seconds",
+            help="drive-loop iteration wall (dispatch+fetch)",
+        )
+        self.inter_token = m.histogram(
+            "advspec_inter_token_seconds",
+            help="step wall / decode-chunk budget",
+        )
+        self.prefill_chunk = m.histogram(
+            "advspec_prefill_chunk_wall_seconds",
+            help="standalone (stalled) admission prefill chunk wall",
+        )
+        self.pool_util = m.gauge(
+            "advspec_page_pool_utilization",
+            help="fraction of KV pages allocated",
+        )
+        self.hit_ratio = m.gauge(
+            "advspec_prefix_cache_hit_ratio",
+            help="prefix-cache lookup hit ratio (this round)",
+        )
+        self.req_finished = m.counter(
+            "advspec_requests_total",
+            help="resolved requests by outcome",
+            outcome="finished",
+        )
+        self.req_evicted = m.counter(
+            "advspec_requests_total", outcome="evicted"
+        )
+        self.req_timeout = m.counter(
+            "advspec_requests_total", outcome="timeout"
+        )
+        self.mock_chat_requests = m.counter(
+            "advspec_engine_chat_requests_total",
+            help="chat requests by serving engine",
+            engine="mock",
+        )
+        self._sync: dict = {}
+        self._fault: dict = {}
+        self._breaker: dict = {}
+
+    def sync(self, reason: str):
+        c = self._sync.get(reason)
+        if c is None:
+            c = self._sync[reason] = self._m.counter(
+                "advspec_host_syncs_total",
+                help="sanctioned host syncs by reason",
+                reason=reason,
+            )
+        return c
+
+    def fault(self, seam: str, kind: str):
+        c = self._fault.get((seam, kind))
+        if c is None:
+            c = self._fault[(seam, kind)] = self._m.counter(
+                "advspec_faults_total",
+                help="classified faults by seam and kind",
+                seam=seam,
+                kind=kind,
+            )
+        return c
+
+    def breaker(self, to: str):
+        c = self._breaker.get(to)
+        if c is None:
+            c = self._breaker[to] = self._m.counter(
+                "advspec_breaker_transitions_total",
+                help="circuit-breaker transitions by target state",
+                to=to,
+            )
+        return c
+
+
+hot = HotMetrics(metrics)
+
+
+def config() -> ObsConfig:
+    return _config
+
+
+def configure(
+    enabled: bool | None = None,
+    recorder_size: int | None = None,
+    events_out: str | None = None,
+    dump_on_fault: bool | None = None,
+) -> ObsConfig:
+    if enabled is not None:
+        _config.enabled = bool(enabled)
+        recorder.enabled = _config.enabled
+    if recorder_size is not None:
+        _config.recorder_size = max(1, int(recorder_size))
+        recorder.resize(_config.recorder_size)
+    if events_out is not None:
+        _config.events_out = events_out or None
+    if dump_on_fault is not None:
+        _config.dump_on_fault = bool(dump_on_fault)
+    return _config
+
+
+def reset_stats() -> None:
+    """Per-invocation reset (one CLI invocation = one round): metrics
+    zero in place, the ring clears, the retrace watch starts fresh."""
+    metrics.reset()
+    recorder.clear()
+    retrace.reset()
+
+
+def emit(ev) -> None:
+    """Append one event to the flight recorder (no-op when disabled)."""
+    if _config.enabled:
+        recorder.append(ev)
+
+
+def record_sync(reason: str) -> None:
+    """Count one sanctioned host sync, labeled by WHY (the runtime
+    mirror of GL-SYNC's static triage: every sync the linter sanctions
+    shows up here by reason, so an operator sees which sanctioned point
+    dominates)."""
+    if _config.enabled:
+        hot.sync(reason).inc()
+
+
+def autodump_path(trigger: str) -> str | None:
+    """Where an auto-dump for ``trigger`` lands: a sibling of the armed
+    ``events_out`` (``ev.jsonl`` -> ``ev.fault.jsonl``). A distinct file
+    so the end-of-round dump can never overwrite the fault-time ring
+    snapshot — on a long round that survives an early fault, the fault
+    events may have aged out of the ring by final dump."""
+    base = _config.events_out
+    if not base:
+        return None
+    root, ext = os.path.splitext(base)
+    return f"{root}.{trigger}{ext or '.jsonl'}"
+
+
+def autodump(trigger: str) -> str | None:
+    """Fault/timeout auto-dump: write the ring NOW (the drive loop may
+    be about to unwind) to the trigger's sibling of ``events_out``.
+    Returns the path written, or None when no destination is armed."""
+    path = autodump_path(trigger)
+    if not (_config.enabled and _config.dump_on_fault and path):
+        return None
+    metrics.counter(
+        "advspec_flight_recorder_dumps_total",
+        help="flight-recorder dumps by trigger",
+        trigger=trigger,
+    ).inc()
+    recorder.dump_jsonl(path)
+    return path
+
+
+def dump_events(path: str) -> int:
+    """On-demand dump (--events-out at end of round)."""
+    return recorder.dump_jsonl(path)
+
+
+def write_metrics(path: str) -> None:
+    """Write the Prometheus text exposition (--metrics-out)."""
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(metrics.render_prometheus())
+
+
+def snapshot() -> dict:
+    """The ``perf.obs`` payload: recorder occupancy, event mix, sync
+    reasons, and the retrace watch's compile report."""
+    syncs = {}
+    for key, value in metrics.snapshot().items():
+        if key.startswith("advspec_host_syncs_total{"):
+            reason = key.split('reason="', 1)[1].rstrip('"}')
+            syncs[reason] = value
+    return {
+        "enabled": _config.enabled,
+        "recorder": {
+            "size": _config.recorder_size,
+            "recorded": recorder.seq,
+            "buffered": len(recorder),
+            "dropped": recorder.dropped,
+        },
+        "events_by_type": recorder.counts_by_type(),
+        "host_syncs": syncs,
+        "retrace": retrace.snapshot(),
+    }

@@ -1,0 +1,247 @@
+"""Flight recorder: a bounded ring buffer of typed structured events.
+
+When a round misbehaves (a fault eviction, a pipeline stall, an
+unexpected retrace) the metrics registry says THAT something happened;
+the flight recorder says WHAT the batcher was doing step by step.
+Design constraints, in order:
+
+- **Cheap when idle.** ``append`` stores a dataclass in a
+  ``deque(maxlen=N)`` — no formatting, no I/O, no locks on the serving
+  path (the drive loop is single-threaded; cross-thread emitters like
+  the breaker registry serialize on their own locks before reaching
+  here). Formatting happens only at dump time.
+- **Bounded.** The ring holds the LAST ``size`` events; older ones are
+  dropped and counted (``dropped``), never grown over.
+- **Deterministic.** Events carry a monotonic ``seq`` and NO wall-clock
+  timestamps; float fields hold either synthetic deterministic seconds
+  (mock engine) or real walls (TPU scheduler), rounded at dump time. A
+  mock round's JSONL is byte-identical across runs.
+
+Event vocabulary (the schema ``tools/obs_dump.py`` validates):
+
+- ``StepEvent`` — one drive-loop dispatch: slot occupancy, the riding
+  admission, prefill/decode token counts, pipeline depth, sync reason.
+- ``RequestEvent`` — lifecycle transitions
+  queued → admitted → prefill → decode → finished / evicted / timeout.
+- ``FaultEvent`` — a classified fault with eviction context (slot id,
+  pages freed, whether the request was requeued).
+- ``BreakerEvent`` — a circuit-breaker state transition.
+- ``CacheEvent`` — prefix-cache lookup / insert / evict.
+- ``CompileEvent`` — the retrace watch saw a jit compile.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from collections import deque
+from dataclasses import dataclass, field
+
+
+@dataclass(slots=True)
+class StepEvent:
+    TYPE = "step"
+    kind: str = "decode"  # fused | decode | prefill
+    n_live: int = 0  # resident rows decoding this step
+    admission_slot: int = -1  # slot of the riding admission (-1: none)
+    prefill_tokens: int = 0  # prompt tokens advanced this step
+    decode_chunk: int = 0  # decode-chunk budget per live row
+    pipeline_depth: int = 0  # steps in flight after this dispatch
+    sync_reason: str = ""  # why the host synced this step ("" = no sync)
+
+
+@dataclass(slots=True)
+class RequestEvent:
+    TYPE = "request"
+    req_id: int = -1
+    state: str = "queued"  # queued|admitted|prefill|decode|finished|evicted|timeout
+    slot: int = -1
+    tokens: int = 0  # tokens relevant to this transition
+    cached_tokens: int = 0
+
+
+@dataclass(slots=True)
+class FaultEvent:
+    TYPE = "fault"
+    seam: str = ""
+    kind: str = ""
+    slot: int = -1
+    req_id: int = -1
+    pages_freed: int = 0
+    requeued: bool = False
+    error: str = ""
+
+
+@dataclass(slots=True)
+class BreakerEvent:
+    TYPE = "breaker"
+    model: str = ""
+    frm: str = ""
+    to: str = ""
+
+
+@dataclass(slots=True)
+class CacheEvent:
+    TYPE = "cache"
+    op: str = "lookup"  # lookup | insert | evict
+    matched_tokens: int = 0
+    blocks: int = 0
+    pages: int = 0
+    hit: bool = False
+
+
+@dataclass(slots=True)
+class CompileEvent:
+    TYPE = "compile"
+    program: str = ""
+    key: str = ""
+    n_compiles: int = 0
+    unexpected: bool = False
+
+
+EVENT_TYPES = (
+    StepEvent,
+    RequestEvent,
+    FaultEvent,
+    BreakerEvent,
+    CacheEvent,
+    CompileEvent,
+)
+
+REQUEST_STATES = (
+    "queued",
+    "admitted",
+    "prefill",
+    "decode",
+    "finished",
+    "evicted",
+    "timeout",
+)
+
+# type name -> {field name: python type} — the schema contract
+# tools/obs_dump.py validates every JSONL line against. Derived from
+# the dataclasses so it can never drift from the emitters.
+EVENT_FIELDS: dict[str, dict[str, type]] = {
+    cls.TYPE: {f.name: f.type for f in dataclasses.fields(cls)}
+    for cls in EVENT_TYPES
+}
+_PY_TYPES = {"int": int, "str": str, "bool": bool, "float": float}
+
+
+def event_to_dict(seq: int, ev) -> dict:
+    """Stable field order: seq, type, then dataclass declaration order."""
+    out: dict = {"seq": seq, "type": ev.TYPE}
+    for f in dataclasses.fields(ev):
+        v = getattr(ev, f.name)
+        if isinstance(v, float):
+            v = round(v, 6)
+        out[f.name] = v
+    return out
+
+
+def validate_event(obj) -> list[str]:
+    """Schema-check one decoded JSONL line; returns human-readable
+    problems (empty = valid). Shared by the recorder's own tests and
+    tools/obs_dump.py."""
+    errors: list[str] = []
+    if not isinstance(obj, dict):
+        return [f"not an object: {obj!r}"]
+    etype = obj.get("type")
+    if etype not in EVENT_FIELDS:
+        return [f"unknown event type {etype!r}"]
+    if not isinstance(obj.get("seq"), int):
+        errors.append("missing/non-int 'seq'")
+    fields = EVENT_FIELDS[etype]
+    for name, anno in fields.items():
+        if name not in obj:
+            errors.append(f"{etype}: missing field {name!r}")
+            continue
+        py = _PY_TYPES.get(anno if isinstance(anno, str) else anno.__name__)
+        v = obj[name]
+        if py is bool:
+            ok = isinstance(v, bool)
+        elif py is int:
+            ok = isinstance(v, int) and not isinstance(v, bool)
+        elif py is float:
+            ok = isinstance(v, (int, float)) and not isinstance(v, bool)
+        elif py is str:
+            ok = isinstance(v, str)
+        else:  # pragma: no cover - schema only uses the four above
+            ok = True
+        if not ok:
+            errors.append(
+                f"{etype}: field {name!r} expected {anno}, got {type(v).__name__}"
+            )
+    for name in obj:
+        if name not in fields and name not in ("seq", "type"):
+            errors.append(f"{etype}: unknown field {name!r}")
+    if etype == "request" and obj.get("state") not in REQUEST_STATES:
+        errors.append(f"request: unknown state {obj.get('state')!r}")
+    return errors
+
+
+@dataclass
+class FlightRecorder:
+    """Bounded ring of (seq, event); the last ``size`` events survive."""
+
+    size: int = 512
+    enabled: bool = True
+    seq: int = 0  # total events ever appended (monotonic)
+    dropped: int = 0  # events pushed out of the ring
+    _buf: deque = field(default_factory=deque)
+
+    def __post_init__(self) -> None:
+        self._buf = deque(self._buf, maxlen=self.size)
+
+    def append(self, ev) -> None:
+        if not self.enabled:
+            return
+        self.seq += 1
+        if len(self._buf) == self._buf.maxlen:
+            self.dropped += 1
+        self._buf.append((self.seq, ev))
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def resize(self, size: int) -> None:
+        size = max(1, int(size))
+        if size != self.size:
+            self.size = size
+            # Shrinking ages out the oldest events — they are drops
+            # like any other (buffered + dropped == seq must hold).
+            self.dropped += max(0, len(self._buf) - size)
+            self._buf = deque(self._buf, maxlen=size)
+
+    def clear(self) -> None:
+        self._buf.clear()
+        self.seq = 0
+        self.dropped = 0
+
+    def events(self) -> list[dict]:
+        return [event_to_dict(seq, ev) for seq, ev in self._buf]
+
+    def counts_by_type(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for _, ev in self._buf:
+            out[ev.TYPE] = out.get(ev.TYPE, 0) + 1
+        return dict(sorted(out.items()))
+
+    def to_jsonl(self) -> str:
+        lines = [
+            json.dumps(e, separators=(",", ":")) for e in self.events()
+        ]
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def dump_jsonl(self, path: str) -> int:
+        """Write the buffered events as JSONL; returns the line count.
+        Atomic-ish (write then rename) so a reader never sees a torn
+        file — the auto-dump fires mid-fault, possibly mid-crash."""
+        import os
+
+        data = self.to_jsonl()
+        tmp = f"{path}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(data)
+        os.replace(tmp, path)
+        return len(self._buf)

@@ -29,6 +29,7 @@ import os
 import threading
 import time
 
+from adversarial_spec_tpu import obs as obs_mod
 from adversarial_spec_tpu.resilience.faults import FaultKind
 
 CLOSED = "closed"
@@ -47,10 +48,12 @@ class CircuitBreaker:
         threshold: int = 3,
         cooldown_s: float = 30.0,
         clock=time.monotonic,
+        name: str = "",
     ):
         self.threshold = max(1, int(threshold))
         self.cooldown_s = float(cooldown_s)
         self._clock = clock
+        self.name = name  # model id, for transition events
         self.state = CLOSED
         self.failures = 0  # consecutive failures while closed
         self.opened_at: float | None = None
@@ -69,6 +72,16 @@ class CircuitBreaker:
             )
             self.transitions.append((self.state, state))
             del self.transitions[:-64]
+            # Transitions are EVENTS now, not just counters: the flight
+            # recorder shows when a model tripped relative to the steps
+            # around it (docs/resilience.md).
+            obs_mod.emit(
+                obs_mod.BreakerEvent(
+                    model=self.name, frm=self.state, to=state
+                )
+            )
+            if obs_mod.config().enabled:
+                obs_mod.hot.breaker(state).inc()
             self.state = state
 
     def allow(self) -> bool:
@@ -163,6 +176,7 @@ class BreakerRegistry:
                     threshold=self.threshold,
                     cooldown_s=self.cooldown_s,
                     clock=self._clock,
+                    name=model,
                 )
                 self._breakers[model] = b
             return b

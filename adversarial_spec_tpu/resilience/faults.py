@@ -30,6 +30,8 @@ import re
 import threading
 from enum import Enum
 
+from adversarial_spec_tpu import obs as obs_mod
+
 
 class FaultKind(str, Enum):
     """What failed, independent of which layer noticed."""
@@ -115,6 +117,11 @@ def record(kind: FaultKind, seam: str) -> None:
     with _lock:
         key = f"{seam}.{kind.value}"
         _counts[key] = _counts.get(key, 0) + 1
+    # Mirror into the observability registry: every classified fault is
+    # a labeled counter too (the Prometheus-facing shape of the same
+    # fact; the scheduler adds eviction-context FaultEvents separately).
+    if obs_mod.config().enabled:
+        obs_mod.hot.fault(seam, kind.value).inc()
 
 
 def snapshot() -> dict[str, int]:

@@ -82,10 +82,36 @@ def _isolate_state(tmp_path, monkeypatch):
 
     prefix_cache.configure(enabled=True, max_pages=0)
     prefix_cache.reset_stats()
+    # Observability state is process-global by design (the recorder and
+    # metric handles outlive a round); tests must not leak an armed
+    # events_out path, a shrunken ring, or recorded events.
+    from adversarial_spec_tpu import obs
+
+    monkeypatch.delenv("ADVSPEC_OBS", raising=False)
+    monkeypatch.delenv("ADVSPEC_EVENTS_OUT", raising=False)
+    monkeypatch.delenv("ADVSPEC_FLIGHT_RECORDER_SIZE", raising=False)
+    obs.configure(
+        enabled=True,
+        recorder_size=obs.DEFAULT_RECORDER_SIZE,
+        events_out="",
+        dump_on_fault=True,
+    )
+    obs.reset_stats()
+    # Full retrace clear (reset() deliberately keeps compile baselines
+    # for warm per-round accounting; tests want cold-start isolation).
+    obs.retrace.clear()
     yield
     dispatch.clear_engine_cache()
     breaker.reset_default_registry()
     prefix_cache.configure(enabled=True, max_pages=0)
     prefix_cache.reset_stats()
+    obs.configure(
+        enabled=True,
+        recorder_size=obs.DEFAULT_RECORDER_SIZE,
+        events_out="",
+        dump_on_fault=True,
+    )
+    obs.reset_stats()
+    obs.retrace.clear()
     faults.reset()
     injector.reset()

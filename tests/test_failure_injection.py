@@ -594,6 +594,106 @@ class TestSchedulerFaultIsolation:
         assert faults_mod.snapshot() == {"admission.oom": 1}
         assert b.allocator.free_pages == total_pages
 
+    def test_kv_alloc_fault_autodumps_reconstructable_flight_record(
+        self, tiny_model, tmp_path
+    ):
+        """Acceptance: an injected ``kv_alloc`` fault produces a JSONL
+        dump — written the moment the fault resolves, not at drain end,
+        to the fault sibling of the armed path so the end-of-round dump
+        can never clobber it — whose final events reconstruct the
+        eviction: the slot the admission targeted, the pages freed, and
+        the fault kind."""
+        import json
+
+        from adversarial_spec_tpu import obs
+        from adversarial_spec_tpu.engine.scheduler import SchedRequest
+
+        params, cfg = tiny_model
+        dump = tmp_path / "flight.jsonl"
+        obs.configure(enabled=True, events_out=str(dump))
+        obs.reset_stats()
+        try:
+            injector_mod.install(
+                FaultInjector(parse_chaos_spec("bug@kv_alloc:times=1"))
+            )
+            b = self._batcher(params, cfg)
+            b.submit(SchedRequest(req_id=0, prompt_ids=[1, 5, 9],
+                                  max_new_tokens=8))
+            b.submit(SchedRequest(req_id=1, prompt_ids=[2, 6],
+                                  max_new_tokens=8))
+            results = b.run_all()
+        finally:
+            obs.configure(events_out="")
+        assert results[0].fault_kind == "bug"
+        fault_dump = tmp_path / "flight.fault.jsonl"
+        assert (
+            fault_dump.exists()
+        ), "fault did not auto-dump the flight recorder"
+        events = [
+            json.loads(line) for line in fault_dump.read_text().splitlines()
+        ]
+        for e in events:
+            assert obs.validate_event(e) == [], e
+        # Reconstruction: the FaultEvent names the seam, kind, slot and
+        # pages freed; the victim's lifecycle ends in "evicted".
+        faults_evs = [e for e in events if e["type"] == "fault"]
+        assert faults_evs, "no FaultEvent in the dump"
+        fe = faults_evs[-1]
+        assert fe["seam"] == "kv_alloc" and fe["kind"] == "bug"
+        assert fe["req_id"] == 0 and fe["slot"] == 0
+        # kv_alloc fires BEFORE any page reservation: nothing to free.
+        assert fe["pages_freed"] == 0 and fe["requeued"] is False
+        victim = [
+            e
+            for e in events
+            if e["type"] == "request" and e["req_id"] == 0
+        ]
+        assert victim[-1]["state"] == "evicted"
+
+    def test_decode_fault_dump_records_slot_and_pages_freed(
+        self, tiny_model, tmp_path
+    ):
+        """A mid-decode eviction's dump carries NONZERO pages_freed and
+        the evicted slot — the triage walkthrough docs/observability.md
+        promises."""
+        import json
+
+        from adversarial_spec_tpu import obs
+        from adversarial_spec_tpu.engine.scheduler import SchedRequest
+
+        params, cfg = tiny_model
+        dump = tmp_path / "flight.jsonl"
+        obs.configure(enabled=True, events_out=str(dump))
+        obs.reset_stats()
+        try:
+            injector_mod.install(
+                FaultInjector(
+                    parse_chaos_spec(
+                        "oom@scheduler_chunk:after=1:times=2:slot=1"
+                    )
+                )
+            )
+            b = self._batcher(params, cfg)
+            b.submit(SchedRequest(req_id=0, prompt_ids=[1, 5, 9],
+                                  max_new_tokens=12))
+            b.submit(SchedRequest(req_id=1, prompt_ids=[2, 6],
+                                  max_new_tokens=12))
+            results = b.run_all()
+        finally:
+            obs.configure(events_out="")
+        assert results[1].fault_kind == "oom"
+        fault_dump = tmp_path / "flight.fault.jsonl"
+        events = [
+            json.loads(line) for line in fault_dump.read_text().splitlines()
+        ]
+        fe = [e for e in events if e["type"] == "fault"][-1]
+        assert fe["kind"] == "oom" and fe["seam"] == "scheduler_chunk"
+        assert fe["slot"] == 1
+        assert fe["pages_freed"] > 0  # the eviction returned real pages
+        # The dump is schema-valid end to end (obs_dump would exit 0).
+        for e in events:
+            assert obs.validate_event(e) == [], e
+
     def test_engine_surfaces_slot_fault_as_transient_completion(self):
         """Through the TpuEngine: a faulted slot becomes an errored,
         transient Completion (the debate core's retry applies) while the

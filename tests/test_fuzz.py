@@ -125,6 +125,8 @@ class TestSchedulerChaosFuzz:
 
         import os
 
+        from adversarial_spec_tpu import obs
+
         cfg = get_config("llama", "tiny")
         params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
         kinds = list(FaultKind)
@@ -135,6 +137,11 @@ class TestSchedulerChaosFuzz:
         extra = os.environ.get("ADVSPEC_CHAOS_FUZZ_SEED")
         if extra is not None:
             seeds = [int(extra)]
+        # Tiny ring (way below the event volume of one drain): the fuzz
+        # additionally pins that chaos can never grow the flight
+        # recorder past its bound — only age events out of it.
+        ring_size = 32
+        obs.configure(enabled=True, recorder_size=ring_size)
         for seed in seeds:
             rng = random.Random(seed)
             rules = [
@@ -162,6 +169,15 @@ class TestSchedulerChaosFuzz:
                 )
             results = b.run_all()
             injector_mod.reset()
+            # Ring-buffer invariant: bounds are NEVER exceeded; every
+            # append past capacity aged one event out (dropped count),
+            # and the buffered+dropped total is exactly what was ever
+            # recorded.
+            assert len(obs.recorder) <= ring_size, f"seed {seed}"
+            assert (
+                len(obs.recorder) + obs.recorder.dropped
+                == obs.recorder.seq
+            ), f"seed {seed}"
             # The invariant: every req_id resolved exactly once.
             assert sorted(r.req_id for r in results) == list(range(n_req)), (
                 f"seed {seed}: lost/duplicated requests "

@@ -750,6 +750,122 @@ class TestGraftlint:
             assert getattr(cfg, f.name) == getattr(dflt, f.name), f.name
 
 
+class TestObsDump:
+    """tools/obs_dump.py — offline validator/pretty-printer for flight-
+    recorder JSONL (the triage half of the observability subsystem)."""
+
+    def _dump(self, tmp_path, events):
+        import json
+
+        p = tmp_path / "ev.jsonl"
+        p.write_text(
+            "".join(json.dumps(e) + "\n" for e in events), encoding="utf-8"
+        )
+        return str(p)
+
+    def _recorder_dump(self, tmp_path):
+        from adversarial_spec_tpu.obs import (
+            FaultEvent,
+            FlightRecorder,
+            RequestEvent,
+            StepEvent,
+        )
+
+        r = FlightRecorder(size=64)
+        r.append(RequestEvent(req_id=0, state="queued", tokens=8))
+        r.append(RequestEvent(req_id=0, state="admitted", slot=1, tokens=8))
+        r.append(
+            StepEvent(kind="fused", n_live=2, admission_slot=1,
+                      prefill_tokens=64, decode_chunk=4, pipeline_depth=2)
+        )
+        r.append(StepEvent(kind="decode", n_live=2, decode_chunk=4,
+                           sync_reason="depth_fetch"))
+        r.append(
+            FaultEvent(seam="scheduler_chunk", kind="oom", slot=1,
+                       req_id=0, pages_freed=3)
+        )
+        r.append(RequestEvent(req_id=0, state="evicted", slot=1))
+        p = tmp_path / "real.jsonl"
+        r.dump_jsonl(str(p))
+        return str(p)
+
+    def test_real_recorder_dump_validates_exit_0(self, tmp_path, capsys):
+        from tools.obs_dump import main
+
+        path = self._recorder_dump(tmp_path)
+        assert main([path, "--timeline", "--requests"]) == 0
+        out = capsys.readouterr().out
+        assert "6 event(s)" in out
+        assert "oom at scheduler_chunk" in out
+        assert "3 page(s) freed" in out
+
+    def test_occupancy_timeline_renders_bars_and_annotations(
+        self, tmp_path, capsys
+    ):
+        from tools.obs_dump import load_events, occupancy_timeline
+
+        events, errors = load_events(self._recorder_dump(tmp_path))
+        assert errors == []
+        text = occupancy_timeline(events)
+        assert "#" in text  # fused glyph at full occupancy
+        assert "adm@1+64tok" in text
+        assert "depth=2" in text
+        assert "sync=depth_fetch" in text
+
+    def test_schema_violations_exit_1_and_are_listed(self, tmp_path, capsys):
+        from tools.obs_dump import main
+
+        path = self._dump(
+            tmp_path,
+            [
+                {"seq": 1, "type": "nope"},
+                {"seq": 2, "type": "request", "req_id": "zero",
+                 "state": "queued", "slot": -1, "tokens": 0,
+                 "cached_tokens": 0},
+                {"seq": 3, "type": "step", "kind": "decode", "n_live": 0,
+                 "admission_slot": -1, "prefill_tokens": 0,
+                 "decode_chunk": 0, "pipeline_depth": 0,
+                 "sync_reason": ""},
+            ],
+        )
+        assert main([path]) == 1
+        err = capsys.readouterr().err
+        assert "unknown event type 'nope'" in err
+        assert "req_id" in err
+        assert "schema violation" in err
+
+    def test_unreadable_input_exits_2(self, tmp_path):
+        from tools.obs_dump import main
+
+        assert main([str(tmp_path / "missing.jsonl")]) == 2
+
+    def test_unexpected_recompiles_warn_in_summary(self, tmp_path, capsys):
+        from tools.obs_dump import main
+
+        path = self._dump(
+            tmp_path,
+            [
+                {"seq": 1, "type": "compile", "program": "decode",
+                 "key": "(4,)", "n_compiles": 2, "unexpected": True},
+            ],
+        )
+        assert main([path]) == 0
+        assert "unexpected jit recompile" in capsys.readouterr().out
+
+    def test_schemas_track_the_dataclasses(self):
+        """EVENT_FIELDS derives from the dataclasses — a new event field
+        is validated automatically, never silently ignored."""
+        import dataclasses
+
+        from adversarial_spec_tpu.obs import EVENT_FIELDS
+        from adversarial_spec_tpu.obs.events import EVENT_TYPES
+
+        for cls in EVENT_TYPES:
+            assert set(EVENT_FIELDS[cls.TYPE]) == {
+                f.name for f in dataclasses.fields(cls)
+            }
+
+
 class TestMutationRun:
     """tools/mutation_run.py — mutant generation invariants (the full
     subprocess sweep runs via `python tools/mutation_run.py`; its score

@@ -50,6 +50,73 @@ class TestTracer:
         assert "total_s" in rep and "x" in rep["spans"]
         assert rep["counters"]["n"] == 3
 
+    def test_span_call_counts_in_report(self):
+        """A span entered twice reports BOTH the accumulated seconds
+        and the entry count — without the count, averages (per-chat
+        latency from N chats) were impossible to reconstruct."""
+        t = Tracer()
+        for _ in range(3):
+            with t.span("chat"):
+                pass
+        with t.span("validate"):
+            pass
+        rep = t.report()
+        assert rep["span_counts"]["chat"] == 3
+        assert rep["span_counts"]["validate"] == 1
+        # The average is now computable: spans[k] / span_counts[k].
+        assert rep["spans"]["chat"] >= 0.0
+        # Directly-assigned spans (cli sets tracer.spans["decode"])
+        # simply have no count — absent, not wrong.
+        t.spans["decode"] = 1.0
+        assert "decode" not in t.report()["span_counts"]
+
+    def test_nested_span_tree(self):
+        t = Tracer()
+        with t.span("round"):
+            with t.span("chat"):
+                time.sleep(0.002)
+            with t.span("chat"):
+                pass
+        tree = t.report()["span_tree"]
+        assert tree["round"]["count"] == 1
+        assert tree["round"]["children"]["chat"]["count"] == 2
+        assert (
+            tree["round"]["total_s"]
+            >= tree["round"]["children"]["chat"]["total_s"]
+        )
+        # Flat view unchanged: both levels visible as before.
+        assert "round" in t.spans and "chat" in t.spans
+
+    def test_merge_with_prefix(self):
+        """Per-opponent debate spans graft under the CLI tracer's
+        'debate' node — one report, two layers."""
+        child = Tracer()
+        child.add_span("opponent/mock://critic", 0.5)
+        child.add_span("opponent/mock://critic", 0.25)
+        child.count("attempts.mock://critic", 2)
+        parent = Tracer()
+        with parent.span("round"):
+            pass
+        parent.merge(child, prefix="debate")
+        assert parent.spans["debate/opponent/mock://critic"] == 0.75
+        assert parent.span_counts["debate/opponent/mock://critic"] == 2
+        assert parent.counters["debate/attempts.mock://critic"] == 2
+        tree = parent.report()["span_tree"]
+        assert (
+            tree["debate"]["children"]["opponent/mock://critic"]["count"]
+            == 2
+        )
+
+    def test_merge_without_prefix_accumulates(self):
+        a, b = Tracer(), Tracer()
+        with a.span("x"):
+            pass
+        with b.span("x"):
+            pass
+        a.merge(b)
+        assert a.span_counts["x"] == 2
+        assert a.report()["span_tree"]["x"]["count"] == 2
+
     def test_maybe_profile_noop(self):
         with maybe_profile(None):
             pass  # must not require jax or a directory

@@ -95,6 +95,13 @@ class GraftlintConfig:
             "stats.record_",
             "random.random",
             "random.randint",
+            # Observability (adversarial_spec_tpu/obs): event appends
+            # and metric observes are host side effects — inside a
+            # traced body they would fire once per compile shape.
+            "obs.",
+            "obs_mod.",
+            "recorder.append",
+            "metrics.",
         ]
     )
     # Extra dotted function names (module.func) to treat as trace roots

@@ -1,0 +1,169 @@
+"""Offline pretty-printer / validator for flight-recorder event JSONL.
+
+The serving path dumps events (``--events-out``, fault/timeout
+auto-dumps); this tool is the triage half: it schema-checks EVERY line
+against the event vocabulary (adversarial_spec_tpu/obs/events.py — the
+schemas are derived from the dataclasses, so they cannot drift from the
+emitters) and renders a per-step occupancy timeline as text, the
+"what was the batcher doing" view docs/observability.md walks through.
+
+Usage:
+    python tools/obs_dump.py events.jsonl              # validate + summary
+    python tools/obs_dump.py events.jsonl --timeline   # + occupancy bars
+    python tools/obs_dump.py events.jsonl --requests   # + per-request log
+
+Exit codes: 0 = every line valid; 1 = schema violations (listed on
+stderr); 2 = unreadable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from adversarial_spec_tpu.obs.events import validate_event  # noqa: E402
+
+_STEP_GLYPH = {"fused": "#", "decode": "=", "prefill": "."}
+
+
+def load_events(path: str) -> tuple[list[dict], list[str]]:
+    """Parse + schema-check a JSONL dump. Returns (valid events,
+    per-line error strings)."""
+    events: list[dict] = []
+    errors: list[str] = []
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError as e:
+                errors.append(f"line {lineno}: not JSON ({e})")
+                continue
+            problems = validate_event(obj)
+            if problems:
+                errors.extend(f"line {lineno}: {p}" for p in problems)
+            else:
+                events.append(obj)
+    return events, errors
+
+
+def summarize(events: list[dict]) -> str:
+    by_type: dict[str, int] = {}
+    for e in events:
+        by_type[e["type"]] = by_type.get(e["type"], 0) + 1
+    parts = [f"{n} {t}" for t, n in sorted(by_type.items())]
+    lines = [f"{len(events)} event(s): " + (", ".join(parts) or "none")]
+    faults = [e for e in events if e["type"] == "fault"]
+    for f in faults:
+        lines.append(
+            f"  fault: {f['kind']} at {f['seam']} "
+            f"(req {f['req_id']}, slot {f['slot']}, "
+            f"{f['pages_freed']} page(s) freed, "
+            f"{'requeued' if f['requeued'] else 'evicted'})"
+        )
+    compiles = [e for e in events if e["type"] == "compile"]
+    unexpected = [c for c in compiles if c["unexpected"]]
+    if unexpected:
+        lines.append(
+            f"  WARNING: {len(unexpected)} unexpected jit recompile(s): "
+            + ", ".join(sorted({c["program"] for c in unexpected}))
+        )
+    return "\n".join(lines)
+
+
+def occupancy_timeline(events: list[dict], width: int = 16) -> str:
+    """Per-step occupancy bars: one row per StepEvent, slot occupancy as
+    a bar, the step kind as the glyph, annotations for the riding
+    admission / sync reason — the step-by-step 'what was the batcher
+    doing' view."""
+    steps = [e for e in events if e["type"] == "step"]
+    if not steps:
+        return "(no step events)"
+    max_live = max(max(s["n_live"] for s in steps), 1)
+    scale = max(max_live, 1)
+    rows = []
+    for s in steps:
+        glyph = _STEP_GLYPH.get(s["kind"], "?")
+        filled = round(s["n_live"] / scale * width)
+        bar = glyph * filled + "-" * (width - filled)
+        notes = [f"live={s['n_live']}"]
+        if s["admission_slot"] >= 0:
+            notes.append(
+                f"adm@{s['admission_slot']}+{s['prefill_tokens']}tok"
+            )
+        if s["pipeline_depth"]:
+            notes.append(f"depth={s['pipeline_depth']}")
+        if s["sync_reason"]:
+            notes.append(f"sync={s['sync_reason']}")
+        rows.append(
+            f"seq {s['seq']:>6} [{bar}] {s['kind']:<8} " + " ".join(notes)
+        )
+    legend = (
+        f"occupancy timeline ({len(steps)} step(s), max live {max_live}; "
+        "#=fused ==decode .=prefill)"
+    )
+    return "\n".join([legend] + rows)
+
+
+def request_log(events: list[dict]) -> str:
+    """Per-request lifecycle, in event order."""
+    reqs = [e for e in events if e["type"] == "request"]
+    if not reqs:
+        return "(no request events)"
+    rows = []
+    for r in reqs:
+        extra = (
+            f" cached={r['cached_tokens']}" if r["cached_tokens"] else ""
+        )
+        rows.append(
+            f"seq {r['seq']:>6} req {r['req_id']:>3} "
+            f"{r['state']:<9} slot={r['slot']} tokens={r['tokens']}{extra}"
+        )
+    return "\n".join(rows)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="events JSONL file to validate/render")
+    ap.add_argument(
+        "--timeline",
+        action="store_true",
+        help="render the per-step occupancy timeline",
+    )
+    ap.add_argument(
+        "--requests",
+        action="store_true",
+        help="render the per-request lifecycle log",
+    )
+    args = ap.parse_args(argv)
+    try:
+        events, errors = load_events(args.path)
+    except OSError as e:
+        print(f"obs_dump: {e}", file=sys.stderr)
+        return 2
+    print(summarize(events))
+    if args.timeline:
+        print()
+        print(occupancy_timeline(events))
+    if args.requests:
+        print()
+        print(request_log(events))
+    for err in errors:
+        print(f"obs_dump: {err}", file=sys.stderr)
+    if errors:
+        print(
+            f"obs_dump: {len(errors)} schema violation(s)", file=sys.stderr
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
