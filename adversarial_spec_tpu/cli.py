@@ -232,6 +232,24 @@ def create_parser() -> argparse.ArgumentParser:
         help="Scheduler steps kept in flight (1-2; default 2; 1 = fused "
         "but synchronous)",
     )
+    d.add_argument(
+        "--speculative",
+        action=argparse.BooleanOptionalAction,
+        default=None,  # None = inherit ADVSPEC_SPECULATIVE (default on)
+        help="Per-slot prompt-lookup speculative decoding in the "
+        "continuous batcher: draft up to γ tokens per row from its own "
+        "context, verify in one multi-position forward (default on; "
+        "greedy output is byte-identical either way; "
+        "ADVSPEC_SPECULATIVE=0 sets the process default)",
+    )
+    d.add_argument(
+        "--gamma",
+        type=int,
+        default=None,  # None = inherit ADVSPEC_GAMMA (default 8)
+        help="Draft length per speculative step (>= 1; default 8, "
+        "ADVSPEC_GAMMA sets the process default; the tpu_ladder gamma "
+        "sweep measures the on-chip crossover)",
+    )
 
     z = parser.add_argument_group("resilience")
     z.add_argument(
@@ -448,6 +466,26 @@ def _configure_interleave(args: argparse.Namespace):
     return interleave
 
 
+def _configure_speculative(args: argparse.Namespace):
+    """Apply speculation flags to the process config (one CLI invocation
+    is one round) so ``perf.spec`` accounts exactly this round's verify
+    steps; the engine's persistent batcher re-resolves the config at the
+    next drain. Flag-else-env-default each invocation, like obs: one
+    round's --no-speculative/--gamma must not leak into the next."""
+    from adversarial_spec_tpu.engine import spec
+
+    spec.configure(
+        enabled=(
+            args.speculative
+            if args.speculative is not None
+            else spec.env_enabled()
+        ),
+        gamma=args.gamma if args.gamma is not None else spec.env_gamma(),
+    )
+    spec.reset_stats()
+    return spec
+
+
 def _configure_obs(args: argparse.Namespace):
     """Arm the observability subsystem from flags; returns the module
     for reporting. One CLI invocation is one round: metrics zero, the
@@ -480,6 +518,7 @@ def run_critique(args: argparse.Namespace) -> int:
     breakers = _configure_resilience(args)
     prefix_cache = _configure_prefix_cache(args)
     interleave = _configure_interleave(args)
+    spec_cfg = _configure_speculative(args)
     obs = _configure_obs(args)
     spec, session_state = load_or_resume_session(args)
     if session_state is not None and session_state.breakers:
@@ -549,6 +588,9 @@ def run_critique(args: argparse.Namespace) -> int:
     # under resident decode vs genuinely stalled the batch (their sum IS
     # the round's prefill_time_s), plus step/sync counts.
     perf["interleave"] = interleave.snapshot()
+    # Speculation telemetry: verify steps, acceptance rate, tokens/step,
+    # rollback pages, draft/verify wall split (engine/spec.py).
+    perf["spec"] = spec_cfg.snapshot()
     # Observability report: flight-recorder occupancy, event mix, host
     # syncs by reason, retrace watch (unexpected recompiles flagged).
     perf["obs"] = obs.snapshot()
@@ -718,6 +760,7 @@ def handle_export_tasks(args: argparse.Namespace) -> int:
     """
     _configure_prefix_cache(args)
     _configure_interleave(args)
+    _configure_speculative(args)
     obs = _configure_obs(args)
     spec = _read_spec_stdin()
     models = parse_models(args)

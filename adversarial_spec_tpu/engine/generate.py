@@ -101,6 +101,12 @@ def pad_batch(
 
 PREFILL_CHUNK = 1024
 
+# One-time flag for the speculative×paged seam warning below: paged
+# generate() has no dense speculative loop (paged speculation is the
+# ContinuousBatcher's per-slot draft/verify step), and the combination
+# used to be silently ignored.
+_PAGED_SPEC_WARNED = False
+
 
 def _sample_step(
     logits, key, finished, out_buf, step, eos_ids, *, greedy, top_k,
@@ -819,15 +825,20 @@ def generate(
     # single-query kernel (under its shard_map wrapper on meshes); the
     # verification span runs the multi-query kernel single-device and
     # the jnp attention path (GSPMD head-sharded) under tp.
-    from adversarial_spec_tpu.engine.speculative import GAMMA
+    from adversarial_spec_tpu.engine import spec as spec_cfg_mod
 
+    _sp_cfg = spec_cfg_mod.config()
+    gamma = _sp_cfg.gamma
+    spec_explicit = speculative is not None
     if speculative is None:
-        # Unspecified → on, unless ADVSPEC_SPECULATIVE=0: the global
-        # kill-switch lets a harvested measurement (tpu_ladder spec_off
-        # vs spec_on) turn speculation off fleet-wide without touching
-        # call sites. The adaptive off-switch below still bounds the
-        # cost per call either way; this saves the one probe phase.
-        speculative = os.environ.get("ADVSPEC_SPECULATIVE", "1") != "0"
+        # Unspecified → the process switchboard (engine/spec.py): env
+        # ADVSPEC_SPECULATIVE seeds it, CLI --no-speculative/--gamma and
+        # tests retune it via configure() — the SAME knob the batcher
+        # consults, so the documented escape hatch reaches the dense
+        # fallback path (sharded meshes, non-paged calls) too. The
+        # adaptive off-switch below still bounds the cost per call
+        # either way.
+        speculative = _sp_cfg.enabled
     spec_dp = 1
     spec_mesh = None
     if mesh is not None and mesh.size > 1:
@@ -853,8 +864,33 @@ def generate(
             # (VERDICT r3 item 9).
             spec_mesh = mesh
     use_spec = (
-        speculative and not paged and max_new_tokens > GAMMA + 1
+        speculative and not paged and max_new_tokens > gamma + 1
     )
+    if spec_explicit and speculative and paged and (
+        max_new_tokens > gamma + 1
+    ):
+        # The dense speculative loop has no paged variant here — paged
+        # speculation lives in the ContinuousBatcher (engine/scheduler's
+        # per-slot draft/verify step), which is where the serving path
+        # already runs. Say so ONCE instead of silently decoding
+        # token-at-a-time under a flag combination that reads like
+        # "speculation on". Only for an EXPLICIT speculative=True: a
+        # paged call that merely inherited the default-on process config
+        # (the engine's dense fallback) asked for nothing and gets no
+        # spurious warning.
+        global _PAGED_SPEC_WARNED
+        if not _PAGED_SPEC_WARNED:
+            _PAGED_SPEC_WARNED = True
+            import sys as _sys
+
+            print(
+                "warning: speculative=True is ignored when paged=True in "
+                "generate() — dense-path speculation has no paged "
+                "variant; paged speculation runs per-slot in the "
+                "ContinuousBatcher (TpuEngine.chat / run_all). "
+                "Pass speculative=False to silence this.",
+                file=_sys.stderr,
+            )
     desynced = False  # per-row steps diverge after any speculative phase
     steps_rows = None
     if use_spec:
@@ -896,7 +932,7 @@ def generate(
             # Device-side reduction → replicated bool (multi-host safe).
             spec_fits = bool(
                 jnp.any(
-                    ~finished & (steps_rows + GAMMA + 1 <= max_new_tokens)
+                    ~finished & (steps_rows + gamma + 1 <= max_new_tokens)
                 )
             )
         else:
@@ -904,7 +940,8 @@ def generate(
         if spec_fits:
             spec_static = dict(
                 prompt_len=S,
-                iters=max(1, DECODE_CHUNK // (GAMMA + 1)),
+                gamma=gamma,
+                iters=max(1, DECODE_CHUNK // (gamma + 1)),
                 greedy=greedy,
                 top_k=top_k,
                 use_top_p=use_top_p,

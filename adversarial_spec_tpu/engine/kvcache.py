@@ -151,8 +151,44 @@ class PageAllocator:
         else:
             self._refs[page] = refs - 1
 
+    def truncate(self, seq_id: int, n_tokens: int) -> list[int]:
+        """Shrink ``seq_id`` to ``n_tokens``, releasing tail pages that no
+        longer back any of its tokens. The speculative-decode rollback
+        primitive: a verify step reserves pages for the full γ-token
+        draft up front, then rolls the rejected tail back here — each
+        released page drops ONE reference, so a tail page shared with
+        the prefix cache (or another sequence) merely loses this
+        sequence's hold and stays resident for its other owners
+        (callers never truncate below an adopted prefix: the accepted
+        length always covers the prompt, and shared prefix pages sit at
+        the table head — the copy-on-append boundary).
+
+        Returns the pages this sequence released (refcount dropped; they
+        are back on the free list only if that was the last reference).
+        """
+        length = self._lengths[seq_id]
+        if not 0 <= n_tokens <= length:
+            raise ValueError(
+                f"cannot truncate sequence {seq_id} ({length} tokens) "
+                f"to {n_tokens}"
+            )
+        table = self._tables[seq_id]
+        keep = -(-n_tokens // self.page_size)
+        released = table[keep:]
+        del table[keep:]
+        for p in released:
+            self._release(p)
+        self._lengths[seq_id] = n_tokens
+        return released
+
     def length(self, seq_id: int) -> int:
         return self._lengths[seq_id]
+
+    def covered_tokens(self, seq_id: int) -> int:
+        """KV slots actually writable for this sequence — its page count
+        times the page size (≥ ``length``; the page-rounded bound the
+        scheduler's speculative write mask is built from)."""
+        return len(self._tables[seq_id]) * self.page_size
 
     def table(self, seq_id: int) -> list[int]:
         return list(self._tables[seq_id])

@@ -137,6 +137,96 @@ class MockEngine:
             )
 
     @staticmethod
+    def _account_spec(
+        req: ChatRequest, text: str, req_index: int = 0
+    ) -> None:
+        """Deterministic CPU mirror of the scheduler's per-slot
+        prompt-lookup speculation: step through this reply's token
+        chunks exactly the way the batcher's verify loop would — draft
+        γ tokens after the most recent [prev, cur] bigram match in the
+        context (prompt + emitted so far), accept the longest prefix
+        matching the actual continuation, emit accepted+1 — and record
+        the SAME stats/events schema (``perf.spec``, SpecEvents, the
+        tokens-per-step and acceptance histograms), so the whole
+        speculation pipeline pins on CPU without a TPU. The mock
+        "model" is greedy and its output IS the target distribution's
+        argmax, so prompt-lookup acceptance here is exact string
+        matching — high on the [SPEC] revision (a near-copy of the
+        prompt), low on fresh prose, zero when the bigram never
+        recurs.
+
+        Tokenization here is whitespace words, NOT the prefix-cache
+        accounting's fixed 4-char chunks: a fixed-offset chunking of
+        the reply never aligns with the prompt's chunking of the same
+        substring (the copy sits at an arbitrary offset mod 4), so
+        chunk-wise acceptance would be identically zero. A real BPE
+        re-tokenizes a copied substring to the same ids regardless of
+        its byte offset — word splitting is the offset-stable mock of
+        that property."""
+        from adversarial_spec_tpu.engine import spec as spec_mod
+
+        if not spec_mod.config().enabled:
+            return
+        gamma = spec_mod.config().gamma
+        span = gamma + 1
+        ctx = (req.system + "\n" + req.user).split()
+        out = text.split()
+        # Most-recent-bigram index over the growing context, the host
+        # analog of speculative._draft's reverse scan. A bigram is
+        # registered only once it is INTERIOR (a newer token landed
+        # after it): the bigram ending at the context's final index IS
+        # the query — indexing it too would make every lookup find
+        # itself and every draft empty.
+        last: dict[tuple[str, str], int] = {
+            (ctx[m - 1], ctx[m]): m for m in range(1, len(ctx) - 1)
+        }
+        steps = drafted = accepted = 0
+        i = 0
+        obs_on = obs_mod.config().enabled
+        while i < len(out):
+            n_allowed = min(gamma, len(out) - i - 1)
+            k = 0
+            if n_allowed > 0 and len(ctx) >= 2:
+                m = last.get((ctx[-2], ctx[-1]))
+                if m is not None:
+                    draft = ctx[m + 1 : m + 1 + gamma]
+                    while (
+                        k < n_allowed
+                        and k < len(draft)
+                        and draft[k] == out[i + k]
+                    ):
+                        k += 1
+            n_emit = k + 1
+            for tok in out[i : i + n_emit]:
+                if len(ctx) >= 2:
+                    last[(ctx[-2], ctx[-1])] = len(ctx) - 1
+                ctx.append(tok)
+            i += n_emit
+            steps += 1
+            drafted += n_allowed
+            accepted += k
+            spec_mod.stats.record_step(n_allowed, k, n_emit)
+            # Synthetic step wall: ONE batched forward per verify step,
+            # 1/1024 s (the same tokens/1024 second-scale the interleave
+            # accounting uses), split by the position-share convention.
+            spec_mod.stats.record_wall(
+                (1 / 1024) / (span + 1), (1 / 1024) * span / (span + 1)
+            )
+            if obs_on:
+                obs_mod.hot.spec_tokens_per_step.observe(float(n_emit))
+                obs_mod.emit(
+                    obs_mod.SpecEvent(
+                        slot=req_index,
+                        req_id=req_index,
+                        drafted=n_allowed,
+                        accepted=k,
+                        emitted=n_emit,
+                    )
+                )
+        if obs_on and drafted:
+            obs_mod.hot.spec_acceptance.observe(accepted / drafted)
+
+    @staticmethod
     def _emit_lifecycle(
         req_index: int, in_tokens: int, cached: int, out_tokens: int
     ) -> None:
@@ -273,6 +363,7 @@ class MockEngine:
             in_tokens = _estimate_tokens(req.system) + _estimate_tokens(
                 req.user
             )
+            self._account_spec(req, text, req_index)
             self._emit_lifecycle(req_index, in_tokens, cached, out_tokens)
             return Completion(
                 text=text,
@@ -314,6 +405,7 @@ class MockEngine:
         out_tokens = min(_estimate_tokens(text), params.max_new_tokens)
         tps = float(opts.get("tps", "0"))
         in_tokens = _estimate_tokens(req.system) + _estimate_tokens(req.user)
+        self._account_spec(req, text, req_index)
         self._emit_lifecycle(req_index, in_tokens, cached, out_tokens)
         usage = Usage(
             input_tokens=in_tokens,

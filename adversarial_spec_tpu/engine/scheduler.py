@@ -66,7 +66,15 @@ from adversarial_spec_tpu.engine.generate import (
 )
 from adversarial_spec_tpu.engine import interleave as interleave_mod
 from adversarial_spec_tpu.engine import prefix_cache as prefix_mod
+from adversarial_spec_tpu.engine import spec as spec_mod
 from adversarial_spec_tpu import obs as obs_mod
+from adversarial_spec_tpu.engine.sampling import filtered_logits
+from adversarial_spec_tpu.engine.speculative import (
+    _draft,
+    _rowwise_slice,
+    _rowwise_write,
+    accept_spans,
+)
 from adversarial_spec_tpu.engine.kvcache import (
     OutOfPages,
     PageAllocator,
@@ -156,6 +164,13 @@ class SchedResult:
     # decode share is apportioned by the caller — engine/tpu.py).
     cached_tokens: int = 0
     prefill_time_s: float = 0.0
+    # Per-request speculation telemetry: verify steps this row took part
+    # in, eligible draft positions verified, and positions accepted
+    # (acceptance rate = accepted / drafted). All zero with
+    # --no-speculative.
+    spec_steps: int = 0
+    spec_drafted: int = 0
+    spec_accepted: int = 0
 
 
 def _next_chunk_len(remaining: int) -> int:
@@ -400,6 +415,336 @@ def fused_prefill_decode_chunk(
     )
 
 
+def _spec_chunk_impl(
+    params,
+    cfg: ModelConfig,
+    pool,
+    page_table: jnp.ndarray,  # [B, Pmax] physical ids (0 = trash/unmapped)
+    ctx_buf: jnp.ndarray,  # [B, C] prompt ++ emitted tokens (draft source)
+    ctx_len: jnp.ndarray,  # [B] tokens valid in ctx_buf
+    prev_tok: jnp.ndarray,  # [B] token before cur (bigram context)
+    cur_tok: jnp.ndarray,  # [B]
+    cur_len: jnp.ndarray,  # [B] prompt+emitted tokens so far
+    pad_lens: jnp.ndarray,  # [B]
+    n_emitted: jnp.ndarray,  # [B]
+    max_new: jnp.ndarray,  # [B] per-row budget
+    alloc_len: jnp.ndarray,  # [B] KV slots covered by allocated pages
+    active: jnp.ndarray,  # [B] bool
+    out_buf: jnp.ndarray,  # [B, cap]
+    eos_ids: jnp.ndarray,
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    top_p: jnp.ndarray,
+    *,
+    gamma: int,
+    greedy: bool,
+    top_k: int,
+    use_top_p: bool = True,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
+    mesh=None,
+):
+    """ONE speculative step over whatever rows are active: draft up to γ
+    tokens per row from that row's own context (prompt + generated so
+    far — prompt-lookup, engine/speculative.py's bigram rule), run ONE
+    batched multi-position verification forward over the paged pool, and
+    accept a prefix by rejection sampling against the true sampling
+    distribution (``accept_spans`` — the dense path's accept math, so
+    greedy output stays byte-identical to plain decode).
+
+    The verification forward IS ``forward_paged_decode`` — the γ+1
+    positions flatten into its batch axis (tokens [B·span, 1], each
+    flattened row carrying its own write target and attention bounds),
+    so the verify program shares the decode chunk's traced body the way
+    ``fused_prefill_decode_chunk`` shares the prefill's. In-span
+    causality comes from the bounds: position i's window ends at its own
+    slot, and every span position's K/V is scattered before attention in
+    each layer, so position i sees exactly [pad, cur_len+i).
+
+    Rollback discipline: draft position k writes its K/V at slot
+    ``cur_len-1+k`` only when the host's page allocation covers it AND
+    the row's output budget could commit it (``n_allowed``); everything
+    else lands on the trash page. Rejected drafts leave stale K/V above
+    the accepted prefix — never read, because the row's next write
+    region starts exactly there — and the host releases any page that
+    no longer backs a committed token (``PageAllocator.truncate``) after
+    fetching the accept counts. Emits 1..γ+1 tokens per active row;
+    rows that cannot fit a draft (budget tail, pages short) degrade to a
+    plain single-token step inside the SAME program, so the compiled
+    shape is one per draft width γ.
+
+    Returns the updated row state plus ``counts`` [5, B] (n_allowed,
+    n_acc, n_emit, active, cur_len) — ONE stacked array so the drive
+    loop's sanctioned accept fetch is a single host copy.
+    """
+    B = cur_tok.shape[0]
+    page_size = pool["k"].shape[3]
+    cap = out_buf.shape[1]
+    C = ctx_buf.shape[1]
+    span = gamma + 1
+    rows = jnp.arange(B)
+    j = jnp.arange(span)[None, :]  # [1, span]
+
+    # Per-row draft positions eligible to COMMIT this step: bounded by
+    # the output budget (the bonus token always needs one slot) and by
+    # the KV slots the host has pages for.
+    n_allowed = jnp.clip(
+        jnp.minimum(max_new - n_emitted - 1, alloc_len - cur_len),
+        0,
+        gamma,
+    )
+    n_allowed = jnp.where(active, n_allowed, 0)
+
+    # --- Draft from the row's own context (most recent bigram match). ---
+    draft = _draft(ctx_buf, prev_tok, cur_tok, ctx_len, gamma)  # [B, γ]
+    toks = jnp.concatenate([cur_tok[:, None], draft], axis=1)  # [B, span]
+    q_pos = (cur_len - 1)[:, None] + jnp.arange(span)[None, :]  # [B, span]
+    # Position 0 is cur (its slot is always covered: alloc_len ≥
+    # cur_len); draft position k commits only while k ≤ n_allowed.
+    writable = active[:, None] & (j <= n_allowed[:, None])
+    safe_q = jnp.minimum(q_pos, page_table.shape[1] * page_size - 1)
+    write_page = jnp.where(
+        writable,
+        page_table[rows[:, None], safe_q // page_size],
+        TRASH_PAGE,
+    )
+    write_off = safe_q % page_size
+    bounds = jnp.stack(
+        [jnp.broadcast_to(pad_lens[:, None], q_pos.shape), q_pos + 1],
+        axis=-1,
+    ).astype(jnp.int32)  # [B, span, 2]
+    positions = q_pos - pad_lens[:, None]
+
+    # --- Verify: the single-token paged forward with batch = B·span. ---
+    logits, pool = forward_paged_decode(
+        params,
+        cfg,
+        toks.reshape(B * span, 1),
+        positions.reshape(B * span, 1),
+        pool,
+        jnp.repeat(page_table, span, axis=0),
+        write_page.reshape(-1),
+        write_off.reshape(-1),
+        bounds.reshape(B * span, 2),
+        q_pos.reshape(-1),
+        use_pallas=use_pallas,
+        pallas_interpret=pallas_interpret,
+        mesh=mesh,
+    )
+    logits = logits.reshape(B, span, -1)
+
+    # --- Accept by rejection sampling against the true distribution. ---
+    filt = filtered_logits(
+        logits,
+        greedy=greedy,
+        top_k=top_k,
+        temperature=temperature,
+        top_p=top_p,
+        use_top_p=use_top_p,
+    )  # [B, span, V]
+    probs = jax.nn.softmax(filt, axis=-1)
+    key, u_key, res_key = jax.random.split(key, 3)
+    n_acc, bonus = accept_spans(
+        probs, draft, n_allowed, u_key, res_key, greedy=greedy
+    )
+    emitted = jnp.concatenate(
+        [draft, jnp.zeros((B, 1), draft.dtype)], axis=1
+    )
+    emitted = emitted.at[rows, n_acc].set(bonus)
+
+    # --- EOS + per-row emit counts (EOS kept, zeros after). ---
+    is_eos = (emitted[..., None] == eos_ids[None, None, :]).any(-1)
+    eos_hits = is_eos & (j <= n_acc[:, None])
+    any_eos = eos_hits.any(axis=1)
+    first_eos = jnp.argmax(eos_hits, axis=1)
+    n_emit = jnp.where(any_eos, first_eos + 1, n_acc + 1)
+    n_emit = jnp.where(active, n_emit, 0)
+    emitted = jnp.where(j < n_emit[:, None], emitted, 0)
+
+    def append(buf, start_raw, width):
+        """Write ``emitted[:n_emit]`` at per-row ``start_raw``, masked so
+        every other slot keeps its current value (a clamped window near
+        the buffer end must never smash earlier tokens)."""
+        w_start = jnp.minimum(start_raw, width - span)
+        d = start_raw - w_start  # [B] ≥ 0 in-window shift
+        src = jnp.take_along_axis(
+            emitted, jnp.clip(j - d[:, None], 0, span - 1), axis=1
+        )
+        current = _rowwise_slice(buf, w_start, span)
+        mask = (
+            active[:, None]
+            & (j >= d[:, None])
+            & (j < (d + n_emit)[:, None])
+        )
+        return _rowwise_write(buf, jnp.where(mask, src, current), w_start)
+
+    out_buf = append(out_buf, jnp.minimum(n_emitted, cap - 1), cap)
+    ctx_buf = append(ctx_buf, jnp.minimum(ctx_len, C - 1), C)
+
+    new_cur = jnp.where(
+        active, emitted[rows, jnp.maximum(n_emit - 1, 0)], cur_tok
+    )
+    new_prev = jnp.where(
+        active,
+        jnp.where(
+            n_emit >= 2, emitted[rows, jnp.maximum(n_emit - 2, 0)], cur_tok
+        ),
+        prev_tok,
+    )
+    n_emitted = n_emitted + n_emit
+    cur_len = cur_len + n_emit
+    ctx_len = ctx_len + n_emit
+    done = (any_eos | (n_emitted >= max_new)) & active
+    active = active & ~done
+    counts = jnp.stack(
+        [n_allowed, n_acc, n_emit, active.astype(jnp.int32), cur_len]
+    )
+    return (
+        pool,
+        ctx_buf,
+        ctx_len,
+        new_prev,
+        new_cur,
+        cur_len,
+        n_emitted,
+        out_buf,
+        active,
+        counts,
+    )
+
+
+# The jitted verify program — the same body, not a hand-forwarded
+# wrapper (the scheduler_decode_chunk convention: a wrapper that forgot
+# to thread a kwarg would silently pin its default on one path only).
+scheduler_spec_chunk = partial(
+    jax.jit,
+    static_argnames=(
+        "cfg",
+        "gamma",
+        "greedy",
+        "top_k",
+        "use_top_p",
+        "use_pallas",
+        "pallas_interpret",
+        "mesh",
+    ),
+    donate_argnames=("pool", "out_buf", "ctx_buf"),
+)(_spec_chunk_impl)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "cfg",
+        "gamma",
+        "greedy",
+        "top_k",
+        "use_top_p",
+        "use_pallas",
+        "pallas_interpret",
+        "mesh",
+    ),
+    donate_argnames=("adm_cache", "pool", "out_buf", "ctx_buf"),
+)
+def fused_prefill_spec_chunk(
+    params,
+    cfg: ModelConfig,
+    adm_tokens: jnp.ndarray,  # [1, Sc] the admission's next prompt chunk
+    adm_pads: jnp.ndarray,  # [1]
+    adm_cache,  # 1-row dense cache being prefilled
+    adm_cache_index: jnp.ndarray,  # scalar: slot of the chunk's 1st token
+    pool,
+    page_table: jnp.ndarray,
+    ctx_buf: jnp.ndarray,
+    ctx_len: jnp.ndarray,
+    prev_tok: jnp.ndarray,
+    cur_tok: jnp.ndarray,
+    cur_len: jnp.ndarray,
+    pad_lens: jnp.ndarray,
+    n_emitted: jnp.ndarray,
+    max_new: jnp.ndarray,
+    alloc_len: jnp.ndarray,
+    active: jnp.ndarray,
+    out_buf: jnp.ndarray,
+    eos_ids: jnp.ndarray,
+    key: jax.Array,
+    temperature: jnp.ndarray,
+    top_p: jnp.ndarray,
+    *,
+    gamma: int,
+    greedy: bool,
+    top_k: int,
+    use_top_p: bool = True,
+    use_pallas: bool = False,
+    pallas_interpret: bool = False,
+    mesh=None,
+):
+    """``fused_prefill_decode_chunk``'s speculative sibling: the
+    in-flight admission's prompt chunk AND every resident row's
+    draft+verify step in ONE device program — a speculating slot rides
+    the same dispatch as an in-flight admission, so turning speculation
+    on never un-fuses chunked-prefill piggybacking. Each half is the
+    SAME traced body as its standalone program (``_prefill_chunk_impl``
+    / ``_spec_chunk_impl``), so greedy tokens are byte-identical either
+    way."""
+    adm_cache, adm_logits = _prefill_chunk_impl(
+        params, cfg, adm_tokens, adm_pads, adm_cache, adm_cache_index
+    )
+    (
+        pool,
+        ctx_buf,
+        ctx_len,
+        prev_tok,
+        cur_tok,
+        cur_len,
+        n_emitted,
+        out_buf,
+        active,
+        counts,
+    ) = _spec_chunk_impl(
+        params,
+        cfg,
+        pool,
+        page_table,
+        ctx_buf,
+        ctx_len,
+        prev_tok,
+        cur_tok,
+        cur_len,
+        pad_lens,
+        n_emitted,
+        max_new,
+        alloc_len,
+        active,
+        out_buf,
+        eos_ids,
+        key,
+        temperature,
+        top_p,
+        gamma=gamma,
+        greedy=greedy,
+        top_k=top_k,
+        use_top_p=use_top_p,
+        use_pallas=use_pallas,
+        pallas_interpret=pallas_interpret,
+        mesh=mesh,
+    )
+    return (
+        adm_cache,
+        adm_logits,
+        pool,
+        ctx_buf,
+        ctx_len,
+        prev_tok,
+        cur_tok,
+        cur_len,
+        n_emitted,
+        out_buf,
+        active,
+        counts,
+    )
+
+
 def sharded_scheduler_decode_chunk(
     mesh,
     params,
@@ -541,6 +886,8 @@ class ContinuousBatcher:
         interleave: bool | None = None,
         pipeline_depth: int | None = None,
         step_tokens: int = 0,
+        speculative: bool | None = None,
+        gamma: int | None = None,
     ):
         self.params = params
         self.cfg = cfg
@@ -583,6 +930,19 @@ class ContinuousBatcher:
         )
         self.step_tokens = step_tokens or (
             ADMISSION_CHUNK + max_batch * chunk
+        )
+        # Per-slot prompt-lookup speculation (None = process config,
+        # engine/spec.py): each decode step drafts up to γ tokens per
+        # resident row from that row's own context and verifies them in
+        # ONE multi-position forward (_spec_chunk_impl). γ is validated
+        # at the knob (spec.configure / env read), so any value that
+        # reaches here is ≥ 1.
+        cfg_sp = spec_mod.config()
+        self.speculative = (
+            cfg_sp.enabled if speculative is None else bool(speculative)
+        )
+        self.gamma = self._clamp_gamma(
+            cfg_sp.gamma if gamma is None else int(gamma), max_new_cap
         )
         self.greedy = greedy
         self.top_k = top_k
@@ -631,14 +991,27 @@ class ContinuousBatcher:
 
         B, cap = self.B, max_new_cap
         self.cap = cap
-        self.page_table = jnp.zeros((B, self.max_pages_per_seq), jnp.int32)
-        self.cur_tok = jnp.zeros((B,), jnp.int32)
-        self.cur_len = jnp.ones((B,), jnp.int32)  # ≥1 so q_pos ≥ 0
-        self.pad_lens = jnp.zeros((B,), jnp.int32)
-        self.n_emitted = jnp.zeros((B,), jnp.int32)
-        self.max_new = jnp.zeros((B,), jnp.int32)
-        self.active = jnp.zeros((B,), bool)
-        self.out_buf = jnp.zeros((B, cap), jnp.int32)
+        # Persistent per-row device state is COMMITTED to the params'
+        # replicated sharding at creation (``_commit``, no-op off-mesh)
+        # for the same reason fresh admission caches are: these arrays
+        # are program inputs on the very first dispatch and donated
+        # outputs ever after — an uncommitted fresh array and a
+        # mesh-committed step output present two jit signatures for the
+        # same program, and XLA compiles it twice (the retrace watch
+        # caught exactly this on the engine's first paged spec drive:
+        # ctx_len/prev_tok/cur_len/n_emitted/active flipped
+        # UnspecifiedValue → NamedSharding between step 1 and step 2).
+        self.page_table = self._commit(
+            jnp.zeros((B, self.max_pages_per_seq), jnp.int32)
+        )
+        self.cur_tok = self._commit(jnp.zeros((B,), jnp.int32))
+        # ≥1 so q_pos ≥ 0
+        self.cur_len = self._commit(jnp.ones((B,), jnp.int32))
+        self.pad_lens = self._commit(jnp.zeros((B,), jnp.int32))
+        self.n_emitted = self._commit(jnp.zeros((B,), jnp.int32))
+        self.max_new = self._commit(jnp.zeros((B,), jnp.int32))
+        self.active = self._commit(jnp.zeros((B,), bool))
+        self.out_buf = self._commit(jnp.zeros((B, cap), jnp.int32))
         # Host-trailing view of ``active``: the pipelined loop dispatches
         # against this snapshot (updated at admission handoff, fault
         # eviction, and step N-1's async fetch) instead of syncing on the
@@ -651,6 +1024,27 @@ class ContinuousBatcher:
         # newcomer that now owns the slot.
         self._active_np = np.zeros((B,), bool)
         self._slot_gen = [0] * B
+        # Speculation state. ctx_buf is the DRAFT SOURCE: each row's
+        # real (unpadded) prompt ids followed by everything it has
+        # emitted — the prompt-lookup bigram scan runs over it on
+        # device. Sized to the model context: submit() guarantees
+        # bucketed prompt + budget fits max_seq_len, so prompt+emitted
+        # always fits too. cur_len/row_len/n_emitted host views trail
+        # the device via the per-step counts fetch; the host needs them
+        # to manage draft page coverage (extend before dispatch,
+        # truncate after the accept counts land).
+        self._ctx_cap = cfg.max_seq_len
+        self.ctx_buf = self._commit(
+            jnp.zeros((B, self._ctx_cap), jnp.int32)
+        )
+        self.ctx_len = self._commit(jnp.zeros((B,), jnp.int32))
+        self.prev_tok = self._commit(jnp.zeros((B,), jnp.int32))
+        self._cur_len_np = np.ones((B,), np.int64)
+        self._row_len_np = np.zeros((B,), np.int64)
+        self._max_new_np = np.zeros((B,), np.int64)
+        # Per-slot speculation telemetry [steps, drafted, accepted],
+        # stamped onto SchedResult at completion/eviction.
+        self._slot_spec: list[list[int]] = [[0, 0, 0] for _ in range(B)]
 
         self._slot_req: list[SchedRequest | None] = [None] * B
         self._slot_seq: list[int | None] = [None] * B
@@ -719,6 +1113,52 @@ class ContinuousBatcher:
         if seed is not None:
             self._key = jax.random.key(seed)
 
+    def reconfigure_speculative(
+        self, enabled: bool | None = None, gamma: int | None = None
+    ) -> None:
+        """Retune speculation between DRAINS on a reused batcher (CLI
+        rounds re-resolve the process config each invocation; the
+        engine's persistent batcher must follow it). Only legal while no
+        rows are resident: the admission path's page-reservation
+        discipline (full budget up front vs lazy per-verify-step)
+        depends on the flag, so flipping it under a live row would break
+        the row's coverage contract. ``run_all`` drains fully, so the
+        engine's call-seam is always idle."""
+        if any(self._active_np) or any(
+            r is not None for r in self._slot_req
+        ):
+            raise RuntimeError(
+                "reconfigure_speculative on a batcher with resident rows"
+            )
+        if enabled is not None:
+            self.speculative = bool(enabled)
+            if self.speculative:
+                # Re-enabling must re-walk the γ-vs-cap clamp: the
+                # constructor may have degraded this batcher to plain
+                # decode (cap <= 1 with self.gamma left unclamped), and
+                # skipping the clamp here would let a span wider than
+                # the output buffer reach the compiled program.
+                self.gamma = self._clamp_gamma(self.gamma, self.cap)
+        if gamma is not None:
+            # Same knob validation as engine/spec.py — a γ that reaches
+            # the compiled program is always ≥ 1.
+            self.gamma = self._clamp_gamma(
+                spec_mod._validate_gamma(int(gamma)), self.cap
+            )
+
+    def _clamp_gamma(self, gamma: int, cap: int) -> int:
+        """Bound γ so a step's full span (γ drafts + the bonus token)
+        fits the per-row output buffer: the spec chunk's masked append
+        window is ``span`` wide, so ``span > cap`` would push the write
+        window start negative (dynamic-slice clamping would then smash
+        tokens at the buffer head). A 1-token cap leaves nothing to
+        draft for — degrade to plain decode rather than compile a
+        0-wide verify."""
+        if cap <= 1:
+            self.speculative = False
+            return gamma
+        return max(1, min(gamma, cap - 1))
+
     # -- admission ---------------------------------------------------------
 
     def submit(self, req: SchedRequest) -> None:
@@ -772,7 +1212,13 @@ class ContinuousBatcher:
             return self._start_admission_cached(slot, req)
         tokens_np, pads_np = pad_batch([req.prompt_ids], pad_id=0)
         S = tokens_np.shape[1]
-        total = S + req.max_new_tokens
+        # Speculative rows reserve only the prompt + the first decode
+        # write slot; draft headroom (and committed growth) is allocated
+        # lazily per verify step and rolled back past the accepted
+        # prefix (_prepare_spec_step / _apply_spec_counts). Plain rows
+        # keep the full up-front reservation: every admitted request is
+        # guaranteed to decode to its budget without further allocation.
+        total = S + (1 if self.speculative else req.max_new_tokens)
         seq_id = self._seq_counter
         self.allocator.new_sequence(seq_id)
         try:
@@ -849,8 +1295,12 @@ class ContinuousBatcher:
         try:
             if matched:
                 self.allocator.adopt(seq_id, pages, matched)
+            # Same lazy-reservation rule as the padded path: prompt + 1
+            # under speculation, full budget otherwise.
             self._extend_evicting(
-                seq_id, (S_real - matched) + req.max_new_tokens
+                seq_id,
+                (S_real - matched)
+                + (1 if self.speculative else req.max_new_tokens),
             )
             cache = self._commit(
                 init_cache(
@@ -1055,6 +1505,28 @@ class ContinuousBatcher:
         self.active = self.active.at[slot].set(row_active)
         self._active_np[slot] = row_active
         self._slot_gen[slot] += 1  # new owner: expire in-flight flags
+        if self.speculative:
+            # Seed the draft source: the row's REAL (unpadded) prompt
+            # ids followed by its first sampled token. ctx coordinates
+            # are independent of the KV layout — padded rows draft from
+            # the same clean token stream canonical rows do.
+            ids_np = np.asarray(req.prompt_ids, np.int32)
+            row_ctx = np.zeros((self._ctx_cap,), np.int32)
+            row_ctx[: len(ids_np)] = ids_np
+            self.ctx_buf = self.ctx_buf.at[slot].set(jnp.asarray(row_ctx))
+            self.ctx_buf = self.ctx_buf.at[slot, len(ids_np)].set(first)
+            self.ctx_len = self.ctx_len.at[slot].set(len(ids_np) + 1)
+            self.prev_tok = self.prev_tok.at[slot].set(
+                int(ids_np[-1]) if len(ids_np) else 0
+            )
+            self._cur_len_np[slot] = row_len + 1
+            self._row_len_np[slot] = row_len
+            self._max_new_np[slot] = req.max_new_tokens
+        # Unconditional: a reused batcher whose speculation was flipped
+        # OFF between drains must not stamp the previous occupant's
+        # counts onto this request's SchedResult ('all zero with
+        # --no-speculative' is the field contract).
+        self._slot_spec[slot] = [0, 0, 0]
         if adm.canonical and self.prefix_cache is not None:
             # Cache this prompt's full blocks (the already-adopted prefix
             # re-inserts as a no-op; only new tail blocks take refs).
@@ -1160,6 +1632,7 @@ class ContinuousBatcher:
         prefill_time_s: float = 0.0,
         slot: int = -1,
         pages_freed: int = 0,
+        spec_counts: tuple[int, int, int] = (0, 0, 0),
     ) -> None:
         """Resolve one faulted request: requeue once if the fault is
         transient (OOM/device-loss/preemption/timeout) and this req_id
@@ -1217,6 +1690,9 @@ class ContinuousBatcher:
                 fault_kind=kind.value,
                 cached_tokens=cached_tokens,
                 prefill_time_s=prefill_time_s,
+                spec_steps=spec_counts[0],
+                spec_drafted=spec_counts[1],
+                spec_accepted=spec_counts[2],
             )
         )
 
@@ -1272,14 +1748,29 @@ class ContinuousBatcher:
             if not occupied:
                 raise exc
             slot = max(occupied, key=lambda s: int(cur_len_np[s]))
-        req = self._slot_req[slot]
         # graftlint: disable=GL-SYNC -- fault decision point: the victim's partial tokens must be rescued before the slot is freed
         n = int(self.n_emitted[slot])
         # graftlint: disable=GL-SYNC -- fault decision point (partial-token rescue, same sanctioned sync as the count above)
         partial = np.asarray(self.out_buf[slot, :n])
-        # Eviction only drops this slot's REFERENCES: pages shared with
-        # the prefix cache (or other admissions) survive untouched — a
-        # faulted slot can never invalidate co-residents' prefix blocks.
+        self._evict_slot(slot, exc, "scheduler_chunk", n, partial)
+
+    def _evict_slot(
+        self,
+        slot: int,
+        exc: BaseException,
+        seam: str,
+        n: int,
+        partial: np.ndarray,
+    ) -> None:
+        """Shared slot-eviction surgery for both fault paths
+        (``_handle_decode_fault``, ``_evict_spec_row``) — callers differ
+        only in victim choice and where the partial-token rescue comes
+        from. Eviction only drops this slot's REFERENCES: pages shared
+        with the prefix cache (or other admissions) survive untouched —
+        a faulted slot can never invalidate co-residents' prefix blocks;
+        for a speculating row ``free_sequence`` drops its committed
+        pages AND any in-flight draft pages."""
+        req = self._slot_req[slot]
         free0 = self.allocator.free_pages
         self.allocator.free_sequence(self._slot_seq[slot])
         self._slot_req[slot] = None
@@ -1289,16 +1780,18 @@ class ContinuousBatcher:
         interleave_mod.stats.record_sync()  # fault decision point
         obs_mod.record_sync("fault")
         self.page_table = self.page_table.at[slot].set(0)
+        st = self._slot_spec[slot]
         self._fault_request(
             req,
             exc,
-            "scheduler_chunk",
+            seam,
             tokens=partial,
             n=n,
             cached_tokens=self._slot_cached[slot],
             prefill_time_s=self._slot_prefill_s[slot],
             slot=slot,
             pages_freed=self.allocator.free_pages - free0,
+            spec_counts=(st[0], st[1], st[2]),
         )
 
     # -- completion --------------------------------------------------------
@@ -1315,6 +1808,7 @@ class ContinuousBatcher:
         n = int(self.n_emitted[slot])
         # graftlint: disable=GL-SYNC -- slot completion token fetch (same sanctioned point as the count above)
         row = np.asarray(self.out_buf[slot, :n])
+        st = self._slot_spec[slot]
         self.results.append(
             SchedResult(
                 req_id=req.req_id,
@@ -1322,8 +1816,15 @@ class ContinuousBatcher:
                 n_generated=n,
                 cached_tokens=self._slot_cached[slot],
                 prefill_time_s=self._slot_prefill_s[slot],
+                spec_steps=st[0],
+                spec_drafted=st[1],
+                spec_accepted=st[2],
             )
         )
+        if self.speculative and st[1] and obs_mod.config().enabled:
+            # Per-request acceptance rate at completion — the obs
+            # histogram the ISSUE's serving headline reads from.
+            obs_mod.hot.spec_acceptance.observe(st[2] / st[1])
         self.allocator.free_sequence(self._slot_seq[slot])
         self._slot_req[slot] = None
         if obs_mod.config().enabled:
@@ -1430,12 +1931,17 @@ class ContinuousBatcher:
 
     # -- pipelined drive loop ---------------------------------------------
 
-    def _fused_chunk_len(self, remaining: int, n_live: int) -> int:
+    def _fused_chunk_len(
+        self, remaining: int, n_live: int, width: int | None = None
+    ) -> int:
         """Prompt-chunk length for a fused step: largest power of two
         that fits the shared per-step token budget after the live rows'
-        decode chunk is accounted (Sarathi-style — the newcomer's
-        prefill shrinks before resident latency does)."""
-        cap = min(ADMISSION_CHUNK, max(self.step_tokens - n_live * self.chunk, 1))
+        decode work is accounted (Sarathi-style — the newcomer's
+        prefill shrinks before resident latency does). ``width`` is the
+        per-row token budget of the riding step: the decode-chunk
+        length normally, γ+1 verify positions under speculation."""
+        w = self.chunk if width is None else width
+        cap = min(ADMISSION_CHUNK, max(self.step_tokens - n_live * w, 1))
         c = ADMISSION_CHUNK
         while c > cap or c > remaining:
             c //= 2
@@ -1535,6 +2041,259 @@ class ContinuousBatcher:
                 fn=scheduler_decode_chunk,
             )
 
+    # -- speculative stepping ----------------------------------------------
+
+    def _evict_spec_row(
+        self, slot: int, exc: BaseException, seam: str
+    ) -> None:
+        """A speculative step could not secure this row's next KV slot
+        (genuine pool exhaustion after prefix-cache LRU eviction) or an
+        injected ``kv_alloc`` fault fired mid-decode: evict ONLY this
+        row (``_evict_slot``) while co-resident rows keep decoding."""
+        # Emitted count comes from the host view (trailing the counts
+        # fetch) — no device sync needed for the count itself.
+        n = int(self._cur_len_np[slot] - self._row_len_np[slot])
+        # graftlint: disable=GL-SYNC -- fault decision point: the victim's partial tokens must be rescued before the slot is freed
+        partial = np.asarray(self.out_buf[slot, :n])
+        self._evict_slot(slot, exc, seam, n, partial)
+
+    def _prepare_spec_step(self, live: list[int]) -> jnp.ndarray:
+        """Size page coverage for ONE speculative step over ``live``
+        rows and return the per-row draft bound (the device program's
+        ``alloc_len``).
+
+        Coverage discipline (the append/rollback contract with
+        ``_apply_spec_counts``):
+
+        - extend each row to ``cur_len + min(γ+1, budget left)`` KV
+          slots — the full draft span, through the prefix cache's
+          LRU-evicting extend so cache pages yield to live decode;
+        - under genuine pressure fall back to ``cur_len + 1`` (the next
+          mandatory single-token write), degrading the row to a plain
+          step INSIDE the same compiled program (``n_allowed`` clamps
+          to 0); if even that page cannot be found, evict the row with
+          a classified OOM (transient → one requeue);
+        - the device receives ``covered_tokens - 1`` as its draft
+          bound: the −1 reserves the slot the step's LAST emitted token
+          (bonus or rejection draw) will need for its own KV write next
+          step, so the post-step length fix-up in
+          ``_apply_spec_counts`` NEVER has to allocate — rollback is
+          the only page operation after a verify, and it cannot fail.
+
+        The device page table is re-pushed from the allocator's
+        authoritative host tables every step: draft pages released by
+        one row's rollback may have been re-acquired by another row
+        since the last push, so tail entries can go stale across steps
+        (never within one — writes/reads are bounded by ``alloc_len``).
+        """
+        span = self.gamma + 1
+        alloc = np.zeros((self.B,), np.int64)
+        for slot in list(live):
+            seq = self._slot_seq[slot]
+            cl = int(self._cur_len_np[slot])
+            remaining = int(self._max_new_np[slot]) - (
+                cl - int(self._row_len_np[slot])
+            )
+            length = self.allocator.length(seq)
+            want = cl + min(span, max(remaining, 1))
+            try:
+                injector.fire("kv_alloc", slot)
+                if want > length:
+                    self._extend_evicting(seq, want - length)
+            except OutOfPages:
+                try:
+                    if cl + 1 > length:
+                        self._extend_evicting(seq, cl + 1 - length)
+                except OutOfPages as e:
+                    self._evict_spec_row(slot, e, "kv_alloc")
+                    live.remove(slot)
+                    continue
+            except Exception as e:
+                # Injected/bug fault at the alloc seam: isolate to this
+                # row, co-residents keep decoding.
+                self._evict_spec_row(slot, e, "kv_alloc")
+                live.remove(slot)
+                continue
+            alloc[slot] = self.allocator.covered_tokens(seq) - 1
+        tables = np.zeros((self.B, self.max_pages_per_seq), np.int32)
+        for slot in live:
+            t = self.allocator.table(self._slot_seq[slot])
+            tables[slot, : len(t)] = np.asarray(t, np.int32) + 1
+        self.page_table = jnp.asarray(tables)
+        return jnp.asarray(alloc, jnp.int32)
+
+    def _dispatch_spec(
+        self, alloc_len: jnp.ndarray, adm: _Admission | None, chunk_len: int
+    ) -> jnp.ndarray:
+        """Issue ONE speculative device program — every live row's
+        draft+verify step, optionally fused with the in-flight
+        admission's next prompt chunk — and return the stacked per-row
+        counts array (still on device; the drive loop fetches it as the
+        sanctioned spec sync)."""
+        self._key, sub = jax.random.split(self._key)
+        injector.fire("scheduler_chunk")
+        if adm is not None:
+            (
+                adm_cache,
+                adm_logits,
+                self.pool,
+                self.ctx_buf,
+                self.ctx_len,
+                self.prev_tok,
+                self.cur_tok,
+                self.cur_len,
+                self.n_emitted,
+                self.out_buf,
+                self.active,
+                counts,
+            ) = fused_prefill_spec_chunk(
+                self.params,
+                self.cfg,
+                adm.tokens[:, adm.pos : adm.pos + chunk_len],
+                adm.pads,
+                adm.cache,
+                jnp.int32(adm.pos),
+                self.pool,
+                self.page_table,
+                self.ctx_buf,
+                self.ctx_len,
+                self.prev_tok,
+                self.cur_tok,
+                self.cur_len,
+                self.pad_lens,
+                self.n_emitted,
+                self.max_new,
+                alloc_len,
+                self.active,
+                self.out_buf,
+                self._eos,
+                sub,
+                self._temp,
+                self._top_p,
+                gamma=self.gamma,
+                greedy=self.greedy,
+                top_k=self.top_k,
+                use_top_p=self._use_top_p,
+                use_pallas=self._use_pallas,
+                pallas_interpret=self._pallas_interpret,
+            )
+            adm.cache, adm.last_logits = adm_cache, adm_logits
+            adm.pos += chunk_len
+            interleave_mod.stats.record_step(fused=True)
+            prefix_mod.stats.record_prefill(chunk_len, 0)
+            if obs_mod.config().enabled:
+                obs_mod.retrace.observe(
+                    "fused_prefill_spec_chunk",
+                    (
+                        "fused_spec",
+                        chunk_len,
+                        adm.S,
+                        self.gamma,
+                        self.B,
+                        self.cap,
+                    ),
+                    fn=fused_prefill_spec_chunk,
+                )
+        else:
+            (
+                self.pool,
+                self.ctx_buf,
+                self.ctx_len,
+                self.prev_tok,
+                self.cur_tok,
+                self.cur_len,
+                self.n_emitted,
+                self.out_buf,
+                self.active,
+                counts,
+            ) = scheduler_spec_chunk(
+                self.params,
+                self.cfg,
+                self.pool,
+                self.page_table,
+                self.ctx_buf,
+                self.ctx_len,
+                self.prev_tok,
+                self.cur_tok,
+                self.cur_len,
+                self.pad_lens,
+                self.n_emitted,
+                self.max_new,
+                alloc_len,
+                self.active,
+                self.out_buf,
+                self._eos,
+                sub,
+                self._temp,
+                self._top_p,
+                gamma=self.gamma,
+                greedy=self.greedy,
+                top_k=self.top_k,
+                use_top_p=self._use_top_p,
+                use_pallas=self._use_pallas,
+                pallas_interpret=self._pallas_interpret,
+            )
+            interleave_mod.stats.record_step(fused=False)
+            if obs_mod.config().enabled:
+                obs_mod.retrace.observe(
+                    "scheduler_spec_chunk",
+                    ("spec", self.gamma, self.B, self.cap, self.greedy),
+                    fn=scheduler_spec_chunk,
+                )
+        return counts
+
+    def _apply_spec_counts(
+        self, counts_np: np.ndarray, live_slots: tuple
+    ) -> None:
+        """Apply one fetched spec step's per-row counts to the host
+        state: advance the trailing cur_len/active views, ROLL BACK
+        draft pages past each row's accepted prefix
+        (``PageAllocator.truncate`` — the pages the step reserved but
+        the rejection sampler didn't commit), and record telemetry.
+        Rows whose ownership generation changed since dispatch are
+        skipped — the multi-token analog of ``_fetch_entry``'s guard (a
+        freed-and-readmitted slot must not have the old step's counts
+        corrupt its new owner's bookkeeping)."""
+        for slot, gen in live_slots:
+            if gen != self._slot_gen[slot] or self._slot_seq[slot] is None:
+                continue
+            n_allowed = int(counts_np[0, slot])
+            n_acc = int(counts_np[1, slot])
+            n_emit = int(counts_np[2, slot])
+            act = bool(counts_np[3, slot])
+            new_cl = int(counts_np[4, slot])
+            seq = self._slot_seq[slot]
+            length = self.allocator.length(seq)
+            released = 0
+            if new_cl > length:
+                # Fully accepted span: a pure length bump within the
+                # pages already held (the draft bound's −1 reserve
+                # guarantees coverage) — never allocates, cannot fail.
+                self.allocator.extend(seq, new_cl - length)
+            else:
+                released = len(self.allocator.truncate(seq, new_cl))
+            self._cur_len_np[slot] = new_cl
+            st = self._slot_spec[slot]
+            st[0] += 1
+            st[1] += n_allowed
+            st[2] += n_acc
+            spec_mod.stats.record_step(n_allowed, n_acc, n_emit)
+            if released:
+                spec_mod.stats.record_rollback(released)
+            if obs_mod.config().enabled:
+                obs_mod.hot.spec_tokens_per_step.observe(float(n_emit))
+                obs_mod.emit(
+                    obs_mod.SpecEvent(
+                        slot=slot,
+                        req_id=self._slot_req[slot].req_id,
+                        drafted=n_allowed,
+                        accepted=n_acc,
+                        emitted=n_emit,
+                        rolled_back_pages=released,
+                    )
+                )
+            self._active_np[slot] = act
+
     @staticmethod
     def _entry_ready(entry: tuple) -> bool:
         """True when a step's flags have already resolved on device —
@@ -1583,6 +2342,18 @@ class ContinuousBatcher:
             t0 = time.monotonic()
             fused_share = 0.0
             dispatched = False
+            # Speculation: each iteration's "decode work" becomes one
+            # γ-draft + verify program per live row, and the host MUST
+            # learn each row's accepted length before it can dispatch
+            # the next step (draft pages roll back, coverage re-sizes,
+            # flags advance per-row) — so the spec path runs one step
+            # deep with a sanctioned counts fetch per iteration instead
+            # of the double buffer; the γ+1 tokens a step can emit are
+            # what buy that sync back.
+            spec = self.speculative
+            width = (self.gamma + 1) if spec else self.chunk
+            spec_counts = None
+            spec_slots: tuple = ()
             # Fuse only the LEADING prefill chunks (strictly more work
             # left after this chunk): the FINAL chunk runs standalone so
             # the handoff happens before this iteration's decode chunk
@@ -1594,7 +2365,7 @@ class ContinuousBatcher:
             # finishes a prefill; every handoff happens inside
             # _advance_admission.
             chunk_len = (
-                self._fused_chunk_len(adm.remaining, len(live))
+                self._fused_chunk_len(adm.remaining, len(live), width)
                 if adm is not None and live
                 else 0
             )
@@ -1604,16 +2375,32 @@ class ContinuousBatcher:
                 and not adm.fuse_deferred
                 and chunk_len < adm.remaining
             )
+            if spec and live and (ride or adm is None):
+                # Coverage sizing for the step dispatched below. The
+                # standalone-admission branch prepares AFTER its
+                # handoff instead (the handoff may activate a new row,
+                # and preparing here too would repeat the per-row
+                # extend walk and a second full page-table push).
+                alloc_len = self._prepare_spec_step(live)
             if ride:
                 try:
-                    self._dispatch_fused(adm, chunk_len)
+                    if spec:
+                        spec_slots = tuple(
+                            (s, self._slot_gen[s]) for s in live
+                        )
+                        spec_counts = self._dispatch_spec(
+                            alloc_len, adm, chunk_len
+                        )
+                    else:
+                        self._dispatch_fused(adm, chunk_len)
                     # Telemetry attribution for the fused program: the
                     # halves aren't separately measurable without a
                     # profiler, so split this iteration's wall clock by
-                    # token share (prompt tokens vs the decode chunk's
-                    # upper bound) — deterministic given host state.
+                    # token share (prompt tokens vs the decode/verify
+                    # half's upper bound) — deterministic given host
+                    # state.
                     fused_share = chunk_len / (
-                        chunk_len + len(live) * self.chunk
+                        chunk_len + len(live) * width
                     )
                     dispatched = True
                 except Exception as e:
@@ -1627,6 +2414,7 @@ class ContinuousBatcher:
                     # admission there instead of evicting another
                     # innocent resident every iteration.
                     adm.fuse_deferred = True
+                    spec_counts = None
                     self._handle_decode_fault(e)
             else:
                 if adm is not None:
@@ -1644,6 +2432,11 @@ class ContinuousBatcher:
                     live = [
                         s for s in range(self.B) if self._active_np[s]
                     ]
+                    if spec and live:
+                        # The handoff may have activated a new row;
+                        # its coverage must be sized before it joins
+                        # the verify step.
+                        alloc_len = self._prepare_spec_step(live)
                     # Restart the clock: the standalone chunk's seconds
                     # are already in the stalled-prefill bucket — the
                     # decode dt below must not re-count them (their sum
@@ -1651,11 +2444,103 @@ class ContinuousBatcher:
                     t0 = time.monotonic()
                 if live:
                     try:
-                        self._dispatch_decode()
+                        if spec:
+                            spec_slots = tuple(
+                                (s, self._slot_gen[s]) for s in live
+                            )
+                            spec_counts = self._dispatch_spec(
+                                alloc_len, None, 0
+                            )
+                        else:
+                            self._dispatch_decode()
                         dispatched = True
                     except Exception as e:
+                        spec_counts = None
                         self._handle_decode_fault(e)
-            if dispatched:
+            if dispatched and spec:
+                depth = 1
+                step_sync = "spec_counts"
+                counts_np = None
+                if spec_counts is not None:
+                    try:
+                        # Start the copy before the blocking fetch —
+                        # marginal, but free.
+                        spec_counts.copy_to_host_async()
+                    except Exception:
+                        pass  # optional fast path only
+                    try:
+                        # The spec path's ONE sanctioned per-step sync:
+                        # the host cannot size the next step's page
+                        # coverage, roll rejected drafts back, or
+                        # advance per-row flags without the accepted
+                        # counts. A [5, B] int fetch — the γ+1 tokens
+                        # the step can emit amortize it.
+                        # graftlint: disable=GL-SYNC -- spec accept fetch: the host must know each row's accepted length to roll draft pages back and size the next step's coverage (the one sanctioned speculative sync)
+                        counts_np = np.asarray(spec_counts)
+                    except Exception as e:
+                        # An async device fault surfaces at the fetch:
+                        # same eviction surgery as dispatch-time.
+                        self._handle_decode_fault(e)
+                    interleave_mod.stats.record_sync()
+                    obs_mod.record_sync("spec_counts")
+                    if counts_np is not None:
+                        self._apply_spec_counts(counts_np, spec_slots)
+                dt = time.monotonic() - t0
+                span = self.gamma + 1
+                if fused_share > 0.0:
+                    p = dt * fused_share
+                    self._record_prefill_time(p, overlapped=True)
+                    adm.prefill_s += p
+                    self.decode_time_s += dt - p
+                    spec_dt = dt - p
+                else:
+                    self.decode_time_s += dt
+                    spec_dt = dt
+                # Draft/verify wall split by position share: the bigram
+                # scan costs about one forward position against the
+                # span's γ+1 (SpecStats' deterministic convention).
+                spec_mod.stats.record_wall(
+                    spec_dt / (span + 1), spec_dt * span / (span + 1)
+                )
+                if obs_mod.config().enabled:
+                    obs_mod.hot.step_wall.observe(dt)
+                    if live:
+                        # Per-row inter-token latency from the tokens
+                        # the step ACTUALLY emitted (the fetched
+                        # counts), not the optimistic γ+1 program
+                        # width — near-zero acceptance must not report
+                        # a γ+1-fold rosier latency than delivered.
+                        emitted = (
+                            sum(
+                                int(counts_np[2, s])
+                                for s, _ in spec_slots
+                            )
+                            if counts_np is not None
+                            else 0
+                        )
+                        obs_mod.hot.inter_token.observe(
+                            dt * len(live) / max(emitted, 1)
+                        )
+                    obs_mod.emit(
+                        obs_mod.StepEvent(
+                            kind=(
+                                "fused_spec"
+                                if fused_share > 0.0
+                                else "spec"
+                            ),
+                            n_live=len(live),
+                            admission_slot=(
+                                adm.slot if fused_share > 0.0 else -1
+                            ),
+                            prefill_tokens=(
+                                chunk_len if fused_share > 0.0 else 0
+                            ),
+                            decode_chunk=width,
+                            pipeline_depth=depth,
+                            sync_reason=step_sync,
+                        )
+                    )
+            elif dispatched:
                 entry = (
                     self.active,
                     tuple((s, self._slot_gen[s]) for s in live),
@@ -1746,25 +2631,85 @@ class ContinuousBatcher:
                     self._abort_admission(e)
             if bool(self.active.any()):
                 t_dec = time.monotonic()
-                try:
-                    self._dispatch_decode()
-                    jax.block_until_ready(self.active)
-                except Exception as e:
-                    self._handle_decode_fault(e)
-                finally:
-                    dt = time.monotonic() - t_dec
-                    self.decode_time_s += dt
-                    if obs_mod.config().enabled:
-                        obs_mod.record_sync("legacy_step")
-                        obs_mod.hot.step_wall.observe(dt)
-                        obs_mod.hot.inter_token.observe(dt / self.chunk)
-                        obs_mod.emit(
-                            obs_mod.StepEvent(
-                                kind="decode",
-                                n_live=int(sum(self._active_np)),
-                                decode_chunk=self.chunk,
-                                sync_reason="legacy_step",
-                            )
+                if self.speculative:
+                    # Legacy + speculation: fully serialized draft/
+                    # verify steps — dispatch one γ-wide program, block
+                    # on the counts, roll rejected draft pages back.
+                    # Same per-row desync bookkeeping as the pipelined
+                    # path, without the async fetch machinery.
+                    self._active_np[:] = np.asarray(self.active)
+                    live = [
+                        s for s in range(self.B) if self._active_np[s]
+                    ]
+                    alloc_len = self._prepare_spec_step(live)
+                    width = self.gamma + 1
+                    if live:
+                        live_slots = tuple(
+                            (s, self._slot_gen[s]) for s in live
                         )
+                        counts_np = None
+                        try:
+                            counts = self._dispatch_spec(
+                                alloc_len, None, 0
+                            )
+                            counts_np = np.asarray(counts)
+                            self._apply_spec_counts(
+                                counts_np, live_slots
+                            )
+                        except Exception as e:
+                            self._handle_decode_fault(e)
+                        finally:
+                            dt = time.monotonic() - t_dec
+                            self.decode_time_s += dt
+                            spec_mod.stats.record_wall(
+                                dt / (width + 1),
+                                dt * width / (width + 1),
+                            )
+                            if obs_mod.config().enabled:
+                                obs_mod.record_sync("legacy_step")
+                                obs_mod.hot.step_wall.observe(dt)
+                                # Actual per-row emission, as in the
+                                # pipelined loop — γ+1 is the program
+                                # width, not the delivered tokens.
+                                emitted = (
+                                    sum(
+                                        int(counts_np[2, s])
+                                        for s, _ in live_slots
+                                    )
+                                    if counts_np is not None
+                                    else 0
+                                )
+                                obs_mod.hot.inter_token.observe(
+                                    dt * len(live) / max(emitted, 1)
+                                )
+                                obs_mod.emit(
+                                    obs_mod.StepEvent(
+                                        kind="spec",
+                                        n_live=len(live),
+                                        decode_chunk=width,
+                                        sync_reason="legacy_step",
+                                    )
+                                )
+                else:
+                    try:
+                        self._dispatch_decode()
+                        jax.block_until_ready(self.active)
+                    except Exception as e:
+                        self._handle_decode_fault(e)
+                    finally:
+                        dt = time.monotonic() - t_dec
+                        self.decode_time_s += dt
+                        if obs_mod.config().enabled:
+                            obs_mod.record_sync("legacy_step")
+                            obs_mod.hot.step_wall.observe(dt)
+                            obs_mod.hot.inter_token.observe(dt / self.chunk)
+                            obs_mod.emit(
+                                obs_mod.StepEvent(
+                                    kind="decode",
+                                    n_live=int(sum(self._active_np)),
+                                    decode_chunk=self.chunk,
+                                    sync_reason="legacy_step",
+                                )
+                            )
             self._collect()
         self._active_np[:] = np.asarray(self.active)

@@ -1,0 +1,158 @@
+"""Speculative-decoding config and telemetry (process-wide, host side).
+
+Prompt-lookup speculation exists in two places: the dense ``generate()``
+path (engine/speculative.py, the original implementation) and per-slot
+in the paged ContinuousBatcher (engine/scheduler.py — draft from the
+row's own context, ONE multi-position verification forward over the
+paged pool, rejection-sampled accept). This module is the one
+switchboard both consult, following the established
+``resilience.faults`` / ``prefix_cache`` / ``interleave`` pattern:
+
+- **config**: ``enabled`` (CLI ``--speculative/--no-speculative``, env
+  ``ADVSPEC_SPECULATIVE``, default on) and ``gamma`` — the draft length
+  per speculative step (CLI ``--gamma``, env ``ADVSPEC_GAMMA``, default
+  8). γ is validated AT THE KNOB: γ < 1 raises here, with the same
+  actionable message the old import-time check in speculative.py gave,
+  instead of failing deep inside a traced accept loop. Unlike the old
+  import-time constant, ``configure(gamma=...)`` retunes a live process
+  (tests, the tpu_ladder γ sweep) without a reimport.
+- **stats**: per-round speculation counters both real engines and the
+  mock's deterministic CPU accounting record into. ``reset`` zeroes in
+  place so engines holding a reference keep counting into the same
+  object. ``snapshot()`` is the CLI's ``perf.spec`` payload.
+
+Deliberately imports no jax: the mock engine uses it on CPU.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+DEFAULT_GAMMA = 8
+
+
+def _validate_gamma(gamma: int) -> int:
+    if gamma < 1:
+        # Fail at the knob, not deep inside a traced accept loop (γ=0
+        # would index draft[:, -1] and run 1-wide verifies that are pure
+        # overhead). The env read fires at import, so the remedy is to
+        # fix the env var, not a kwarg.
+        raise ValueError(
+            f"ADVSPEC_GAMMA must be >= 1, got {gamma}; unset ADVSPEC_GAMMA "
+            "(and pass speculative=False if the goal was disabling "
+            "speculation)"
+        )
+    return gamma
+
+
+def env_enabled() -> bool:
+    """The process default for the master switch (``ADVSPEC_SPECULATIVE``)."""
+    return os.environ.get("ADVSPEC_SPECULATIVE", "1") != "0"
+
+
+def env_gamma() -> int:
+    """The process default draft length (``ADVSPEC_GAMMA``), validated."""
+    return _validate_gamma(
+        int(os.environ.get("ADVSPEC_GAMMA", str(DEFAULT_GAMMA)))
+    )
+
+
+@dataclass
+class SpecConfig:
+    """Process-wide knobs, set once per CLI round (or by tests)."""
+
+    enabled: bool = True
+    gamma: int = DEFAULT_GAMMA
+
+
+@dataclass
+class SpecStats:
+    """Process-wide speculation counters, aggregated across every
+    batcher drain (and the mock engine's deterministic accounting).
+
+    ``drafted_tokens`` counts draft positions that could actually have
+    committed (per-row ``n_allowed`` — the budget/page-clamped draft
+    width), so ``accepted / drafted`` is a true acceptance rate, not
+    diluted by positions that were never eligible. ``emitted_tokens``
+    additionally counts each step's bonus/rejection token.
+
+    The draft/verify wall split is attributed by position share of the
+    fused draft+verify program (the draft's bigram scan costs about one
+    forward position against the span's γ+1): measuring the halves
+    separately would need a profiler — the same deterministic-share
+    convention the fused prefill+decode step uses.
+    """
+
+    # PER-ROW verify steps: +1 per LIVE row per dispatched program (B
+    # co-resident rows ⇒ +B per program), so emitted/spec_steps is a
+    # true per-row tokens-per-step. Program dispatch counts live in the
+    # retrace watch / StepEvents, not here.
+    spec_steps: int = 0
+    drafted_tokens: int = 0  # eligible draft positions verified
+    accepted_tokens: int = 0  # draft positions accepted
+    emitted_tokens: int = 0  # tokens emitted by spec steps (incl. bonus)
+    rolled_back_pages: int = 0  # draft pages released by rollback
+    draft_time_s: float = 0.0
+    verify_time_s: float = 0.0
+
+    def record_step(self, drafted: int, accepted: int, emitted: int) -> None:
+        self.spec_steps += 1
+        self.drafted_tokens += drafted
+        self.accepted_tokens += accepted
+        self.emitted_tokens += emitted
+
+    def record_wall(self, draft_s: float, verify_s: float) -> None:
+        self.draft_time_s += draft_s
+        self.verify_time_s += verify_s
+
+    def record_rollback(self, pages: int) -> None:
+        self.rolled_back_pages += pages
+
+    def reset(self) -> None:
+        for f in self.__dataclass_fields__:
+            setattr(self, f, type(getattr(self, f))())
+
+    def snapshot(self) -> dict:
+        out = {f: getattr(self, f) for f in self.__dataclass_fields__}
+        out["acceptance_rate"] = (
+            round(self.accepted_tokens / self.drafted_tokens, 4)
+            if self.drafted_tokens
+            else 0.0
+        )
+        out["tokens_per_step"] = (
+            round(self.emitted_tokens / self.spec_steps, 4)
+            if self.spec_steps
+            else 0.0
+        )
+        return out
+
+
+_config = SpecConfig(enabled=env_enabled(), gamma=env_gamma())
+stats = SpecStats()
+
+
+def config() -> SpecConfig:
+    return _config
+
+
+def configure(
+    enabled: bool | None = None, gamma: int | None = None
+) -> SpecConfig:
+    if enabled is not None:
+        _config.enabled = bool(enabled)
+    if gamma is not None:
+        _config.gamma = _validate_gamma(int(gamma))
+    return _config
+
+
+def reset_stats() -> None:
+    stats.reset()
+
+
+def snapshot() -> dict:
+    """Stats + config, the ``perf.spec`` payload."""
+    out = stats.snapshot()
+    out["enabled"] = _config.enabled
+    out["gamma"] = _config.gamma
+    return out

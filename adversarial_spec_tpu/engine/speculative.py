@@ -57,11 +57,10 @@ from __future__ import annotations
 
 from functools import partial
 
-import os
-
 import jax
 import jax.numpy as jnp
 
+from adversarial_spec_tpu.engine import spec as spec_config
 from adversarial_spec_tpu.engine.sampling import (
     filtered_logits,
     sample_tokens,
@@ -73,17 +72,12 @@ from adversarial_spec_tpu.models.transformer import Cache, Params, forward
 # verification forward when drafts match (revision-heavy [SPEC] output)
 # but wastes a γ+1-wide forward when they miss; 8 is the prior, the
 # ladder's gamma sweep (tpu_ladder.py) measures the crossover on chip.
-GAMMA = int(os.environ.get("ADVSPEC_GAMMA", "8"))
-if GAMMA < 1:
-    # Fail at the knob, not deep inside a traced accept loop (γ=0 would
-    # index draft[:, -1] and run 1-wide verifies that are pure
-    # overhead). This fires at import (generate imports GAMMA on every
-    # path), so the remedy is to fix the env var, not a kwarg.
-    raise ValueError(
-        f"ADVSPEC_GAMMA must be >= 1, got {GAMMA}; unset ADVSPEC_GAMMA "
-        "(and pass speculative=False if the goal was disabling "
-        "speculation)"
-    )
+# The knob LIVES in engine/spec.py now (``ADVSPEC_GAMMA`` / ``--gamma``,
+# reconfigurable per round without a reimport); this module-level value
+# is the import-time snapshot kept for callers that treat γ as a
+# constant — importing it validates the env var exactly as before
+# (spec.env_gamma fails fast on γ < 1).
+GAMMA = spec_config.config().gamma
 
 
 def _rowwise_slice(buf: jnp.ndarray, starts: jnp.ndarray, size: int):
@@ -98,6 +92,64 @@ def _rowwise_write(buf: jnp.ndarray, vals: jnp.ndarray, starts: jnp.ndarray):
     return jax.vmap(
         lambda row, v, s: jax.lax.dynamic_update_slice(row, v, (s,))
     )(buf, vals, starts)
+
+
+def accept_spans(
+    probs: jnp.ndarray,  # [B, γ+1, V] filtered target distribution
+    draft: jnp.ndarray,  # [B, γ]
+    n_allowed: jnp.ndarray,  # [B] draft positions eligible to commit
+    u_key: jax.Array,
+    res_key: jax.Array,
+    *,
+    greedy: bool,
+):
+    """THE accept math — rejection-sample a per-row accept length against
+    the true sampling distribution, shared verbatim by the dense path
+    (``speculative_decode_steps``) and the paged ContinuousBatcher's
+    verify step (engine/scheduler.py), so greedy output stays
+    byte-identical to plain decode on both.
+
+    ``n_allowed`` caps how many draft positions may commit this step
+    (the dense path passes a constant γ; the batcher clamps per row by
+    output budget and allocated pages). Positions at or past the cap are
+    FORCED rejections — crucially, a forced stop draws the bonus token
+    from the FULL distribution at that position, not the residual:
+    zeroing a draft token the coin never rejected would bias the
+    marginal (and break greedy parity whenever the draft equals the
+    argmax). Returns ``(n_acc [B], bonus [B])``.
+    """
+    B, gamma = draft.shape
+    rows = jnp.arange(B)
+    p_draft = jnp.take_along_axis(
+        probs[:, :-1], draft[..., None], axis=-1
+    )[..., 0]  # [B, γ] target prob of each draft token
+    u = jax.random.uniform(u_key, (B, gamma))
+    pos = jnp.arange(gamma)[None, :]
+    # greedy: p ∈ {0,1} ⇒ exact argmax match
+    accept = (u < p_draft) & (pos < n_allowed[:, None])
+    n_acc = jnp.sum(
+        jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1
+    )  # [B]
+
+    # --- The bonus token: residual draw at a NATURAL rejection point,
+    # a fresh full-distribution draw when the allowed span ran out. ---
+    at = probs[rows, n_acc]  # [B, V] distribution at emit position
+    rejected = n_acc < n_allowed
+    rej_draft = draft[rows, jnp.minimum(n_acc, gamma - 1)]
+    # Residual: zero the rejected draft token, renormalize. Marginal
+    # over (accept, residual) is exactly `at` — see module docstring.
+    res = at.at[rows, rej_draft].set(
+        jnp.where(rejected, 0.0, at[rows, rej_draft])
+    )
+    res = res / jnp.maximum(res.sum(-1, keepdims=True), 1e-30)
+    bonus = jax.random.categorical(
+        res_key, jnp.log(jnp.maximum(res, 1e-30)), axis=-1
+    ).astype(jnp.int32)
+    if greedy:
+        # Bit-identical contract: no RNG in the greedy path. The
+        # residual of a one-hot is one-hot ⇒ argmax, computed directly.
+        bonus = jnp.argmax(res, axis=-1).astype(jnp.int32)
+    return n_acc, bonus
 
 
 def _draft(context, prev, cur, limits, gamma):
@@ -252,35 +304,18 @@ def speculative_decode_steps(
         )  # [B, γ+1, V]
         probs = jax.nn.softmax(filt, axis=-1)
 
-        # --- Rejection-sample the accept length per row. ---
+        # --- Rejection-sample the accept length per row (accept_spans —
+        # the same shared math the batcher's verify step runs; a full-γ
+        # n_allowed makes the cap term an identity here). ---
         key, u_key, res_key = jax.random.split(key, 3)
-        p_draft = jnp.take_along_axis(
-            probs[:, :-1], draft[..., None], axis=-1
-        )[..., 0]  # [B, γ] target prob of each draft token
-        u = jax.random.uniform(u_key, (B, gamma))
-        accept = u < p_draft  # greedy: p ∈ {0,1} ⇒ exact argmax match
-        n_acc = jnp.sum(
-            jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1
-        )  # [B]
-
-        # --- The (γ+1)-th token: residual draw at the rejection point,
-        # or a fresh draw from the last position when all drafts hit. ---
-        at = probs[rows, n_acc]  # [B, V] distribution at emit position
-        rejected = n_acc < gamma
-        rej_draft = draft[rows, jnp.minimum(n_acc, gamma - 1)]
-        # Residual: zero the rejected draft token, renormalize. Marginal
-        # over (accept, residual) is exactly `at` — see module docstring.
-        res = at.at[rows, rej_draft].set(
-            jnp.where(rejected, 0.0, at[rows, rej_draft])
+        n_acc, bonus = accept_spans(
+            probs,
+            draft,
+            jnp.full((B,), gamma, jnp.int32),
+            u_key,
+            res_key,
+            greedy=greedy,
         )
-        res = res / jnp.maximum(res.sum(-1, keepdims=True), 1e-30)
-        bonus = jax.random.categorical(
-            res_key, jnp.log(jnp.maximum(res, 1e-30)), axis=-1
-        ).astype(jnp.int32)
-        if greedy:
-            # Bit-identical contract: no RNG in the greedy path. The
-            # residual of a one-hot is one-hot ⇒ argmax, computed directly.
-            bonus = jnp.argmax(res, axis=-1).astype(jnp.int32)
 
         emitted = jnp.concatenate(
             [draft, jnp.zeros((B, 1), draft.dtype)], axis=1

@@ -40,6 +40,7 @@ from adversarial_spec_tpu.debate.usage import Usage
 from adversarial_spec_tpu.engine import interleave as interleave_mod
 from adversarial_spec_tpu.engine import prefix_cache as prefix_mod
 from adversarial_spec_tpu.engine import registry as registry_mod
+from adversarial_spec_tpu.engine import spec as spec_mod
 from adversarial_spec_tpu.engine.generate import (
     MIN_BUCKET,
     bucket_length,
@@ -806,6 +807,15 @@ class TpuEngine:
                     top_k=params.top_k,
                     top_p=params.top_p,
                     seed=seed,
+                )
+                # Speculation knobs re-resolve from the process config
+                # every drain (one CLI invocation = one round; a later
+                # round's --no-speculative/--gamma must reach the
+                # persistent batcher). The batcher is idle here —
+                # run_all drains fully — so the flip is legal.
+                sp = spec_mod.config()
+                batcher.reconfigure_speculative(
+                    enabled=sp.enabled, gamma=sp.gamma
                 )
             else:
                 batcher = ContinuousBatcher(

@@ -37,6 +37,7 @@ from adversarial_spec_tpu.obs.events import (  # noqa: F401 (re-export)
     FaultEvent,
     FlightRecorder,
     RequestEvent,
+    SpecEvent,
     StepEvent,
     validate_event,
 )
@@ -118,6 +119,8 @@ class HotMetrics:
         "req_evicted",
         "req_timeout",
         "mock_chat_requests",
+        "spec_tokens_per_step",
+        "spec_acceptance",
         "_m",
         "_sync",
         "_fault",
@@ -165,6 +168,21 @@ class HotMetrics:
             "advspec_engine_chat_requests_total",
             help="chat requests by serving engine",
             engine="mock",
+        )
+        # Speculative decoding (engine/scheduler.py spec steps and the
+        # mock's deterministic acceptance model): tokens each row
+        # emitted per verify step (1 = a fully rejected draft, γ+1 = a
+        # fully accepted one), and per-request acceptance rate at
+        # completion.
+        self.spec_tokens_per_step = m.histogram(
+            "advspec_spec_tokens_per_step",
+            help="tokens emitted per row per speculative verify step",
+            buckets=(1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 32.0),
+        )
+        self.spec_acceptance = m.histogram(
+            "advspec_spec_acceptance_ratio",
+            help="per-request accepted/drafted ratio at completion",
+            buckets=RATIO_BUCKETS,
         )
         self._sync: dict = {}
         self._fault: dict = {}

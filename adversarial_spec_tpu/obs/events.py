@@ -28,6 +28,7 @@ Event vocabulary (the schema ``tools/obs_dump.py`` validates):
 - ``BreakerEvent`` — a circuit-breaker state transition.
 - ``CacheEvent`` — prefix-cache lookup / insert / evict.
 - ``CompileEvent`` — the retrace watch saw a jit compile.
+- ``SpecEvent`` — one row's speculative draft/verify outcome.
 """
 
 from __future__ import annotations
@@ -41,7 +42,7 @@ from dataclasses import dataclass, field
 @dataclass(slots=True)
 class StepEvent:
     TYPE = "step"
-    kind: str = "decode"  # fused | decode | prefill
+    kind: str = "decode"  # fused | decode | prefill | spec | fused_spec
     n_live: int = 0  # resident rows decoding this step
     admission_slot: int = -1  # slot of the riding admission (-1: none)
     prefill_tokens: int = 0  # prompt tokens advanced this step
@@ -99,6 +100,24 @@ class CompileEvent:
     unexpected: bool = False
 
 
+@dataclass(slots=True)
+class SpecEvent:
+    """One row's speculative draft/verify outcome (CacheEvent-style:
+    per-observation, the recorder's bounded ring keeps the recent ones).
+    ``drafted`` counts positions ELIGIBLE to commit (the budget/page
+    clamped draft width), so accepted/drafted is a true acceptance rate;
+    ``emitted`` includes the bonus/rejection token; ``rolled_back_pages``
+    is the draft tail the host released after the accept fetch."""
+
+    TYPE = "spec"
+    slot: int = -1
+    req_id: int = -1
+    drafted: int = 0
+    accepted: int = 0
+    emitted: int = 0
+    rolled_back_pages: int = 0
+
+
 EVENT_TYPES = (
     StepEvent,
     RequestEvent,
@@ -106,6 +125,7 @@ EVENT_TYPES = (
     BreakerEvent,
     CacheEvent,
     CompileEvent,
+    SpecEvent,
 )
 
 REQUEST_STATES = (

@@ -40,9 +40,17 @@ Modes:
                                   # emit-path cost over the mock mixed
                                   # workload (CPU host-overhead pin,
                                   # budget < 3%); writes BENCH_obs.json
+  python bench.py --mode spec     # per-slot speculation in the batcher:
+                                  # growing-spec rounds under the mock
+                                  # acceptance model (tokens/step,
+                                  # acceptance) + real-batcher spec-on
+                                  # vs spec-off walls with identical
+                                  # greedy tokens; writes BENCH_spec.json
   --no-interleave                 # escape hatch for any batcher-driven
                                   # mode: run the legacy serialized loop
                                   # (equivalent to ADVSPEC_INTERLEAVE=0)
+  --no-speculative                # escape hatch: plain token-at-a-time
+                                  # decode (ADVSPEC_SPECULATIVE=0)
 """
 
 from __future__ import annotations
@@ -609,6 +617,174 @@ def _run_interleave(platform: str) -> dict:
     }
 
 
+def _run_spec(platform: str) -> dict:
+    """Per-slot speculation in the ContinuousBatcher, measured twice:
+
+    1. MOCK ACCEPTANCE MODEL (engine/mock.py): a growing-spec
+       multi-round debate workload — each round's ``[SPEC]`` revision is
+       a near-copy of the document in the prompt, exactly the output
+       shape prompt-lookup thrives on. Deterministic on CPU, so the
+       headline mean tokens/step and acceptance rate are byte-stable
+       run to run. Plain decode emits 1 token/step by definition, so
+       tokens/step IS the speedup bound speculation buys at equal
+       quality (transcripts must be byte-identical spec-on vs off).
+    2. REAL BATCHER (llama tiny on CPU / 1b on TPU): the same growing
+       workload through the paged serving path, spec-on vs spec-off —
+       walls both ways, byte-identical greedy tokens, the measured
+       acceptance on a real (random-weight) model, and the retrace
+       watch's verdict that the verify program compiled once per
+       distinct draft width (``unexpected_recompiles`` must be 0).
+    """
+    from adversarial_spec_tpu.utils.jaxenv import configure_jax
+
+    configure_jax()
+    import random
+    import re
+
+    import jax
+    import jax.numpy as jnp
+
+    from adversarial_spec_tpu import obs
+    from adversarial_spec_tpu.engine import spec as spec_mod
+    from adversarial_spec_tpu.engine.mock import MockEngine
+    from adversarial_spec_tpu.engine.scheduler import (
+        ContinuousBatcher,
+        SchedRequest,
+    )
+    from adversarial_spec_tpu.engine.types import ChatRequest, SamplingParams
+    from adversarial_spec_tpu.models import transformer as T
+    from adversarial_spec_tpu.models.config import get_config
+
+    gamma = spec_mod.env_gamma()
+    n_rounds, n_opp = 4, 2
+
+    # --- 1. Mock acceptance model: growing-spec debate rounds. -------
+    def mock_rounds(enabled: bool):
+        spec_mod.configure(enabled=enabled, gamma=gamma)
+        spec_mod.reset_stats()
+        eng = MockEngine()
+        doc = (
+            "The allocator SHALL bound page reuse by refcount. "
+            "Verification MUST cover every accepted draft position. "
+        ) * 24
+        texts = []
+        t0 = time.monotonic()
+        for rnd in range(1, n_rounds + 1):
+            reqs = [
+                ChatRequest(
+                    model="mock://critic",
+                    system="You are an adversarial spec critic.",
+                    user=(
+                        f"Debate round {rnd}\n--- DOCUMENT ---\n{doc}"
+                        "\n--- END DOCUMENT ---"
+                    ),
+                )
+                for _ in range(n_opp)
+            ]
+            outs = eng.chat(reqs, SamplingParams())
+            texts.append([c.text for c in outs])
+            m = re.search(r"\[SPEC\]\n(.*)\n\[/SPEC\]", outs[0].text, re.S)
+            doc = m.group(1) if m else doc
+        return texts, time.monotonic() - t0, spec_mod.stats.snapshot()
+
+    mock_on_texts, mock_on_wall, mock_snap = mock_rounds(True)
+    mock_off_texts, mock_off_wall, _ = mock_rounds(False)
+
+    # --- 2. Real batcher: growing-spec rounds, spec on vs off. -------
+    size = "1b" if platform != "cpu" else "tiny"
+    cfg = get_config("llama", size)
+    params = T.init_params(
+        jax.random.key(0),
+        cfg,
+        dtype=jnp.bfloat16 if platform != "cpu" else jnp.float32,
+    )
+    base_len, delta_len, max_new = (
+        (1024, 256, 64) if platform != "cpu" else (384, 64, 24)
+    )
+
+    def batcher_rounds(enabled: bool):
+        spec_mod.configure(enabled=enabled, gamma=gamma)
+        spec_mod.reset_stats()
+        obs.configure(enabled=True)
+        obs.reset_stats()
+        rng = random.Random(1)
+        # Tiled segments, not i.i.d. tokens: prompt-lookup drafts from
+        # recurring n-grams, and a spec document genuinely repeats its
+        # phrasing (section headers, SHALL/MUST boilerplate) — an
+        # i.i.d.-random prompt has no bigram structure to draft from
+        # and would measure the overhead half of the trade only.
+        seg = [rng.randrange(3, cfg.vocab_size) for _ in range(16)]
+        spec = (seg * (base_len // len(seg) + 1))[:base_len]
+        b = ContinuousBatcher(
+            params,
+            cfg,
+            max_batch=n_opp,
+            max_new_cap=max_new,
+            page_size=64,
+            capacity_tokens=1 << 15,
+            greedy=True,
+            prefix_cache=False,
+        )
+        toks = []
+        t0 = time.monotonic()
+        for _ in range(n_rounds):
+            for i in range(n_opp):
+                b.submit(
+                    SchedRequest(
+                        req_id=i,
+                        prompt_ids=list(spec),
+                        max_new_tokens=max_new,
+                    )
+                )
+            results = b.run_all()
+            toks.append([r.tokens.tolist() for r in results])
+            # The spec grows by round R's first revision — the debate
+            # loop's shape (critique tokens re-enter the next prompt).
+            spec = spec + toks[-1][0] + [
+                rng.randrange(3, cfg.vocab_size) for _ in range(delta_len)
+            ]
+        wall = time.monotonic() - t0
+        return toks, wall, spec_mod.stats.snapshot(), obs.snapshot()
+
+    on_toks, on_wall, on_snap, on_obs = batcher_rounds(True)
+    off_toks, off_wall, _, _ = batcher_rounds(False)
+    retrace = on_obs["retrace"]
+    verify = retrace["programs"].get("scheduler_spec_chunk", {})
+
+    return {
+        "metric": "spec_mock_tokens_per_step",
+        # Plain decode = 1 token/step, so this IS the ≥2× criterion.
+        "value": mock_snap["tokens_per_step"],
+        "unit": "mean tokens emitted per verify step (mock model)",
+        "vs_baseline": None,  # no published speculation baseline
+        "platform": platform,
+        "model": f"llama-{size}",
+        "gamma": gamma,
+        "rounds": n_rounds,
+        "opponents": n_opp,
+        "mock": {
+            "tokens_per_step": mock_snap["tokens_per_step"],
+            "acceptance_rate": mock_snap["acceptance_rate"],
+            "spec_steps": mock_snap["spec_steps"],
+            "transcripts_identical": mock_on_texts == mock_off_texts,
+            "wall_s_spec_on": round(mock_on_wall, 3),
+            "wall_s_spec_off": round(mock_off_wall, 3),
+        },
+        "batcher": {
+            "tokens_per_step": on_snap["tokens_per_step"],
+            "acceptance_rate": on_snap["acceptance_rate"],
+            "spec_steps": on_snap["spec_steps"],
+            "rolled_back_pages": on_snap["rolled_back_pages"],
+            "tokens_identical": on_toks == off_toks,
+            "wall_s_spec_on": round(on_wall, 3),
+            "wall_s_spec_off": round(off_wall, 3),
+            "unexpected_recompiles": retrace["unexpected_recompiles"],
+            "verify_program": verify,
+        },
+        "escape_hatch": "--no-speculative / ADVSPEC_SPECULATIVE=0",
+    }
+
+
 def _run_obs_overhead(platform: str) -> dict:
     """Observability overhead bench: what fraction of the mock mixed
     workload's wall the recorder+metrics emit path costs. Budget < 3%
@@ -863,6 +1039,14 @@ def main() -> int:
     prefix_mode = _mode("prefix")
     interleave_mode = _mode("interleave")
     obs_mode = _mode("obs-overhead")
+    spec_mode = _mode("spec")
+    if "--no-speculative" in args:
+        # Escape hatch mirror of --no-interleave: batcher-driven modes
+        # (and any TPU child) decode token-at-a-time.
+        os.environ["ADVSPEC_SPECULATIVE"] = "0"
+        from adversarial_spec_tpu.engine import spec as _sp
+
+        _sp.configure(enabled=False)
     if "--long-context" in args:
         mode_flag, runner = "--long-context", _run_long_context
     elif "--round-loop" in args:
@@ -873,6 +1057,8 @@ def main() -> int:
         mode_flag, runner = "--interleave", _run_interleave
     elif obs_mode:
         mode_flag, runner = "--obs-overhead", _run_obs_overhead
+    elif spec_mode:
+        mode_flag, runner = "--spec", _run_spec
     else:
         mode_flag, runner = "", _run_bench
 
@@ -906,7 +1092,7 @@ def main() -> int:
                     "(tunnel hang or compile error); CPU fallback"
                 ),
             )
-    if prefix_mode or interleave_mode or obs_mode:
+    if prefix_mode or interleave_mode or obs_mode or spec_mode:
         # Persist the perf trajectory point alongside the BENCH_r*
         # series the driver records.
         name = (
@@ -915,6 +1101,8 @@ def main() -> int:
             else "BENCH_interleave.json"
             if interleave_mode
             else "BENCH_obs.json"
+            if obs_mode
+            else "BENCH_spec.json"
         )
         out = os.path.join(
             os.path.dirname(os.path.abspath(__file__)), name

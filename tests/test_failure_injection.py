@@ -42,6 +42,23 @@ from adversarial_spec_tpu.resilience.injector import (
 PARAMS = SamplingParams(max_new_tokens=8, greedy=True)
 
 
+@pytest.fixture(autouse=True)
+def _spec_off(monkeypatch):
+    """This module pins fault classification/isolation semantics;
+    speculation is default-on and only multiplies the jit programs each
+    engine/batcher here compiles. Faults landing mid-verify (draft-page
+    rollback on eviction, JSONL reconstruction) are pinned in
+    tests/test_spec_batcher.py::TestSpecChaos."""
+    from adversarial_spec_tpu.engine import spec as spec_mod
+
+    prev = spec_mod.config()
+    prev_enabled, prev_gamma = prev.enabled, prev.gamma
+    monkeypatch.setenv("ADVSPEC_SPECULATIVE", "0")
+    spec_mod.configure(enabled=False)
+    yield
+    spec_mod.configure(enabled=prev_enabled, gamma=prev_gamma)
+
+
 def _req(model="tpu://random-tiny"):
     return ChatRequest(model=model, system="s", user="u")
 

@@ -18,6 +18,23 @@ from adversarial_spec_tpu.debate.parsing import (
 )
 from adversarial_spec_tpu.debate.telegram import split_message
 
+
+@pytest.fixture(autouse=True)
+def _spec_off_module(monkeypatch):
+    """Speculation is default-on and only multiplies the jit programs
+    every batcher/engine this module compiles; its subject is
+    orthogonal. Spec-on coverage (incl. SpecEvents, spec chaos fuzz,
+    and the obs families) lives in tests/test_spec_batcher.py."""
+    from adversarial_spec_tpu.engine import spec as spec_mod
+
+    prev = spec_mod.config()
+    prev_enabled, prev_gamma = prev.enabled, prev.gamma
+    monkeypatch.setenv("ADVSPEC_SPECULATIVE", "0")
+    spec_mod.configure(enabled=False)
+    yield
+    spec_mod.configure(enabled=prev_enabled, gamma=prev_gamma)
+
+
 _ALPHABET = (
     string.ascii_letters
     + string.digits

@@ -105,6 +105,25 @@ def test_phase_b_env_child_smoke(tmp_path):
     assert steps["gamma4"]["env"] == {"ADVSPEC_GAMMA": "4"}
 
 
+def test_batcher_spec_child_smoke(tmp_path):
+    """Phase B' (batcher γ sweep): the child must drain the bench-shaped
+    pool through the ContinuousBatcher under the env γ and record the
+    speculation telemetry the crossover is judged by."""
+    out = tmp_path / "smoke.jsonl"
+    proc = _run_child(
+        ["--child-batcher-spec", str(out), "batcher_gamma4"],
+        out,
+        extra_env={"ADVSPEC_GAMMA": "4"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    steps = load(str(out), include_smoke=True)
+    row = steps["batcher_gamma4"]
+    assert row["decode_tok_s"] > 0
+    assert row["spec_steps"] > 0
+    assert row["tokens_per_step"] >= 1.0
+    assert row["env"] == {"ADVSPEC_GAMMA": "4"}
+
+
 class TestOrchestrator:
     """The orchestrator's unattended branching: probe gating, skip of a
     completed phase A, phase-B completeness, and the final marker."""
@@ -139,7 +158,12 @@ class TestOrchestrator:
 
         monkeypatch.delenv("ADVSPEC_LADDER_SMOKE", raising=False)
         out = self._steps_file(
-            tmp_path, ["phase_a_complete", *tpu_ladder.ENV_STEPS]
+            tmp_path,
+            [
+                "phase_a_complete",
+                *tpu_ladder.ENV_STEPS,
+                *tpu_ladder.BATCHER_SPEC_STEPS,
+            ],
         )
         monkeypatch.setattr(bench, "_probe_tpu", lambda **kw: True)
         monkeypatch.setattr(
@@ -154,14 +178,26 @@ class TestOrchestrator:
         import tpu_ladder
 
         monkeypatch.delenv("ADVSPEC_LADDER_SMOKE", raising=False)
-        done = [s for s in tpu_ladder.ENV_STEPS if s != "gamma16"]
+        done = [
+            s
+            for s in (
+                list(tpu_ladder.ENV_STEPS)
+                + list(tpu_ladder.BATCHER_SPEC_STEPS)
+            )
+            if s != "gamma16"
+        ]
         out = self._steps_file(tmp_path, ["phase_a_complete", *done])
         monkeypatch.setattr(bench, "_probe_tpu", lambda **kw: True)
         launched = []
 
         class FakeChild:
             def __init__(self, cmd, **kw):
-                i = cmd.index("--child-env")
+                flag = (
+                    "--child-env"
+                    if "--child-env" in cmd
+                    else "--child-batcher-spec"
+                )
+                i = cmd.index(flag)
                 step = cmd[i + 2]
                 launched.append(step)
                 with open(cmd[i + 1], "a") as f:

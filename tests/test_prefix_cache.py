@@ -34,6 +34,23 @@ def _fresh_prefix_state():
     prefix_mod.reset_stats()
 
 
+@pytest.fixture(autouse=True)
+def _spec_off(monkeypatch):
+    """This module pins prefix-cache adoption/eviction semantics;
+    speculation is default-on and only multiplies the jit programs each
+    batcher here compiles. The spec × prefix-cache interaction (shared
+    tails surviving rollback, the γ-clamp found by the replay shape) is
+    pinned in tests/test_spec_batcher.py."""
+    from adversarial_spec_tpu.engine import spec as spec_mod
+
+    prev = spec_mod.config()
+    prev_enabled, prev_gamma = prev.enabled, prev.gamma
+    monkeypatch.setenv("ADVSPEC_SPECULATIVE", "0")
+    spec_mod.configure(enabled=False)
+    yield
+    spec_mod.configure(enabled=prev_enabled, gamma=prev_gamma)
+
+
 @pytest.fixture(scope="module")
 def tiny_model():
     cfg = get_config("llama", "tiny")

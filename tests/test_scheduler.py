@@ -25,6 +25,23 @@ def tiny_model():
     return params, cfg
 
 
+@pytest.fixture(autouse=True)
+def _spec_off(monkeypatch):
+    """This module pins admission/interleave/slot semantics; speculation
+    is default-on and would only multiply the jit programs every batcher
+    here compiles (each distinct (B, cap) pair adds a γ-wide verify
+    program). Spec-on coverage of these same paths — parity, legacy
+    loop, slot churn, tp=2 — lives in tests/test_spec_batcher.py."""
+    from adversarial_spec_tpu.engine import spec as spec_mod
+
+    prev = spec_mod.config()
+    prev_enabled, prev_gamma = prev.enabled, prev.gamma
+    monkeypatch.setenv("ADVSPEC_SPECULATIVE", "0")
+    spec_mod.configure(enabled=False)
+    yield
+    spec_mod.configure(enabled=prev_enabled, gamma=prev_gamma)
+
+
 def _reference(params, cfg, prompt, max_new):
     out = generate(
         params,
@@ -187,11 +204,17 @@ class TestPagedUnderDp:
 
 
 def _spy_dispatches(sched_mod, calls):
-    """Wrap the three dispatch entry points with call-order spies;
-    returns the originals for restoration."""
+    """Wrap the dispatch entry points with call-order spies; returns the
+    originals for restoration. The speculative siblings map onto the
+    same letters — "D" is a decode-side program (token-at-a-time or
+    draft+verify), "F" is a fused ride (either flavor) — so the
+    interleave properties hold under whatever the speculation default
+    is."""
     real_prefill = sched_mod.prefill_chunk
     real_decode = sched_mod.scheduler_decode_chunk
     real_fused = sched_mod.fused_prefill_decode_chunk
+    real_spec = sched_mod.scheduler_spec_chunk
+    real_fused_spec = sched_mod.fused_prefill_spec_chunk
 
     def spy_prefill(*a, **kw):
         calls.append("P")
@@ -205,10 +228,26 @@ def _spy_dispatches(sched_mod, calls):
         calls.append("F")
         return real_fused(*a, **kw)
 
+    def spy_spec(*a, **kw):
+        calls.append("D")
+        return real_spec(*a, **kw)
+
+    def spy_fused_spec(*a, **kw):
+        calls.append("F")
+        return real_fused_spec(*a, **kw)
+
     sched_mod.prefill_chunk = spy_prefill
     sched_mod.scheduler_decode_chunk = spy_decode
     sched_mod.fused_prefill_decode_chunk = spy_fused
-    return real_prefill, real_decode, real_fused
+    sched_mod.scheduler_spec_chunk = spy_spec
+    sched_mod.fused_prefill_spec_chunk = spy_fused_spec
+    return (
+        real_prefill,
+        real_decode,
+        real_fused,
+        real_spec,
+        real_fused_spec,
+    )
 
 
 class TestChunkedPrefillInterleave:
@@ -246,6 +285,8 @@ class TestChunkedPrefillInterleave:
                 sched_mod.prefill_chunk,
                 sched_mod.scheduler_decode_chunk,
                 sched_mod.fused_prefill_decode_chunk,
+                sched_mod.scheduler_spec_chunk,
+                sched_mod.fused_prefill_spec_chunk,
             ) = real
 
         assert len(results) == 2
@@ -285,6 +326,8 @@ class TestChunkedPrefillInterleave:
                 sched_mod.prefill_chunk,
                 sched_mod.scheduler_decode_chunk,
                 sched_mod.fused_prefill_decode_chunk,
+                sched_mod.scheduler_spec_chunk,
+                sched_mod.fused_prefill_spec_chunk,
             ) = real
 
         s = "".join(calls)
@@ -317,7 +360,7 @@ class TestChunkedPrefillInterleave:
         must still emit its full reference output."""
         params, cfg = tiny_model
         prompts = [
-            [((i * 13 + j * 7) % 500) + 3 for j in range(600 if i % 2 == 0 else 5)]
+            [((i * 13 + j * 7) % 500) + 3 for j in range(296 if i % 2 == 0 else 5)]
             for i in range(6)
         ]
         budgets = [8 if i % 2 == 0 else 24 for i in range(6)]

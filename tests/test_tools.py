@@ -514,6 +514,36 @@ class TestGraftlint:
         assert "time.monotonic" in findings[0].message
         assert "helper" in findings[0].message
 
+    def test_trace_roots_cover_spec_verify_programs(self):
+        """GL-TRACE's discovered roots must include the speculative
+        verify programs (ISSUE 6): both the standalone and the fused
+        draft+verify chunk are jit roots whose transitive bodies the
+        rule walks."""
+        from pathlib import Path
+
+        from tools.graftlint.config import load_config
+        from tools.graftlint.core import (
+            DEFAULT_ROOTS,
+            Context,
+            build_index,
+            collect_files,
+        )
+        from tools.graftlint.rules.trace import traced_functions
+
+        repo = REPO_ROOT
+        cfg = load_config(repo)
+        files = collect_files([Path(repo) / r for r in DEFAULT_ROOTS])
+        index = build_index(
+            files, repo, set(cfg.sig_preserving_decorators)
+        )
+        ctx = Context(repo, cfg, index)
+        roots = {
+            fn for (mod, fn) in traced_functions(ctx)
+            if mod.endswith("engine.scheduler")
+        }
+        assert "_spec_chunk_impl" in roots
+        assert "fused_prefill_spec_chunk" in roots
+
     def test_retrace_rule_static_and_traced_args(self):
         from tools.graftlint.core import lint_sources
 

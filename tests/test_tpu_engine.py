@@ -20,6 +20,23 @@ from adversarial_spec_tpu.engine.types import ChatRequest, SamplingParams
 PARAMS = SamplingParams(max_new_tokens=8, greedy=True)
 
 
+@pytest.fixture(autouse=True)
+def _spec_off(monkeypatch):
+    """This module pins the engine seam (registry → loader → mesh →
+    serve); speculation is default-on and only multiplies the jit
+    programs every engine here compiles. The engine × speculation
+    interaction is pinned by test_paged_spec_uses_batcher_and_matches_dense
+    (which opts back in) and tests/test_spec_batcher.py."""
+    from adversarial_spec_tpu.engine import spec as spec_mod
+
+    prev = spec_mod.config()
+    prev_enabled, prev_gamma = prev.enabled, prev.gamma
+    monkeypatch.setenv("ADVSPEC_SPECULATIVE", "0")
+    spec_mod.configure(enabled=False)
+    yield
+    spec_mod.configure(enabled=prev_enabled, gamma=prev_gamma)
+
+
 def _req(model, user="hello"):
     return ChatRequest(model=model, system="sys", user=user)
 
@@ -229,7 +246,12 @@ class TestContinuousServing:
 
     def test_paged_spec_uses_batcher_and_matches_dense(self, engine):
         import adversarial_spec_tpu.engine.tpu as tpu_mod
+        from adversarial_spec_tpu.engine import spec as spec_mod
 
+        # Opt back in (module _spec_off fixture): this test IS the
+        # engine × speculation pin — the batcher must speculate and
+        # still match the dense engine's greedy tokens.
+        spec_mod.configure(enabled=True)
         save_registry_entry(
             ModelSpec(alias="cont-tiny", family="llama", size="tiny",
                       kv="paged", dtype="float32", mesh={"dp": 1})

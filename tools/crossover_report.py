@@ -172,6 +172,42 @@ def main() -> int:
             v = steps[name]["decode_tok_s"]
             print(f"  {name:<9} {v:>8} tok/s  ({v / base - 1:+.1%} "
                   "vs north_star)")
+    # Phase B': the batcher γ sweep (per-slot speculation on the paged
+    # serving path). decode_tok_s rows already print in the lever table
+    # above; this adds the speculation-specific columns the crossover
+    # is actually judged by.
+    batcher_rows = sorted(
+        k for k in steps
+        if k.startswith("batcher_") and "tokens_per_step" in steps[k]
+        # spec_off is the baseline line above, not a sweep point — its
+        # record also carries tokens_per_step (0.0, zero spec steps)
+        # and would print a contradictory duplicate row.
+        and k != "batcher_spec_off"
+    )
+    if batcher_rows:
+        off = steps.get("batcher_spec_off", {}).get("decode_tok_s")
+        print("\nbatcher γ sweep    tok/s     tokens/step  acceptance")
+        if off:
+            print(f"  batcher_spec_off {off:<9} 1.0          -")
+        for name in batcher_rows:
+            row = steps[name]
+            print(
+                f"  {name:<16} {row.get('decode_tok_s', '?'):<9} "
+                f"{row.get('tokens_per_step', '?'):<12} "
+                f"{row.get('acceptance_rate', '?')}"
+            )
+        if off:
+            best = max(
+                batcher_rows,
+                key=lambda n: steps[n].get("decode_tok_s") or 0,
+            )
+            best_tok = steps[best].get("decode_tok_s") or 0
+            if best_tok <= off:
+                print("  → speculation not winning in the batcher at "
+                      "this workload: consider ADVSPEC_SPECULATIVE=0")
+            else:
+                print(f"  → best: {best} ({best_tok / off - 1:+.1%} vs "
+                      "spec-off)")
     lc = steps.get("long_context_16k", {}).get("prefill_tok_s")
     if lc:
         print(f"long_context_16k prefill: {lc} tok/s")

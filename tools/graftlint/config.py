@@ -75,7 +75,12 @@ class GraftlintConfig:
     )
     # Bare local names that hold device values in the sync class.
     sync_device_names: list[str] = field(
-        default_factory=lambda: ["first", "active_ref", "adm_logits"]
+        default_factory=lambda: [
+            "first",
+            "active_ref",
+            "adm_logits",
+            "spec_counts",
+        ]
     )
     # --- GL-TRACE ----------------------------------------------------
     # Dotted-call prefixes that are host side effects inside a traced

@@ -32,6 +32,20 @@ from tools.graftlint.core import Context, Rule, register
 _NUMPY_NAMES = {"np", "numpy"}
 
 
+def _is_identity_test(expr: ast.expr) -> bool:
+    """``x is None`` / ``x is not None`` — a pure host-side identity
+    check on the Python reference; no device value is materialized, so
+    it is not a sync no matter how tainted ``x`` is."""
+    return (
+        isinstance(expr, ast.Compare)
+        and all(isinstance(op, (ast.Is, ast.IsNot)) for op in expr.ops)
+        and all(
+            isinstance(c, ast.Constant) and c.value is None
+            for c in expr.comparators
+        )
+    )
+
+
 def _is_device_tainted(
     expr: ast.expr, device_attrs: set[str], device_names: set[str]
 ) -> bool:
@@ -154,7 +168,11 @@ class SyncRule(Rule):
                     and tainted(f.value)
                 ):
                     warn(sub, ".item() on a device value")
-            elif isinstance(sub, (ast.If, ast.While)) and tainted(sub.test):
+            elif (
+                isinstance(sub, (ast.If, ast.While))
+                and tainted(sub.test)
+                and not _is_identity_test(sub.test)
+            ):
                 # Truthiness of a device expression blocks the host.
                 # (int()/bool()/np.asarray inside the test are already
                 # reported above; this catches the bare `if x.any():`.)

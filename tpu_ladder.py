@@ -36,6 +36,12 @@ Phase B (one child per env setting — knobs read at import time):
   chunk=128 / unroll=4 / gamma=8 / block_t=auto are phase A's
   north_star).
 
+Phase B' (batcher γ sweep — the paged serving path):
+  batcher_spec_off / batcher_gamma{4,8,16}: per-slot prompt-lookup
+  speculation through the ContinuousBatcher, recording decode tok/s +
+  tokens-per-verify-step + acceptance — the on-chip crossover the
+  γ=8 default (engine/spec.py) is judged by.
+
 ADVSPEC_LADDER_SMOKE=1 dry-runs the whole ladder code path on CPU with
 tiny shapes (tests/test_ladder.py); smoke rows are stamped
 ``"smoke": true`` and excluded from resumability and from every tuning
@@ -45,6 +51,7 @@ Usage:
   python tpu_ladder.py --out tpu_results/r04.jsonl         # orchestrate
   python tpu_ladder.py --child-main OUT                    # internal
   python tpu_ladder.py --child-env OUT STEP                # internal
+  python tpu_ladder.py --child-batcher-spec OUT STEP       # internal
 """
 
 from __future__ import annotations
@@ -425,6 +432,102 @@ def _child_env(out_path: str, step: str) -> int:
     return 0
 
 
+# Phase B': the γ sweep through the ContinuousBatcher — per-slot
+# prompt-lookup speculation on the PAGED serving path (the path the CLI
+# actually drives; phase B's gamma4/gamma16 sweep the dense generate()
+# loop). γ is a width-vs-waste trade: too small caps the accepted span,
+# too large pays a wider verify forward for drafts the sampler rejects —
+# the on-chip crossover against batcher_spec_off is the data the γ=8
+# default (engine/spec.py) is judged by. Knobs travel as env because
+# each child is a fresh process: spec.py reads ADVSPEC_GAMMA /
+# ADVSPEC_SPECULATIVE at import and the batcher snapshots that config
+# at construction.
+BATCHER_SPEC_STEPS = {
+    "batcher_spec_off": {"ADVSPEC_SPECULATIVE": "0"},
+    "batcher_gamma4": {"ADVSPEC_GAMMA": "4"},
+    "batcher_gamma8": {"ADVSPEC_GAMMA": "8"},
+    "batcher_gamma16": {"ADVSPEC_GAMMA": "16"},
+}
+
+
+def _child_batcher_spec(out_path: str, step: str) -> int:
+    """One warm drain then one timed drain of the bench-shaped opponent
+    pool through the ContinuousBatcher under this step's speculation
+    knobs, recording decode tok/s, mean tokens per verify step, and the
+    acceptance rate."""
+    from adversarial_spec_tpu.utils.jaxenv import configure_jax
+
+    configure_jax()
+    import jax
+    import jax.numpy as jnp
+
+    from adversarial_spec_tpu.engine import spec as spec_mod
+    from adversarial_spec_tpu.engine.scheduler import (
+        ContinuousBatcher,
+        SchedRequest,
+    )
+    from adversarial_spec_tpu.models import transformer as T
+    from adversarial_spec_tpu.models.config import get_config
+
+    smoke = _smoke()
+    if jax.devices()[0].platform == "cpu" and not smoke:
+        _append(out_path, {"step": f"{step}_abort_cpu"})
+        return 1
+    if smoke:
+        cfg = get_config("llama", "tiny")
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        n_prompt, n_decode = SMOKE_PROMPT, SMOKE_DECODE
+    else:
+        cfg = get_config("llama", "1b")
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.bfloat16)
+        n_prompt, n_decode = BENCH_PROMPT, BENCH_DECODE
+    rng = __import__("random").Random(0)
+    base = [rng.randrange(3, cfg.vocab_size) for _ in range(n_prompt)]
+
+    def drain():
+        b = ContinuousBatcher(
+            params,
+            cfg,
+            max_batch=BENCH_B,
+            max_new_cap=n_decode,
+            page_size=64,
+            capacity_tokens=1 << 16,
+            greedy=True,
+            prefix_cache=False,
+        )
+        for i in range(BENCH_B):
+            b.submit(
+                SchedRequest(
+                    req_id=i,
+                    prompt_ids=list(base),
+                    max_new_tokens=n_decode,
+                )
+            )
+        spec_mod.reset_stats()
+        t0 = time.monotonic()
+        results = b.run_all()
+        wall = time.monotonic() - t0
+        toks = sum(r.n_generated for r in results)
+        return toks, wall, b.decode_time_s, spec_mod.stats.snapshot()
+
+    drain()  # warm: compiles every program this shape dispatches
+    toks, wall, decode_s, snap = drain()
+    _append(
+        out_path,
+        {
+            "step": step,
+            "decode_tok_s": round(toks / max(decode_s, 1e-9), 1),
+            "decode_time_s": round(decode_s, 3),
+            "tokens_per_step": snap["tokens_per_step"],
+            "acceptance_rate": snap["acceptance_rate"],
+            "spec_steps": snap["spec_steps"],
+            "rolled_back_pages": snap["rolled_back_pages"],
+            "wall_s": round(wall, 3),
+            "env": {k: os.environ[k] for k in BATCHER_SPEC_STEPS[step]},
+        },
+    )
+    return 0
+
 
 def _clean_env(knobs: dict[str, str] | None = None) -> dict[str, str]:
     """Child env for a measurement: ambient ADVSPEC_* tuning knobs are
@@ -498,7 +601,11 @@ def orchestrate(out_path: str) -> int:
                   file=sys.stderr)
             return 2
 
-    for step, knobs in ENV_STEPS.items():
+    phase_b = [("--child-env", s, k) for s, k in ENV_STEPS.items()] + [
+        ("--child-batcher-spec", s, k)
+        for s, k in BATCHER_SPEC_STEPS.items()
+    ]
+    for flag, step, knobs in phase_b:
         if step in done:
             continue
         if not _probe_tpu(timeout_s=60.0):
@@ -506,7 +613,7 @@ def orchestrate(out_path: str) -> int:
             return 2
         env = _clean_env(knobs)
         child = subprocess.Popen(
-            [sys.executable, os.path.abspath(__file__), "--child-env",
+            [sys.executable, os.path.abspath(__file__), flag,
              out_path, step],
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
             start_new_session=True, env=env, cwd=REPO,
@@ -516,7 +623,11 @@ def orchestrate(out_path: str) -> int:
             return 2
 
     done = _done_steps(out_path)
-    missing = [s for s in ENV_STEPS if s not in done]
+    missing = [
+        s
+        for s in list(ENV_STEPS) + list(BATCHER_SPEC_STEPS)
+        if s not in done
+    ]
     if missing:
         # A phase-B child exited without recording its step (crash or
         # cpu-backend abort): not complete — the session loop retries.
@@ -534,6 +645,9 @@ def main() -> int:
     if "--child-env" in args:
         i = args.index("--child-env")
         return _child_env(args[i + 1], args[i + 2])
+    if "--child-batcher-spec" in args:
+        i = args.index("--child-batcher-spec")
+        return _child_batcher_spec(args[i + 1], args[i + 2])
     out = "tpu_results/ladder.jsonl"
     if "--out" in args:
         out = args[args.index("--out") + 1]
