@@ -217,6 +217,32 @@ def create_parser() -> argparse.ArgumentParser:
         "(0 = bounded only by the pool, evicting LRU under pressure)",
     )
     d.add_argument(
+        "--kv-tier",
+        action=argparse.BooleanOptionalAction,
+        default=None,  # None = inherit ADVSPEC_KV_TIER (default on)
+        help="Tiered KV cache: LRU-evicted prefix blocks demote to "
+        "host RAM and promote back instead of re-prefilling; with "
+        "--kv-store-dir they also persist to a content-addressed disk "
+        "store a restarted server rehydrates from (--no-kv-tier "
+        "disables; ADVSPEC_KV_TIER=0 sets the process default)",
+    )
+    d.add_argument(
+        "--kv-host-mb",
+        type=int,
+        default=None,  # None = inherit ADVSPEC_KV_HOST_MB (default 256)
+        help="Host-RAM KV tier budget in MiB (0 disables tier 1; "
+        "default 256, ADVSPEC_KV_HOST_MB sets the process default)",
+    )
+    d.add_argument(
+        "--kv-store-dir",
+        default=None,  # None = inherit ADVSPEC_KV_STORE_DIR (default off)
+        help="Root directory of the persistent content-addressed KV "
+        "block store (tier 2); entries are namespaced by a "
+        "model/config fingerprint, written atomically, and corrupt "
+        "entries quarantine instead of serving (unset disables; "
+        "ADVSPEC_KV_STORE_DIR sets the process default)",
+    )
+    d.add_argument(
         "--interleave",
         action=argparse.BooleanOptionalAction,
         default=None,  # None = inherit ADVSPEC_INTERLEAVE (default on)
@@ -258,7 +284,7 @@ def create_parser() -> argparse.ArgumentParser:
             "Arm fault injection: kind@seam[:p=F][:after=N][:times=N]"
             "[:slot=K], comma-separated (kinds: oom, device_lost, "
             "preempted, timeout, bug; seams: generate, scheduler_chunk, "
-            "kv_alloc, checkpoint_load). Also via ADVSPEC_CHAOS"
+            "kv_alloc, kv_swap, checkpoint_load). Also via ADVSPEC_CHAOS"
         ),
     )
     z.add_argument(
@@ -466,6 +492,35 @@ def _configure_interleave(args: argparse.Namespace):
     return interleave
 
 
+def _configure_kv_tier(args: argparse.Namespace):
+    """Arm the tiered KV cache from flags; returns the module for
+    reporting. Flag-else-env-default each invocation (one invocation =
+    one round), like obs/spec: one round's --no-kv-tier or store dir
+    must not leak into the next. Stats reset per invocation so
+    ``perf.kv_tier`` accounts exactly this round's swaps; the tiers
+    themselves live on the engine's persistent batcher (rebuilt when
+    these knobs change — the batcher key covers them)."""
+    from adversarial_spec_tpu.engine import kvtier
+
+    kvtier.configure(
+        enabled=(
+            args.kv_tier if args.kv_tier is not None else kvtier.env_enabled()
+        ),
+        host_mb=(
+            args.kv_host_mb
+            if args.kv_host_mb is not None
+            else kvtier.env_host_mb()
+        ),
+        store_dir=(
+            args.kv_store_dir
+            if args.kv_store_dir is not None
+            else kvtier.env_store_dir()
+        ),
+    )
+    kvtier.reset_stats()
+    return kvtier
+
+
 def _configure_speculative(args: argparse.Namespace):
     """Apply speculation flags to the process config (one CLI invocation
     is one round) so ``perf.spec`` accounts exactly this round's verify
@@ -519,6 +574,7 @@ def run_critique(args: argparse.Namespace) -> int:
     prefix_cache = _configure_prefix_cache(args)
     interleave = _configure_interleave(args)
     spec_cfg = _configure_speculative(args)
+    kv_tier = _configure_kv_tier(args)
     obs = _configure_obs(args)
     spec, session_state = load_or_resume_session(args)
     if session_state is not None and session_state.breakers:
@@ -591,6 +647,10 @@ def run_critique(args: argparse.Namespace) -> int:
     # Speculation telemetry: verify steps, acceptance rate, tokens/step,
     # rollback pages, draft/verify wall split (engine/spec.py).
     perf["spec"] = spec_cfg.snapshot()
+    # Tiered-KV telemetry: per-tier hit rates, demotions/promotions/
+    # rehydrations, store writes + quarantines, swap walls
+    # (engine/kvtier.py).
+    perf["kv_tier"] = kv_tier.snapshot()
     # Observability report: flight-recorder occupancy, event mix, host
     # syncs by reason, retrace watch (unexpected recompiles flagged).
     perf["obs"] = obs.snapshot()
@@ -614,6 +674,15 @@ def run_critique(args: argparse.Namespace) -> int:
         _err(
             f"prefix cache: {prefix_snap['hits']}/{prefix_snap['lookups']} "
             f"hits, {prefix_snap['saved_tokens']} prefill tokens saved"
+        )
+    tier_snap = perf["kv_tier"]
+    if tier_snap["enabled"] and (
+        tier_snap["promoted_tokens"] or tier_snap["rehydrated_tokens"]
+    ):
+        _err(
+            f"kv tier: {tier_snap['promoted_tokens']} tokens promoted "
+            f"from host RAM, {tier_snap['rehydrated_tokens']} rehydrated "
+            "from the disk store"
         )
     if fault_counts:
         total_faults = sum(fault_counts.values())
@@ -761,6 +830,7 @@ def handle_export_tasks(args: argparse.Namespace) -> int:
     _configure_prefix_cache(args)
     _configure_interleave(args)
     _configure_speculative(args)
+    _configure_kv_tier(args)
     obs = _configure_obs(args)
     spec = _read_spec_stdin()
     models = parse_models(args)

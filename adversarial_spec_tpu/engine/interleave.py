@@ -30,12 +30,19 @@ accounting) record into, à la ``resilience.faults`` / ``prefix_cache``:
 snapshot computes it), so ``stalled + overlapped == prefill`` holds
 exactly — the invariant tier-1 pins on the mock engine's deterministic
 numbers. Deliberately imports no jax: the mock engine uses it on CPU.
+
+The config/stats mechanics live in ``engine/procconfig.py`` (shared
+with ``spec``, ``prefix_cache``, ``kvtier``); this module keeps only
+what is interleave-specific — the knobs, the counters, and the
+depth clamp.
 """
 
 from __future__ import annotations
 
 import os
 from dataclasses import dataclass
+
+from adversarial_spec_tpu.engine import procconfig
 
 # The drive loop keeps at most this many device steps in flight. Depth 1
 # degenerates to "fused but synchronous" (fetch each step right after
@@ -53,7 +60,7 @@ class InterleaveConfig:
 
 
 @dataclass
-class InterleaveStats:
+class InterleaveStats(procconfig.StatsBase):
     """Process-wide counters, aggregated across every batcher (and the
     mock engine's accounting). ``reset`` zeroes in place so engines
     holding a reference keep counting into the same object."""
@@ -82,12 +89,8 @@ class InterleaveStats:
     def record_sync(self) -> None:
         self.sync_points += 1
 
-    def reset(self) -> None:
-        for f in self.__dataclass_fields__:
-            setattr(self, f, type(getattr(self, f))())
-
     def snapshot(self) -> dict:
-        out = {f: getattr(self, f) for f in self.__dataclass_fields__}
+        out = self.as_dict()
         # The invariant the telemetry promises: total prefill time IS
         # the two buckets — there is no third place prefill time can
         # hide. Computed here (NOT rounded: rounding the addends would
@@ -106,36 +109,36 @@ def _depth_from_env() -> int:
     return max(1, min(d, MAX_PIPELINE_DEPTH))
 
 
-_config = InterleaveConfig(
-    enabled=os.environ.get("ADVSPEC_INTERLEAVE", "1") != "0",
-    pipeline_depth=_depth_from_env(),
+def _clamp_depth(depth) -> int:
+    return max(1, min(int(depth), MAX_PIPELINE_DEPTH))
+
+
+_state = procconfig.ProcState(
+    InterleaveConfig(
+        enabled=os.environ.get("ADVSPEC_INTERLEAVE", "1") != "0",
+        pipeline_depth=_depth_from_env(),
+    ),
+    InterleaveStats(),
+    coerce={"pipeline_depth": _clamp_depth},
 )
-stats = InterleaveStats()
+_config = _state.config
+stats = _state.stats
 
 
 def config() -> InterleaveConfig:
-    return _config
+    return _state.config
 
 
 def configure(
     enabled: bool | None = None, pipeline_depth: int | None = None
 ) -> InterleaveConfig:
-    if enabled is not None:
-        _config.enabled = bool(enabled)
-    if pipeline_depth is not None:
-        _config.pipeline_depth = max(
-            1, min(int(pipeline_depth), MAX_PIPELINE_DEPTH)
-        )
-    return _config
+    return _state.configure(enabled=enabled, pipeline_depth=pipeline_depth)
 
 
 def reset_stats() -> None:
-    stats.reset()
+    _state.reset_stats()
 
 
 def snapshot() -> dict:
     """Stats + config, the ``perf.interleave`` payload."""
-    out = stats.snapshot()
-    out["enabled"] = _config.enabled
-    out["pipeline_depth"] = _config.pipeline_depth
-    return out
+    return _state.snapshot()

@@ -67,6 +67,11 @@ class PageAllocator:
         self._tables: dict[int, list[int]] = {}
         self._lengths: dict[int, int] = {}
         self._refs: dict[int, int] = {}  # page -> reference count
+        # Pages with an in-flight tier swap (a host->device promotion
+        # scatter targeting them — engine/kvtier.py): they must stay
+        # referenced until the swap owner unpins, and freeing one is a
+        # bookkeeping corruption check_invariants / _release catch.
+        self._swap_pins: dict[int, int] = {}  # page -> pin count
 
     @property
     def free_pages(self) -> int:
@@ -141,11 +146,38 @@ class PageAllocator:
         """Drop the prefix cache's reference (page frees at zero)."""
         self._release(page)
 
+    def swap_pin(self, page: int) -> None:
+        """Mark ``page`` as the target of an in-flight tier swap (a
+        promotion's host→device write — engine/kvtier.py). Freeing a
+        pinned page is a refcount corruption: the swap would scatter
+        into storage another sequence may own by then. Pins pair with
+        ``swap_unpin`` in try/finally (GL-REFCOUNT enforces the
+        pairing statically)."""
+        if page not in self._refs:
+            raise ValueError(f"cannot swap-pin unallocated page {page}")
+        self._swap_pins[page] = self._swap_pins.get(page, 0) + 1
+
+    def swap_unpin(self, page: int) -> None:
+        """Drop one swap pin (the promotion write was dispatched — the
+        page's owning references keep it alive from here)."""
+        n = self._swap_pins.get(page, 0)
+        if n <= 0:
+            raise RuntimeError(f"swap-unpin without pin on page {page}")
+        if n == 1:
+            del self._swap_pins[page]
+        else:
+            self._swap_pins[page] = n - 1
+
     def _release(self, page: int) -> None:
         refs = self._refs.get(page, 0)
         if refs <= 0:
             raise RuntimeError(f"double free of page {page}")
         if refs == 1:
+            if page in self._swap_pins:
+                raise RuntimeError(
+                    f"freeing page {page} with a tier swap in flight "
+                    "(swap_pin held)"
+                )
             del self._refs[page]
             self._free.append(page)
         else:
@@ -245,6 +277,17 @@ class PageAllocator:
                     f"page {p}: refcount {r} exceeds "
                     f"{table_refs.get(p, 0)} table refs + 1 cache ref "
                     "(leaked reference)"
+                )
+        # Tier-swap pins: a pinned page must be live (referenced) — a
+        # pin on a freed page means a promotion is scattering into
+        # storage nobody owns — and pin counts must be positive.
+        for p, n in self._swap_pins.items():
+            if n < 1:
+                raise RuntimeError(f"page {p} has nonpositive swap pin {n}")
+            if p not in self._refs:
+                raise RuntimeError(
+                    f"page {p} swap-pinned but not referenced "
+                    "(in-flight swap against a freed page)"
                 )
 
     def table_array(self, seq_ids: list[int], max_pages: int) -> np.ndarray:

@@ -38,7 +38,9 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass, field
 
+from adversarial_spec_tpu.engine import procconfig
 from adversarial_spec_tpu.engine.kvcache import OutOfPages, PageAllocator
+from adversarial_spec_tpu.engine.kvtier import chain_hash
 from adversarial_spec_tpu import obs as obs_mod
 
 
@@ -53,7 +55,7 @@ class PrefixCacheConfig:
 
 
 @dataclass
-class PrefixCacheStats:
+class PrefixCacheStats(procconfig.StatsBase):
     """Process-wide counters, aggregated across every cache instance
     (mock engine, each ContinuousBatcher, generate's shared-prefix
     prefill). ``reset`` zeroes in place so engines holding a reference
@@ -92,45 +94,41 @@ class PrefixCacheStats:
         self.prefilled_tokens += computed_tokens
         self.saved_tokens += saved_tokens
 
-    def reset(self) -> None:
-        for f in self.__dataclass_fields__:
-            setattr(self, f, 0)
-
     def snapshot(self) -> dict:
-        out = {f: getattr(self, f) for f in self.__dataclass_fields__}
+        out = self.as_dict()
         out["hit_rate"] = round(self.hits / self.lookups, 4) if self.lookups else 0.0
         return out
 
 
-_config = PrefixCacheConfig(
-    enabled=os.environ.get("ADVSPEC_PREFIX_CACHE", "1") != "0"
+_state = procconfig.ProcState(
+    PrefixCacheConfig(
+        enabled=os.environ.get("ADVSPEC_PREFIX_CACHE", "1") != "0"
+    ),
+    PrefixCacheStats(),
+    # max_pages is config-only (the cap), not part of the perf payload.
+    snapshot_fields=("enabled",),
 )
-stats = PrefixCacheStats()
+_config = _state.config
+stats = _state.stats
 
 
 def config() -> PrefixCacheConfig:
-    return _config
+    return _state.config
 
 
 def configure(
     enabled: bool | None = None, max_pages: int | None = None
 ) -> PrefixCacheConfig:
-    if enabled is not None:
-        _config.enabled = bool(enabled)
-    if max_pages is not None:
-        _config.max_pages = int(max_pages)
-    return _config
+    return _state.configure(enabled=enabled, max_pages=max_pages)
 
 
 def reset_stats() -> None:
-    stats.reset()
+    _state.reset_stats()
 
 
 def snapshot() -> dict:
     """Stats + config, the ``perf.prefix_cache`` payload."""
-    out = stats.snapshot()
-    out["enabled"] = _config.enabled
-    return out
+    return _state.snapshot()
 
 
 @dataclass
@@ -142,6 +140,10 @@ class _Block:
     parent: "_Block | None"
     children: dict = field(default_factory=dict)
     last_used: int = 0
+    # Content-addressed chain hash (engine/kvtier.py) — the block's
+    # cross-process identity, stamped at insert when tiers are
+    # attached; None on a tier-less cache (hashing skipped).
+    chain: str | None = None
 
 
 class PrefixCache:
@@ -167,6 +169,21 @@ class PrefixCache:
         self._root: dict[tuple, _Block] = {}
         self._by_page: dict[int, _Block] = {}
         self._clock = 0
+        # Lower tiers (engine/kvtier.py), attached by the owner before
+        # the first insert: LRU-evicted leaves demote into them, and
+        # ``lookup_tiered`` continues the radix walk past the device
+        # tier. ``_kv_fetch(page, n_tokens)`` (scheduler-installed)
+        # returns a LAZY payload materializer for a page's KV — None on
+        # accounting-only caches (the mock engine).
+        self.tiers = None
+        self._kv_fetch = None
+
+    def attach_tiers(self, tiers, kv_fetch=None) -> None:
+        """Arm the host/disk tiers. Must precede the first ``insert``
+        (blocks are chain-stamped at insert; a block inserted tier-less
+        has no cross-process identity and silently skips demotion)."""
+        self.tiers = tiers
+        self._kv_fetch = kv_fetch
 
     @property
     def cached_pages(self) -> int:
@@ -199,6 +216,53 @@ class PrefixCache:
             self.stats.record_lookup(matched)
         return matched, pages
 
+    def lookup_tiered(
+        self, tokens, record: bool = True
+    ) -> tuple[int, list[int], list]:
+        """``lookup`` continued past the device tier: after the radix
+        walk stops, subsequent full blocks are matched against the host
+        tier, then the disk store, by chain hash — the contiguous run
+        of lower-tier blocks the admission can promote instead of
+        prefilling. Returns ``(matched_tokens, pages, tier_hits)``;
+        with no tiers attached it degenerates to ``lookup``."""
+        self._clock += 1
+        pages: list[int] = []
+        hits: list = []
+        children = self._root
+        chain = ""
+        blocks = self._blocks(tokens)
+        depth = 0
+        for key in blocks:
+            node = children.get(key)
+            if node is None:
+                break
+            node.last_used = self._clock
+            if self.tiers is not None:
+                # Reuse the chain stamped at insert — rehashing ~every
+                # matched block per lookup (and per pool-full admission
+                # retry) would be pure hot-path recomputation.
+                chain = (
+                    node.chain
+                    if node.chain is not None
+                    else chain_hash(chain, key)
+                )
+            pages.append(node.page)
+            children = node.children
+            depth += 1
+        if self.tiers is not None:
+            for key in blocks[depth:]:
+                chain = chain_hash(chain, key)
+                hit = self.tiers.lookup_chain(chain, key)
+                if hit is None:
+                    break
+                hits.append(hit)
+        matched = len(pages) * self.page_size
+        if record:
+            self.stats.record_lookup(matched)
+            if self.tiers is not None:
+                self.tiers.record_lookup(hits)
+        return matched, pages, hits
+
     def insert(self, tokens, pages: list[int]) -> int:
         """Register the full blocks of ``tokens``; ``pages[i]`` is the
         allocator page holding block i's KV. Blocks already cached keep
@@ -211,15 +275,39 @@ class PrefixCache:
         added = 0
         children = self._root
         parent: _Block | None = None
+        chain = ""
         for key, page in zip(blocks, pages):
+            if self.tiers is not None:
+                chain = chain_hash(chain, key)
             node = children.get(key)
             if node is None:
-                node = _Block(tokens=key, page=page, parent=parent)
+                node = _Block(
+                    tokens=key,
+                    page=page,
+                    parent=parent,
+                    chain=chain if self.tiers is not None else None,
+                )
                 # graftlint: disable=GL-REFCOUNT -- ownership transfer, not a leak: the ref is recorded in _by_page on the next line and released by _drop (LRU eviction / clear); nothing between can raise
                 self.allocator.cache_ref(page)
                 self._by_page[page] = node
                 children[key] = node
                 added += 1
+                if self.tiers is not None and self.tiers.needs_store(chain):
+                    # Disk write-through: queue the new block for the
+                    # persistent store (flushed at drain end — file I/O
+                    # off the serving path). The payload gather is
+                    # dispatched NOW (the page is live and immutable
+                    # here; by flush time it may be reused) but
+                    # materializes lazily. needs_store first: a
+                    # re-promoted/rehydrated block already queued or on
+                    # disk must not pay a discarded gather.
+                    self.tiers.enqueue_store(
+                        chain,
+                        key,
+                        self._kv_fetch(page, len(key))
+                        if self._kv_fetch is not None
+                        else None,
+                    )
             node.last_used = self._clock
             parent = node
             children = node.children
@@ -236,12 +324,27 @@ class PrefixCache:
     def _drop(self, block: _Block) -> bool:
         """Remove one leaf block from the index and release the cache's
         page reference. Returns True if the page actually freed (no live
-        sequence was sharing it)."""
+        sequence was sharing it).
+
+        With tiers attached the block DEMOTES on its way out: its KV is
+        gathered off the page BEFORE the reference drops (the page may
+        return to the free list and be re-used by the very allocation
+        that triggered this eviction — the gather is an independent
+        copy, started async, materialized off the hot path), and the
+        block enters the host tier keyed by its chain hash."""
         siblings = (
             block.parent.children if block.parent is not None else self._root
         )
         del siblings[block.tokens]
         del self._by_page[block.page]
+        if self.tiers is not None and block.chain is not None:
+            self.tiers.demote(
+                block.chain,
+                block.tokens,
+                self._kv_fetch(block.page, len(block.tokens))
+                if self._kv_fetch is not None
+                else None,
+            )
         freed = self.allocator.refcount(block.page) == 1
         self.allocator.cache_unref(block.page)
         self.stats.evicted_blocks += 1

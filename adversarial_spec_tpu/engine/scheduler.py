@@ -65,6 +65,7 @@ from adversarial_spec_tpu.engine.generate import (
     prefill_chunk,
 )
 from adversarial_spec_tpu.engine import interleave as interleave_mod
+from adversarial_spec_tpu.engine import kvtier as kvtier_mod
 from adversarial_spec_tpu.engine import prefix_cache as prefix_mod
 from adversarial_spec_tpu.engine import spec as spec_mod
 from adversarial_spec_tpu import obs as obs_mod
@@ -984,6 +985,30 @@ class ContinuousBatcher:
         self.pool = init_page_pool(
             layout, dtype=self._dtype, kv_dtype=kv_dtype
         )
+        # Tiered KV (engine/kvtier.py): host-RAM demotion of LRU-evicted
+        # prefix blocks + the persistent content-addressed disk store,
+        # both below this pool. The host budget is denominated in real
+        # page bytes; the store is namespaced by a model/config/layout
+        # fingerprint so incompatible KV can never rehydrate. None when
+        # tiering (or the prefix cache) is off.
+        self.tiers = None
+        if self.prefix_cache is not None and kvtier_mod.armed():
+            kv_bytes = (
+                1 if kv_dtype == "int8" else np.dtype(self._dtype).itemsize
+            )
+            block_bytes = (
+                cfg.n_layers * cfg.n_kv_heads * page_size * cfg.head_dim
+            ) * kv_bytes * 2
+            if kv_dtype == "int8":  # per-(token, head) f32 scale pages
+                block_bytes += cfg.n_layers * cfg.n_kv_heads * page_size * 4 * 2
+            self.tiers = kvtier_mod.build_for(
+                block_bytes,
+                (cfg, page_size, kv_dtype, self._dtype),
+            )
+            if self.tiers is not None:
+                self.prefix_cache.attach_tiers(
+                    self.tiers, kv_fetch=self._fetch_page_kv
+                )
         self.max_pages_per_seq = -(-(cfg.max_seq_len) // page_size)
         # Fused paged kernel on real TPUs; gather path elsewhere.
         self._use_pallas = jax.default_backend() == "tpu"
@@ -1264,6 +1289,111 @@ class ContinuousBatcher:
         else:
             self.prefix_cache.extend_evicting(seq_id, n_tokens)
 
+    # -- tiered KV swaps ---------------------------------------------------
+
+    def _fetch_page_kv(self, page: int, n_tokens: int):
+        """Demotion fetch: gather one evicted block's KV off its pool
+        page into an INDEPENDENT device array (the page returns to the
+        free list right after and may be re-used by the very allocation
+        that triggered the eviction), start the device→host copy async
+        (the ``copy_to_host_async`` discipline — no sanctioned sync is
+        added to the drive loop), and hand the tier a lazy materializer:
+        by the time the host tier spills/promotes/settles, the copy has
+        long resolved and the fetch is a free host read."""
+        phys = np.full((1, n_tokens), page + 1, np.int32)
+        offs = np.arange(n_tokens, dtype=np.int32)[None, :]
+        demote_kv = read_tokens(self.pool, phys, offs)
+        for v in demote_kv.values():
+            try:
+                v.copy_to_host_async()
+            except Exception:
+                pass  # optional fast path only
+
+        def materialize() -> dict:
+            # graftlint: disable=GL-SYNC -- demotion materializer: resolved lazily at spill/promotion/settle time, long after the async copy started at evict time landed — a free host read, not a drive-loop stall
+            return {k: np.asarray(demote_kv[k]) for k in demote_kv}
+
+        return materialize
+
+    def _promote_tier_blocks(
+        self, slot: int, seq_id: int, ids, matched: int, tier_hits: list
+    ) -> int:
+        """Promote a contiguous run of lower-tier blocks into this
+        admission's freshly reserved pages: host→device ``device_put``
+        + pool scatter per block, dispatched WITHOUT a host sync so the
+        transfers overlap the admission's delta prefill chunks. Each
+        target page is swap-pinned around its scatter (a fault
+        mid-promotion must never leave an in-flight write against a
+        freed page — ``PageAllocator.check_invariants`` enforces it).
+
+        A hit whose entry vanished since lookup (host LRU overflow, a
+        quarantined disk read — the promotion "lost the race") stops
+        the run; the remaining tokens fall back to plain prefill, which
+        is always correct. Returns the promoted token count; the
+        promoted blocks are re-inserted into the radix index so
+        co-admitted opponents share them immediately."""
+        import time
+
+        tiers = self.tiers
+        ps = self.page_size
+        consumed: list = []
+        payloads: list[dict] = []
+        t0 = time.monotonic()
+        for hit in tier_hits:
+            injector.fire("kv_swap", slot)
+            ok, payload = tiers.materialize(hit)
+            if not ok or payload is None:
+                break  # lost the race: prefill recomputes from here
+            consumed.append(hit)
+            payloads.append(payload)
+        if not consumed:
+            return 0
+        done = len(consumed) * ps
+        table = self.allocator.table(seq_id)
+        pages = [
+            table[(matched + i * ps) // ps] for i in range(len(consumed))
+        ]
+        # ONE batched host→device transfer + pool scatter for the whole
+        # promoted run: a per-block write_tokens would copy the full
+        # pool per block on the eager path. Target pages stay
+        # swap-pinned for the duration (a fault mid-scatter must never
+        # leave an in-flight write against a freed page).
+        phys = np.repeat(np.asarray(pages, np.int32) + 1, ps)[None, :]
+        offs = np.tile(np.arange(ps, dtype=np.int32), len(consumed))[None, :]
+        promo_kv = {
+            k: jnp.asarray(np.concatenate([p[k] for p in payloads], axis=3))
+            for k in payloads[0]
+        }
+        pinned: list[int] = []
+        try:
+            for page in pages:
+                self.allocator.swap_pin(page)
+                pinned.append(page)
+            self.pool = write_tokens(
+                self.pool,
+                promo_kv["k"],
+                promo_kv["v"],
+                phys,
+                offs,
+                ks_new=promo_kv.get("ks"),
+                vs_new=promo_kv.get("vs"),
+            )
+        finally:
+            for page in pinned:
+                self.allocator.swap_unpin(page)
+        # Consume BEFORE the radix re-insert: insert's cap enforcement
+        # may LRU-evict tail blocks straight back into the host tier,
+        # and consuming afterwards would pop those freshly re-demoted
+        # entries (emptying the tier the next admission needs).
+        per = (time.monotonic() - t0) / len(consumed)
+        for hit in consumed:
+            tiers.consume(hit, slot=slot, wall_s=per)
+        self.prefix_cache.insert(
+            list(ids[: matched + done]),
+            table[: (matched + done) // ps],
+        )
+        return done
+
     def _start_admission_cached(self, slot: int, req: SchedRequest) -> bool:
         """Prefix-cache admission: adopt the longest cached prefix and
         set up a CANONICAL-layout (pad 0, slot == logical position)
@@ -1282,10 +1412,18 @@ class ContinuousBatcher:
         # record=False: a pool-full deferral retries this whole method
         # every scheduler iteration — stats count once, on success, with
         # the clamped (actually adopted) match.
-        matched, pages = self.prefix_cache.lookup(ids, record=False)
+        if self.tiers is not None:
+            matched, pages, tier_hits = self.prefix_cache.lookup_tiered(
+                ids, record=False
+            )
+        else:
+            matched, pages = self.prefix_cache.lookup(ids, record=False)
+            tier_hits = []
         # Keep at least the last token to prefill (logits source).
-        matched = min(matched, ((S_real - 1) // ps) * ps)
+        limit = ((S_real - 1) // ps) * ps
+        matched = min(matched, limit)
         pages = pages[: matched // ps]
+        tier_hits = tier_hits[: (limit - matched) // ps]
         S = bucket_length(S_real)
         prefill_end = min(-(-S_real // ps) * ps, S)
         tokens_np = np.zeros((1, S), np.int32)
@@ -1302,22 +1440,42 @@ class ContinuousBatcher:
                 (S_real - matched)
                 + (1 if self.speculative else req.max_new_tokens),
             )
+            # Lower-tier blocks continuing the device match promote into
+            # the pages the extend just reserved — async host→device
+            # writes that overlap the delta prefill below; a hit that
+            # lost the race degrades to prefill (chaos seam: kv_swap).
+            promoted = (
+                self._promote_tier_blocks(
+                    slot, seq_id, ids, matched, tier_hits
+                )
+                if tier_hits
+                else 0
+            )
+            total = matched + promoted
             cache = self._commit(
                 init_cache(
                     self.cfg, 1, S, dtype=self._dtype, kv_dtype=self.kv_dtype
                 )
             )
-            if matched:
-                # Materialize the adopted prefix KV into the dense
-                # admission cache so the delta's attention sees it.
-                table = np.asarray(pages, np.int32) + 1  # physical ids
-                slots = np.arange(matched, dtype=np.int32)[None, :]
+            if total:
+                # Materialize the adopted + promoted prefix KV into the
+                # dense admission cache so the delta's attention sees it
+                # (the promoted blocks' scatter was dispatched above;
+                # this gather queues after it — no host sync).
+                table = (
+                    np.asarray(
+                        self.allocator.table(seq_id)[: total // ps],
+                        np.int32,
+                    )
+                    + 1
+                )  # physical ids
+                slots = np.arange(total, dtype=np.int32)[None, :]
                 gathered = read_tokens(
                     self.pool, table[slots // ps], slots % ps
                 )
                 for k in cache:
                     cache[k] = (
-                        cache[k].at[:, :, :, :matched, :].set(gathered[k])
+                        cache[k].at[:, :, :, :total, :].set(gathered[k])
                     )
             self._admission = _Admission(
                 slot=slot,
@@ -1326,11 +1484,11 @@ class ContinuousBatcher:
                 tokens=jnp.asarray(tokens_np),
                 pads=jnp.zeros((1,), jnp.int32),
                 cache=cache,
-                pos=matched,
+                pos=total,
                 S=S,
                 canonical=True,
                 S_real=S_real,
-                matched=matched,
+                matched=total,
                 prefill_end=prefill_end,
             )
         except OutOfPages:
@@ -1341,13 +1499,15 @@ class ContinuousBatcher:
             raise
         self._seq_counter += 1
         self.prefix_cache.stats.record_lookup(matched)
+        if self.tiers is not None:
+            self.tiers.record_lookup(tier_hits)
         obs_mod.emit(
             obs_mod.RequestEvent(
                 req_id=req.req_id,
                 state="admitted",
                 slot=slot,
                 tokens=S_real,
-                cached_tokens=matched,
+                cached_tokens=total,
             )
         )
         return True
@@ -1596,9 +1756,14 @@ class ContinuousBatcher:
                 except Exception as e:
                     # Fault isolation: only this request is affected —
                     # the batch keeps decoding and admission continues
-                    # with the next queued request.
+                    # with the next queued request. Faults that know
+                    # their seam (injected kv_swap mid-promotion) keep
+                    # it; everything else faulted reserving pages.
                     self._fault_request(
-                        self.queue.pop(0), e, "kv_alloc", slot=slot
+                        self.queue.pop(0),
+                        e,
+                        getattr(e, "seam", "kv_alloc") or "kv_alloc",
+                        slot=slot,
                     )
                     continue
                 if not started:
@@ -1881,6 +2046,13 @@ class ContinuousBatcher:
             self._drive_pipelined(timeout_s)
         else:
             self._drive_legacy(timeout_s)
+        if self.tiers is not None:
+            # Drain-end settle: flush queued disk write-through entries
+            # and resolve lazy demotion payloads — every async
+            # device→host copy started this drain has resolved by now,
+            # so this is host work (file I/O + free fetches), never a
+            # serving-path stall.
+            self.tiers.settle()
         out = sorted(self.results, key=lambda r: r.req_id)
         # Drain per-run state: a batcher kept alive across rounds (the
         # prefix cache's raison d'être) must not replay old results.

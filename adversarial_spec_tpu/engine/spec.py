@@ -21,13 +21,18 @@ switchboard both consult, following the established
   place so engines holding a reference keep counting into the same
   object. ``snapshot()`` is the CLI's ``perf.spec`` payload.
 
-Deliberately imports no jax: the mock engine uses it on CPU.
+Deliberately imports no jax: the mock engine uses it on CPU. The
+config/stats mechanics live in ``engine/procconfig.py`` (shared with
+``interleave``, ``prefix_cache``, ``kvtier``); γ's fail-at-the-knob
+validation stays here, passed in as the coercer.
 """
 
 from __future__ import annotations
 
 import os
 from dataclasses import dataclass
+
+from adversarial_spec_tpu.engine import procconfig
 
 DEFAULT_GAMMA = 8
 
@@ -67,7 +72,7 @@ class SpecConfig:
 
 
 @dataclass
-class SpecStats:
+class SpecStats(procconfig.StatsBase):
     """Process-wide speculation counters, aggregated across every
     batcher drain (and the mock engine's deterministic accounting).
 
@@ -109,12 +114,8 @@ class SpecStats:
     def record_rollback(self, pages: int) -> None:
         self.rolled_back_pages += pages
 
-    def reset(self) -> None:
-        for f in self.__dataclass_fields__:
-            setattr(self, f, type(getattr(self, f))())
-
     def snapshot(self) -> dict:
-        out = {f: getattr(self, f) for f in self.__dataclass_fields__}
+        out = self.as_dict()
         out["acceptance_rate"] = (
             round(self.accepted_tokens / self.drafted_tokens, 4)
             if self.drafted_tokens
@@ -128,31 +129,29 @@ class SpecStats:
         return out
 
 
-_config = SpecConfig(enabled=env_enabled(), gamma=env_gamma())
-stats = SpecStats()
+_state = procconfig.ProcState(
+    SpecConfig(enabled=env_enabled(), gamma=env_gamma()),
+    SpecStats(),
+    coerce={"gamma": lambda g: _validate_gamma(int(g))},
+)
+_config = _state.config
+stats = _state.stats
 
 
 def config() -> SpecConfig:
-    return _config
+    return _state.config
 
 
 def configure(
     enabled: bool | None = None, gamma: int | None = None
 ) -> SpecConfig:
-    if enabled is not None:
-        _config.enabled = bool(enabled)
-    if gamma is not None:
-        _config.gamma = _validate_gamma(int(gamma))
-    return _config
+    return _state.configure(enabled=enabled, gamma=gamma)
 
 
 def reset_stats() -> None:
-    stats.reset()
+    _state.reset_stats()
 
 
 def snapshot() -> dict:
     """Stats + config, the ``perf.spec`` payload."""
-    out = stats.snapshot()
-    out["enabled"] = _config.enabled
-    out["gamma"] = _config.gamma
-    return out
+    return _state.snapshot()

@@ -38,6 +38,7 @@ import numpy as np
 from adversarial_spec_tpu import obs as obs_mod
 from adversarial_spec_tpu.debate.usage import Usage
 from adversarial_spec_tpu.engine import interleave as interleave_mod
+from adversarial_spec_tpu.engine import kvtier as kvtier_mod
 from adversarial_spec_tpu.engine import prefix_cache as prefix_mod
 from adversarial_spec_tpu.engine import registry as registry_mod
 from adversarial_spec_tpu.engine import spec as spec_mod
@@ -728,6 +729,13 @@ class TpuEngine:
             # loop (--no-interleave) or the pipeline depth per round.
             interleave_mod.config().enabled,
             interleave_mod.config().pipeline_depth,
+            # Tiered-KV knobs likewise: flipping --no-kv-tier, the host
+            # budget, or the store dir between rounds must rebuild the
+            # tiers (and re-fingerprint the store) rather than keep
+            # serving under the old config.
+            kvtier_mod.config().enabled,
+            kvtier_mod.config().host_mb,
+            kvtier_mod.config().store_dir,
         )
         t0 = time.monotonic()
         try:

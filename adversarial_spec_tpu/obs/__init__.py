@@ -39,6 +39,7 @@ from adversarial_spec_tpu.obs.events import (  # noqa: F401 (re-export)
     RequestEvent,
     SpecEvent,
     StepEvent,
+    SwapEvent,
     validate_event,
 )
 from adversarial_spec_tpu.obs.metrics import (  # noqa: F401 (re-export)
@@ -125,6 +126,8 @@ class HotMetrics:
         "_sync",
         "_fault",
         "_breaker",
+        "_tier_hit",
+        "_swap",
     )
 
     def __init__(self, m: MetricsRegistry) -> None:
@@ -187,6 +190,8 @@ class HotMetrics:
         self._sync: dict = {}
         self._fault: dict = {}
         self._breaker: dict = {}
+        self._tier_hit: dict = {}
+        self._swap: dict = {}
 
     def sync(self, reason: str):
         c = self._sync.get(reason)
@@ -218,6 +223,29 @@ class HotMetrics:
                 to=to,
             )
         return c
+
+    def tier_hit_ratio(self, tier: str):
+        """Per-tier KV hit-ratio gauge (engine/kvtier.py lookups)."""
+        g = self._tier_hit.get(tier)
+        if g is None:
+            g = self._tier_hit[tier] = self._m.gauge(
+                "advspec_kv_tier_hit_ratio",
+                help="tiered-KV lookup hit ratio by tier (this round)",
+                tier=tier,
+            )
+        return g
+
+    def swap_latency(self, direction: str):
+        """KV swap wall histogram by direction (in: promote/rehydrate
+        toward the device; out: demote/spill/store away from it)."""
+        h = self._swap.get(direction)
+        if h is None:
+            h = self._swap[direction] = self._m.histogram(
+                "advspec_kv_swap_seconds",
+                help="KV tier swap wall by direction",
+                direction=direction,
+            )
+        return h
 
 
 hot = HotMetrics(metrics)

@@ -29,6 +29,8 @@ Event vocabulary (the schema ``tools/obs_dump.py`` validates):
 - ``CacheEvent`` — prefix-cache lookup / insert / evict.
 - ``CompileEvent`` — the retrace watch saw a jit compile.
 - ``SpecEvent`` — one row's speculative draft/verify outcome.
+- ``SwapEvent`` — one KV-tier transition (demote/promote/rehydrate/
+  spill/store/free/quarantine) with post-op per-tier residency.
 """
 
 from __future__ import annotations
@@ -118,6 +120,26 @@ class SpecEvent:
     rolled_back_pages: int = 0
 
 
+@dataclass(slots=True)
+class SwapEvent:
+    """One KV-tier state transition (engine/kvtier.py). ``op`` names
+    the edge of the tier state machine (demote: device→host; promote:
+    host→device; rehydrate: disk→device; spill: host LRU→disk; store:
+    insert write-through→disk; free: host LRU drop; quarantine: corrupt
+    disk entry moved aside). ``host_resident``/``disk_resident`` are
+    the per-tier block counts AFTER the op — tools/obs_dump.py's
+    occupancy timeline reads tier residency off these."""
+
+    TYPE = "swap"
+    op: str = "demote"
+    tier: str = "host"  # tier the op targets
+    blocks: int = 0
+    tokens: int = 0
+    slot: int = -1  # admission slot driving the swap (-1: none)
+    host_resident: int = 0
+    disk_resident: int = 0
+
+
 EVENT_TYPES = (
     StepEvent,
     RequestEvent,
@@ -126,6 +148,17 @@ EVENT_TYPES = (
     CacheEvent,
     CompileEvent,
     SpecEvent,
+    SwapEvent,
+)
+
+SWAP_OPS = (
+    "demote",
+    "promote",
+    "rehydrate",
+    "spill",
+    "store",
+    "free",
+    "quarantine",
 )
 
 REQUEST_STATES = (
@@ -197,6 +230,8 @@ def validate_event(obj) -> list[str]:
             errors.append(f"{etype}: unknown field {name!r}")
     if etype == "request" and obj.get("state") not in REQUEST_STATES:
         errors.append(f"request: unknown state {obj.get('state')!r}")
+    if etype == "swap" and obj.get("op") not in SWAP_OPS:
+        errors.append(f"swap: unknown op {obj.get('op')!r}")
     return errors
 
 

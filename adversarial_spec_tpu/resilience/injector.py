@@ -12,6 +12,8 @@ seam                fires just before
 ``generate``        a model group's decode dispatch (engine/tpu.py)
 ``scheduler_chunk`` each ContinuousBatcher decode chunk
 ``kv_alloc``        page reservation at admission (engine/scheduler.py)
+``kv_swap``         each tier-block promotion into an admission's pages
+                    (engine/scheduler.py — the tiered-KV swap path)
 ``checkpoint_load`` parameter materialization (engine/tpu.py)
 ==================  =====================================================
 
@@ -44,7 +46,13 @@ from dataclasses import dataclass, field
 
 from adversarial_spec_tpu.resilience.faults import FaultKind
 
-SEAMS = ("generate", "scheduler_chunk", "kv_alloc", "checkpoint_load")
+SEAMS = (
+    "generate",
+    "scheduler_chunk",
+    "kv_alloc",
+    "kv_swap",
+    "checkpoint_load",
+)
 
 # Marker text per kind: mirrors what PJRT/XLA put in real messages so the
 # textual classify() path agrees with the attribute path.

@@ -46,6 +46,11 @@ Modes:
                                   # acceptance) + real-batcher spec-on
                                   # vs spec-off walls with identical
                                   # greedy tokens; writes BENCH_spec.json
+  python bench.py --mode tier     # tiered KV cache: restart-rehydration
+                                  # (disk store) + pressure-thrash
+                                  # (host tier) workloads on the CPU
+                                  # mock, plus a real-batcher parity/
+                                  # retrace phase; writes BENCH_tier.json
   --no-interleave                 # escape hatch for any batcher-driven
                                   # mode: run the legacy serialized loop
                                   # (equivalent to ADVSPEC_INTERLEAVE=0)
@@ -785,6 +790,258 @@ def _run_spec(platform: str) -> dict:
     }
 
 
+def _run_tier(platform: str) -> dict:
+    """Tiered-KV bench (engine/kvtier.py), three phases:
+
+    1. RESTART REHYDRATION (mock, deterministic): a 5-round growing-spec
+       session with the disk store armed, "restarted" after round 2 (a
+       FRESH engine — new allocator, radix index, host tier — sharing
+       only the store directory). The restarted process's rounds are
+       the session's rounds 2+; the headline is the fraction of their
+       prefill tokens the store rehydrates vs a tier-off restart, with
+       byte-identical transcripts both ways.
+    2. PRESSURE THRASH (mock, deterministic): the radix index capped
+       far below the document's block count, so every insert LRU-evicts
+       the tail. Tier-off re-prefills the evicted tail every round;
+       tier-on promotes it back from host RAM. Reported as the fraction
+       of tier-off's rounds-2+ re-prefill the host tier avoids.
+    3. REAL BATCHER (llama tiny on CPU / 1b on TPU): the same two
+       stories through the paged serving path — demote/promote under a
+       page cap and restart-rehydration through a store dir — with
+       byte-identical greedy tokens tier-on vs tier-off, allocator +
+       tier invariants checked after every drain, and the retrace
+       watch's verdict that tiering added zero unexpected recompiles.
+    """
+    import re
+    import shutil
+
+    from adversarial_spec_tpu import obs
+    from adversarial_spec_tpu.engine import kvtier as kvtier_mod
+    from adversarial_spec_tpu.engine import prefix_cache as prefix_mod
+    from adversarial_spec_tpu.engine.mock import MockEngine
+    from adversarial_spec_tpu.engine.types import ChatRequest, SamplingParams
+
+    n_opp = 2
+    base_doc = (
+        "The allocator SHALL bound page reuse by refcount. "
+        "Demoted blocks MUST reach exactly one terminal state. "
+        "Rehydrated prefixes MUST be byte-identical to recomputation. "
+    ) * 64  # ~10.6 KB -> ~2600 mock tokens, ~165 blocks
+
+    def mock_session(
+        tier_on: bool,
+        store_dir: str,
+        restart_after: int,
+        n_rounds: int,
+        cap_pages: int = 0,
+    ):
+        """Drive a growing-spec session; returns (texts, per-round
+        prefilled tokens, tier snapshot). ``restart_after=k`` swaps in a
+        FRESH MockEngine after round k (the restart); per-round prefill
+        is measured as deltas on the process-wide prefix stats."""
+        kvtier_mod.configure(
+            enabled=tier_on, host_mb=64, store_dir=store_dir
+        )
+        prefix_mod.configure(enabled=True, max_pages=cap_pages)
+        prefix_mod.reset_stats()
+        kvtier_mod.reset_stats()
+        eng = MockEngine()
+        doc = base_doc
+        texts, per_round = [], []
+        for rnd in range(1, n_rounds + 1):
+            if restart_after and rnd == restart_after + 1:
+                eng = MockEngine()  # the restart: only the store survives
+            before = prefix_mod.stats.prefilled_tokens
+            reqs = [
+                ChatRequest(
+                    model="mock://critic",
+                    system="You are an adversarial spec critic.",
+                    # PREFIX-STABLE ordering (the PR 2 template rule):
+                    # document first, round header trailing — required
+                    # for cross-round (and cross-restart) chain hits.
+                    user=(
+                        f"--- DOCUMENT ---\n{doc}\n--- END DOCUMENT ---\n"
+                        f"Debate round {rnd}"
+                    ),
+                )
+                for _ in range(n_opp)
+            ]
+            outs = eng.chat(reqs, SamplingParams())
+            texts.append([c.text for c in outs])
+            per_round.append(
+                prefix_mod.stats.prefilled_tokens - before
+            )
+            m = re.search(r"\[SPEC\]\n(.*)\n\[/SPEC\]", outs[0].text, re.S)
+            doc = m.group(1) if m else doc
+        return texts, per_round, kvtier_mod.stats.snapshot()
+
+    # --- 1. restart rehydration (disk store). ------------------------
+    store = tempfile.mkdtemp(prefix="bench_tier_store_")
+    restart_after, n_rounds = 2, 5
+    on_texts, on_rounds, on_snap = mock_session(
+        True, store, restart_after, n_rounds
+    )
+    off_texts, off_rounds, _ = mock_session(
+        False, "", restart_after, n_rounds
+    )
+    tail_on = sum(on_rounds[restart_after:])
+    tail_off = sum(off_rounds[restart_after:])
+    rehydrated_fraction = 1.0 - tail_on / max(tail_off, 1)
+    shutil.rmtree(store, ignore_errors=True)
+
+    # --- 2. pressure thrash (host tier). -----------------------------
+    cap = 64  # far under the document's block count: every insert evicts
+    p_on_texts, p_on_rounds, p_snap = mock_session(True, "", 0, 4, cap)
+    p_off_texts, p_off_rounds, _ = mock_session(False, "", 0, 4, cap)
+    thrash_on = sum(p_on_rounds[1:])
+    thrash_off = sum(p_off_rounds[1:])
+    pressure_saving = 1.0 - thrash_on / max(thrash_off, 1)
+
+    # --- 3. real batcher: parity + invariants + retrace. --------------
+    from adversarial_spec_tpu.utils.jaxenv import configure_jax
+
+    configure_jax()
+    import random
+
+    import jax
+    import jax.numpy as jnp
+
+    from adversarial_spec_tpu.engine import spec as spec_mod
+    from adversarial_spec_tpu.engine.scheduler import (
+        ContinuousBatcher,
+        SchedRequest,
+    )
+    from adversarial_spec_tpu.models import transformer as T
+    from adversarial_spec_tpu.models.config import get_config
+
+    size = "1b" if platform != "cpu" else "tiny"
+    cfg = get_config("llama", size)
+    params = T.init_params(
+        jax.random.key(0),
+        cfg,
+        dtype=jnp.bfloat16 if platform != "cpu" else jnp.float32,
+    )
+    base_len, delta_len, max_new, b_rounds = (
+        (1024, 128, 48, 2) if platform != "cpu" else (512, 64, 16, 2)
+    )
+    spec_mod.configure(enabled=False)  # isolate the tier effect
+
+    def batcher_rounds(tier_on: bool, cap_pages: int, store_dir: str):
+        kvtier_mod.configure(
+            enabled=tier_on, host_mb=64, store_dir=store_dir
+        )
+        prefix_mod.configure(enabled=True, max_pages=cap_pages)
+        prefix_mod.reset_stats()
+        kvtier_mod.reset_stats()
+        obs.configure(enabled=True)
+        obs.reset_stats()
+        rng = random.Random(1)
+        seg = [rng.randrange(3, cfg.vocab_size) for _ in range(16)]
+        doc = (seg * (base_len // len(seg) + 1))[:base_len]
+        b = ContinuousBatcher(
+            params,
+            cfg,
+            max_batch=n_opp,
+            max_new_cap=max_new,
+            page_size=64,
+            capacity_tokens=1 << 15,
+            greedy=True,
+        )
+        toks, per_round = [], []
+        t0 = time.monotonic()
+        for _ in range(b_rounds):
+            before = prefix_mod.stats.prefilled_tokens
+            for i in range(n_opp):
+                b.submit(
+                    SchedRequest(
+                        req_id=i,
+                        prompt_ids=list(doc),
+                        max_new_tokens=max_new,
+                    )
+                )
+            results = b.run_all()
+            toks.append([r.tokens.tolist() for r in results])
+            per_round.append(prefix_mod.stats.prefilled_tokens - before)
+            doc = doc + [
+                rng.randrange(3, cfg.vocab_size) for _ in range(delta_len)
+            ]
+            b.allocator.check_invariants()
+            if b.tiers is not None:
+                b.tiers.check_invariants()
+        wall = time.monotonic() - t0
+        return (
+            toks,
+            per_round,
+            wall,
+            kvtier_mod.stats.snapshot(),
+            obs.snapshot(),
+        )
+
+    # Pressure story (page cap forces demote/promote mid-session).
+    bt_on, bp_on, bw_on, bsnap_on, bobs_on = batcher_rounds(True, 4, "")
+    bt_off, bp_off, bw_off, _, _ = batcher_rounds(False, 4, "")
+    # Restart story: batcher A populates the store; a FRESH batcher B
+    # (same store) rehydrates; the tier-off fresh batcher is cold.
+    bstore = tempfile.mkdtemp(prefix="bench_tier_bstore_")
+    batcher_rounds(True, 0, bstore)
+    rt_warm, rp_warm, _, rsnap, robs = batcher_rounds(True, 0, bstore)
+    rt_cold, rp_cold, _, _, _ = batcher_rounds(False, 0, "")
+    shutil.rmtree(bstore, ignore_errors=True)
+
+    return {
+        "metric": "tier_restart_rehydrated_fraction",
+        # Fraction of the restarted process's rounds-2+ prefill tokens
+        # served from the disk store (vs a tier-off restart).
+        "value": round(rehydrated_fraction, 4),
+        "unit": "fraction of rounds-2+ prefill tokens rehydrated after "
+        "restart (mock)",
+        "vs_baseline": None,  # no published tiering baseline
+        "platform": platform,
+        "model": f"llama-{size}",
+        "opponents": n_opp,
+        "restart": {
+            "rounds": n_rounds,
+            "restart_after_round": restart_after,
+            "rehydrated_fraction": round(rehydrated_fraction, 4),
+            "prefill_per_round_tier_on": on_rounds,
+            "prefill_per_round_tier_off": off_rounds,
+            "rehydrated_tokens": on_snap["rehydrated_tokens"],
+            "disk_hit_rate": on_snap["disk_hit_rate"],
+            "store_writes": on_snap["store_writes"],
+            "transcripts_identical": on_texts == off_texts,
+        },
+        "pressure": {
+            "rounds": 4,
+            "prefix_cache_page_cap": cap,
+            "reprefill_avoided_fraction": round(pressure_saving, 4),
+            "prefill_per_round_tier_on": p_on_rounds,
+            "prefill_per_round_tier_off": p_off_rounds,
+            "promoted_tokens": p_snap["promoted_tokens"],
+            "demoted_tokens": p_snap["demoted_tokens"],
+            "host_hit_rate": p_snap["host_hit_rate"],
+            "transcripts_identical": p_on_texts == p_off_texts,
+        },
+        "batcher": {
+            "rounds": b_rounds,
+            "pressure_tokens_identical": bt_on == bt_off,
+            "pressure_prefill_tier_on": bp_on,
+            "pressure_prefill_tier_off": bp_off,
+            "pressure_promoted_tokens": bsnap_on["promoted_tokens"],
+            "wall_s_tier_on": round(bw_on, 3),
+            "wall_s_tier_off": round(bw_off, 3),
+            "restart_tokens_identical": rt_warm == rt_cold,
+            "restart_prefill_warm": rp_warm,
+            "restart_prefill_cold": rp_cold,
+            "restart_rehydrated_tokens": rsnap["rehydrated_tokens"],
+            "unexpected_recompiles": (
+                bobs_on["retrace"]["unexpected_recompiles"]
+                + robs["retrace"]["unexpected_recompiles"]
+            ),
+        },
+        "escape_hatch": "--no-kv-tier / ADVSPEC_KV_TIER=0",
+    }
+
+
 def _run_obs_overhead(platform: str) -> dict:
     """Observability overhead bench: what fraction of the mock mixed
     workload's wall the recorder+metrics emit path costs. Budget < 3%
@@ -1040,6 +1297,7 @@ def main() -> int:
     interleave_mode = _mode("interleave")
     obs_mode = _mode("obs-overhead")
     spec_mode = _mode("spec")
+    tier_mode = _mode("tier")
     if "--no-speculative" in args:
         # Escape hatch mirror of --no-interleave: batcher-driven modes
         # (and any TPU child) decode token-at-a-time.
@@ -1059,6 +1317,8 @@ def main() -> int:
         mode_flag, runner = "--obs-overhead", _run_obs_overhead
     elif spec_mode:
         mode_flag, runner = "--spec", _run_spec
+    elif tier_mode:
+        mode_flag, runner = "--tier", _run_tier
     else:
         mode_flag, runner = "", _run_bench
 
@@ -1092,7 +1352,7 @@ def main() -> int:
                     "(tunnel hang or compile error); CPU fallback"
                 ),
             )
-    if prefix_mode or interleave_mode or obs_mode or spec_mode:
+    if prefix_mode or interleave_mode or obs_mode or spec_mode or tier_mode:
         # Persist the perf trajectory point alongside the BENCH_r*
         # series the driver records.
         name = (
@@ -1103,6 +1363,8 @@ def main() -> int:
             else "BENCH_obs.json"
             if obs_mode
             else "BENCH_spec.json"
+            if spec_mode
+            else "BENCH_tier.json"
         )
         out = os.path.join(
             os.path.dirname(os.path.abspath(__file__)), name

@@ -82,6 +82,23 @@ def _isolate_state(tmp_path, monkeypatch):
 
     prefix_cache.configure(enabled=True, max_pages=0)
     prefix_cache.reset_stats()
+    # Tiered-KV config/stats are process-global by design (the tiers
+    # live on persistent batchers); tests must not leak a store dir,
+    # a host budget, or swap counts into each other. Tiering is pinned
+    # OFF suite-wide (the PR 6 speculation-off precedent: per-insert
+    # chain hashing and per-eviction demotion gathers in every batcher/
+    # mock test are pure wall cost when the subject is orthogonal —
+    # tier coverage of the same paths lives in tests/test_kv_tier.py,
+    # which opts in explicitly, as do CLI tests of the env default).
+    from adversarial_spec_tpu.engine import kvtier
+
+    monkeypatch.setenv("ADVSPEC_KV_TIER", "0")
+    monkeypatch.delenv("ADVSPEC_KV_HOST_MB", raising=False)
+    monkeypatch.delenv("ADVSPEC_KV_STORE_DIR", raising=False)
+    kvtier.configure(
+        enabled=False, host_mb=kvtier.DEFAULT_HOST_MB, store_dir=""
+    )
+    kvtier.reset_stats()
     # Observability state is process-global by design (the recorder and
     # metric handles outlive a round); tests must not leak an armed
     # events_out path, a shrunken ring, or recorded events.
@@ -105,6 +122,10 @@ def _isolate_state(tmp_path, monkeypatch):
     breaker.reset_default_registry()
     prefix_cache.configure(enabled=True, max_pages=0)
     prefix_cache.reset_stats()
+    kvtier.configure(
+        enabled=False, host_mb=kvtier.DEFAULT_HOST_MB, store_dir=""
+    )
+    kvtier.reset_stats()
     obs.configure(
         enabled=True,
         recorder_size=obs.DEFAULT_RECORDER_SIZE,

@@ -105,6 +105,28 @@ def test_phase_b_env_child_smoke(tmp_path):
     assert steps["gamma4"]["env"] == {"ADVSPEC_GAMMA": "4"}
 
 
+@pytest.mark.slow
+def test_tier_child_smoke(tmp_path):
+    """Phase C (tiered KV): the child must record the restart-
+    rehydration step and every pool-sweep row with the tier telemetry
+    the crossover report renders."""
+    import tpu_ladder
+
+    out = tmp_path / "smoke.jsonl"
+    proc = _run_child(["--child-tier", str(out)], out)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    steps = load(str(out), include_smoke=True)
+    for required in tpu_ladder.TIER_STEPS:
+        assert required in steps, (required, sorted(steps))
+    tr = steps["tier_restart"]
+    assert tr["rehydrated_fraction"] > 0
+    assert tr["rehydrated_tokens"] > 0
+    for p in tpu_ladder.TIER_POOL_TOKENS:
+        row = steps[f"tier_pool{p}"]
+        assert row["decode_tok_s"] > 0
+        assert row["pool_tokens"] > 0
+
+
 def test_batcher_spec_child_smoke(tmp_path):
     """Phase B' (batcher γ sweep): the child must drain the bench-shaped
     pool through the ContinuousBatcher under the env γ and record the
@@ -163,6 +185,7 @@ class TestOrchestrator:
                 "phase_a_complete",
                 *tpu_ladder.ENV_STEPS,
                 *tpu_ladder.BATCHER_SPEC_STEPS,
+                *tpu_ladder.TIER_STEPS,
             ],
         )
         monkeypatch.setattr(bench, "_probe_tpu", lambda **kw: True)
@@ -183,6 +206,7 @@ class TestOrchestrator:
             for s in (
                 list(tpu_ladder.ENV_STEPS)
                 + list(tpu_ladder.BATCHER_SPEC_STEPS)
+                + list(tpu_ladder.TIER_STEPS)
             )
             if s != "gamma16"
         ]
@@ -196,8 +220,17 @@ class TestOrchestrator:
                     "--child-env"
                     if "--child-env" in cmd
                     else "--child-batcher-spec"
+                    if "--child-batcher-spec" in cmd
+                    else "--child-tier"
                 )
                 i = cmd.index(flag)
+                if flag == "--child-tier":
+                    # The tier child records every remaining tier step.
+                    launched.append("tier")
+                    with open(cmd[i + 1], "a") as f:
+                        for s in tpu_ladder.TIER_STEPS:
+                            f.write(json.dumps({"step": s}) + "\n")
+                    return
                 step = cmd[i + 2]
                 launched.append(step)
                 with open(cmd[i + 1], "a") as f:

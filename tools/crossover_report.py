@@ -166,6 +166,9 @@ def main() -> int:
             and k != "north_star"
             and not k.startswith("crossover_T")
             and not k.startswith("config2")
+            # Tier rows print in their own table below (their workload
+            # shape differs; a %-vs-north_star figure would mislead).
+            and not k.startswith("tier_")
             and k != "profile_trace"
         )
         for name in lever_names:
@@ -208,6 +211,42 @@ def main() -> int:
             else:
                 print(f"  → best: {best} ({best_tok / off - 1:+.1%} vs "
                       "spec-off)")
+    # Phase C: tiered KV — restart rehydration and the host-tier hit
+    # ratio vs pool size (the pressure story engine/kvtier.py exists
+    # for). These rows have no decode_tok_s baseline comparison; the
+    # judgment is prefill avoided.
+    tier_rows = sorted(
+        (k for k in steps if k.startswith("tier_pool")),
+        key=lambda k: steps[k].get("pool_tokens", 0),
+    )
+    if tier_rows:
+        print("\ntier sweep        pool tok  host hit  promoted tok  tok/s")
+        for name in tier_rows:
+            row = steps[name]
+            print(
+                f"  {name:<15} {row.get('pool_tokens', '?'):<9} "
+                f"{row.get('host_hit_ratio', '?'):<9} "
+                f"{row.get('promoted_tokens', '?'):<13} "
+                f"{row.get('decode_tok_s', '?')}"
+            )
+        hot = [
+            n for n in tier_rows if (steps[n].get("host_hit_ratio") or 0) > 0
+        ]
+        if hot:
+            print(
+                "  → host tier absorbing re-prefill up to pool "
+                f"{max(steps[n].get('pool_tokens', 0) for n in hot)} tok"
+            )
+    tr_row = steps.get("tier_restart")
+    if tr_row:
+        print(
+            "tier_restart: "
+            f"{tr_row.get('rehydrated_fraction', '?')} of restart prefill "
+            f"rehydrated from the store "
+            f"({tr_row.get('rehydrated_tokens', '?')} tok; "
+            f"cold {tr_row.get('wall_cold_s', '?')}s → warm "
+            f"{tr_row.get('wall_warm_s', '?')}s)"
+        )
     lc = steps.get("long_context_16k", {}).get("prefill_tok_s")
     if lc:
         print(f"long_context_16k prefill: {lc} tok/s")

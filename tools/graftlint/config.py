@@ -74,12 +74,19 @@ class GraftlintConfig:
         ]
     )
     # Bare local names that hold device values in the sync class.
+    # demote_kv / promo_kv are the tiered-KV swap arrays (the demotion
+    # gather handle and the promotion device_put — engine/kvtier.py):
+    # fetching either inside the drive loop is a host sync, so the
+    # promotion queue's fetch sites are tainted like any other device
+    # value and sanctioned fetches carry reasoned inline disables.
     sync_device_names: list[str] = field(
         default_factory=lambda: [
             "first",
             "active_ref",
             "adm_logits",
             "spec_counts",
+            "demote_kv",
+            "promo_kv",
         ]
     )
     # --- GL-TRACE ----------------------------------------------------
@@ -133,11 +140,17 @@ class GraftlintConfig:
             "adversarial_spec_tpu.engine.mock",
         ]
     )
+    # swap_pin marks a page as the target of an in-flight tier swap
+    # (host->device promotion scatter): a raise between pin and unpin
+    # would leave the allocator convinced a swap is forever in flight
+    # (and _release refusing to free the page) — the demote/promote
+    # release-path discipline, statically enforced.
     refcount_pairs: list[str] = field(
         default_factory=lambda: [
             "new_sequence=free_sequence",
             "adopt=free_sequence",
             "cache_ref=cache_unref",
+            "swap_pin=swap_unpin",
         ]
     )
 

@@ -68,6 +68,16 @@ def summarize(events: list[dict]) -> str:
             f"{f['pages_freed']} page(s) freed, "
             f"{'requeued' if f['requeued'] else 'evicted'})"
         )
+    quarantined = [
+        e
+        for e in events
+        if e["type"] == "swap" and e["op"] == "quarantine"
+    ]
+    if quarantined:
+        lines.append(
+            f"  WARNING: {len(quarantined)} corrupt KV store entr"
+            f"{'y' if len(quarantined) == 1 else 'ies'} quarantined"
+        )
     compiles = [e for e in events if e["type"] == "compile"]
     unexpected = [c for c in compiles if c["unexpected"]]
     if unexpected:
@@ -82,14 +92,35 @@ def occupancy_timeline(events: list[dict], width: int = 16) -> str:
     """Per-step occupancy bars: one row per StepEvent, slot occupancy as
     a bar, the step kind as the glyph, annotations for the riding
     admission / sync reason — the step-by-step 'what was the batcher
-    doing' view."""
-    steps = [e for e in events if e["type"] == "step"]
-    if not steps:
+    doing' view. When the dump carries SwapEvents (tiered KV,
+    engine/kvtier.py) each step row is additionally annotated with the
+    per-tier residency as of that step (host/disk block counts trail
+    the most recent swap), and the swaps themselves print inline."""
+    steps = [
+        e for e in events if e["type"] in ("step", "swap")
+    ]
+    if not any(e["type"] == "step" for e in steps):
         return "(no step events)"
-    max_live = max(max(s["n_live"] for s in steps), 1)
+    max_live = max(
+        max(s["n_live"] for s in steps if s["type"] == "step"), 1
+    )
     scale = max(max_live, 1)
+    tiered = any(e["type"] == "swap" for e in steps)
     rows = []
+    host_res = disk_res = 0
     for s in steps:
+        if s["type"] == "swap":
+            host_res, disk_res = s["host_resident"], s["disk_resident"]
+            notes = [f"{s['blocks']} block(s)", f"{s['tokens']}tok"]
+            if s["slot"] >= 0:
+                notes.append(f"slot={s['slot']}")
+            notes.append(f"host={host_res}")
+            notes.append(f"disk={disk_res}")
+            rows.append(
+                f"seq {s['seq']:>6} [{'~' * width}] "
+                f"{s['op'] + '>' + s['tier']:<8} " + " ".join(notes)
+            )
+            continue
         glyph = _STEP_GLYPH.get(s["kind"], "?")
         filled = round(s["n_live"] / scale * width)
         bar = glyph * filled + "-" * (width - filled)
@@ -102,12 +133,18 @@ def occupancy_timeline(events: list[dict], width: int = 16) -> str:
             notes.append(f"depth={s['pipeline_depth']}")
         if s["sync_reason"]:
             notes.append(f"sync={s['sync_reason']}")
+        if tiered:
+            notes.append(f"host={host_res}")
+            notes.append(f"disk={disk_res}")
         rows.append(
             f"seq {s['seq']:>6} [{bar}] {s['kind']:<8} " + " ".join(notes)
         )
+    n_steps = sum(1 for s in steps if s["type"] == "step")
     legend = (
-        f"occupancy timeline ({len(steps)} step(s), max live {max_live}; "
-        "#=fused ==decode .=prefill)"
+        f"occupancy timeline ({n_steps} step(s), max live {max_live}; "
+        "#=fused ==decode .=prefill"
+        + ("; ~=tier swap, host/disk=resident blocks" if tiered else "")
+        + ")"
     )
     return "\n".join([legend] + rows)
 

@@ -42,6 +42,16 @@ Phase B' (batcher γ sweep — the paged serving path):
   tokens-per-verify-step + acceptance — the on-chip crossover the
   γ=8 default (engine/spec.py) is judged by.
 
+Phase C (tiered KV — engine/kvtier.py, one child for all steps):
+  tier_restart: restart rehydration through the real batcher — a
+  fresh batcher re-serving a session from a COLD store vs a WARM one
+  (the store the first batcher wrote through), recording the
+  rehydrated prefill fraction.
+  tier_pool{N}: host-tier hit ratio vs page-pool size — the pool
+  shrinks below the working set, LRU eviction demotes, and the next
+  round's admissions promote; the crossover_report row that judges
+  how much host RAM buys at each pool size.
+
 ADVSPEC_LADDER_SMOKE=1 dry-runs the whole ladder code path on CPU with
 tiny shapes (tests/test_ladder.py); smoke rows are stamped
 ``"smoke": true`` and excluded from resumability and from every tuning
@@ -52,6 +62,7 @@ Usage:
   python tpu_ladder.py --child-main OUT                    # internal
   python tpu_ladder.py --child-env OUT STEP                # internal
   python tpu_ladder.py --child-batcher-spec OUT STEP       # internal
+  python tpu_ladder.py --child-tier OUT                    # internal
 """
 
 from __future__ import annotations
@@ -529,6 +540,157 @@ def _child_batcher_spec(out_path: str, step: str) -> int:
     return 0
 
 
+# Phase C (tiered KV): page-pool sizes for the host-tier hit-ratio
+# sweep. The bench pool (4 opponents x (1024 prompt + 256 decode)) needs
+# ~5120 resident tokens; the smaller entries force LRU pressure, so the
+# sweep maps "how much re-prefill does host RAM absorb" against pool
+# size. Step names are stable across smoke/real runs (smoke scales the
+# shapes, and smoke rows are excluded from consumers anyway).
+TIER_POOL_TOKENS = (4096, 8192, 16384)
+TIER_STEPS = ("tier_restart",) + tuple(
+    f"tier_pool{p}" for p in TIER_POOL_TOKENS
+)
+
+
+def _child_tier(out_path: str) -> int:
+    """Phase C: tiered-KV measurements through the real batcher, one
+    warm child for every step (shared model + compile cache)."""
+    import shutil
+    import tempfile
+
+    from adversarial_spec_tpu.utils.jaxenv import configure_jax
+
+    configure_jax()
+    import jax
+    import jax.numpy as jnp
+
+    from adversarial_spec_tpu.engine import kvtier as kvtier_mod
+    from adversarial_spec_tpu.engine import prefix_cache as prefix_mod
+    from adversarial_spec_tpu.engine import spec as spec_mod
+    from adversarial_spec_tpu.engine.scheduler import (
+        ContinuousBatcher,
+        SchedRequest,
+    )
+    from adversarial_spec_tpu.models import transformer as T
+    from adversarial_spec_tpu.models.config import get_config
+
+    smoke = _smoke()
+    if jax.devices()[0].platform == "cpu" and not smoke:
+        _append(out_path, {"step": "tier_abort_cpu"})
+        return 1
+    if smoke:
+        cfg = get_config("llama", "tiny")
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        n_prompt, n_decode, scale = SMOKE_PROMPT * 8, SMOKE_DECODE, 16
+    else:
+        cfg = get_config("llama", "1b")
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.bfloat16)
+        n_prompt, n_decode, scale = BENCH_PROMPT, BENCH_DECODE, 1
+    done = _done_steps(out_path)
+    spec_mod.configure(enabled=False)  # isolate the tier effect
+    rng = __import__("random").Random(0)
+    seg = [rng.randrange(3, cfg.vocab_size) for _ in range(16)]
+    base = (seg * (n_prompt // len(seg) + 1))[:n_prompt]
+
+    def rounds(tier_on, capacity, store_dir, n_rounds=2):
+        kvtier_mod.configure(
+            enabled=tier_on, host_mb=256, store_dir=store_dir
+        )
+        prefix_mod.configure(enabled=True, max_pages=0)
+        prefix_mod.reset_stats()
+        kvtier_mod.reset_stats()
+        b = ContinuousBatcher(
+            params,
+            cfg,
+            max_batch=BENCH_B,
+            max_new_cap=n_decode,
+            page_size=64,
+            capacity_tokens=capacity,
+            greedy=True,
+        )
+        doc = list(base)
+        per_round, toks = [], 0
+        t0 = time.monotonic()
+        for _ in range(n_rounds):
+            before = prefix_mod.stats.prefilled_tokens
+            for i in range(BENCH_B):
+                b.submit(
+                    SchedRequest(
+                        req_id=i,
+                        prompt_ids=list(doc),
+                        max_new_tokens=n_decode,
+                    )
+                )
+            results = b.run_all()
+            toks += sum(r.n_generated for r in results)
+            per_round.append(prefix_mod.stats.prefilled_tokens - before)
+            doc = doc + [
+                rng.randrange(3, cfg.vocab_size)
+                for _ in range(max(n_decode, 16))
+            ]
+        wall = time.monotonic() - t0
+        return (
+            per_round,
+            toks,
+            wall,
+            b.decode_time_s,
+            kvtier_mod.stats.snapshot(),
+        )
+
+    roomy = 1 << (17 if not smoke else 14)  # no pressure: restart story
+    if "tier_restart" not in done:
+        # Throwaway warmup drain FIRST: the cold run would otherwise be
+        # the process's first batcher drive and its wall would measure
+        # jit compilation, not the store's rehydration cost.
+        rounds(True, roomy, "")
+        store = tempfile.mkdtemp(prefix="ladder_tier_store_")
+        try:
+            cold_rounds, _, cold_wall, _, _ = rounds(True, roomy, store)
+            warm_rounds, _, warm_wall, _, snap = rounds(True, roomy, store)
+            off_rounds, _, _, _, _ = rounds(False, roomy, "")
+            _append(
+                out_path,
+                {
+                    "step": "tier_restart",
+                    "prefill_tokens_cold": cold_rounds,
+                    "prefill_tokens_warm": warm_rounds,
+                    "prefill_tokens_tier_off": off_rounds,
+                    "rehydrated_fraction": round(
+                        1.0 - sum(warm_rounds) / max(sum(off_rounds), 1), 4
+                    ),
+                    "rehydrated_tokens": snap["rehydrated_tokens"],
+                    "wall_cold_s": round(cold_wall, 3),
+                    "wall_warm_s": round(warm_wall, 3),
+                },
+            )
+        finally:
+            shutil.rmtree(store, ignore_errors=True)
+
+    for p in TIER_POOL_TOKENS:
+        step = f"tier_pool{p}"
+        if step in done:
+            continue
+        # Floor: one grown-round request (bucketed prompt + budget) must
+        # still fit; with BENCH_B opponents the sweep stays under the
+        # working set, so LRU pressure fires at every sweep point.
+        capacity = max(p // scale, 1024)
+        per_round, toks, wall, decode_s, snap = rounds(True, capacity, "")
+        _append(
+            out_path,
+            {
+                "step": step,
+                "pool_tokens": capacity,
+                "decode_tok_s": round(toks / max(decode_s, 1e-9), 1),
+                "prefill_tokens_per_round": per_round,
+                "host_hit_ratio": snap["host_hit_rate"],
+                "promoted_tokens": snap["promoted_tokens"],
+                "demoted_tokens": snap["demoted_tokens"],
+                "wall_s": round(wall, 3),
+            },
+        )
+    return 0
+
+
 def _clean_env(knobs: dict[str, str] | None = None) -> dict[str, str]:
     """Child env for a measurement: ambient ADVSPEC_* tuning knobs are
     stripped so the harvest records CANONICAL defaults (an operator's
@@ -622,10 +784,26 @@ def orchestrate(out_path: str) -> int:
             print(f"ladder: {step} stalled; abandoning", file=sys.stderr)
             return 2
 
+    # Phase C (tiered KV): one warm child records every remaining tier
+    # step (restart rehydration + the pool-size sweep share one model).
+    if any(s not in _done_steps(out_path) for s in TIER_STEPS):
+        if not _probe_tpu(timeout_s=60.0):
+            print("ladder: tunnel gone before tier phase", file=sys.stderr)
+            return 2
+        child = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--child-tier",
+             out_path],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True, env=_clean_env(), cwd=REPO,
+        )
+        if not _wait_progress(out_path, child, stall_s=900.0):
+            print("ladder: tier phase stalled; abandoning", file=sys.stderr)
+            return 2
+
     done = _done_steps(out_path)
     missing = [
         s
-        for s in list(ENV_STEPS) + list(BATCHER_SPEC_STEPS)
+        for s in list(ENV_STEPS) + list(BATCHER_SPEC_STEPS) + list(TIER_STEPS)
         if s not in done
     ]
     if missing:
@@ -648,6 +826,8 @@ def main() -> int:
     if "--child-batcher-spec" in args:
         i = args.index("--child-batcher-spec")
         return _child_batcher_spec(args[i + 1], args[i + 2])
+    if "--child-tier" in args:
+        return _child_tier(args[args.index("--child-tier") + 1])
     out = "tpu_results/ladder.jsonl"
     if "--out" in args:
         out = args[args.index("--out") + 1]
