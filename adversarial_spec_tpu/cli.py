@@ -180,6 +180,25 @@ def create_parser() -> argparse.ArgumentParser:
         "recorder + retrace watch (--no-obs disables every emit; "
         "ADVSPEC_OBS=0 sets the process default)",
     )
+    b.add_argument(
+        "--slo-ttft-ms",
+        type=float,
+        default=None,  # None = inherit ADVSPEC_SLO_TTFT_MS (default off)
+        help="Per-request TTFT SLO budget in milliseconds: a request "
+        "whose own prefill wall breaches it arms ONE flight-recorder "
+        "dump scoped to its trace (sibling <stem>.slo_ttft.jsonl of "
+        "--events-out, the fault-dump discipline). 0 disables; "
+        "ADVSPEC_SLO_TTFT_MS sets the process default",
+    )
+    b.add_argument(
+        "--slo-round-s",
+        type=float,
+        default=None,  # None = inherit ADVSPEC_SLO_ROUND_S (default off)
+        help="Per-request service SLO budget in seconds (prefill + "
+        "decode, the per-opponent round latency): a breaching request "
+        "self-captures once to <stem>.slo_round.jsonl. 0 disables; "
+        "ADVSPEC_SLO_ROUND_S sets the process default",
+    )
 
     d = parser.add_argument_group("decode")
     d.add_argument(
@@ -561,6 +580,16 @@ def _configure_obs(args: argparse.Namespace):
             else obs.env_recorder_size()
         ),
         events_out=args.events_out or "",
+        slo_ttft_ms=(
+            args.slo_ttft_ms
+            if getattr(args, "slo_ttft_ms", None) is not None
+            else obs.env_slo_ttft_ms()
+        ),
+        slo_round_s=(
+            args.slo_round_s
+            if getattr(args, "slo_round_s", None) is not None
+            else obs.env_slo_round_s()
+        ),
     )
     obs.reset_stats()
     return obs
@@ -666,6 +695,22 @@ def run_critique(args: argparse.Namespace) -> int:
             f"{perf['obs']['retrace']['unexpected_recompiles']} unexpected "
             "jit recompile(s) detected — see perf.obs.retrace in --json"
         )
+    if perf["obs"]["slo"]["breaches"]:
+        breaches = perf["obs"]["slo"]["breaches"]
+        where = (
+            "trace-scoped flight-recorder capture(s) written next to "
+            "--events-out (see tools/trace_view.py)"
+            if args.events_out
+            # No armed destination = counted but not captured; don't
+            # send the operator hunting for files that don't exist.
+            else "pass --events-out to capture trace-scoped dumps"
+        )
+        _err(
+            "warning: SLO breach(es) "
+            + ", ".join(f"{k}={v}" for k, v in breaches.items())
+            + " — "
+            + where
+        )
     _err(
         f"perf: round {perf['spans'].get('round', 0):.2f}s, "
         f"decode {perf['decode_tokens_per_sec']} tok/s"
@@ -763,6 +808,10 @@ def output_results(
             "all_agreed": result.all_agreed,
             "round": args.round,
             "doc_type": args.doc_type or "generic",
+            # The round's causal trace id: every flight-recorder event
+            # this round caused carries it (tools/trace_view.py joins
+            # the events JSONL back to this report on it).
+            "trace_id": getattr(result, "trace_id", ""),
             "models": models,
             "focus": args.focus,
             "persona": args.persona,
@@ -775,6 +824,7 @@ def output_results(
                     "response": r.critique,
                     "spec": r.revised_spec,
                     "error": r.error,
+                    "span_id": r.span_id,
                     "input_tokens": r.usage.input_tokens,
                     "output_tokens": r.usage.output_tokens,
                     "cached_tokens": r.usage.cached_tokens,

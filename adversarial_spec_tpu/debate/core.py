@@ -21,10 +21,12 @@ response per round instead of 3 retries x backoff.
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from dataclasses import dataclass, field
 from pathlib import Path
 
+from adversarial_spec_tpu import obs as obs_mod
 from adversarial_spec_tpu.debate import prompts
 from adversarial_spec_tpu.debate.parsing import (
     detect_agreement,
@@ -96,10 +98,16 @@ def build_request(
     return ChatRequest(model=model, system=system, user=user)
 
 
-def _to_response(model: str, comp: Completion, latency_s: float) -> ModelResponse:
+def _to_response(
+    model: str, comp: Completion, latency_s: float, span_id: str = ""
+) -> ModelResponse:
     if not comp.ok:
         return ModelResponse(
-            model=model, error=comp.error, usage=comp.usage, latency_s=latency_s
+            model=model,
+            error=comp.error,
+            usage=comp.usage,
+            latency_s=latency_s,
+            span_id=span_id,
         )
     resp = ModelResponse(
         model=model,
@@ -108,6 +116,7 @@ def _to_response(model: str, comp: Completion, latency_s: float) -> ModelRespons
         revised_spec=extract_spec(comp.text),
         usage=comp.usage,
         latency_s=latency_s,
+        span_id=span_id,
     )
     if has_malformed_spec(comp.text):
         # Parity: warn-not-crash on malformed [SPEC] (models.py:633-637);
@@ -146,7 +155,20 @@ def run_round(
         if cfg.sampling.timeout_s > 0
         else None
     )
-    requests = [build_request(m, spec, round_num, cfg) for m in models]
+    # Causal tracing (obs/trace.py): ONE trace per round, ONE span per
+    # opponent request, minted HERE — above any engine choice — so the
+    # mock and real serving paths carry byte-identical ids for the same
+    # invocation sequence. The ids ride the requests by value; the
+    # ambient scope below covers emitters that don't know their request.
+    trace_id = obs_mod.trace.mint_trace(round_num)
+    requests = [
+        dataclasses.replace(
+            build_request(m, spec, round_num, cfg),
+            trace_id=trace_id,
+            span_id=obs_mod.trace.mint_span(trace_id, i),
+        )
+        for i, m in enumerate(models)
+    ]
 
     # Group indices by engine so co-resident models batch together. A
     # model whose circuit breaker is open degrades HERE — no engine call,
@@ -162,61 +184,132 @@ def run_round(
                     "circuit open: skipped after repeated faults "
                     f"(probe in {remaining:.0f}s)"
                 ),
+                span_id=req.span_id,
             )
             continue
         engine = get_engine(req.model)
         groups.setdefault(id(engine), (engine, []))[1].append(i)
 
-    for engine, indices in groups.values():
-        pending = list(indices)
-        for attempt in range(MAX_RETRIES):
-            batch = [requests[i] for i in pending]
-            t0 = time.monotonic()
-            completions = engine.chat(batch, cfg.sampling)
-            latency = time.monotonic() - t0
-            tracer.add_span("engine_chat", latency)
-            still_pending = []
-            for i, comp in zip(pending, completions):
-                # The group's wall IS each rider's wall: rows of one
-                # batched decode finish together from the caller's view.
-                tracer.add_span(f"opponent/{requests[i].model}", latency)
-                tracer.count(f"attempts.{requests[i].model}", 1)
-                # Every attempt's outcome feeds the model's breaker:
-                # threshold consecutive failures open it.
-                if comp.ok:
-                    breakers.record(requests[i].model, ok=True)
-                else:
-                    breakers.record(
-                        requests[i].model,
-                        ok=False,
-                        kind=classify_message(comp.error or ""),
-                    )
-                # Retry only while the breaker still allows the model: a
-                # failed half-open probe reopens the circuit and must
-                # cost ONE attempt, not the full 3x backoff budget it
-                # exists to avoid.
-                if (
-                    not comp.ok
-                    and comp.transient
-                    and attempt < MAX_RETRIES - 1
-                    and breakers.allow(requests[i].model)
-                ):
-                    still_pending.append(i)
-                else:
-                    results[i] = _to_response(requests[i].model, comp, latency)
-            pending = still_pending
-            if not pending:
-                break
-            if deadline is not None and time.monotonic() >= deadline:
-                break  # round budget exhausted: no further retries
-            cfg.sleep(RETRY_BASE_DELAY * (2**attempt))
-        for i in pending:  # exhausted retries
-            results[i] = ModelResponse(
-                model=requests[i].model, error="retries exhausted"
+    # The round's ambient trace scope: every event emitted below this
+    # frame — engine fan-in counters, scheduler steps, prefix-cache and
+    # tier ops, retrace compiles — inherits the round's trace_id unless
+    # its emitter stamped a more specific span. Round/opponent SpanEvents
+    # are ORDERING markers (wall_s 0): the debate layer's walls are real
+    # host time, which would break the mock round's byte-deterministic
+    # JSONL pin — the measured per-request decomposition lives in the
+    # engine-emitted request spans (and, for humans, in the report's
+    # latency_s), not here.
+    obs_mod.trace.set_ambient(trace_id, "")
+    obs_mod.emit(
+        obs_mod.SpanEvent(name="round", phase="begin", trace_id=trace_id)
+    )
+    for i, req in enumerate(requests):
+        obs_mod.emit(
+            obs_mod.SpanEvent(
+                name="opponent",
+                phase="begin",
+                req_id=i,
+                trace_id=trace_id,
+                span_id=req.span_id,
             )
+        )
+        if results[i] is not None:
+            # Breaker-open degrade resolved this opponent above with
+            # zero engine calls — close its span immediately so the
+            # stream never carries a begun-but-never-ended opponent
+            # for a request that already has its response.
+            obs_mod.emit(
+                obs_mod.SpanEvent(
+                    name="opponent",
+                    phase="end",
+                    req_id=i,
+                    trace_id=trace_id,
+                    span_id=req.span_id,
+                )
+            )
+    try:
+        for engine, indices in groups.values():
+            pending = list(indices)
+            for attempt in range(MAX_RETRIES):
+                batch = [requests[i] for i in pending]
+                t0 = time.monotonic()
+                completions = engine.chat(batch, cfg.sampling)
+                latency = time.monotonic() - t0
+                tracer.add_span("engine_chat", latency)
+                still_pending = []
+                for i, comp in zip(pending, completions):
+                    # The group's wall IS each rider's wall: rows of one
+                    # batched decode finish together from the caller's
+                    # view.
+                    tracer.add_span(f"opponent/{requests[i].model}", latency)
+                    tracer.count(f"attempts.{requests[i].model}", 1)
+                    # Every attempt's outcome feeds the model's breaker:
+                    # threshold consecutive failures open it.
+                    if comp.ok:
+                        breakers.record(requests[i].model, ok=True)
+                    else:
+                        breakers.record(
+                            requests[i].model,
+                            ok=False,
+                            kind=classify_message(comp.error or ""),
+                        )
+                    # Retry only while the breaker still allows the
+                    # model: a failed half-open probe reopens the circuit
+                    # and must cost ONE attempt, not the full 3x backoff
+                    # budget it exists to avoid.
+                    if (
+                        not comp.ok
+                        and comp.transient
+                        and attempt < MAX_RETRIES - 1
+                        and breakers.allow(requests[i].model)
+                    ):
+                        still_pending.append(i)
+                    else:
+                        results[i] = _to_response(
+                            requests[i].model,
+                            comp,
+                            latency,
+                            requests[i].span_id,
+                        )
+                        obs_mod.emit(
+                            obs_mod.SpanEvent(
+                                name="opponent",
+                                phase="end",
+                                req_id=i,
+                                trace_id=trace_id,
+                                span_id=requests[i].span_id,
+                            )
+                        )
+                pending = still_pending
+                if not pending:
+                    break
+                if deadline is not None and time.monotonic() >= deadline:
+                    break  # round budget exhausted: no further retries
+                cfg.sleep(RETRY_BASE_DELAY * (2**attempt))
+            for i in pending:  # exhausted retries
+                results[i] = ModelResponse(
+                    model=requests[i].model,
+                    error="retries exhausted",
+                    span_id=requests[i].span_id,
+                )
+                obs_mod.emit(
+                    obs_mod.SpanEvent(
+                        name="opponent",
+                        phase="end",
+                        req_id=i,
+                        trace_id=trace_id,
+                        span_id=requests[i].span_id,
+                    )
+                )
+    finally:
+        obs_mod.emit(
+            obs_mod.SpanEvent(name="round", phase="end", trace_id=trace_id)
+        )
+        obs_mod.trace.set_ambient("", "")
 
     return RoundResult(
         responses=[r for r in results if r is not None],
         round_num=round_num,
         tracer=tracer,
+        trace_id=trace_id,
     )

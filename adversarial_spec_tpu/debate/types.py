@@ -28,6 +28,10 @@ class ModelResponse:
     error: str | None = None
     usage: Usage = field(default_factory=Usage)
     latency_s: float = 0.0
+    # This opponent request's causal-trace span (obs/trace.py): joins
+    # the CLI report row to the flight-recorder events and the
+    # tools/trace_view.py waterfall for this exact request.
+    span_id: str = ""
 
     @property
     def ok(self) -> bool:
@@ -42,6 +46,7 @@ class ModelResponse:
             "error": self.error,
             "usage": self.usage.to_dict(),
             "latency_s": round(self.latency_s, 3),
+            "span_id": self.span_id,
         }
 
 
@@ -60,6 +65,9 @@ class RoundResult:
     # retry/backoff accounting); the CLI merges it into the round-level
     # tracer via ``Tracer.merge`` so one report nests both layers.
     tracer: Tracer = field(default_factory=Tracer)
+    # The round's causal trace id (obs/trace.py): every flight-recorder
+    # event this round caused carries it.
+    trace_id: str = ""
 
     @property
     def successful(self) -> list[ModelResponse]:

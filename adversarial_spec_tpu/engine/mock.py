@@ -40,6 +40,14 @@ and rehydrated blocks count as cached, and the disk store (keyed by a
 mock-namespace fingerprint) persists across engine instances, so
 restart-rehydration hit rates pin deterministically on CPU.
 
+Trace parity works the same way (obs/trace.py): each request's chat
+runs under its own ambient trace scope, so every event the accounting
+emits stamps the round/opponent ids minted by the debate layer, and the
+per-request span set (queued/prefill/decode under a ``request``
+envelope) carries SYNTHETIC walls on the tokens/1024 second-scale —
+the tools/trace_view.py waterfall and its checked decomposition pin
+byte-deterministically on CPU, SLO breach capture included.
+
 Interleave parity works the same way (engine/interleave.py): the first
 request of a ``chat`` batch prefills with nothing resident to overlap
 (stalled), every later request's prefill rides the residents' decode
@@ -236,11 +244,22 @@ class MockEngine:
 
     @staticmethod
     def _emit_lifecycle(
-        req_index: int, in_tokens: int, cached: int, out_tokens: int
+        req_index: int,
+        in_tokens: int,
+        cached: int,
+        out_tokens: int,
+        span_id: str = "",
     ) -> None:
         """The scheduler's RequestEvent lifecycle, deterministically:
         queued → admitted → prefill → decode → finished, one synthetic
-        slot per request. Same schema, pinnable bytes."""
+        slot per request, plus the scheduler's per-request causal-trace
+        spans (queued/prefill/decode under a ``request`` envelope) with
+        SYNTHETIC walls on the same tokens/1024 second-scale the
+        interleave accounting uses — so the waterfall decomposition
+        (prefill + decode == request service wall, the sum
+        ``tools/trace_view.py`` checks) pins EXACTLY on CPU. Same
+        schema, pinnable bytes. The SLO gates see the synthetic walls
+        too, so breach capture pins without a TPU."""
         if not obs_mod.config().enabled:
             return
         transitions = (
@@ -249,6 +268,18 @@ class MockEngine:
             ("prefill", in_tokens - cached),
             ("decode", out_tokens),
             ("finished", out_tokens),
+        )
+        prefill_s = (in_tokens - cached) / 1024.0
+        decode_s = out_tokens / 1024.0
+        spans = (
+            ("request", "begin", 0.0),
+            ("queued", "begin", 0.0),
+            ("queued", "end", 0.0),
+            ("prefill", "begin", 0.0),
+            ("prefill", "end", prefill_s),
+            ("decode", "begin", 0.0),
+            ("decode", "end", decode_s),
+            ("request", "end", prefill_s + decode_s),
         )
         for state, tokens in transitions:
             obs_mod.emit(
@@ -260,7 +291,20 @@ class MockEngine:
                     cached_tokens=cached,
                 )
             )
+        for name, phase, wall in spans:
+            obs_mod.emit(
+                obs_mod.SpanEvent(
+                    name=name,
+                    phase=phase,
+                    req_id=req_index,
+                    slot=req_index,
+                    wall_s=wall,
+                    span_id=span_id,
+                )
+            )
         obs_mod.hot.req_finished.inc()
+        obs_mod.slo_check("ttft", span_id, prefill_s)
+        obs_mod.slo_check("round", span_id, prefill_s + decode_s)
 
     def _account_prefix(
         self,
@@ -383,6 +427,19 @@ class MockEngine:
         overlapped: bool = False,
         req_index: int = 0,
     ) -> Completion:
+        # The request's ambient trace scope: every event this request's
+        # accounting emits (cache/tier/step/spec) stamps with its
+        # trace/span, exactly as the scheduler scopes admissions.
+        with obs_mod.trace_scope(req.trace_id, req.span_id):
+            return self._one_traced(req, params, overlapped, req_index)
+
+    def _one_traced(
+        self,
+        req: ChatRequest,
+        params: SamplingParams,
+        overlapped: bool = False,
+        req_index: int = 0,
+    ) -> Completion:
         parsed = urlparse(req.model)
         behavior = parsed.netloc or parsed.path.lstrip("/")
         opts = {k: v[-1] for k, v in parse_qs(parsed.query).items()}
@@ -410,7 +467,9 @@ class MockEngine:
                 req.user
             )
             self._account_spec(req, text, req_index)
-            self._emit_lifecycle(req_index, in_tokens, cached, out_tokens)
+            self._emit_lifecycle(
+                req_index, in_tokens, cached, out_tokens, req.span_id
+            )
             return Completion(
                 text=text,
                 usage=Usage(
@@ -452,7 +511,9 @@ class MockEngine:
         tps = float(opts.get("tps", "0"))
         in_tokens = _estimate_tokens(req.system) + _estimate_tokens(req.user)
         self._account_spec(req, text, req_index)
-        self._emit_lifecycle(req_index, in_tokens, cached, out_tokens)
+        self._emit_lifecycle(
+            req_index, in_tokens, cached, out_tokens, req.span_id
+        )
         usage = Usage(
             input_tokens=in_tokens,
             output_tokens=out_tokens,

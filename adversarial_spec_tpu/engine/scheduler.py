@@ -104,6 +104,12 @@ class SchedRequest:
     req_id: int
     prompt_ids: list[int]
     max_new_tokens: int
+    # Causal-trace ids (obs/trace.py), carried by value from the debate
+    # round that issued this request; every flight-recorder event the
+    # batcher emits for it is stamped with them (explicitly where the
+    # emit site knows the request, via the ambient scope elsewhere).
+    trace_id: str = ""
+    span_id: str = ""
 
 
 @dataclass
@@ -172,6 +178,15 @@ class SchedResult:
     spec_steps: int = 0
     spec_drafted: int = 0
     spec_accepted: int = 0
+    # This request's own decode wall: each drive-loop step's decode
+    # share splits evenly over the rows live at dispatch, so the slot
+    # sums reproduce the batcher's decode_time_s counter. Together with
+    # prefill_time_s it IS the request's service wall — the end wall of
+    # its ``request`` trace span (tools/trace_view.py checks the sum).
+    decode_time_s: float = 0.0
+    # Echo of the request's causal-trace ids.
+    trace_id: str = ""
+    span_id: str = ""
 
 
 def _next_chunk_len(remaining: int) -> int:
@@ -1076,6 +1091,16 @@ class ContinuousBatcher:
         # Per-slot request telemetry, stamped at admission handoff.
         self._slot_cached: list[int] = [0] * B
         self._slot_prefill_s: list[float] = [0.0] * B
+        # Per-slot causal-trace state: the owner's trace/span ids and
+        # its accumulated decode wall (each step's decode share splits
+        # evenly over the rows live at dispatch; the slot sums
+        # reproduce decode_time_s).
+        self._slot_trace: list[str] = [""] * B
+        self._slot_span: list[str] = [""] * B
+        self._slot_decode_s: list[float] = [0.0] * B
+        # Host submit time per queued req_id: the 'queued' span's wall
+        # (queue wait) measured at admission start.
+        self._queued_t: dict[int, float] = {}
         self._admission: _Admission | None = None
         self._seq_counter = 0
         self.capacity_tokens = n_pages * page_size
@@ -1209,13 +1234,29 @@ class ContinuousBatcher:
                 f"{self.capacity_tokens}; raise capacity_tokens"
             )
         self.queue.append(req)
-        obs_mod.emit(
-            obs_mod.RequestEvent(
-                req_id=req.req_id,
-                state="queued",
-                tokens=len(req.prompt_ids),
+        if obs_mod.config().enabled:
+            import time
+
+            self._queued_t[req.req_id] = time.monotonic()
+            obs_mod.emit(
+                obs_mod.RequestEvent(
+                    req_id=req.req_id,
+                    state="queued",
+                    tokens=len(req.prompt_ids),
+                    trace_id=req.trace_id,
+                    span_id=req.span_id,
+                )
             )
-        )
+            for name in ("request", "queued"):
+                obs_mod.emit(
+                    obs_mod.SpanEvent(
+                        name=name,
+                        phase="begin",
+                        req_id=req.req_id,
+                        trace_id=req.trace_id,
+                        span_id=req.span_id,
+                    )
+                )
 
     def _commit(self, cache: dict) -> dict:
         """Commit a freshly created admission cache to the params'
@@ -1277,6 +1318,7 @@ class ContinuousBatcher:
                 req_id=req.req_id, state="admitted", slot=slot, tokens=S
             )
         )
+        self._emit_admitted_spans(req, slot)
         return True
 
     def _extend_evicting(self, seq_id: int, n_tokens: int) -> None:
@@ -1510,7 +1552,41 @@ class ContinuousBatcher:
                 cached_tokens=total,
             )
         )
+        self._emit_admitted_spans(req, slot)
         return True
+
+    def _emit_admitted_spans(self, req: SchedRequest, slot: int) -> None:
+        """Trace-span bookkeeping at admission start: the 'queued' span
+        ends (wall = the measured queue wait) and the 'prefill' span
+        opens. Called by both admission variants under the request's
+        ambient scope (``_admit``)."""
+        if not obs_mod.config().enabled:
+            return
+        import time
+
+        t0 = self._queued_t.pop(req.req_id, None)
+        wait = (time.monotonic() - t0) if t0 is not None else 0.0
+        obs_mod.emit(
+            obs_mod.SpanEvent(
+                name="queued",
+                phase="end",
+                req_id=req.req_id,
+                slot=slot,
+                wall_s=wait,
+                trace_id=req.trace_id,
+                span_id=req.span_id,
+            )
+        )
+        obs_mod.emit(
+            obs_mod.SpanEvent(
+                name="prefill",
+                phase="begin",
+                req_id=req.req_id,
+                slot=slot,
+                trace_id=req.trace_id,
+                span_id=req.span_id,
+            )
+        )
 
     def _advance_admission(self) -> None:
         """One STANDALONE prefill chunk of the in-flight admission —
@@ -1703,6 +1779,9 @@ class ContinuousBatcher:
         self._slot_req[slot] = req
         self._slot_seq[slot] = seq_id
         self._slot_cached[slot] = adm.matched
+        self._slot_trace[slot] = req.trace_id
+        self._slot_span[slot] = req.span_id
+        self._slot_decode_s[slot] = 0.0
         elapsed = time.monotonic() - t0
         # The handoff (pool scatter + first-token sample + sync) is time
         # the batch genuinely waits on: stalled, in both loop modes.
@@ -1729,6 +1808,35 @@ class ContinuousBatcher:
                     cached_tokens=adm.matched,
                 )
             )
+            # Trace spans: prefill closes with this request's OWN
+            # prefill wall (stalled + overlapped chunks + handoff —
+            # exactly SchedResult.prefill_time_s), decode opens. The
+            # TTFT SLO gate sees the same wall the ttft histogram does;
+            # a breach arms the once-per-request trace-scoped capture.
+            obs_mod.emit(
+                obs_mod.SpanEvent(
+                    name="prefill",
+                    phase="end",
+                    req_id=req.req_id,
+                    slot=slot,
+                    wall_s=self._slot_prefill_s[slot],
+                    trace_id=req.trace_id,
+                    span_id=req.span_id,
+                )
+            )
+            obs_mod.emit(
+                obs_mod.SpanEvent(
+                    name="decode",
+                    phase="begin",
+                    req_id=req.req_id,
+                    slot=slot,
+                    trace_id=req.trace_id,
+                    span_id=req.span_id,
+                )
+            )
+            obs_mod.slo_check(
+                "ttft", req.span_id, self._slot_prefill_s[slot]
+            )
         if not row_active:
             self._finish_slot(slot)
 
@@ -1751,8 +1859,13 @@ class ContinuousBatcher:
             if self._admission is not None or not self.queue:
                 return
             if self._slot_req[slot] is None and not self._active_np[slot]:
+                # The request's ambient trace scope: cache lookups, tier
+                # promotions, and retrace observations this admission
+                # causes stamp with ITS trace/span (obs/trace.py).
+                req0 = self.queue[0]
                 try:
-                    started = self._start_admission(slot, self.queue[0])
+                    with obs_mod.trace_scope(req0.trace_id, req0.span_id):
+                        started = self._start_admission(slot, req0)
                 except Exception as e:
                     # Fault isolation: only this request is affected —
                     # the batch keeps decoding and admission continues
@@ -1775,12 +1888,13 @@ class ContinuousBatcher:
                     # Short prefills (≤ one ADMISSION_CHUNK of work left —
                     # possibly several sub-chunk pieces on the canonical
                     # path) admit to completion immediately.
-                    while (
-                        self._admission is not None
-                        and self._admission.slot == slot
-                        and self._admission.remaining <= ADMISSION_CHUNK
-                    ):
-                        self._advance_admission()
+                    with obs_mod.trace_scope(req0.trace_id, req0.span_id):
+                        while (
+                            self._admission is not None
+                            and self._admission.slot == slot
+                            and self._admission.remaining <= ADMISSION_CHUNK
+                        ):
+                            self._advance_admission()
                 except Exception as e:
                     self._abort_admission(e)
 
@@ -1798,12 +1912,16 @@ class ContinuousBatcher:
         slot: int = -1,
         pages_freed: int = 0,
         spec_counts: tuple[int, int, int] = (0, 0, 0),
+        decode_time_s: float = 0.0,
     ) -> None:
         """Resolve one faulted request: requeue once if the fault is
         transient (OOM/device-loss/preemption/timeout) and this req_id
         hasn't been retried yet — budgeted against the caller's existing
         deadline, since the requeue drains through the same run_all loop
-        — else finalize with the partial tokens + fault metadata."""
+        — else finalize with the partial tokens + fault metadata. Every
+        event here stamps the INJURED request's trace/span explicitly
+        (the ambient scope may belong to a co-resident admission), so
+        the auto-dump's JSONL resolves the fault to its victim."""
         kind = faults.classify(exc)
         faults.record(kind, seam)
         requeued = kind.transient and req.req_id not in self._retried
@@ -1816,16 +1934,33 @@ class ContinuousBatcher:
                 pages_freed=pages_freed,
                 requeued=requeued,
                 error=f"{type(exc).__name__}: {exc}",
+                trace_id=req.trace_id,
+                span_id=req.span_id,
             )
         )
         if requeued:
             self._retried.add(req.req_id)
             self.queue.append(req)
+            if obs_mod.config().enabled:
+                import time
+
+                self._queued_t[req.req_id] = time.monotonic()
             obs_mod.emit(
                 obs_mod.RequestEvent(
                     req_id=req.req_id,
                     state="queued",
                     tokens=len(req.prompt_ids),
+                    trace_id=req.trace_id,
+                    span_id=req.span_id,
+                )
+            )
+            obs_mod.emit(
+                obs_mod.SpanEvent(
+                    name="queued",
+                    phase="begin",
+                    req_id=req.req_id,
+                    trace_id=req.trace_id,
+                    span_id=req.span_id,
                 )
             )
             return
@@ -1836,10 +1971,25 @@ class ContinuousBatcher:
                 slot=slot,
                 tokens=n,
                 cached_tokens=cached_tokens,
+                trace_id=req.trace_id,
+                span_id=req.span_id,
             )
         )
         if obs_mod.config().enabled:
             obs_mod.hot.req_evicted.inc()
+            # Close the request's trace envelope with what it actually
+            # consumed — an evicted request still waterfalls.
+            obs_mod.emit(
+                obs_mod.SpanEvent(
+                    name="request",
+                    phase="end",
+                    req_id=req.req_id,
+                    slot=slot,
+                    wall_s=prefill_time_s + decode_time_s,
+                    trace_id=req.trace_id,
+                    span_id=req.span_id,
+                )
+            )
         # The whole point of the flight recorder: when a fault evicts,
         # the last N events (reconstructing what the batcher was doing)
         # land on disk IMMEDIATELY, before any further unwind.
@@ -1858,6 +2008,9 @@ class ContinuousBatcher:
                 spec_steps=spec_counts[0],
                 spec_drafted=spec_counts[1],
                 spec_accepted=spec_counts[2],
+                decode_time_s=decode_time_s,
+                trace_id=req.trace_id,
+                span_id=req.span_id,
             )
         )
 
@@ -1873,6 +2026,18 @@ class ContinuousBatcher:
             raise exc
         free0 = self.allocator.free_pages
         self.allocator.free_sequence(adm.seq_id)
+        if obs_mod.config().enabled:
+            obs_mod.emit(
+                obs_mod.SpanEvent(
+                    name="prefill",
+                    phase="end",
+                    req_id=adm.req.req_id,
+                    slot=adm.slot,
+                    wall_s=adm.prefill_s,
+                    trace_id=adm.req.trace_id,
+                    span_id=adm.req.span_id,
+                )
+            )
         self._fault_request(
             adm.req,
             exc,
@@ -1946,6 +2111,20 @@ class ContinuousBatcher:
         obs_mod.record_sync("fault")
         self.page_table = self.page_table.at[slot].set(0)
         st = self._slot_spec[slot]
+        if obs_mod.config().enabled:
+            # The victim's decode span closes with its accumulated
+            # share before the request envelope does (_fault_request).
+            obs_mod.emit(
+                obs_mod.SpanEvent(
+                    name="decode",
+                    phase="end",
+                    req_id=req.req_id,
+                    slot=slot,
+                    wall_s=self._slot_decode_s[slot],
+                    trace_id=req.trace_id,
+                    span_id=req.span_id,
+                )
+            )
         self._fault_request(
             req,
             exc,
@@ -1957,6 +2136,7 @@ class ContinuousBatcher:
             slot=slot,
             pages_freed=self.allocator.free_pages - free0,
             spec_counts=(st[0], st[1], st[2]),
+            decode_time_s=self._slot_decode_s[slot],
         )
 
     # -- completion --------------------------------------------------------
@@ -1984,6 +2164,9 @@ class ContinuousBatcher:
                 spec_steps=st[0],
                 spec_drafted=st[1],
                 spec_accepted=st[2],
+                decode_time_s=self._slot_decode_s[slot],
+                trace_id=req.trace_id,
+                span_id=req.span_id,
             )
         )
         if self.speculative and st[1] and obs_mod.config().enabled:
@@ -2008,8 +2191,41 @@ class ContinuousBatcher:
                     slot=slot,
                     tokens=n,
                     cached_tokens=self._slot_cached[slot],
+                    trace_id=req.trace_id,
+                    span_id=req.span_id,
                 )
             )
+            # Close the trace spans: decode with the slot's accumulated
+            # decode share, the request envelope with prefill + decode
+            # (its SERVICE wall — the sum tools/trace_view.py checks
+            # against the stage walls, and the value the per-request
+            # round SLO gate judges).
+            service_s = (
+                self._slot_prefill_s[slot] + self._slot_decode_s[slot]
+            )
+            obs_mod.emit(
+                obs_mod.SpanEvent(
+                    name="decode",
+                    phase="end",
+                    req_id=req.req_id,
+                    slot=slot,
+                    wall_s=self._slot_decode_s[slot],
+                    trace_id=req.trace_id,
+                    span_id=req.span_id,
+                )
+            )
+            obs_mod.emit(
+                obs_mod.SpanEvent(
+                    name="request",
+                    phase="end",
+                    req_id=req.req_id,
+                    slot=slot,
+                    wall_s=service_s,
+                    trace_id=req.trace_id,
+                    span_id=req.span_id,
+                )
+            )
+            obs_mod.slo_check("round", req.span_id, service_s)
 
     def _collect(self, active_np: np.ndarray | None = None) -> None:
         """Resolve finished slots. The legacy loop passes nothing (full
@@ -2089,14 +2305,33 @@ class ContinuousBatcher:
                     req_id=req.req_id,
                     tokens=np.zeros((0,), np.int32),
                     n_generated=0,
+                    trace_id=req.trace_id,
+                    span_id=req.span_id,
                 )
             )
             obs_mod.emit(
-                obs_mod.RequestEvent(req_id=req.req_id, state="timeout")
+                obs_mod.RequestEvent(
+                    req_id=req.req_id,
+                    state="timeout",
+                    trace_id=req.trace_id,
+                    span_id=req.span_id,
+                )
             )
             if obs_mod.config().enabled:
                 obs_mod.hot.req_timeout.inc()
+                obs_mod.emit(
+                    obs_mod.SpanEvent(
+                        name="request",
+                        phase="end",
+                        req_id=req.req_id,
+                        trace_id=req.trace_id,
+                        span_id=req.span_id,
+                    )
+                )
         self.queue.clear()
+        # Queue-wait bookkeeping dies with the queue: a req_id reused
+        # by a later drain must not inherit this round's submit time.
+        self._queued_t.clear()
         # Deadline evictions are triage material exactly like faults:
         # dump what the batcher was doing when the budget ran out.
         obs_mod.autodump("timeout")
@@ -2271,11 +2506,20 @@ class ContinuousBatcher:
             try:
                 injector.fire("kv_alloc", slot)
                 if want > length:
-                    self._extend_evicting(seq, want - length)
+                    # This row's trace scope: a cache eviction / tier
+                    # demotion its extend forces stamps with the
+                    # request that caused the pressure.
+                    with obs_mod.trace_scope(
+                        self._slot_trace[slot], self._slot_span[slot]
+                    ):
+                        self._extend_evicting(seq, want - length)
             except OutOfPages:
                 try:
                     if cl + 1 > length:
-                        self._extend_evicting(seq, cl + 1 - length)
+                        with obs_mod.trace_scope(
+                            self._slot_trace[slot], self._slot_span[slot]
+                        ):
+                            self._extend_evicting(seq, cl + 1 - length)
                 except OutOfPages as e:
                     self._evict_spec_row(slot, e, "kv_alloc")
                     live.remove(slot)
@@ -2462,6 +2706,8 @@ class ContinuousBatcher:
                         accepted=n_acc,
                         emitted=n_emit,
                         rolled_back_pages=released,
+                        trace_id=self._slot_trace[slot],
+                        span_id=self._slot_span[slot],
                     )
                 )
             self._active_np[slot] = act
@@ -2556,15 +2802,21 @@ class ContinuousBatcher:
                 alloc_len = self._prepare_spec_step(live)
             if ride:
                 try:
-                    if spec:
-                        spec_slots = tuple(
-                            (s, self._slot_gen[s]) for s in live
-                        )
-                        spec_counts = self._dispatch_spec(
-                            alloc_len, adm, chunk_len
-                        )
-                    else:
-                        self._dispatch_fused(adm, chunk_len)
+                    # Fused dispatches run under the riding admission's
+                    # trace scope so its retrace/compile observations
+                    # attribute to the request that shaped the program.
+                    with obs_mod.trace_scope(
+                        adm.req.trace_id, adm.req.span_id
+                    ):
+                        if spec:
+                            spec_slots = tuple(
+                                (s, self._slot_gen[s]) for s in live
+                            )
+                            spec_counts = self._dispatch_spec(
+                                alloc_len, adm, chunk_len
+                            )
+                        else:
+                            self._dispatch_fused(adm, chunk_len)
                     # Telemetry attribution for the fused program: the
                     # halves aren't separately measurable without a
                     # profiler, so split this iteration's wall clock by
@@ -2597,7 +2849,10 @@ class ContinuousBatcher:
                     # the handoff when the prefill completes, so the
                     # new row is live for the decode dispatch below.
                     try:
-                        self._advance_admission()
+                        with obs_mod.trace_scope(
+                            adm.req.trace_id, adm.req.span_id
+                        ):
+                            self._advance_admission()
                         adm.fuse_deferred = False
                     except Exception as e:
                         self._abort_admission(e)
@@ -2668,6 +2923,14 @@ class ContinuousBatcher:
                 else:
                     self.decode_time_s += dt
                     spec_dt = dt
+                if live:
+                    # Per-request decode attribution: this step's decode
+                    # wall splits evenly over the rows live at dispatch
+                    # (slot sums reproduce decode_time_s — the 'decode'
+                    # trace span's wall).
+                    dec_share = spec_dt / len(live)
+                    for s in live:
+                        self._slot_decode_s[s] += dec_share
                 # Draft/verify wall split by position share: the bigram
                 # scan costs about one forward position against the
                 # span's γ+1 (SpecStats' deterministic convention).
@@ -2710,6 +2973,18 @@ class ContinuousBatcher:
                             decode_chunk=width,
                             pipeline_depth=depth,
                             sync_reason=step_sync,
+                            # The riding admission's span; batch-level
+                            # otherwise (trace stamps from ambient).
+                            span_id=(
+                                adm.req.span_id
+                                if fused_share > 0.0
+                                else ""
+                            ),
+                            trace_id=(
+                                adm.req.trace_id
+                                if fused_share > 0.0
+                                else ""
+                            ),
                         )
                     )
             elif dispatched:
@@ -2757,8 +3032,16 @@ class ContinuousBatcher:
                     self._record_prefill_time(p, overlapped=True)
                     adm.prefill_s += p
                     self.decode_time_s += dt - p
+                    dec_dt = dt - p
                 else:
                     self.decode_time_s += dt
+                    dec_dt = dt
+                if live:
+                    # Per-request decode attribution (see the spec
+                    # branch): even split over rows live at dispatch.
+                    dec_share = dec_dt / len(live)
+                    for s in live:
+                        self._slot_decode_s[s] += dec_share
                 if obs_mod.config().enabled:
                     obs_mod.hot.step_wall.observe(dt)
                     if live:
@@ -2776,6 +3059,16 @@ class ContinuousBatcher:
                             decode_chunk=self.chunk,
                             pipeline_depth=depth,
                             sync_reason=step_sync,
+                            span_id=(
+                                adm.req.span_id
+                                if fused_share > 0.0
+                                else ""
+                            ),
+                            trace_id=(
+                                adm.req.trace_id
+                                if fused_share > 0.0
+                                else ""
+                            ),
                         )
                     )
             self._collect(self._active_np)
@@ -2797,8 +3090,12 @@ class ContinuousBatcher:
             if self._admission is not None:
                 # One prompt chunk, then fall through to a decode chunk —
                 # resident rows keep emitting while the newcomer prefills.
+                adm = self._admission
                 try:
-                    self._advance_admission()
+                    with obs_mod.trace_scope(
+                        adm.req.trace_id, adm.req.span_id
+                    ):
+                        self._advance_admission()
                 except Exception as e:
                     self._abort_admission(e)
             if bool(self.active.any()):
@@ -2833,6 +3130,10 @@ class ContinuousBatcher:
                         finally:
                             dt = time.monotonic() - t_dec
                             self.decode_time_s += dt
+                            if live:
+                                dec_share = dt / len(live)
+                                for s in live:
+                                    self._slot_decode_s[s] += dec_share
                             spec_mod.stats.record_wall(
                                 dt / (width + 1),
                                 dt * width / (width + 1),
@@ -2863,6 +3164,11 @@ class ContinuousBatcher:
                                     )
                                 )
                 else:
+                    live = [
+                        s
+                        for s in range(self.B)
+                        if self._slot_req[s] is not None
+                    ]
                     try:
                         self._dispatch_decode()
                         jax.block_until_ready(self.active)
@@ -2871,6 +3177,10 @@ class ContinuousBatcher:
                     finally:
                         dt = time.monotonic() - t_dec
                         self.decode_time_s += dt
+                        if live:
+                            dec_share = dt / len(live)
+                            for s in live:
+                                self._slot_decode_s[s] += dec_share
                         if obs_mod.config().enabled:
                             obs_mod.record_sync("legacy_step")
                             obs_mod.hot.step_wall.observe(dt)

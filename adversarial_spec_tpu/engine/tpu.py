@@ -555,6 +555,9 @@ class TpuEngine:
                         seam=getattr(e, "seam", "generate"),
                         kind=kind.value,
                         error=msg,
+                        # Group-level failure: the round's trace, no
+                        # single victim span.
+                        trace_id=batch[0].trace_id if batch else "",
                     )
                 )
                 obs_mod.autodump("fault")
@@ -620,7 +623,7 @@ class TpuEngine:
             lm.cfg.max_seq_len - params.max_new_tokens >= MIN_BUCKET
         )
         if lm.spec.kv == "paged" and lm.mesh.size == 1 and fits_batcher:
-            return self._chat_continuous(lm, prompts, params)
+            return self._chat_continuous(lm, prompts, params, batch)
 
         t0 = time.monotonic()
         with lm.mesh:
@@ -674,13 +677,19 @@ class TpuEngine:
         return completions
 
     def _chat_continuous(
-        self, lm: LoadedModel, prompts: list[list[int]], params: SamplingParams
+        self,
+        lm: LoadedModel,
+        prompts: list[list[int]],
+        params: SamplingParams,
+        batch: list[ChatRequest] | None = None,
     ) -> list[Completion]:
         """Serve one model's requests through the ContinuousBatcher.
 
         Pool capacity is bucketed to a power of two so repeat rounds of
         similar size reuse the compiled chunk program (pool shape is a
-        jit constant).
+        jit constant). ``batch`` carries the callers' ChatRequests so
+        each SchedRequest inherits its causal trace/span ids — the hop
+        that ties a debate round to the device steps that served it.
         """
         tok = lm.tokenizer
         # The batcher checks bucket_length(prompt) + budget against the
@@ -740,7 +749,7 @@ class TpuEngine:
         t0 = time.monotonic()
         try:
             results, decode_time = self._run_batcher(
-                lm, batcher_key, prompts, params, seed
+                lm, batcher_key, prompts, params, seed, batch
             )
         except BaseException:
             # An escaping exception (decode fault whose donated-state
@@ -791,7 +800,9 @@ class TpuEngine:
             )
         return completions
 
-    def _run_batcher(self, lm, batcher_key, prompts, params, seed):
+    def _run_batcher(
+        self, lm, batcher_key, prompts, params, seed, batch=None
+    ):
         """Acquire (reuse or build) the model's persistent batcher and
         drain this call's requests through it.
 
@@ -850,11 +861,18 @@ class TpuEngine:
             # counters accumulate across rounds.
             decode_t0 = batcher.decode_time_s
             for i, ids in enumerate(prompts):
+                src = batch[i] if batch is not None else None
                 batcher.submit(
                     SchedRequest(
                         req_id=i,
                         prompt_ids=ids,
                         max_new_tokens=params.max_new_tokens,
+                        # Trace propagation: the opponent request's ids
+                        # ride into per-slot batcher state so every
+                        # event of every device step resolves back to
+                        # the debate round that caused it.
+                        trace_id=src.trace_id if src is not None else "",
+                        span_id=src.span_id if src is not None else "",
                     )
                 )
             results = batcher.run_all(timeout_s=params.timeout_s)

@@ -39,6 +39,12 @@ class ChatRequest:
     user: str
     # Opaque metadata echoed back on the completion (e.g. persona label).
     tag: str = ""
+    # Causal-trace ids (obs/trace.py): the debate round that issued this
+    # request and this request's own span. Minted by the debate layer,
+    # carried by value down the serving stack so every flight-recorder
+    # event an engine emits resolves back to one round + opponent.
+    trace_id: str = ""
+    span_id: str = ""
 
 
 @dataclass

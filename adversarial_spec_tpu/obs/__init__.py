@@ -7,10 +7,14 @@ shared substrate (the north-star metric — per-round wall / tokens/sec/chip
 - ``metrics`` — the process-wide :class:`MetricsRegistry` (counters,
   gauges, fixed-bucket histograms; ``snapshot()`` + ``render_prometheus()``).
 - ``recorder`` — the process-wide :class:`FlightRecorder` ring of typed
-  events (Step/Request/Fault/Breaker/Cache/Compile); dumped as JSONL on
-  demand (``--events-out``) and automatically on fault/timeout eviction.
+  events (Step/Request/Fault/Breaker/Cache/Compile/Spec/Swap/Span);
+  dumped as JSONL on demand (``--events-out``) and automatically on
+  fault/timeout eviction and per-request SLO breach.
 - ``retrace`` — the :class:`RetraceWatch` counting jit compiles per
   program and flagging unexpected recompiles in the report.
+- ``trace`` — causal trace/span ids (one trace per debate round, one
+  span per opponent request) every event carries, minted by the debate
+  layer and propagated down to the device-step emit sites.
 
 Process-wide config + reset semantics follow the established
 ``resilience.faults`` / ``prefix_cache`` / ``interleave`` pattern: the
@@ -29,6 +33,7 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass
 
+from adversarial_spec_tpu.obs import trace  # noqa: F401 (re-export)
 from adversarial_spec_tpu.obs.events import (  # noqa: F401 (re-export)
     BreakerEvent,
     CacheEvent,
@@ -37,9 +42,11 @@ from adversarial_spec_tpu.obs.events import (  # noqa: F401 (re-export)
     FaultEvent,
     FlightRecorder,
     RequestEvent,
+    SpanEvent,
     SpecEvent,
     StepEvent,
     SwapEvent,
+    atomic_write_text,
     validate_event,
 )
 from adversarial_spec_tpu.obs.metrics import (  # noqa: F401 (re-export)
@@ -64,6 +71,14 @@ class ObsConfig:
     # the fault-time snapshot (no path = no auto-dump).
     events_out: str | None = None
     dump_on_fault: bool = True
+    # Per-request SLO budgets (0 = disabled). A request breaching its
+    # budget arms ONE automatic flight-recorder dump scoped to its
+    # trace (same sibling-file discipline as fault dumps), so slow
+    # requests self-capture in production: ``slo_ttft_ms`` bounds the
+    # request's own prefill wall through its first sampled token,
+    # ``slo_round_s`` its full service wall (prefill + decode).
+    slo_ttft_ms: float = 0.0
+    slo_round_s: float = 0.0
 
 
 def env_enabled() -> bool:
@@ -84,17 +99,43 @@ def env_recorder_size() -> int:
     return max(1, n)
 
 
+def _env_float(name: str) -> float:
+    try:
+        return max(0.0, float(os.environ.get(name, "0") or "0"))
+    except ValueError:
+        return 0.0
+
+
+def env_slo_ttft_ms() -> float:
+    """Process default per-request TTFT budget (``ADVSPEC_SLO_TTFT_MS``,
+    milliseconds; 0 = disabled)."""
+    return _env_float("ADVSPEC_SLO_TTFT_MS")
+
+
+def env_slo_round_s() -> float:
+    """Process default per-request service budget
+    (``ADVSPEC_SLO_ROUND_S``, seconds; 0 = disabled)."""
+    return _env_float("ADVSPEC_SLO_ROUND_S")
+
+
 _config = ObsConfig(
     enabled=env_enabled(),
     recorder_size=env_recorder_size(),
     events_out=os.environ.get("ADVSPEC_EVENTS_OUT") or None,
+    slo_ttft_ms=env_slo_ttft_ms(),
+    slo_round_s=env_slo_round_s(),
 )
+# (kind, span_id) pairs that already fired their SLO capture — the
+# exactly-once-per-breaching-request guard; cleared by reset_stats().
+_slo_fired: set[tuple[str, str]] = set()
 
 metrics = MetricsRegistry()
 recorder = FlightRecorder(
     size=_config.recorder_size, enabled=_config.enabled
 )
-retrace = RetraceWatch(emit=lambda ev: recorder.append(ev))
+# Route through emit() (defined below; resolved at call time) so
+# CompileEvents pick up the ambient trace/span like every other event.
+retrace = RetraceWatch(emit=lambda ev: emit(ev))
 
 
 class HotMetrics:
@@ -260,6 +301,8 @@ def configure(
     recorder_size: int | None = None,
     events_out: str | None = None,
     dump_on_fault: bool | None = None,
+    slo_ttft_ms: float | None = None,
+    slo_round_s: float | None = None,
 ) -> ObsConfig:
     if enabled is not None:
         _config.enabled = bool(enabled)
@@ -271,21 +314,41 @@ def configure(
         _config.events_out = events_out or None
     if dump_on_fault is not None:
         _config.dump_on_fault = bool(dump_on_fault)
+    if slo_ttft_ms is not None:
+        _config.slo_ttft_ms = max(0.0, float(slo_ttft_ms))
+    if slo_round_s is not None:
+        _config.slo_round_s = max(0.0, float(slo_round_s))
     return _config
 
 
 def reset_stats() -> None:
     """Per-invocation reset (one CLI invocation = one round): metrics
-    zero in place, the ring clears, the retrace watch starts fresh."""
+    zero in place, the ring clears, the retrace watch starts fresh, and
+    the trace-id counter + ambient context + fired-SLO set clear (trace
+    state must never leak across CLI invocations)."""
     metrics.reset()
     recorder.clear()
     retrace.reset()
+    trace.reset()
+    _slo_fired.clear()
 
 
 def emit(ev) -> None:
-    """Append one event to the flight recorder (no-op when disabled)."""
+    """Append one event to the flight recorder (no-op when disabled).
+    Events whose ``trace_id``/``span_id`` are empty are stamped from
+    the ambient trace context (obs/trace.py): emit sites that know
+    their request stamp explicitly; everything else (prefix-cache,
+    tier, retrace emits) inherits the request being served."""
     if _config.enabled:
+        amb = trace.ambient
+        if not ev.trace_id:
+            ev.trace_id = amb.trace
+        if not ev.span_id:
+            ev.span_id = amb.span
         recorder.append(ev)
+
+
+trace_scope = trace.scope  # re-export: the emitters' stamping scope
 
 
 def record_sync(reason: str) -> None:
@@ -310,10 +373,12 @@ def autodump_path(trigger: str) -> str | None:
     return f"{root}.{trigger}{ext or '.jsonl'}"
 
 
-def autodump(trigger: str) -> str | None:
-    """Fault/timeout auto-dump: write the ring NOW (the drive loop may
-    be about to unwind) to the trigger's sibling of ``events_out``.
-    Returns the path written, or None when no destination is armed."""
+def autodump(trigger: str, trace_id: str | None = None) -> str | None:
+    """Fault/timeout/SLO auto-dump: write the ring NOW (the drive loop
+    may be about to unwind) to the trigger's sibling of ``events_out``.
+    ``trace_id`` scopes the dump to one round's causal story (the SLO
+    capture path). Returns the path written, or None when no
+    destination is armed."""
     path = autodump_path(trigger)
     if not (_config.enabled and _config.dump_on_fault and path):
         return None
@@ -322,19 +387,63 @@ def autodump(trigger: str) -> str | None:
         help="flight-recorder dumps by trigger",
         trigger=trigger,
     ).inc()
-    recorder.dump_jsonl(path)
+    recorder.dump_jsonl(path, trace_id=trace_id)
     return path
 
 
+def slo_check(kind: str, span_id: str, wall_s: float) -> str | None:
+    """Check one request's measured wall against its SLO budget and, on
+    a breach, self-capture: count it and arm ONE flight-recorder dump
+    scoped to the request's trace (sibling file ``<stem>.slo_<kind>``,
+    the fault-dump discipline). ``kind`` is ``"ttft"`` (budget
+    ``slo_ttft_ms``, milliseconds) or ``"round"`` (``slo_round_s``,
+    seconds — the per-opponent service wall the source paper's
+    convergence protocol makes the user-facing cost unit). Fires at
+    most once per (kind, request) — the breach metric and the dump
+    alike — so a persistent offender cannot flood the disk. Returns
+    the dump path when a capture was written, else None."""
+    if not _config.enabled or not span_id:
+        return None
+    budget = (
+        _config.slo_ttft_ms / 1000.0
+        if kind == "ttft"
+        else _config.slo_round_s
+    )
+    if budget <= 0.0 or wall_s <= budget:
+        return None
+    key = (kind, span_id)
+    if key in _slo_fired:
+        return None
+    _slo_fired.add(key)
+    metrics.counter(
+        "advspec_slo_breaches_total",
+        help="per-request SLO budget breaches by kind",
+        kind=kind,
+    ).inc()
+    return autodump(f"slo_{kind}", trace_id=trace.trace_of(span_id))
+
+
+def slo_breaches() -> dict[str, int]:
+    """Breach counts by kind this round (the ``perf.obs.slo`` view)."""
+    out: dict[str, int] = {}
+    for kind, _ in _slo_fired:
+        out[kind] = out.get(kind, 0) + 1
+    return dict(sorted(out.items()))
+
+
 def dump_events(path: str) -> int:
-    """On-demand dump (--events-out at end of round)."""
+    """On-demand dump (--events-out at end of round). Atomic tmp+rename
+    like every obs file write — a tailing reader never sees half a
+    dump."""
     return recorder.dump_jsonl(path)
 
 
 def write_metrics(path: str) -> None:
-    """Write the Prometheus text exposition (--metrics-out)."""
-    with open(path, "w", encoding="utf-8") as f:
-        f.write(metrics.render_prometheus())
+    """Write the Prometheus text exposition (--metrics-out) atomically
+    (tmp+rename, DiskStore's discipline): a scraper hitting the file
+    mid-round must read the previous complete exposition, never a torn
+    one."""
+    atomic_write_text(path, metrics.render_prometheus())
 
 
 def snapshot() -> dict:
@@ -356,4 +465,9 @@ def snapshot() -> dict:
         "events_by_type": recorder.counts_by_type(),
         "host_syncs": syncs,
         "retrace": retrace.snapshot(),
+        "slo": {
+            "ttft_ms": _config.slo_ttft_ms,
+            "round_s": _config.slo_round_s,
+            "breaches": slo_breaches(),
+        },
     }

@@ -31,6 +31,15 @@ Event vocabulary (the schema ``tools/obs_dump.py`` validates):
 - ``SpecEvent`` — one row's speculative draft/verify outcome.
 - ``SwapEvent`` — one KV-tier transition (demote/promote/rehydrate/
   spill/store/free/quarantine) with post-op per-tier residency.
+- ``SpanEvent`` — a causal-trace stage boundary (begin/end) with the
+  stage's measured wall on the end record.
+
+Causal tracing (obs/trace.py): EVERY event additionally carries
+``trace_id`` (the debate round that caused it) and ``span_id`` (the
+opponent request), stamped explicitly where the emitter knows its
+request and from the ambient trace context otherwise (``obs.emit``
+fills empty fields). Both default to "" so events emitted outside any
+round (tests, tools) stay valid.
 """
 
 from __future__ import annotations
@@ -51,6 +60,8 @@ class StepEvent:
     decode_chunk: int = 0  # decode-chunk budget per live row
     pipeline_depth: int = 0  # steps in flight after this dispatch
     sync_reason: str = ""  # why the host synced this step ("" = no sync)
+    trace_id: str = ""  # round the step served (ambient)
+    span_id: str = ""  # riding admission's request ("" = batch-level)
 
 
 @dataclass(slots=True)
@@ -61,6 +72,8 @@ class RequestEvent:
     slot: int = -1
     tokens: int = 0  # tokens relevant to this transition
     cached_tokens: int = 0
+    trace_id: str = ""
+    span_id: str = ""
 
 
 @dataclass(slots=True)
@@ -73,6 +86,8 @@ class FaultEvent:
     pages_freed: int = 0
     requeued: bool = False
     error: str = ""
+    trace_id: str = ""  # the injured request's round
+    span_id: str = ""  # the injured request itself
 
 
 @dataclass(slots=True)
@@ -81,6 +96,8 @@ class BreakerEvent:
     model: str = ""
     frm: str = ""
     to: str = ""
+    trace_id: str = ""
+    span_id: str = ""
 
 
 @dataclass(slots=True)
@@ -91,6 +108,8 @@ class CacheEvent:
     blocks: int = 0
     pages: int = 0
     hit: bool = False
+    trace_id: str = ""  # admission that drove the op (ambient)
+    span_id: str = ""
 
 
 @dataclass(slots=True)
@@ -100,6 +119,8 @@ class CompileEvent:
     key: str = ""
     n_compiles: int = 0
     unexpected: bool = False
+    trace_id: str = ""  # request whose dispatch compiled (ambient)
+    span_id: str = ""
 
 
 @dataclass(slots=True)
@@ -118,6 +139,8 @@ class SpecEvent:
     accepted: int = 0
     emitted: int = 0
     rolled_back_pages: int = 0
+    trace_id: str = ""
+    span_id: str = ""
 
 
 @dataclass(slots=True)
@@ -138,6 +161,31 @@ class SwapEvent:
     slot: int = -1  # admission slot driving the swap (-1: none)
     host_resident: int = 0
     disk_resident: int = 0
+    trace_id: str = ""  # admission that drove the swap (ambient)
+    span_id: str = ""
+
+
+@dataclass(slots=True)
+class SpanEvent:
+    """A causal-trace stage boundary (obs/trace.py id model). ``begin``
+    marks entry into a stage (``wall_s`` 0), ``end`` carries the
+    stage's measured wall — synthetic deterministic seconds from the
+    mock engine, real walls from the scheduler, exactly the float
+    convention every other event follows. The per-request stage
+    vocabulary the scheduler and mock both emit (``queued`` →
+    ``prefill`` → ``decode`` under a ``request`` envelope whose end
+    wall is the request's SERVICE time, prefill + decode — the
+    decomposition ``tools/trace_view.py`` CHECKS, not just renders)
+    plus the debate layer's ``round``/``opponent`` spans."""
+
+    TYPE = "span"
+    name: str = ""  # request|queued|prefill|decode|round|opponent|...
+    phase: str = "begin"  # begin | end
+    req_id: int = -1
+    slot: int = -1
+    wall_s: float = 0.0  # stage duration, set on the end record
+    trace_id: str = ""
+    span_id: str = ""
 
 
 EVENT_TYPES = (
@@ -149,7 +197,10 @@ EVENT_TYPES = (
     CompileEvent,
     SpecEvent,
     SwapEvent,
+    SpanEvent,
 )
+
+SPAN_PHASES = ("begin", "end")
 
 SWAP_OPS = (
     "demote",
@@ -232,6 +283,8 @@ def validate_event(obj) -> list[str]:
         errors.append(f"request: unknown state {obj.get('state')!r}")
     if etype == "swap" and obj.get("op") not in SWAP_OPS:
         errors.append(f"swap: unknown op {obj.get('op')!r}")
+    if etype == "span" and obj.get("phase") not in SPAN_PHASES:
+        errors.append(f"span: unknown phase {obj.get('phase')!r}")
     return errors
 
 
@@ -273,8 +326,14 @@ class FlightRecorder:
         self.seq = 0
         self.dropped = 0
 
-    def events(self) -> list[dict]:
-        return [event_to_dict(seq, ev) for seq, ev in self._buf]
+    def events(self, trace_id: str | None = None) -> list[dict]:
+        """Buffered events as dicts; ``trace_id`` scopes to one round's
+        causal story (the SLO auto-dump's view)."""
+        return [
+            event_to_dict(seq, ev)
+            for seq, ev in self._buf
+            if trace_id is None or ev.trace_id == trace_id
+        ]
 
     def counts_by_type(self) -> dict[str, int]:
         out: dict[str, int] = {}
@@ -282,21 +341,41 @@ class FlightRecorder:
             out[ev.TYPE] = out.get(ev.TYPE, 0) + 1
         return dict(sorted(out.items()))
 
-    def to_jsonl(self) -> str:
+    def to_jsonl(self, trace_id: str | None = None) -> str:
         lines = [
-            json.dumps(e, separators=(",", ":")) for e in self.events()
+            json.dumps(e, separators=(",", ":"))
+            for e in self.events(trace_id)
         ]
         return "\n".join(lines) + ("\n" if lines else "")
 
-    def dump_jsonl(self, path: str) -> int:
-        """Write the buffered events as JSONL; returns the line count.
-        Atomic-ish (write then rename) so a reader never sees a torn
-        file — the auto-dump fires mid-fault, possibly mid-crash."""
-        import os
+    def dump_jsonl(self, path: str, trace_id: str | None = None) -> int:
+        """Write the buffered events as JSONL; returns the line count
+        written. ``trace_id`` scopes the dump to one round's events
+        (SLO-triggered captures). Atomic via the shared tmp+rename
+        discipline (DiskStore's): the auto-dump fires mid-fault,
+        possibly mid-crash, and a reader must never see a torn file."""
+        data = self.to_jsonl(trace_id)
+        atomic_write_text(path, data)
+        return data.count("\n")
 
-        data = self.to_jsonl()
-        tmp = f"{path}.tmp"
+
+def atomic_write_text(path: str, data: str) -> None:
+    """Write ``data`` to ``path`` atomically: a pid-suffixed temp file
+    in the same directory, then ``os.replace`` (atomic on POSIX) —
+    DiskStore's discipline (engine/kvtier.py). A reader polling the
+    path (a Prometheus scraper on --metrics-out, a tail on the events
+    JSONL) sees either the old complete file or the new complete file,
+    never a torn one; a crashed writer leaves only a ``.tmp`` orphan."""
+    import os
+
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
         with open(tmp, "w", encoding="utf-8") as f:
             f.write(data)
         os.replace(tmp, path)
-        return len(self._buf)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise

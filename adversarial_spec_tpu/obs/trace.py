@@ -1,0 +1,124 @@
+"""Causal request tracing: trace/span ids + the ambient stamping context.
+
+The metrics registry answers "how much", the flight recorder answers
+"what was the batcher doing" — this module answers "to whom": every
+flight-recorder event carries a ``trace_id`` (one per debate round) and
+a ``span_id`` (one per opponent request), so a ``FaultEvent`` or a TTFT
+sample ties back to the exact round and opponent that caused it.
+
+Id model
+--------
+
+- ``trace_id`` — minted once per debate round by the debate layer
+  (``run_round``): ``tr-<round:03d>-<n:02d>`` where ``n`` is a
+  process-wide counter, reset per CLI invocation (``reset()``). Minting
+  is DETERMINISTIC: the same invocation sequence yields byte-identical
+  ids on the mock and real engines alike (the debate layer mints before
+  any engine is chosen), which is what lets tier-1 pin trace parity on
+  CPU.
+- ``span_id`` — minted per opponent request as ``<trace_id>/s<i:02d>``
+  (``i`` = the request's index in the round). A span id embeds its
+  trace id, so a span alone resolves to exactly one round + opponent.
+
+Propagation is by VALUE down the serving stack (``ChatRequest`` →
+``SchedRequest`` → per-slot batcher state) and by AMBIENT context for
+emit sites that do not know their request (prefix-cache CacheEvents,
+tier SwapEvents, retrace CompileEvents): ``obs.emit`` stamps any event
+whose ``trace_id``/``span_id`` fields are empty from the ambient pair
+set here. The drive loop is single-threaded, so plain module state
+suffices — no contextvars, no locks (same concession the recorder
+makes).
+
+``reset()`` clears BOTH the counter and the ambient pair; it rides
+``obs.reset_stats()`` so one CLI invocation's trace state can never
+leak into the next (one invocation = one round).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from contextlib import contextmanager
+
+
+class _Ambient:
+    """The current (trace_id, span_id) pair ``obs.emit`` stamps from.
+
+    A tiny slotted object rather than two module globals so the emit
+    hot path pays one attribute load to reach both fields.
+    """
+
+    __slots__ = ("trace", "span")
+
+    def __init__(self) -> None:
+        self.trace = ""
+        self.span = ""
+
+
+ambient = _Ambient()
+_trace_counter = 0
+
+
+def mint_trace(round_num: int = 0, seed: int | None = None) -> str:
+    """Mint the next trace id for ``round_num``.
+
+    Counter-based and deterministic: the n-th mint of a process (post
+    ``reset()``) always yields the same id, so mock and real rounds of
+    the same shape carry byte-identical ids. ``seed`` (optional) mixes
+    an 8-hex suffix in for callers that need ids unique across
+    processes (a serving daemon would pass its instance seed); the CLI
+    round path leaves it None so tier-1 can pin exact ids.
+    """
+    global _trace_counter
+    _trace_counter += 1
+    tid = f"tr-{round_num:03d}-{_trace_counter:02d}"
+    if seed is not None:
+        suffix = hashlib.sha256(
+            f"{seed}:{round_num}:{_trace_counter}".encode()
+        ).hexdigest()[:8]
+        tid = f"{tid}-{suffix}"
+    return tid
+
+
+def mint_span(trace_id: str, index: int) -> str:
+    """Span id for opponent request ``index`` of ``trace_id``. Embeds
+    the trace id so a span alone resolves to one round + opponent."""
+    return f"{trace_id}/s{index:02d}"
+
+
+def trace_of(span_id: str) -> str:
+    """The trace id a span id embeds ('' for an empty/foreign id)."""
+    return span_id.rsplit("/s", 1)[0] if "/s" in span_id else ""
+
+
+def set_ambient(trace_id: str = "", span_id: str = "") -> None:
+    ambient.trace = trace_id
+    ambient.span = span_id
+
+
+def get_ambient() -> tuple[str, str]:
+    return ambient.trace, ambient.span
+
+
+@contextmanager
+def scope(trace_id: str, span_id: str = ""):
+    """Temporarily set the ambient pair (restores the previous pair on
+    exit, even through exceptions) — the scheduler wraps admission and
+    per-slot work in this so prefix-cache/tier/retrace emits inside
+    stamp the request that caused them."""
+    prev_trace, prev_span = ambient.trace, ambient.span
+    ambient.trace = trace_id
+    ambient.span = span_id
+    try:
+        yield
+    finally:
+        ambient.trace = prev_trace
+        ambient.span = prev_span
+
+
+def reset() -> None:
+    """Per-invocation reset: counter back to zero, ambient cleared.
+    Rides ``obs.reset_stats()`` (no-leak across CLI invocations)."""
+    global _trace_counter
+    _trace_counter = 0
+    ambient.trace = ""
+    ambient.span = ""

@@ -1119,7 +1119,10 @@ def _run_obs_overhead(platform: str) -> dict:
             )
             hot.prefill_chunk.observe(0.012695)
             hot.ttft.observe(0.012695)
-            # _emit_lifecycle: 5 transitions + outcome counter
+            # _emit_lifecycle: 5 transitions + outcome counter + the
+            # causal-trace span set (request envelope + stage walls)
+            # + the two SLO gates, exactly the mock's per-request
+            # accounting since the tracing PR.
             for st in ("queued", "admitted", "prefill", "decode", "finished"):
                 emit(
                     obs.RequestEvent(
@@ -1127,6 +1130,24 @@ def _run_obs_overhead(platform: str) -> dict:
                         cached_tokens=288,
                     )
                 )
+            for name, phase, wall in (
+                ("request", "begin", 0.0),
+                ("queued", "begin", 0.0),
+                ("queued", "end", 0.0),
+                ("prefill", "begin", 0.0),
+                ("prefill", "end", 0.012695),
+                ("decode", "begin", 0.0),
+                ("decode", "end", 0.062695),
+                ("request", "end", 0.07539),
+            ):
+                emit(
+                    obs.SpanEvent(
+                        name=name, phase=phase, req_id=i, slot=1,
+                        wall_s=wall, span_id="tr-001-01/s01",
+                    )
+                )
+            obs.slo_check("ttft", "tr-001-01/s01", 0.012695)
+            obs.slo_check("round", "tr-001-01/s01", 0.07539)
             hot.req_finished.inc()
             # chat fan-in counter (1/len(batch) per request; count the
             # whole inc here — a deliberate overestimate)

@@ -795,19 +795,23 @@ class TestMutationHardening:
         assert code == 0
         data = json.loads(out)
         assert set(data) == {
-            "all_agreed", "round", "doc_type", "models", "focus",
-            "persona", "preserve_intent", "session", "results", "cost",
-            "perf",
+            "all_agreed", "round", "doc_type", "trace_id", "models",
+            "focus", "persona", "preserve_intent", "session", "results",
+            "cost", "perf",
         }
         assert data["all_agreed"] is True
         assert data["round"] == 1
         assert data["doc_type"] == "generic"
         assert data["preserve_intent"] is False
+        # Deterministic causal-trace ids (obs/trace.py): round 1's
+        # first trace, span per opponent index.
+        assert data["trace_id"] == "tr-001-01"
         assert set(data["results"][0]) == {
-            "model", "agreed", "response", "spec", "error",
+            "model", "agreed", "response", "spec", "error", "span_id",
             "input_tokens", "output_tokens", "cached_tokens",
             "prefill_time_s", "decode_time_s", "cost",
         }
+        assert data["results"][0]["span_id"] == "tr-001-01/s00"
 
     def test_providers_json_schema(self, monkeypatch, capsys):
         code, out, _ = run_cli(

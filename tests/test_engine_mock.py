@@ -299,6 +299,7 @@ class TestTypesMutationHardening:
                 "decode_time_s": 0.0,
             },
             "latency_s": 0.123,
+            "span_id": "",
         }
 
     def test_round_result_partitions(self):

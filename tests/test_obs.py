@@ -180,10 +180,18 @@ class TestFlightRecorder:
             "slot": -1,
             "tokens": 0,
             "cached_tokens": 0,
+            "trace_id": "",
+            "span_id": "",
         }
         assert validate_event(good) == []
         assert validate_event({**good, "state": "exploded"})  # bad state
         assert validate_event({**good, "extra": 1})  # unknown field
+        # Trace ids are schema fields like any other: wrong type and
+        # missing both reject.
+        assert validate_event({**good, "trace_id": 7})
+        missing = dict(good)
+        del missing["span_id"]
+        assert validate_event(missing)
 
     def test_dump_jsonl_atomic_write(self, tmp_path):
         r = FlightRecorder(size=4)

@@ -876,7 +876,8 @@ class TestObsDump:
             tmp_path,
             [
                 {"seq": 1, "type": "compile", "program": "decode",
-                 "key": "(4,)", "n_compiles": 2, "unexpected": True},
+                 "key": "(4,)", "n_compiles": 2, "unexpected": True,
+                 "trace_id": "", "span_id": ""},
             ],
         )
         assert main([path]) == 0
@@ -894,6 +895,279 @@ class TestObsDump:
             assert set(EVENT_FIELDS[cls.TYPE]) == {
                 f.name for f in dataclasses.fields(cls)
             }
+        # Trace ids are part of EVERY event's schema, and the span
+        # event is in the vocabulary.
+        for cls in EVENT_TYPES:
+            assert "trace_id" in EVENT_FIELDS[cls.TYPE]
+            assert "span_id" in EVENT_FIELDS[cls.TYPE]
+        assert "span" in EVENT_FIELDS
+
+    def _traced_dump(self, tmp_path):
+        from adversarial_spec_tpu.obs import (
+            FlightRecorder,
+            RequestEvent,
+            SpanEvent,
+            StepEvent,
+        )
+
+        r = FlightRecorder(size=64)
+        r.append(
+            SpanEvent(name="request", phase="begin", req_id=0,
+                      trace_id="tr-001-01", span_id="tr-001-01/s00")
+        )
+        r.append(
+            RequestEvent(req_id=0, state="queued", tokens=8,
+                         trace_id="tr-001-01", span_id="tr-001-01/s00")
+        )
+        r.append(
+            StepEvent(kind="decode", n_live=1, decode_chunk=4,
+                      trace_id="tr-001-01")
+        )
+        r.append(
+            SpanEvent(name="prefill", phase="end", req_id=0, slot=1,
+                      wall_s=0.25, trace_id="tr-001-01",
+                      span_id="tr-001-01/s00")
+        )
+        r.append(
+            StepEvent(kind="decode", n_live=1, decode_chunk=4,
+                      trace_id="tr-002-01")
+        )
+        p = tmp_path / "traced.jsonl"
+        r.dump_jsonl(str(p))
+        return str(p)
+
+    def test_trace_filter_scopes_the_views(self, tmp_path, capsys):
+        from tools.obs_dump import main
+
+        path = self._traced_dump(tmp_path)
+        assert main([path, "--trace", "tr-001-01"]) == 0
+        out = capsys.readouterr().out
+        assert "4 event(s)" in out  # the tr-002-01 step is filtered
+        assert main([path, "--trace", "tr-002-01"]) == 0
+        assert "1 event(s)" in capsys.readouterr().out
+
+    def test_span_rows_render_in_timeline_and_request_log(
+        self, tmp_path, capsys
+    ):
+        from tools.obs_dump import main
+
+        path = self._traced_dump(tmp_path)
+        assert main([path, "--timeline", "--requests"]) == 0
+        out = capsys.readouterr().out
+        assert "request:begin" in out
+        assert "prefill:end" in out
+        assert "0.2500s" in out  # end rows carry the stage wall
+        assert "span begin" in out  # legend documents the glyphs
+        assert "span=tr-001-01/s00" in out  # request log row suffix
+
+
+class TestTraceView:
+    """tools/trace_view.py — per-request waterfalls + the CHECKED
+    stage-wall decomposition (deeper coverage incl. corruption rides
+    tests/test_trace.py with real scheduler/mock streams)."""
+
+    def _write(self, tmp_path, events):
+        import json
+
+        p = tmp_path / "ev.jsonl"
+        p.write_text(
+            "".join(json.dumps(e) + "\n" for e in events),
+            encoding="utf-8",
+        )
+        return str(p)
+
+    def _span(self, seq, name, phase, wall=0.0, sid="tr-001-01/s00"):
+        return {
+            "seq": seq, "type": "span", "name": name, "phase": phase,
+            "req_id": 0, "slot": 0, "wall_s": wall,
+            "trace_id": "tr-001-01", "span_id": sid,
+        }
+
+    def test_consistent_stream_renders_and_exits_0(self, tmp_path, capsys):
+        from tools.trace_view import main
+
+        path = self._write(
+            tmp_path,
+            [
+                self._span(1, "request", "begin"),
+                self._span(2, "queued", "end", 0.01),
+                self._span(3, "prefill", "end", 0.25),
+                self._span(4, "decode", "end", 0.75),
+                self._span(5, "request", "end", 1.0),
+            ],
+        )
+        assert main([path]) == 0
+        out = capsys.readouterr().out
+        assert "service 1.0000s" in out
+        assert "critical path: tr-001-01/s00" in out
+        assert "dominant stage: decode" in out
+
+    def test_sum_violation_exits_1(self, tmp_path, capsys):
+        from tools.trace_view import main
+
+        path = self._write(
+            tmp_path,
+            [
+                self._span(1, "prefill", "end", 0.25),
+                self._span(2, "decode", "end", 0.25),
+                self._span(3, "request", "end", 1.0),
+            ],
+        )
+        assert main([path]) == 1
+        assert "DECOMPOSITION VIOLATION" in capsys.readouterr().err
+
+    def test_open_requests_are_rendered_not_checked(self, tmp_path):
+        """A request evicted mid-flight (no decode end) waterfalls as
+        'open' but cannot fail the sum check — there is nothing to
+        check yet."""
+        from tools.trace_view import main
+
+        path = self._write(
+            tmp_path,
+            [
+                self._span(1, "request", "begin"),
+                self._span(2, "prefill", "end", 0.25),
+            ],
+        )
+        assert main([path]) == 0
+
+    def test_trace_scoping_and_json_mode(self, tmp_path, capsys):
+        import json as json_mod
+
+        from tools.trace_view import main
+
+        path = self._write(
+            tmp_path,
+            [
+                self._span(1, "prefill", "end", 0.5),
+                self._span(2, "decode", "end", 0.5),
+                self._span(3, "request", "end", 1.0),
+                self._span(
+                    4, "request", "end", 9.0, sid="tr-002-01/s00"
+                )
+                | {"trace_id": "tr-002-01"},
+            ],
+        )
+        assert main([path, "--trace", "tr-001-01", "--json"]) == 0
+        data = json_mod.loads(capsys.readouterr().out)
+        assert set(data["requests"]) == {"tr-001-01/s00"}
+        assert data["check_problems"] == []
+
+    def test_unreadable_input_exits_2(self, tmp_path):
+        from tools.trace_view import main
+
+        assert main([str(tmp_path / "missing.jsonl")]) == 2
+
+
+class TestBenchTrend:
+    """tools/bench_trend.py — the BENCH_*.json join + schema gate."""
+
+    def _metric_file(self, tmp_path, name="BENCH_demo.json", **over):
+        import json
+
+        payload = {
+            "metric": "demo_metric", "value": 1.5, "unit": "x",
+            "platform": "cpu", "within_budget": True,
+        }
+        payload.update(over)
+        for k, v in list(payload.items()):
+            if v is None:
+                del payload[k]
+        (tmp_path / name).write_text(json.dumps(payload))
+        return payload
+
+    def test_joins_metric_and_ladder_files(self, tmp_path, capsys):
+        import json
+
+        from tools.bench_trend import main
+
+        self._metric_file(tmp_path)
+        (tmp_path / "BENCH_r01.json").write_text(
+            json.dumps(
+                {
+                    "n": 1, "cmd": "python bench.py", "rc": 0,
+                    "tail": "…",
+                    "parsed": {
+                        "metric": "tok_per_sec", "value": 497.9,
+                        "unit": "tok/s", "platform": "tpu",
+                    },
+                }
+            )
+        )
+        assert main(["--dir", str(tmp_path)]) == 0
+        out = capsys.readouterr().out
+        assert "demo_metric" in out and "tok_per_sec" in out
+        assert "497.9" in out
+        assert main(["--dir", str(tmp_path), "--json"]) == 0
+        data = json.loads(capsys.readouterr().out)
+        assert [r["mode"] for r in data["rows"]] == ["demo", "r01"]
+        assert data["problems"] == []
+
+    def test_schema_violation_fails_the_gate(self, tmp_path, capsys):
+        from tools.bench_trend import main
+
+        self._metric_file(
+            tmp_path, name="BENCH_bad.json", value="fast"
+        )
+        assert main(["--dir", str(tmp_path)]) == 1
+        err = capsys.readouterr().err
+        assert "BENCH_bad.json" in err and "value" in err
+
+    def test_successful_ladder_run_requires_parsed_payload(
+        self, tmp_path, capsys
+    ):
+        import json
+
+        from tools.bench_trend import main
+
+        (tmp_path / "BENCH_r09.json").write_text(
+            json.dumps({"n": 9, "cmd": "x", "rc": 0, "tail": ""})
+        )
+        assert main(["--dir", str(tmp_path)]) == 1
+        assert "no parsed metric payload" in capsys.readouterr().err
+        # A FAILED ladder run legitimately has no payload.
+        (tmp_path / "BENCH_r09.json").write_text(
+            json.dumps({"n": 9, "cmd": "x", "rc": 1, "tail": "boom"})
+        )
+        assert main(["--dir", str(tmp_path)]) == 0
+        capsys.readouterr()
+        # ...but a parsed payload PRESENT on a failed run must still
+        # schema-validate: malformed fields are a gate failure, not a
+        # crash in the renderer.
+        (tmp_path / "BENCH_r09.json").write_text(
+            json.dumps(
+                {
+                    "n": 9, "cmd": "x", "rc": 1, "tail": "boom",
+                    "parsed": {
+                        "metric": "m", "value": "fast", "unit": "x",
+                        "platform": "cpu",
+                    },
+                }
+            )
+        )
+        assert main(["--dir", str(tmp_path)]) == 1
+        assert "value" in capsys.readouterr().err
+
+    def test_committed_bench_record_is_valid(self):
+        """The repo's own BENCH_* files pass the gate (this is what
+        lint_all --full runs)."""
+        from pathlib import Path
+
+        from tools.bench_trend import collect
+
+        rows, problems = collect(Path(__file__).resolve().parent.parent)
+        assert problems == []
+        assert len(rows) >= 8
+        modes = {r["mode"] for r in rows}
+        assert {"obs", "prefix", "spec", "tier", "interleave"} <= modes
+        obs_row = next(r for r in rows if r["mode"] == "obs")
+        assert obs_row["within_budget"] is True
+
+    def test_empty_and_missing_dirs_exit_2(self, tmp_path):
+        from tools.bench_trend import main
+
+        assert main(["--dir", str(tmp_path)]) == 2
+        assert main(["--dir", str(tmp_path / "nope")]) == 2
 
 
 class TestMutationRun:

@@ -263,9 +263,9 @@ class TestContinuousServing:
         calls = []
         orig = tpu_mod.TpuEngine._chat_continuous
 
-        def spy(self, lm, prompts, params):
+        def spy(self, lm, prompts, params, batch=None):
             calls.append(len(prompts))
-            return orig(self, lm, prompts, params)
+            return orig(self, lm, prompts, params, batch)
 
         tpu_mod.TpuEngine._chat_continuous = spy
         try:
@@ -304,6 +304,43 @@ class TestContinuousServing:
             assert c.usage.output_tokens == c.usage.decode_tokens
             assert c.usage.device_time_s >= c.usage.decode_time_s >= 0
 
+    def test_paged_chat_propagates_trace_ids_to_events(self, engine):
+        """The engine-seam hop of causal tracing: ChatRequest ids ride
+        through chat → _chat_continuous → SchedRequest and arrive
+        byte-identical on the real batcher's request events — the same
+        ids the mock path stamps, so a paged CLI round resolves every
+        event to one round/opponent regardless of engine."""
+        import dataclasses
+
+        from adversarial_spec_tpu import obs
+
+        save_registry_entry(
+            ModelSpec(alias="cont-tiny", family="llama", size="tiny",
+                      kv="paged", dtype="float32", mesh={"dp": 1})
+        )
+        obs.reset_stats()
+        reqs = [
+            dataclasses.replace(
+                _req("tpu://cont-tiny", user),
+                trace_id="tr-004-01",
+                span_id=f"tr-004-01/s{i:02d}",
+            )
+            for i, user in enumerate(["alpha", "beta bee"])
+        ]
+        comps = engine.chat(reqs, PARAMS)
+        assert all(c.ok for c in comps)
+        spans_seen = {
+            e["req_id"]: e["span_id"]
+            for e in obs.recorder.events()
+            if e["type"] == "request"
+        }
+        assert spans_seen == {
+            0: "tr-004-01/s00",
+            1: "tr-004-01/s01",
+        }
+        for e in obs.recorder.events():
+            if e["trace_id"]:
+                assert e["trace_id"] == "tr-004-01", e
 
     def test_timeout_returns_partial(self, engine):
         """timeout_s parity with the dense path: an expired deadline

@@ -114,6 +114,13 @@ class GraftlintConfig:
             "obs_mod.",
             "recorder.append",
             "metrics.",
+            # Causal tracing (obs/trace.py): ambient-scope mutation and
+            # span minting are host side effects — at trace time they
+            # would stamp one compile's ids onto every later dispatch.
+            "trace.",
+            "trace_mod.",
+            "trace_scope",
+            "slo_check",
         ]
     )
     # Extra dotted function names (module.func) to treat as trace roots

@@ -11,13 +11,17 @@ stage failed):
    tools/mutation_run.py consume must stay importable and structurally
    sound (non-empty marker tuples, tests + graftlint fixtures excluded
    from mutation targets).
-3. **unroll compile check** (``--full`` only — it jit-compiles an
+3. **bench-trend** (``--full`` only) — every committed BENCH_*.json
+   must schema-validate and join into the perf-trajectory table
+   (tools/bench_trend.py): a malformed bench file fails the gate
+   instead of silently dropping out of the record.
+4. **unroll compile check** (``--full`` only — it jit-compiles an
    80-layer config three times, minutes of CPU) — the decode-scan
    unroll cost measurement, tools/unroll_compile_check.py.
 
 Usage:
     python tools/lint_all.py          # graftlint + mutmut sanity
-    python tools/lint_all.py --full   # + unroll compile check
+    python tools/lint_all.py --full   # + bench trend + unroll check
 """
 
 from __future__ import annotations
@@ -105,6 +109,23 @@ def _stage_mutmut_sanity() -> bool:
     return ok
 
 
+def _stage_bench_trend() -> bool:
+    from tools.bench_trend import collect
+
+    rows, problems = collect(REPO)
+    for p in problems:
+        print(f"lint_all: bench-trend: {p}", file=sys.stderr)
+    ok = not problems and bool(rows)
+    if not rows:
+        print("lint_all: bench-trend: no BENCH_*.json found", file=sys.stderr)
+    print(
+        f"lint_all: bench-trend {'OK' if ok else 'FAILED'} "
+        f"({len(rows)} bench file(s))",
+        file=sys.stderr,
+    )
+    return ok
+
+
 def _stage_unroll() -> bool:
     r = subprocess.run(
         [sys.executable, str(REPO / "tools" / "unroll_compile_check.py")],
@@ -129,6 +150,7 @@ def main(argv: list[str] | None = None) -> int:
     ok = _stage_graftlint()
     ok = _stage_mutmut_sanity() and ok
     if args.full:
+        ok = _stage_bench_trend() and ok
         ok = _stage_unroll() and ok
     print(
         f"lint_all: {'ALL OK' if ok else 'FAILURES'}",

@@ -11,6 +11,12 @@ Usage:
     python tools/obs_dump.py events.jsonl              # validate + summary
     python tools/obs_dump.py events.jsonl --timeline   # + occupancy bars
     python tools/obs_dump.py events.jsonl --requests   # + per-request log
+    python tools/obs_dump.py events.jsonl --trace ID   # one round only
+
+``--trace`` scopes every view to one causal trace (one debate round;
+obs/trace.py id model) — validation still covers EVERY line, so a
+scoped view can't hide a schema violation elsewhere in the dump. The
+per-request waterfall/critical-path view lives in tools/trace_view.py.
 
 Exit codes: 0 = every line valid; 1 = schema violations (listed on
 stderr); 2 = unreadable input.
@@ -97,7 +103,7 @@ def occupancy_timeline(events: list[dict], width: int = 16) -> str:
     per-tier residency as of that step (host/disk block counts trail
     the most recent swap), and the swaps themselves print inline."""
     steps = [
-        e for e in events if e["type"] in ("step", "swap")
+        e for e in events if e["type"] in ("step", "swap", "span")
     ]
     if not any(e["type"] == "step" for e in steps):
         return "(no step events)"
@@ -109,6 +115,26 @@ def occupancy_timeline(events: list[dict], width: int = 16) -> str:
     rows = []
     host_res = disk_res = 0
     for s in steps:
+        if s["type"] == "span":
+            # Trace-span boundaries print inline so the timeline shows
+            # WHERE in the step stream each request's stages opened and
+            # closed (wall on the end rows; trace_view.py renders the
+            # per-request waterfall proper).
+            notes = []
+            if s["req_id"] >= 0:
+                notes.append(f"req={s['req_id']}")
+            if s["phase"] == "end" and s["wall_s"]:
+                notes.append(f"{s['wall_s']:.4f}s")
+            if s["span_id"]:
+                notes.append(s["span_id"])
+            elif s["trace_id"]:
+                notes.append(s["trace_id"])
+            glyph = ">" if s["phase"] == "begin" else "<"
+            rows.append(
+                f"seq {s['seq']:>6} [{glyph * width}] "
+                f"{s['name'] + ':' + s['phase']:<13} " + " ".join(notes)
+            )
+            continue
         if s["type"] == "swap":
             host_res, disk_res = s["host_resident"], s["disk_resident"]
             notes = [f"{s['blocks']} block(s)", f"{s['tokens']}tok"]
@@ -140,10 +166,12 @@ def occupancy_timeline(events: list[dict], width: int = 16) -> str:
             f"seq {s['seq']:>6} [{bar}] {s['kind']:<8} " + " ".join(notes)
         )
     n_steps = sum(1 for s in steps if s["type"] == "step")
+    spanned = any(e["type"] == "span" for e in steps)
     legend = (
         f"occupancy timeline ({n_steps} step(s), max live {max_live}; "
         "#=fused ==decode .=prefill"
         + ("; ~=tier swap, host/disk=resident blocks" if tiered else "")
+        + ("; >=span begin <=span end" if spanned else "")
         + ")"
     )
     return "\n".join([legend] + rows)
@@ -159,6 +187,8 @@ def request_log(events: list[dict]) -> str:
         extra = (
             f" cached={r['cached_tokens']}" if r["cached_tokens"] else ""
         )
+        if r.get("span_id"):
+            extra += f" span={r['span_id']}"
         rows.append(
             f"seq {r['seq']:>6} req {r['req_id']:>3} "
             f"{r['state']:<9} slot={r['slot']} tokens={r['tokens']}{extra}"
@@ -179,12 +209,19 @@ def main(argv: list[str] | None = None) -> int:
         action="store_true",
         help="render the per-request lifecycle log",
     )
+    ap.add_argument(
+        "--trace",
+        help="scope the rendered views to one trace id (one debate "
+        "round); validation still covers every line",
+    )
     args = ap.parse_args(argv)
     try:
         events, errors = load_events(args.path)
     except OSError as e:
         print(f"obs_dump: {e}", file=sys.stderr)
         return 2
+    if args.trace:
+        events = [e for e in events if e.get("trace_id") == args.trace]
     print(summarize(events))
     if args.timeline:
         print()

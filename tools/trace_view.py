@@ -1,0 +1,224 @@
+"""Per-request latency waterfalls from flight-recorder event JSONL.
+
+The serving path stamps every event with causal trace/span ids
+(obs/trace.py) and emits per-request stage spans (queued → prefill →
+decode under a ``request`` envelope). This tool is the triage half: it
+reconstructs each request's waterfall, prints the critical path per
+round, and — the load-bearing part — **checks** the decomposition: a
+request's stage walls (prefill + decode) must sum to its reported
+service wall within tolerance. A waterfall that doesn't add up is a
+telemetry bug, and this tool treats it as one (exit 1), so the
+decomposition stays checked, not decorative.
+
+Usage:
+    python tools/trace_view.py events.jsonl               # waterfalls + check
+    python tools/trace_view.py events.jsonl --trace ID    # one round only
+    python tools/trace_view.py events.jsonl --json        # machine-readable
+
+Exit codes: 0 = every request's decomposition checks out; 1 = a sum
+violation or schema error; 2 = unreadable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from tools.obs_dump import load_events  # noqa: E402
+
+# |request_wall - (prefill + decode)| must stay within
+# max(ABS_TOL, REL_TOL * request_wall). The scheduler computes the
+# envelope as exactly prefill + decode, and the mock's synthetic
+# seconds are exact binary fractions — the tolerance only absorbs the
+# dump-time 6-decimal rounding of each float.
+ABS_TOL = 1e-5
+REL_TOL = 0.01
+
+# Stage render order in a waterfall row.
+STAGES = ("queued", "prefill", "decode")
+
+
+def collect_requests(events: list[dict]) -> dict[str, dict]:
+    """Group span events by span_id into per-request records:
+    ``{span_id: {trace_id, req_id, begin_seq, stages: {name: wall},
+    ended, extra}}``. A re-emitted stage (a requeued request prefilling
+    twice) keeps the LAST end wall — the one the request actually paid
+    on its surviving attempt."""
+    out: dict[str, dict] = {}
+    for e in events:
+        if e["type"] != "span" or not e["span_id"]:
+            continue
+        rec = out.setdefault(
+            e["span_id"],
+            {
+                "trace_id": e["trace_id"],
+                "req_id": e.get("req_id", -1),
+                "begin_seq": e["seq"],
+                "stages": {},
+                "request_wall": None,
+                "end_seq": None,
+            },
+        )
+        rec["begin_seq"] = min(rec["begin_seq"], e["seq"])
+        if e["phase"] != "end":
+            continue
+        if e["name"] == "request":
+            rec["request_wall"] = e["wall_s"]
+            rec["end_seq"] = e["seq"]
+        elif e["name"] in STAGES:
+            rec["stages"][e["name"]] = e["wall_s"]
+    return out
+
+
+def check_decomposition(requests: dict[str, dict]) -> list[str]:
+    """The contract: for every request whose envelope closed with both
+    device stages present, prefill + decode == request wall within
+    tolerance (queued time is WAIT, deliberately outside the service
+    envelope). Returns human-readable violations (empty = all good)."""
+    problems: list[str] = []
+    for span_id, rec in sorted(requests.items()):
+        wall = rec["request_wall"]
+        stages = rec["stages"]
+        if wall is None or "prefill" not in stages or "decode" not in stages:
+            continue  # evicted/timeout mid-flight: nothing to check
+        total = stages["prefill"] + stages["decode"]
+        if abs(wall - total) > max(ABS_TOL, REL_TOL * wall):
+            problems.append(
+                f"{span_id}: stage walls sum to {total:.6f}s but the "
+                f"request reported {wall:.6f}s service"
+            )
+    return problems
+
+
+def render_waterfall(
+    requests: dict[str, dict], width: int = 32
+) -> str:
+    """Per-request bars, one row per stage, scaled to the slowest
+    request's service wall — the 'where did this opponent's round go'
+    view."""
+    if not requests:
+        return "(no request spans)"
+    scale = max(
+        (
+            sum(r["stages"].values())
+            for r in requests.values()
+            if r["stages"]
+        ),
+        default=0.0,
+    )
+    rows: list[str] = []
+    for span_id, rec in sorted(
+        requests.items(), key=lambda kv: kv[1]["begin_seq"]
+    ):
+        wall = rec["request_wall"]
+        head = f"{span_id}  (req {rec['req_id']}"
+        head += (
+            f", service {wall:.4f}s)" if wall is not None else ", open)"
+        )
+        rows.append(head)
+        offset = 0.0
+        for name in STAGES:
+            if name not in rec["stages"]:
+                continue
+            w = rec["stages"][name]
+            lead = round(offset / scale * width) if scale else 0
+            fill = max(round(w / scale * width), 1) if scale else 0
+            fill = min(fill, width - lead)
+            bar = " " * lead + "█" * fill
+            rows.append(f"  {name:<8} |{bar:<{width}}| {w:.4f}s")
+            if name != "queued":  # wait time doesn't advance service
+                offset += w
+        rows.append("")
+    return "\n".join(rows).rstrip()
+
+
+def critical_path(requests: dict[str, dict]) -> str:
+    """Per-trace summary: request count, total service, and the
+    slowest request with its dominant stage — the first thing to read
+    when an SLO capture lands."""
+    traces: dict[str, list[tuple[str, dict]]] = {}
+    for span_id, rec in requests.items():
+        traces.setdefault(rec["trace_id"], []).append((span_id, rec))
+    lines: list[str] = []
+    for trace_id in sorted(traces):
+        recs = traces[trace_id]
+        closed = [
+            (sid, r) for sid, r in recs if r["request_wall"] is not None
+        ]
+        lines.append(
+            f"trace {trace_id or '(unstamped)'}: {len(recs)} request(s), "
+            f"{len(closed)} closed"
+        )
+        if not closed:
+            continue
+        sid, worst = max(closed, key=lambda kv: kv[1]["request_wall"])
+        stages = worst["stages"]
+        dom = max(stages, key=stages.get) if stages else "?"
+        lines.append(
+            f"  critical path: {sid} at {worst['request_wall']:.4f}s "
+            f"(dominant stage: {dom}"
+            + (f" {stages[dom]:.4f}s)" if stages else ")")
+        )
+        for name in STAGES:
+            total = sum(r["stages"].get(name, 0.0) for _, r in closed)
+            lines.append(f"  total {name:<8} {total:.4f}s")
+    return "\n".join(lines) if lines else "(no traced requests)"
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="events JSONL file to render")
+    ap.add_argument(
+        "--trace", help="restrict to one trace id (one debate round)"
+    )
+    ap.add_argument(
+        "--json",
+        action="store_true",
+        help="machine-readable per-request records + check verdicts",
+    )
+    ap.add_argument(
+        "--no-check",
+        action="store_true",
+        help="render only; skip the stage-sum consistency check",
+    )
+    args = ap.parse_args(argv)
+    try:
+        events, errors = load_events(args.path)
+    except OSError as e:
+        print(f"trace_view: {e}", file=sys.stderr)
+        return 2
+    for err in errors:
+        print(f"trace_view: {err}", file=sys.stderr)
+    if args.trace:
+        events = [e for e in events if e.get("trace_id") == args.trace]
+    requests = collect_requests(events)
+    problems = [] if args.no_check else check_decomposition(requests)
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "requests": requests,
+                    "check_problems": problems,
+                },
+                indent=2,
+                sort_keys=True,
+            )
+        )
+    else:
+        print(render_waterfall(requests))
+        print()
+        print(critical_path(requests))
+    for p in problems:
+        print(f"trace_view: DECOMPOSITION VIOLATION: {p}", file=sys.stderr)
+    if problems or errors:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
