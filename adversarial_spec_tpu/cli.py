@@ -296,6 +296,28 @@ def create_parser() -> argparse.ArgumentParser:
         "sweep measures the on-chip crossover)",
     )
 
+    d.add_argument(
+        "--stream",
+        action=argparse.BooleanOptionalAction,
+        default=None,  # None = inherit ADVSPEC_STREAM (default on)
+        help="Stream tokens per request from the serving path to a "
+        "host-side consumer at the drive loop's existing fetch points "
+        "(default on; --no-stream restores the blocking path, "
+        "byte-identical end to end; ADVSPEC_STREAM=0 sets the process "
+        "default)",
+    )
+    d.add_argument(
+        "--early-cancel",
+        action=argparse.BooleanOptionalAction,
+        default=None,  # None = inherit ADVSPEC_EARLY_CANCEL (default on)
+        help="Cancel an opponent's request mid-decode the moment its "
+        "verdict marker ([AGREE]) appears in the stream: the slot and "
+        "pages free immediately and queued requests admit into them "
+        "(default on; needs --stream; transcripts stay byte-identical "
+        "up to each cancellation point; ADVSPEC_EARLY_CANCEL=0 sets "
+        "the process default)",
+    )
+
     z = parser.add_argument_group("resilience")
     z.add_argument(
         "--chaos",
@@ -560,6 +582,29 @@ def _configure_speculative(args: argparse.Namespace):
     return spec
 
 
+def _configure_streaming(args: argparse.Namespace):
+    """Arm token streaming + early cancellation from flags; returns the
+    module for reporting. Flag-else-env-default each invocation (one
+    invocation = one round), like obs/spec: one round's --no-stream or
+    --no-early-cancel must not leak into the next. Stats reset per
+    invocation so ``perf.stream`` accounts exactly this round's
+    deliveries and cancels."""
+    from adversarial_spec_tpu.engine import streaming
+
+    streaming.configure(
+        enabled=(
+            args.stream if args.stream is not None else streaming.env_enabled()
+        ),
+        early_cancel=(
+            args.early_cancel
+            if args.early_cancel is not None
+            else streaming.env_early_cancel()
+        ),
+    )
+    streaming.reset_stats()
+    return streaming
+
+
 def _configure_obs(args: argparse.Namespace):
     """Arm the observability subsystem from flags; returns the module
     for reporting. One CLI invocation is one round: metrics zero, the
@@ -604,6 +649,7 @@ def run_critique(args: argparse.Namespace) -> int:
     interleave = _configure_interleave(args)
     spec_cfg = _configure_speculative(args)
     kv_tier = _configure_kv_tier(args)
+    streaming = _configure_streaming(args)
     obs = _configure_obs(args)
     spec, session_state = load_or_resume_session(args)
     if session_state is not None and session_state.breakers:
@@ -680,6 +726,9 @@ def run_critique(args: argparse.Namespace) -> int:
     # rehydrations, store writes + quarantines, swap walls
     # (engine/kvtier.py).
     perf["kv_tier"] = kv_tier.snapshot()
+    # Streaming telemetry: requests streamed, deliveries, cancels, and
+    # the decode tokens early cancellation saved (engine/streaming.py).
+    perf["stream"] = streaming.snapshot()
     # Observability report: flight-recorder occupancy, event mix, host
     # syncs by reason, retrace watch (unexpected recompiles flagged).
     perf["obs"] = obs.snapshot()
@@ -719,6 +768,13 @@ def run_critique(args: argparse.Namespace) -> int:
         _err(
             f"prefix cache: {prefix_snap['hits']}/{prefix_snap['lookups']} "
             f"hits, {prefix_snap['saved_tokens']} prefill tokens saved"
+        )
+    stream_snap = perf["stream"]
+    if stream_snap["cancels"]:
+        _err(
+            f"early cancel: {stream_snap['cancels']} request(s) stopped "
+            f"at their verdict marker, {stream_snap['tokens_saved']} "
+            "decode token(s) saved"
         )
     tier_snap = perf["kv_tier"]
     if tier_snap["enabled"] and (
@@ -881,6 +937,7 @@ def handle_export_tasks(args: argparse.Namespace) -> int:
     _configure_interleave(args)
     _configure_speculative(args)
     _configure_kv_tier(args)
+    _configure_streaming(args)
     obs = _configure_obs(args)
     spec = _read_spec_stdin()
     models = parse_models(args)

@@ -29,11 +29,13 @@ from pathlib import Path
 from adversarial_spec_tpu import obs as obs_mod
 from adversarial_spec_tpu.debate import prompts
 from adversarial_spec_tpu.debate.parsing import (
+    StreamScanner,
     detect_agreement,
     extract_spec,
     has_malformed_spec,
 )
 from adversarial_spec_tpu.debate.types import ModelResponse, RoundResult
+from adversarial_spec_tpu.engine import streaming as stream_mod
 from adversarial_spec_tpu.engine.dispatch import get_engine
 from adversarial_spec_tpu.engine.types import ChatRequest, Completion, SamplingParams
 from adversarial_spec_tpu.resilience import breaker as breaker_mod
@@ -96,6 +98,29 @@ def build_request(
         round=round_num, spec=spec
     )
     return ChatRequest(model=model, system=system, user=user)
+
+
+def _early_cancel_consumer():
+    """One chat call's early-convergence stream consumer: an
+    incremental marker scanner per batch row (parsing.StreamScanner
+    over EARLY_CANCEL_MARKERS). The moment a row's verdict is
+    decidable — its marker's last character arrives, however the
+    stream was chunked — it returns False and the engine cancels that
+    request mid-decode. The truncated transcript contains the full
+    marker, so ``detect_agreement`` on it gives exactly the verdict
+    the full text would; everything past the marker is decode the
+    debate never reads (the matched-ceiling study's point: round
+    COUNT, not round length, drives quality). Built fresh per attempt:
+    a retried request streams from scratch."""
+    scanners: dict[int, StreamScanner] = {}
+
+    def consume(row: int, text: str) -> bool:
+        sc = scanners.get(row)
+        if sc is None:
+            sc = scanners[row] = StreamScanner()
+        return sc.feed(text) is None
+
+    return consume
 
 
 def _to_response(
@@ -229,11 +254,28 @@ def run_round(
             )
     try:
         for engine, indices in groups.values():
+            # Streaming early cancellation (docs/streaming.md): when
+            # armed AND the engine's chat exposes the consumer seam,
+            # each request streams through a marker scanner and stops
+            # the moment its verdict is decidable. Engines without the
+            # seam (test fakes, the dense fallback) serve the blocking
+            # path unchanged.
+            stream_ok = stream_mod.armed() and stream_mod.consumer_supported(
+                engine
+            )
             pending = list(indices)
             for attempt in range(MAX_RETRIES):
                 batch = [requests[i] for i in pending]
                 t0 = time.monotonic()
-                completions = engine.chat(batch, cfg.sampling)
+                completions = (
+                    engine.chat(
+                        batch,
+                        cfg.sampling,
+                        consumer=_early_cancel_consumer(),
+                    )
+                    if stream_ok
+                    else engine.chat(batch, cfg.sampling)
+                )
                 latency = time.monotonic() - t0
                 tracer.add_span("engine_chat", latency)
                 still_pending = []

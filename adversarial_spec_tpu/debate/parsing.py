@@ -20,6 +20,60 @@ AGREE_MARKER = "[AGREE]"
 SPEC_OPEN, SPEC_CLOSE = "[SPEC]", "[/SPEC]"
 TASK_RE = re.compile(r"\[TASK\](.*?)\[/TASK\]", re.DOTALL)
 
+# Markers that make a STREAMED response's verdict decidable the moment
+# they appear (the debate core's early-cancel consumer, docs/
+# streaming.md): everything decoded past one of these is never read by
+# the debate loop, so the request cancels mid-decode and the freed
+# capacity serves queued work. Substring semantics deliberately mirror
+# ``detect_agreement`` — a marker inside a code fence still counts —
+# so the incremental verdict can NEVER diverge from the whole-text
+# parse of the same prefix. This tuple also drives the summary cleanup
+# below: a section marker added here is stripped from critique
+# summaries by the same path, with no second list to forget.
+EARLY_CANCEL_MARKERS: tuple[str, ...] = (AGREE_MARKER,)
+
+
+class StreamScanner:
+    """Incremental marker scanner over a growing text stream.
+
+    ``feed`` receives the text decoded SO FAR (each call a superset of
+    the last) and returns the earliest marker whose full text has
+    appeared, or None while the verdict is undecidable. Only the
+    unseen tail plus a ``max(len(marker)) - 1`` lookback window is
+    rescanned, so a marker split across any chunking of the stream —
+    token boundaries never align with marker boundaries — is caught
+    exactly when its last character arrives, and feeding the whole
+    text again stays O(stream length) overall. The verdict is sticky:
+    once found, later feeds return it without rescanning (the consumer
+    has already asked for cancellation; extra chunks may still arrive
+    from steps in flight)."""
+
+    def __init__(self, markers: tuple[str, ...] = EARLY_CANCEL_MARKERS):
+        self.markers = tuple(markers)
+        self._lookback = max(
+            (len(m) for m in self.markers), default=1
+        ) - 1
+        self._pos = 0  # stream offset scanned so far
+        self.found: str | None = None
+        self.found_at: int = -1  # stream offset of the found marker
+
+    def feed(self, text_so_far: str) -> str | None:
+        if self.found is not None or not self.markers:
+            return self.found
+        start = max(self._pos - self._lookback, 0)
+        window = text_so_far[start:]
+        best: str | None = None
+        best_at = -1
+        for marker in self.markers:
+            i = window.find(marker)
+            if i != -1 and (best_at == -1 or i < best_at):
+                best, best_at = marker, i
+        self._pos = len(text_so_far)
+        if best is not None:
+            self.found = best
+            self.found_at = start + best_at
+        return self.found
+
 _TASK_FIELDS = ("title", "description", "priority", "dependencies", "estimate")
 _PRIORITIES = {"critical", "high", "medium", "low"}
 
@@ -130,7 +184,14 @@ def get_critique_summary(critique: str, max_chars: int = 200) -> str:
     Parity: reference scripts/models.py:250-260 — strip tags, take the first
     non-empty line, truncate with an ellipsis.
     """
-    cleaned = critique.replace(AGREE_MARKER, "").strip()
+    # Marker-list-driven cleanup: every verdict marker the streaming
+    # path can cancel on (EARLY_CANCEL_MARKERS) is stripped here too —
+    # one list, so a section marker added for early cancel can never
+    # leak into summaries.
+    cleaned = critique
+    for marker in EARLY_CANCEL_MARKERS:
+        cleaned = cleaned.replace(marker, "")
+    cleaned = cleaned.strip()
     cleaned = re.sub(
         re.escape(SPEC_OPEN) + ".*?" + re.escape(SPEC_CLOSE),
         "",

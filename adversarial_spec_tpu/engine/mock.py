@@ -19,6 +19,22 @@ Model-id grammar (query params configure behavior):
                                           calls, then behaves like ``critic``.
 - any id with ``&tps=N``                — simulates N tokens/sec decode speed
                                           in the reported usage (no sleeping).
+- agreeing ids with ``&agree_tail=N``   — append N deterministic filler
+                                          remarks AFTER the [AGREE] marker:
+                                          the decode early cancellation
+                                          exists to avoid paying for
+                                          (bench.py --mode cancel).
+
+Streaming parity works the same way (engine/streaming.py): a consumer
+passed to ``chat`` receives the reply in fixed-width character chunks
+(markers split across deliveries, like real token boundaries), and a
+consumer returning False truncates the reply at that chunk boundary —
+the transcript is the blocking reply's byte-identical prefix. The
+cancel is accounted in ``perf.stream`` (tokens saved = the full reply's
+remainder) and emits the scheduler's exact schema (CancelEvent, the
+``cancelled`` lifecycle state, the request span closing with a
+``cancelled`` phase), so the whole cancellation pipeline pins
+deterministically on CPU.
 
 The round number is recovered from the round template's "Debate round {N}"
 header (prompts.REVIEW_PROMPT_TEMPLATE), the same information a real opponent
@@ -64,9 +80,17 @@ from urllib.parse import parse_qs, urlparse
 
 from adversarial_spec_tpu import obs as obs_mod
 from adversarial_spec_tpu.debate.usage import Usage
+from adversarial_spec_tpu.engine import streaming as stream_mod
 from adversarial_spec_tpu.engine.types import ChatRequest, Completion, SamplingParams
 
 _ROUND_RE = re.compile(r"Debate round (\d+)")
+
+# Streaming delivery granularity: the reply streams to the consumer in
+# fixed-width character chunks. Width 5 on purpose — "[AGREE]" is 7
+# characters, so the verdict marker routinely SPLITS across deliveries,
+# which is exactly the case the incremental scanner
+# (debate/parsing.StreamScanner) must handle.
+_STREAM_CHUNK_CHARS = 5
 
 # Mock prefix-cache geometry. A "token" is _TOKEN_CHARS characters of
 # system+user text (matching _estimate_tokens' 4-chars-per-token rule, so
@@ -249,6 +273,7 @@ class MockEngine:
         cached: int,
         out_tokens: int,
         span_id: str = "",
+        cancelled: bool = False,
     ) -> None:
         """The scheduler's RequestEvent lifecycle, deterministically:
         queued → admitted → prefill → decode → finished, one synthetic
@@ -267,10 +292,14 @@ class MockEngine:
             ("admitted", in_tokens),
             ("prefill", in_tokens - cached),
             ("decode", out_tokens),
-            ("finished", out_tokens),
+            ("cancelled" if cancelled else "finished", out_tokens),
         )
         prefill_s = (in_tokens - cached) / 1024.0
         decode_s = out_tokens / 1024.0
+        # A cancelled request's envelope closes with the ``cancelled``
+        # phase and its service wall SO FAR — still exactly
+        # prefill + decode, so trace_view's decomposition check covers
+        # cancelled requests (the scheduler's truncated span set).
         spans = (
             ("request", "begin", 0.0),
             ("queued", "begin", 0.0),
@@ -279,7 +308,11 @@ class MockEngine:
             ("prefill", "end", prefill_s),
             ("decode", "begin", 0.0),
             ("decode", "end", decode_s),
-            ("request", "end", prefill_s + decode_s),
+            (
+                "request",
+                "cancelled" if cancelled else "end",
+                prefill_s + decode_s,
+            ),
         )
         for state, tokens in transitions:
             obs_mod.emit(
@@ -302,7 +335,10 @@ class MockEngine:
                     span_id=span_id,
                 )
             )
-        obs_mod.hot.req_finished.inc()
+        if not cancelled:
+            # Cancelled requests count through advspec_cancelled_total
+            # (emitted by the caller), not the finished outcome.
+            obs_mod.hot.req_finished.inc()
         obs_mod.slo_check("ttft", span_id, prefill_s)
         obs_mod.slo_check("round", span_id, prefill_s + decode_s)
 
@@ -407,7 +443,10 @@ class MockEngine:
         return cached
 
     def chat(
-        self, requests: list[ChatRequest], params: SamplingParams
+        self,
+        requests: list[ChatRequest],
+        params: SamplingParams,
+        consumer=None,
     ) -> list[Completion]:
         # Request 0 prefills into an empty batch (stalled); every later
         # request's prefill would ride the residents' decode in the
@@ -416,7 +455,10 @@ class MockEngine:
         if obs_mod.config().enabled:
             obs_mod.hot.mock_chat_requests.inc(len(requests))
         return [
-            self._one(req, params, overlapped=i > 0, req_index=i)
+            self._one(
+                req, params, overlapped=i > 0, req_index=i,
+                consumer=consumer,
+            )
             for i, req in enumerate(requests)
         ]
 
@@ -426,12 +468,45 @@ class MockEngine:
         params: SamplingParams,
         overlapped: bool = False,
         req_index: int = 0,
+        consumer=None,
     ) -> Completion:
         # The request's ambient trace scope: every event this request's
         # accounting emits (cache/tier/step/spec) stamps with its
         # trace/span, exactly as the scheduler scopes admissions.
         with obs_mod.trace_scope(req.trace_id, req.span_id):
-            return self._one_traced(req, params, overlapped, req_index)
+            return self._one_traced(
+                req, params, overlapped, req_index, consumer
+            )
+
+    @staticmethod
+    def _stream_text(req_index: int, text: str, consumer) -> tuple[str, bool]:
+        """Deterministic CPU mirror of the batcher's streaming
+        delivery: the reply streams in ``_STREAM_CHUNK_CHARS``-wide
+        chunks (each call the text SO FAR — the engine-seam contract),
+        and a consumer returning False truncates the reply at that
+        chunk boundary, so the transcript is the blocking reply's
+        byte-identical prefix. Deliveries are accounted exactly the
+        way the scheduler's ``_deliver_stream`` does — one
+        ``record_delivery`` per callback that carried NEW (estimated)
+        tokens — so ``perf.stream`` deliveries/streamed_tokens mean
+        the same thing on both engines. Returns (possibly truncated
+        text, cancelled?). A raising consumer disables streaming for
+        the rest of the reply — the scheduler's containment rule."""
+        pos = 0
+        last_tokens = 0
+        while pos < len(text):
+            pos = min(pos + _STREAM_CHUNK_CHARS, len(text))
+            cur_tokens = _estimate_tokens(text[:pos])
+            if cur_tokens > last_tokens:
+                stream_mod.stats.record_delivery(cur_tokens - last_tokens)
+                last_tokens = cur_tokens
+            try:
+                keep = bool(consumer(req_index, text[:pos]))
+            except Exception:
+                return text, False
+            if not keep:
+                return text[:pos], True
+        return text, False
 
     def _one_traced(
         self,
@@ -439,6 +514,7 @@ class MockEngine:
         params: SamplingParams,
         overlapped: bool = False,
         req_index: int = 0,
+        consumer=None,
     ) -> Completion:
         parsed = urlparse(req.model)
         behavior = parsed.netloc or parsed.path.lstrip("/")
@@ -499,6 +575,16 @@ class MockEngine:
         cached = self._account_prefix(req, overlapped, req_index)
         if behavior == "agree" or (agree_after and round_num >= agree_after):
             text = "[AGREE]\nNo remaining objections; the document is ready."
+            tail = int(opts.get("agree_tail", "0"))
+            if tail > 0:
+                # Deterministic verbosity AFTER the verdict marker —
+                # exactly the decode early cancellation converts back
+                # into served capacity (bench.py --mode cancel).
+                text += "\n\nExtended remarks:" + "".join(
+                    f"\n- remark {k}: the document remains acceptable "
+                    "in every reviewed dimension."
+                    for k in range(1, tail + 1)
+                )
         else:
             crit = _CRITIQUES[(round_num - 1) % len(_CRITIQUES)]
             spec = _extract_document(req.user)
@@ -507,12 +593,37 @@ class MockEngine:
                 f"1. {crit}\n\n[SPEC]\n{revised}\n[/SPEC]"
             )
 
+        full_tokens = min(_estimate_tokens(text), params.max_new_tokens)
+        cancelled = False
+        if consumer is not None and stream_mod.config().enabled:
+            stream_mod.stats.record_request()
+            text, cancelled = self._stream_text(req_index, text, consumer)
         out_tokens = min(_estimate_tokens(text), params.max_new_tokens)
         tps = float(opts.get("tps", "0"))
         in_tokens = _estimate_tokens(req.system) + _estimate_tokens(req.user)
+        stream_saved = 0
+        if cancelled:
+            stream_saved = max(full_tokens - out_tokens, 0)
+            stream_mod.stats.record_cancel(out_tokens, stream_saved)
+            if obs_mod.config().enabled:
+                obs_mod.hot.cancel("early_converge").inc()
+                obs_mod.hot.cancel_tokens_saved.observe(float(stream_saved))
+                obs_mod.emit(
+                    obs_mod.CancelEvent(
+                        req_id=req_index,
+                        slot=req_index,
+                        reason="early_converge",
+                        tokens_emitted=out_tokens,
+                        tokens_saved=stream_saved,
+                        span_id=req.span_id,
+                    )
+                )
+        # Speculation accounting runs over the DELIVERED text only: the
+        # batcher never decodes past a cancel either.
         self._account_spec(req, text, req_index)
         self._emit_lifecycle(
-            req_index, in_tokens, cached, out_tokens, req.span_id
+            req_index, in_tokens, cached, out_tokens, req.span_id,
+            cancelled=cancelled,
         )
         usage = Usage(
             input_tokens=in_tokens,
@@ -521,7 +632,7 @@ class MockEngine:
             decode_time_s=out_tokens / tps if tps > 0 else 0.0,
             cached_tokens=cached,
         )
-        return Completion(text=text, usage=usage)
+        return Completion(text=text, usage=usage, cancelled=cancelled)
 
 
 def _extract_document(user_prompt: str) -> str:

@@ -68,6 +68,7 @@ from adversarial_spec_tpu.engine import interleave as interleave_mod
 from adversarial_spec_tpu.engine import kvtier as kvtier_mod
 from adversarial_spec_tpu.engine import prefix_cache as prefix_mod
 from adversarial_spec_tpu.engine import spec as spec_mod
+from adversarial_spec_tpu.engine import streaming as stream_mod
 from adversarial_spec_tpu import obs as obs_mod
 from adversarial_spec_tpu.engine.sampling import filtered_logits
 from adversarial_spec_tpu.engine.speculative import (
@@ -110,6 +111,12 @@ class SchedRequest:
     # emit site knows the request, via the ambient scope elsewhere).
     trace_id: str = ""
     span_id: str = ""
+    # Host-side streaming consumer (engine/streaming.py): called at the
+    # drive loop's existing fetch points with ALL token ids this
+    # request has emitted so far (np.ndarray); return False to cancel
+    # the request mid-decode (``_cancel_slot``). None = the blocking
+    # path, byte-identical to pre-streaming behavior.
+    on_tokens: object = None
 
 
 @dataclass
@@ -184,6 +191,12 @@ class SchedResult:
     # prefill_time_s it IS the request's service wall — the end wall of
     # its ``request`` trace span (tools/trace_view.py checks the sum).
     decode_time_s: float = 0.0
+    # Streaming early-convergence cancellation (engine/streaming.py):
+    # ``cancelled`` marks a CLEAN mid-decode stop requested by the
+    # consumer (``tokens`` holds the partial transcript, no error);
+    # ``tokens_saved`` is the budget remainder never decoded.
+    cancelled: bool = False
+    tokens_saved: int = 0
     # Echo of the request's causal-trace ids.
     trace_id: str = ""
     span_id: str = ""
@@ -1088,6 +1101,13 @@ class ContinuousBatcher:
 
         self._slot_req: list[SchedRequest | None] = [None] * B
         self._slot_seq: list[int | None] = [None] * B
+        # Streaming state (engine/streaming.py): the owner's consumer
+        # callback and how many tokens it has been delivered so far —
+        # deliveries happen at the drive loop's EXISTING fetch points
+        # (no new sanctioned syncs), and a consumer returning False
+        # triggers ``_cancel_slot``.
+        self._slot_consumer: list = [None] * B
+        self._slot_streamed: list[int] = [0] * B
         # Per-slot request telemetry, stamped at admission handoff.
         self._slot_cached: list[int] = [0] * B
         self._slot_prefill_s: list[float] = [0.0] * B
@@ -1733,8 +1753,9 @@ class ContinuousBatcher:
         # fetched above, blocking on every step in flight.
         interleave_mod.stats.record_sync()
         obs_mod.record_sync("admission_handoff")
-        # graftlint: disable=GL-SYNC -- admission handoff is a sanctioned sync point: the first sampled token decides slot activation
-        first_is_eos = bool(np.isin(np.asarray(first), self._eos_np))
+        # graftlint: disable=GL-SYNC -- admission handoff is a sanctioned sync point: the first sampled token decides slot activation (and seeds the slot's stream delivery)
+        first_np = np.asarray(first)
+        first_is_eos = bool(np.isin(first_np, self._eos_np))
         self.n_emitted = self.n_emitted.at[slot].set(1)
         self.max_new = self.max_new.at[slot].set(req.max_new_tokens)
         row_active = (req.max_new_tokens > 1) and not first_is_eos
@@ -1782,6 +1803,10 @@ class ContinuousBatcher:
         self._slot_trace[slot] = req.trace_id
         self._slot_span[slot] = req.span_id
         self._slot_decode_s[slot] = 0.0
+        self._slot_consumer[slot] = req.on_tokens
+        self._slot_streamed[slot] = 0
+        if req.on_tokens is not None:
+            stream_mod.stats.record_request()
         elapsed = time.monotonic() - t0
         # The handoff (pool scatter + first-token sample + sync) is time
         # the batch genuinely waits on: stalled, in both loop modes.
@@ -1837,6 +1862,16 @@ class ContinuousBatcher:
             obs_mod.slo_check(
                 "ttft", req.span_id, self._slot_prefill_s[slot]
             )
+        # First-token stream delivery: ``first`` was already fetched
+        # for the EOS check above, so this rides the handoff sync. A
+        # consumer that cancels on the very first token (its marker is
+        # a single token, or the prompt itself decided the verdict)
+        # stops the row before it ever joins a decode step.
+        if req.on_tokens is not None:
+            keep = self._deliver_stream(slot, 1, first_np.reshape(1))
+            if not keep and row_active:
+                self._cancel_slot(slot, 1, first_np.reshape(1))
+                return
         if not row_active:
             self._finish_slot(slot)
 
@@ -2101,16 +2136,10 @@ class ContinuousBatcher:
         for a speculating row ``free_sequence`` drops its committed
         pages AND any in-flight draft pages."""
         req = self._slot_req[slot]
-        free0 = self.allocator.free_pages
-        self.allocator.free_sequence(self._slot_seq[slot])
-        self._slot_req[slot] = None
-        self._slot_seq[slot] = None
-        self.active = self.active.at[slot].set(False)
-        self._active_np[slot] = False
+        st = self._slot_spec[slot]
+        pages_freed = self._release_slot(slot)
         interleave_mod.stats.record_sync()  # fault decision point
         obs_mod.record_sync("fault")
-        self.page_table = self.page_table.at[slot].set(0)
-        st = self._slot_spec[slot]
         if obs_mod.config().enabled:
             # The victim's decode span closes with its accumulated
             # share before the request envelope does (_fault_request).
@@ -2134,10 +2163,230 @@ class ContinuousBatcher:
             cached_tokens=self._slot_cached[slot],
             prefill_time_s=self._slot_prefill_s[slot],
             slot=slot,
-            pages_freed=self.allocator.free_pages - free0,
+            pages_freed=pages_freed,
             spec_counts=(st[0], st[1], st[2]),
             decode_time_s=self._slot_decode_s[slot],
         )
+
+    def _release_slot(self, slot: int) -> int:
+        """THE slot-release surgery, shared by fault eviction
+        (``_evict_slot``) and cancellation (``_cancel_slot``) — one
+        implementation so a new release invariant cannot be added to
+        one path and forgotten on the other (the PR 6 lesson, where the
+        two fault paths had already drifted apart). Drops the slot's
+        sequence references (pages shared with the prefix cache or
+        other admissions survive untouched; for a speculating row this
+        covers committed AND in-flight draft pages), clears ownership
+        and streaming state, deactivates the device row, zeroes its
+        page-table row, and bumps the ownership generation so any
+        in-flight flags/counts/deliveries for the old owner expire.
+        Returns the pages actually freed."""
+        free0 = self.allocator.free_pages
+        self.allocator.free_sequence(self._slot_seq[slot])
+        self._slot_req[slot] = None
+        self._slot_seq[slot] = None
+        self._slot_consumer[slot] = None
+        self._slot_streamed[slot] = 0
+        self.active = self.active.at[slot].set(False)
+        self._active_np[slot] = False
+        self._slot_gen[slot] += 1
+        self.page_table = self.page_table.at[slot].set(0)
+        return self.allocator.free_pages - free0
+
+    # -- streaming + cancellation ------------------------------------------
+
+    def _stream_armed(self, slots) -> bool:
+        """True when any of ``slots`` has a streaming consumer — the
+        gate for the extra (same-sync-point) token fetches below."""
+        return any(self._slot_consumer[s] is not None for s in slots)
+
+    def _deliver_stream(self, slot: int, n: int, tokens) -> bool:
+        """Deliver this slot's tokens-so-far to its streaming consumer
+        (pure host callback — no device work, no sync). Returns False
+        when the consumer asked for cancellation. A consumer that
+        RAISES is disabled for the rest of the request and the row
+        decodes to its budget — a broken callback must not corrupt the
+        batcher or take co-residents down with it."""
+        cb = self._slot_consumer[slot]
+        if cb is None or n <= self._slot_streamed[slot]:
+            return True
+        new = n - self._slot_streamed[slot]
+        self._slot_streamed[slot] = n
+        stream_mod.stats.record_delivery(new)
+        try:
+            return bool(cb(np.asarray(tokens[:n])))
+        except Exception:
+            self._slot_consumer[slot] = None
+            return True
+
+    def _stream_entry(
+        self, emitted_np: np.ndarray, out_np: np.ndarray, live_slots
+    ) -> None:
+        """Stream one fetched step's tokens to every live consumer and
+        cancel the rows whose consumers are done. ``live_slots`` are
+        (slot, generation) pairs recorded at dispatch — the same guard
+        ``_fetch_entry`` uses, so a freed-and-readmitted slot can never
+        have an old step's tokens delivered to its new owner."""
+        for slot, gen in live_slots:
+            if (
+                gen != self._slot_gen[slot]
+                or self._slot_req[slot] is None
+                or self._slot_consumer[slot] is None
+            ):
+                continue
+            n = int(emitted_np[slot])
+            keep = self._deliver_stream(slot, n, out_np[slot])
+            if not keep and self._active_np[slot]:
+                # Still decoding: stop paying for the rest of the
+                # budget. (An already-finished row resolves through
+                # _collect with nothing left to save.)
+                self._cancel_slot(slot, n, out_np[slot, :n])
+
+    def _cancel_slot(
+        self,
+        slot: int,
+        n: int,
+        tokens,
+        reason: str = "early_converge",
+    ) -> None:
+        """First-class mid-decode cancellation: the consumer has read
+        everything the debate will ever use, so the request stops HERE
+        — a clean result carrying the partial transcript, not a fault.
+
+        The slot frees through the same reference-drop surgery fault
+        eviction uses — ``_release_slot``, the ONE shared
+        implementation — (pages shared with the prefix cache survive;
+        for a speculating row the per-step counts fetch already rolled
+        draft pages back past the accepted prefix via
+        ``PageAllocator.truncate``, so ``free_sequence`` drops exactly
+        the committed coverage), and the freed capacity re-admits
+        queued work at the next ``_admit``. Before the refs drop, the
+        computed KV is SALVAGED: the full pages covering
+        prompt + emitted tokens insert into the prefix cache, so a
+        later admission sharing the prefix adopts instead of
+        re-prefilling (the canonical layout makes page content
+        position-pure, hence cacheable mid-request).
+
+        In-flight steps may still write this row's KV tail: device
+        programs execute in dispatch order, so those stale writes land
+        BEFORE any later owner's data (the fault-eviction discipline),
+        and the inserted pages end strictly below every position an
+        in-flight step can touch — full pages cover at most
+        prompt + n - 1 tokens (the last emitted token's KV is only
+        written when it is consumed), while in-flight writes start at
+        or past that boundary, i.e. in the first NON-inserted page.
+        The ownership-generation bump expires any in-flight flags or
+        spec counts for the slot.
+        """
+        req = self._slot_req[slot]
+        seq = self._slot_seq[slot]
+        # Budget remainder: how much reserved decode capacity the
+        # cancel returned to the pool. An UPPER bound on the decode
+        # actually avoided — where EOS would have landed is unknowable
+        # once we stop decoding (the mock, which scripts its own reply,
+        # reports the exact remainder instead; engine/streaming.py).
+        saved = max(int(req.max_new_tokens) - n, 0)
+        if self.prefix_cache is not None:
+            covered = len(req.prompt_ids) + max(n - 1, 0)
+            n_full = covered // self.page_size
+            if n_full:
+                ids = list(req.prompt_ids) + [
+                    int(t) for t in tokens[: max(n - 1, 0)]
+                ]
+                self.prefix_cache.insert(
+                    ids[: n_full * self.page_size],
+                    self.allocator.table(seq)[:n_full],
+                )
+        st = self._slot_spec[slot]
+        cached = self._slot_cached[slot]
+        prefill_s = self._slot_prefill_s[slot]
+        decode_s = self._slot_decode_s[slot]
+        self._release_slot(slot)
+        stream_mod.stats.record_cancel(n, saved)
+        self.results.append(
+            SchedResult(
+                req_id=req.req_id,
+                tokens=np.asarray(tokens[:n], np.int32),
+                n_generated=n,
+                cancelled=True,
+                tokens_saved=saved,
+                cached_tokens=cached,
+                prefill_time_s=prefill_s,
+                spec_steps=st[0],
+                spec_drafted=st[1],
+                spec_accepted=st[2],
+                decode_time_s=decode_s,
+                trace_id=req.trace_id,
+                span_id=req.span_id,
+            )
+        )
+        if obs_mod.config().enabled:
+            obs_mod.hot.cancel(reason).inc()
+            obs_mod.hot.cancel_tokens_saved.observe(float(saved))
+            if self.speculative and st[1]:
+                obs_mod.hot.spec_acceptance.observe(st[2] / st[1])
+            obs_mod.hot.pool_util.set(
+                round(
+                    1.0
+                    - self.allocator.free_pages / self.allocator.n_pages,
+                    6,
+                )
+            )
+            obs_mod.emit(
+                obs_mod.RequestEvent(
+                    req_id=req.req_id,
+                    state="cancelled",
+                    slot=slot,
+                    tokens=n,
+                    cached_tokens=cached,
+                    trace_id=req.trace_id,
+                    span_id=req.span_id,
+                )
+            )
+            obs_mod.emit(
+                obs_mod.CancelEvent(
+                    req_id=req.req_id,
+                    slot=slot,
+                    reason=reason,
+                    tokens_emitted=n,
+                    tokens_saved=saved,
+                    trace_id=req.trace_id,
+                    span_id=req.span_id,
+                )
+            )
+            # Truncated span set: decode closes with the slot's
+            # accumulated share, the request envelope closes with
+            # phase ``cancelled`` and the service wall SO FAR — still
+            # exactly prefill + decode, so tools/trace_view.py's
+            # decomposition check holds for cancelled requests too.
+            obs_mod.emit(
+                obs_mod.SpanEvent(
+                    name="decode",
+                    phase="end",
+                    req_id=req.req_id,
+                    slot=slot,
+                    wall_s=decode_s,
+                    trace_id=req.trace_id,
+                    span_id=req.span_id,
+                )
+            )
+            obs_mod.emit(
+                obs_mod.SpanEvent(
+                    name="request",
+                    phase="cancelled",
+                    req_id=req.req_id,
+                    slot=slot,
+                    wall_s=prefill_s + decode_s,
+                    trace_id=req.trace_id,
+                    span_id=req.span_id,
+                )
+            )
+            # A cancelled request still consumed service: the round SLO
+            # judges the wall it actually paid, exactly as
+            # ``_finish_slot`` does (and as the mock does for cancelled
+            # lifecycles) — a breach that happens to end in a cancel
+            # must still count and self-capture.
+            obs_mod.slo_check("round", req.span_id, prefill_s + decode_s)
 
     # -- completion --------------------------------------------------------
 
@@ -2154,6 +2403,10 @@ class ContinuousBatcher:
         # graftlint: disable=GL-SYNC -- slot completion token fetch (same sanctioned point as the count above)
         row = np.asarray(self.out_buf[slot, :n])
         st = self._slot_spec[slot]
+        # Final-tail stream delivery: an EOS/budget-terminated row hands
+        # its consumer the last tokens here (a late cancel is moot —
+        # the row is already done, nothing left to save).
+        self._deliver_stream(slot, n, row)
         self.results.append(
             SchedResult(
                 req_id=req.req_id,
@@ -2173,8 +2426,11 @@ class ContinuousBatcher:
             # Per-request acceptance rate at completion — the obs
             # histogram the ISSUE's serving headline reads from.
             obs_mod.hot.spec_acceptance.observe(st[2] / st[1])
-        self.allocator.free_sequence(self._slot_seq[slot])
-        self._slot_req[slot] = None
+        # The shared release surgery (also fault eviction's and
+        # cancellation's): beyond the ref drop it clears _slot_seq —
+        # the hand-rolled version left it stale — and keeps every
+        # release invariant in one place.
+        self._release_slot(slot)
         if obs_mod.config().enabled:
             obs_mod.hot.req_finished.inc()
             obs_mod.hot.pool_util.set(
@@ -2727,13 +2983,28 @@ class ContinuousBatcher:
         Fetches only DEACTIVATE, and only rows whose slot still belongs
         to the request that was live at dispatch (generation match) — a
         slot freed and re-admitted mid-flight must not have the old
-        row's completion flag truncate its new owner."""
-        active_ref, live_slots = entry
+        row's completion flag truncate its new owner.
+
+        When streaming is armed the entry additionally carries the
+        step's emitted counts and an out_buf SNAPSHOT (out_buf itself
+        is donated to the next dispatch; the snapshot is an independent
+        device copy taken at dispatch time): their fetch rides the SAME
+        resolved/depth-bound point as the flags — this is exactly how
+        decoded tokens already land on host every step, so the stream
+        consumer adds no new sanctioned sync."""
+        active_ref, emitted_ref, out_ref, live_slots = entry
         # graftlint: disable=GL-SYNC -- pipelined fetch: called only when the entry resolved (is_ready) or at the depth bound, the double buffer's one sanctioned blocking point
         act = np.asarray(active_ref)
         for s, gen in live_slots:
             if gen == self._slot_gen[s] and not act[s]:
                 self._active_np[s] = False
+        if emitted_ref is None:
+            return
+        # graftlint: disable=GL-SYNC -- stream token fetch riding the same resolved/depth-bound entry fetch as the flags above (no new sync point; the async copy started at dispatch)
+        emitted_np = np.asarray(emitted_ref)
+        # graftlint: disable=GL-SYNC -- stream token fetch (the out_buf snapshot in the same entry; see above)
+        out_np = np.asarray(out_ref)
+        self._stream_entry(emitted_np, out_np, live_slots)
 
     def _drive_pipelined(self, timeout_s: float) -> None:
         """Admit → dispatch (fused when an admission and live rows
@@ -2912,6 +3183,25 @@ class ContinuousBatcher:
                     obs_mod.record_sync("spec_counts")
                     if counts_np is not None:
                         self._apply_spec_counts(counts_np, spec_slots)
+                        if self._stream_armed(
+                            s for s, _ in spec_slots
+                        ):
+                            # Stream delivery at the spec path's ONE
+                            # sanctioned per-step sync: the counts
+                            # fetch above already blocked on this
+                            # step, so the token fetch adds no new
+                            # sync point (out_buf is the step's live
+                            # output here — its donation happens at
+                            # the NEXT dispatch). Emitted counts come
+                            # from the host views _apply_spec_counts
+                            # just advanced.
+                            # graftlint: disable=GL-SYNC -- stream token fetch at the sanctioned spec_counts sync (the counts fetch above already blocked on this step)
+                            out_np = np.asarray(self.out_buf)
+                            self._stream_entry(
+                                self._cur_len_np - self._row_len_np,
+                                out_np,
+                                spec_slots,
+                            )
                 dt = time.monotonic() - t0
                 span = self.gamma + 1
                 if fused_share > 0.0:
@@ -2988,16 +3278,29 @@ class ContinuousBatcher:
                         )
                     )
             elif dispatched:
+                # Streaming consumers ride the double buffer: the entry
+                # carries the step's emitted counts plus an out_buf
+                # SNAPSHOT (jnp.copy — out_buf itself is donated to the
+                # next dispatch, so a raw ref would be deleted before
+                # the depth-bound fetch; the copy is a device-side op
+                # that overlaps compute and only exists while a
+                # consumer is attached).
+                streaming = self._stream_armed(live)
                 entry = (
                     self.active,
+                    self.n_emitted if streaming else None,
+                    jnp.copy(self.out_buf) if streaming else None,
                     tuple((s, self._slot_gen[s]) for s in live),
                 )
-                try:
-                    # Start the device→host copy now; the fetch one
-                    # iteration later should find it already resolved.
-                    entry[0].copy_to_host_async()
-                except Exception:
-                    pass  # optional fast path only
+                for ref in entry[:3]:
+                    if ref is None:
+                        continue
+                    try:
+                        # Start the device→host copy now; the fetch one
+                        # iteration later should find it resolved.
+                        ref.copy_to_host_async()
+                    except Exception:
+                        pass  # optional fast path only
                 inflight.append(entry)
                 depth = len(inflight)
                 step_sync = ""
@@ -3125,6 +3428,16 @@ class ContinuousBatcher:
                             self._apply_spec_counts(
                                 counts_np, live_slots
                             )
+                            if self._stream_armed(live):
+                                # Stream + cancel at the legacy spec
+                                # step's full sync (this whole loop is
+                                # serialized by design).
+                                self._stream_entry(
+                                    self._cur_len_np
+                                    - self._row_len_np,
+                                    np.asarray(self.out_buf),
+                                    live_slots,
+                                )
                         except Exception as e:
                             self._handle_decode_fault(e)
                         finally:
@@ -3193,5 +3506,14 @@ class ContinuousBatcher:
                                     sync_reason="legacy_step",
                                 )
                             )
+                    if self._stream_armed(live):
+                        # Stream + cancel at the legacy step's full
+                        # sync (this loop blocks every chunk anyway).
+                        self._active_np[:] = np.asarray(self.active)
+                        self._stream_entry(
+                            np.asarray(self.n_emitted),
+                            np.asarray(self.out_buf),
+                            tuple((s, self._slot_gen[s]) for s in live),
+                        )
             self._collect()
         self._active_np[:] = np.asarray(self.active)

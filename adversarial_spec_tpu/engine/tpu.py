@@ -42,6 +42,7 @@ from adversarial_spec_tpu.engine import kvtier as kvtier_mod
 from adversarial_spec_tpu.engine import prefix_cache as prefix_mod
 from adversarial_spec_tpu.engine import registry as registry_mod
 from adversarial_spec_tpu.engine import spec as spec_mod
+from adversarial_spec_tpu.engine import streaming as stream_mod
 from adversarial_spec_tpu.engine.generate import (
     MIN_BUCKET,
     bucket_length,
@@ -512,7 +513,10 @@ class TpuEngine:
     # -- serving -----------------------------------------------------------
 
     def chat(
-        self, requests: list[ChatRequest], params: SamplingParams
+        self,
+        requests: list[ChatRequest],
+        params: SamplingParams,
+        consumer=None,
     ) -> list[Completion]:
         if obs_mod.config().enabled:
             obs_mod.metrics.counter(
@@ -530,6 +534,12 @@ class TpuEngine:
         out: list[Completion | None] = [None] * len(requests)
         for gi, (alias, indices) in enumerate(groups.items()):
             batch = [requests[i] for i in indices]
+            # The caller's stream consumer indexes rows of ITS batch;
+            # re-map each group's row back through the group indices.
+            group_consumer = None
+            if consumer is not None:
+                def group_consumer(row, text, _c=consumer, _ix=tuple(indices)):
+                    return _c(_ix[row], text)
             try:
                 completions = self._chat_one_model(
                     alias,
@@ -543,6 +553,7 @@ class TpuEngine:
                     prefetch_next=(
                         aliases[gi + 1] if gi + 1 < len(aliases) else None
                     ),
+                    consumer=group_consumer,
                 )
             except Exception as e:  # degrade, never raise (parity: ref)
                 msg = f"{type(e).__name__}: {e}"
@@ -575,6 +586,7 @@ class TpuEngine:
         batch: list[ChatRequest],
         params: SamplingParams,
         prefetch_next: str | None = None,
+        consumer=None,
     ) -> list[Completion]:
         # Pin BEFORE loading: from the moment this model can be resident
         # it must not be an eviction victim of a concurrent background
@@ -588,13 +600,17 @@ class TpuEngine:
             if prefetch_next is not None:
                 self._maybe_prefetch(prefetch_next)
             injector.fire("generate")
-            return self._chat_loaded(lm, batch, params)
+            return self._chat_loaded(lm, batch, params, consumer)
         finally:
             with self._lock:
                 self._pinned.discard(alias)
 
     def _chat_loaded(
-        self, lm: LoadedModel, batch: list[ChatRequest], params: SamplingParams
+        self,
+        lm: LoadedModel,
+        batch: list[ChatRequest],
+        params: SamplingParams,
+        consumer=None,
     ) -> list[Completion]:
         tok = lm.tokenizer
         instruct = lm.spec.checkpoint != "random"
@@ -623,7 +639,12 @@ class TpuEngine:
             lm.cfg.max_seq_len - params.max_new_tokens >= MIN_BUCKET
         )
         if lm.spec.kv == "paged" and lm.mesh.size == 1 and fits_batcher:
-            return self._chat_continuous(lm, prompts, params, batch)
+            return self._chat_continuous(lm, prompts, params, batch, consumer)
+        # The round-synchronous generate() fallback has no per-request
+        # token stream (one fused program decodes the whole batch to
+        # budget): consumers are served the blocking result only —
+        # streaming and early cancellation are batcher-path features
+        # (docs/streaming.md).
 
         t0 = time.monotonic()
         with lm.mesh:
@@ -682,6 +703,7 @@ class TpuEngine:
         prompts: list[list[int]],
         params: SamplingParams,
         batch: list[ChatRequest] | None = None,
+        consumer=None,
     ) -> list[Completion]:
         """Serve one model's requests through the ContinuousBatcher.
 
@@ -749,7 +771,7 @@ class TpuEngine:
         t0 = time.monotonic()
         try:
             results, decode_time = self._run_batcher(
-                lm, batcher_key, prompts, params, seed, batch
+                lm, batcher_key, prompts, params, seed, batch, consumer
             )
         except BaseException:
             # An escaping exception (decode fault whose donated-state
@@ -781,8 +803,11 @@ class TpuEngine:
                     # Fault-evicted rows keep their partial decode in
                     # ``text`` (diagnostic value) but carry the error so
                     # the debate core's retry/degrade policy applies.
+                    # Cancelled rows are CLEAN partials: the consumer
+                    # read everything it needed before stopping them.
                     text=tok.decode(r.tokens[: r.n_generated]),
                     error=r.error,
+                    cancelled=r.cancelled,
                     transient=(
                         r.fault_kind is not None
                         and faults.FaultKind(r.fault_kind).transient
@@ -800,8 +825,30 @@ class TpuEngine:
             )
         return completions
 
+    @staticmethod
+    def _make_stream_callback(tok, consumer, row):
+        """Incremental detokenization for one request: the batcher
+        hands ALL emitted ids so far (monotone supersets); decode the
+        full prefix each delivery — a partial multi-byte token decodes
+        differently once its continuation arrives, and HF detokenizers
+        are not concatenative in general (metaspace/whitespace joining),
+        so suffix-diffing could hand the consumer text the blocking
+        path never produces, breaking the seam's byte-parity guarantee.
+        The full re-decode is a DELIBERATE O(n²/chunk) host cost:
+        deliveries happen once per fetched chunk (not per token), n is
+        capped by max_new_tokens, and it is paid only while a consumer
+        is attached — cheap against the 32 model forwards each chunk
+        represents. Returning False asks the batcher to cancel the
+        request mid-decode."""
+
+        def on_tokens(token_ids) -> bool:
+            return bool(consumer(row, tok.decode(token_ids)))
+
+        return on_tokens
+
     def _run_batcher(
-        self, lm, batcher_key, prompts, params, seed, batch=None
+        self, lm, batcher_key, prompts, params, seed, batch=None,
+        consumer=None,
     ):
         """Acquire (reuse or build) the model's persistent batcher and
         drain this call's requests through it.
@@ -860,6 +907,7 @@ class TpuEngine:
             # Per-round telemetry delta: the persistent batcher's
             # counters accumulate across rounds.
             decode_t0 = batcher.decode_time_s
+            stream_on = consumer is not None and stream_mod.config().enabled
             for i, ids in enumerate(prompts):
                 src = batch[i] if batch is not None else None
                 batcher.submit(
@@ -873,6 +921,11 @@ class TpuEngine:
                         # the debate round that caused it.
                         trace_id=src.trace_id if src is not None else "",
                         span_id=src.span_id if src is not None else "",
+                        on_tokens=(
+                            self._make_stream_callback(tok, consumer, i)
+                            if stream_on
+                            else None
+                        ),
                     )
                 )
             results = batcher.run_all(timeout_s=params.timeout_s)

@@ -10,9 +10,20 @@ batch dimension of one XLA program (SURVEY §2.3).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Protocol, runtime_checkable
+from typing import Callable, Protocol, runtime_checkable
 
 from adversarial_spec_tpu.debate.usage import Usage
+
+# Streaming consumer at the engine seam (docs/streaming.md): called
+# with (request index within the chat batch, the full response text
+# decoded SO FAR — each call a superset of the last, so a marker split
+# across token boundaries is always eventually visible in one string).
+# Return False to cancel that request mid-decode; the engine resolves
+# it with the partial text (byte-identical to the blocking path up to
+# the cancellation point) and ``Completion.cancelled`` set. Engines
+# whose ``chat`` lacks the ``consumer`` parameter simply serve the
+# blocking path (debate/core.py inspects before passing one).
+StreamConsumer = Callable[[int, str], bool]
 
 
 @dataclass(frozen=True)
@@ -57,6 +68,11 @@ class Completion:
     error: str | None = None
     # Transient errors are retried by the caller; permanent ones are not.
     transient: bool = False
+    # Set when a streaming consumer cancelled this request mid-decode
+    # (early convergence): ``text`` holds the partial transcript up to
+    # the cancellation point — a CLEAN result, not an error (the
+    # consumer read everything it needed).
+    cancelled: bool = False
     usage: Usage = field(default_factory=Usage)
 
     @property
@@ -69,9 +85,18 @@ class Engine(Protocol):
     """Minimal engine surface the debate core depends on."""
 
     def chat(
-        self, requests: list[ChatRequest], params: SamplingParams
+        self,
+        requests: list[ChatRequest],
+        params: SamplingParams,
+        consumer: StreamConsumer | None = None,
     ) -> list[Completion]:
-        """Complete every request; must return len(requests) completions."""
+        """Complete every request; must return len(requests) completions.
+
+        ``consumer`` (optional capability — callers probe for the
+        parameter via ``streaming.consumer_supported`` before passing
+        one) streams each request's decoded-text-so-far to the host and
+        lets it cancel mid-decode; with ``None`` the call is the
+        original blocking path, byte-identical to pre-streaming."""
         ...
 
     def validate(self, model: str) -> str | None:

@@ -37,6 +37,7 @@ from adversarial_spec_tpu.obs import trace  # noqa: F401 (re-export)
 from adversarial_spec_tpu.obs.events import (  # noqa: F401 (re-export)
     BreakerEvent,
     CacheEvent,
+    CancelEvent,
     CompileEvent,
     EVENT_FIELDS,
     FaultEvent,
@@ -163,12 +164,14 @@ class HotMetrics:
         "mock_chat_requests",
         "spec_tokens_per_step",
         "spec_acceptance",
+        "cancel_tokens_saved",
         "_m",
         "_sync",
         "_fault",
         "_breaker",
         "_tier_hit",
         "_swap",
+        "_cancel",
     )
 
     def __init__(self, m: MetricsRegistry) -> None:
@@ -228,11 +231,23 @@ class HotMetrics:
             help="per-request accepted/drafted ratio at completion",
             buckets=RATIO_BUCKETS,
         )
+        # Streaming early-convergence cancellation (engine/streaming.py):
+        # budget tokens each cancelled request never decoded — the
+        # capacity the cancellation converted back into served traffic.
+        self.cancel_tokens_saved = m.histogram(
+            "advspec_cancel_tokens_saved",
+            help="decode-budget tokens saved per cancelled request",
+            buckets=(
+                8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0, 1024.0,
+                2048.0, 4096.0,
+            ),
+        )
         self._sync: dict = {}
         self._fault: dict = {}
         self._breaker: dict = {}
         self._tier_hit: dict = {}
         self._swap: dict = {}
+        self._cancel: dict = {}
 
     def sync(self, reason: str):
         c = self._sync.get(reason)
@@ -275,6 +290,19 @@ class HotMetrics:
                 tier=tier,
             )
         return g
+
+    def cancel(self, reason: str):
+        """Mid-decode cancellation counter by reason (early_converge
+        from the debate layer's marker scanner; other consumers may
+        name their own)."""
+        c = self._cancel.get(reason)
+        if c is None:
+            c = self._cancel[reason] = self._m.counter(
+                "advspec_cancelled_total",
+                help="mid-decode request cancellations by reason",
+                reason=reason,
+            )
+        return c
 
     def swap_latency(self, direction: str):
         """KV swap wall histogram by direction (in: promote/rehydrate

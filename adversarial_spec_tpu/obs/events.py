@@ -31,7 +31,10 @@ Event vocabulary (the schema ``tools/obs_dump.py`` validates):
 - ``SpecEvent`` — one row's speculative draft/verify outcome.
 - ``SwapEvent`` — one KV-tier transition (demote/promote/rehydrate/
   spill/store/free/quarantine) with post-op per-tier residency.
-- ``SpanEvent`` — a causal-trace stage boundary (begin/end) with the
+- ``CancelEvent`` — one streaming early-convergence cancellation
+  (tokens emitted before the cancel, budget tokens saved).
+- ``SpanEvent`` — a causal-trace stage boundary (begin/end, or
+  ``cancelled`` closing a request envelope mid-decode) with the
   stage's measured wall on the end record.
 
 Causal tracing (obs/trace.py): EVERY event additionally carries
@@ -166,6 +169,26 @@ class SwapEvent:
 
 
 @dataclass(slots=True)
+class CancelEvent:
+    """One streaming early-convergence cancellation
+    (engine/streaming.py): the request's consumer saw everything it
+    needed (its verdict marker arrived) and the batcher stopped
+    decoding it — a HAPPY-path event, not a fault. ``tokens_emitted``
+    is the partial transcript's length at the cancel point;
+    ``tokens_saved`` the budget remainder that was never decoded (the
+    capacity the freed slot immediately re-admits queued work into)."""
+
+    TYPE = "cancel"
+    req_id: int = -1
+    slot: int = -1
+    reason: str = "early_converge"
+    tokens_emitted: int = 0
+    tokens_saved: int = 0
+    trace_id: str = ""
+    span_id: str = ""
+
+
+@dataclass(slots=True)
 class SpanEvent:
     """A causal-trace stage boundary (obs/trace.py id model). ``begin``
     marks entry into a stage (``wall_s`` 0), ``end`` carries the
@@ -180,7 +203,7 @@ class SpanEvent:
 
     TYPE = "span"
     name: str = ""  # request|queued|prefill|decode|round|opponent|...
-    phase: str = "begin"  # begin | end
+    phase: str = "begin"  # begin | end | cancelled (request envelopes)
     req_id: int = -1
     slot: int = -1
     wall_s: float = 0.0  # stage duration, set on the end record
@@ -197,10 +220,14 @@ EVENT_TYPES = (
     CompileEvent,
     SpecEvent,
     SwapEvent,
+    CancelEvent,
     SpanEvent,
 )
 
-SPAN_PHASES = ("begin", "end")
+# ``cancelled`` closes a request envelope mid-decode (streaming early
+# convergence): it carries the service wall exactly like ``end``, so
+# trace_view's decomposition check covers cancelled requests too.
+SPAN_PHASES = ("begin", "end", "cancelled")
 
 SWAP_OPS = (
     "demote",
@@ -220,6 +247,7 @@ REQUEST_STATES = (
     "finished",
     "evicted",
     "timeout",
+    "cancelled",
 )
 
 # type name -> {field name: python type} — the schema contract

@@ -51,6 +51,13 @@ Modes:
                                   # (host tier) workloads on the CPU
                                   # mock, plus a real-batcher parity/
                                   # retrace phase; writes BENCH_tier.json
+  python bench.py --mode cancel   # streaming early-convergence
+                                  # cancellation: mock debate rounds
+                                  # with verbose early-[AGREE]
+                                  # opponents (tokens-saved fraction,
+                                  # byte-identical prefixes) + real-
+                                  # batcher freed-slot re-admission;
+                                  # writes BENCH_cancel.json
   --no-interleave                 # escape hatch for any batcher-driven
                                   # mode: run the legacy serialized loop
                                   # (equivalent to ADVSPEC_INTERLEAVE=0)
@@ -1042,6 +1049,180 @@ def _run_tier(platform: str) -> dict:
     }
 
 
+def _run_cancel(platform: str) -> dict:
+    """Streaming early-convergence cancellation bench, two phases:
+
+    1. MOCK DEBATE ROUNDS (deterministic): a 4-opponent pool where two
+       opponents agree IMMEDIATELY but keep talking (``agree_tail`` —
+       the verbose-agreement failure mode the matched-ceiling debate
+       study makes pure waste) and two critique normally. Early cancel
+       stops each agreeing opponent the moment ``[AGREE]`` completes;
+       the headline is the fraction of the round's decode tokens that
+       never had to be produced, pinned ≥ 30%, with every streamed
+       transcript the blocking reply's byte-identical prefix.
+    2. REAL BATCHER (tiny CPU model / 1b TPU): one slot, two queued
+       requests — the first cancels after a few tokens, so the second
+       admits into the freed slot and the whole drain finishes in far
+       fewer decode dispatches than the first request's budget alone
+       would have taken (freed-slot re-admission, pinned), with
+       ``check_invariants`` clean after the cancel and
+       ``unexpected_recompiles`` 0 with streaming on.
+    """
+    from adversarial_spec_tpu.utils.jaxenv import configure_jax
+
+    configure_jax()
+    import jax
+    import jax.numpy as jnp
+
+    from adversarial_spec_tpu import obs
+    from adversarial_spec_tpu.debate.core import run_round
+    from adversarial_spec_tpu.engine import streaming as stream_mod
+    from adversarial_spec_tpu.engine.scheduler import (
+        ContinuousBatcher,
+        SchedRequest,
+    )
+    from adversarial_spec_tpu.models import transformer as T
+    from adversarial_spec_tpu.models.config import get_config
+
+    spec_doc = (
+        "## Goals\nServe heavy traffic fast.\n## Constraints\n"
+        "The allocator SHALL bound page reuse by refcount.\n" * 8
+    )
+    models = [
+        "mock://critic?agree_after=1&agree_tail=160",
+        "mock://critic?agree_after=1&agree_tail=160",
+        "mock://critic",
+        "mock://critic",
+    ]
+
+    def mock_round(early_cancel: bool):
+        stream_mod.configure(enabled=True, early_cancel=early_cancel)
+        stream_mod.reset_stats()
+        t0 = time.monotonic()
+        result = run_round(spec_doc, list(models), round_num=1)
+        wall = time.monotonic() - t0
+        texts = [r.critique for r in result.responses]
+        return texts, wall, stream_mod.snapshot()
+
+    on_texts, on_wall, on_snap = mock_round(True)
+    off_texts, off_wall, _ = mock_round(False)
+    # Byte-identical transcripts up to each cancellation point: every
+    # streamed reply is a prefix of the blocking reply.
+    prefix_ok = all(
+        full.startswith(part) for part, full in zip(on_texts, off_texts)
+    )
+    saved_fraction = on_snap["saved_fraction"]
+
+    # --- 2. Real batcher: freed-slot re-admission. -------------------
+    size = "1b" if platform != "cpu" else "tiny"
+    cfg = get_config("llama", size)
+    params = T.init_params(
+        jax.random.key(0),
+        cfg,
+        dtype=jnp.bfloat16 if platform != "cpu" else jnp.float32,
+    )
+    budget = 256 if platform == "cpu" else 512
+    prompts = [[5, 6, 7, 8] * 24, [9, 10, 11, 12] * 24]
+
+    def batcher_drain(cancel: bool, only_req0: bool = False):
+        stream_mod.configure(enabled=True, early_cancel=True)
+        stream_mod.reset_stats()
+        obs.configure(enabled=True)
+        obs.reset_stats()
+        obs.retrace.clear()
+        b = ContinuousBatcher(
+            params,
+            cfg,
+            max_batch=1,
+            max_new_cap=budget,
+            page_size=64,
+            capacity_tokens=1 << 13,
+            greedy=True,
+        )
+        cb = (lambda toks: len(toks) < 8) if cancel else None
+        b.submit(
+            SchedRequest(
+                req_id=0,
+                prompt_ids=list(prompts[0]),
+                max_new_tokens=budget,
+                on_tokens=cb,
+            )
+        )
+        if not only_req0:
+            b.submit(
+                SchedRequest(
+                    req_id=1,
+                    prompt_ids=list(prompts[1]),
+                    max_new_tokens=16,
+                )
+            )
+        t0 = time.monotonic()
+        results = b.run_all()
+        wall = time.monotonic() - t0
+        b.allocator.check_invariants()
+        steps = sum(
+            1
+            for e in obs.recorder.events()
+            if e["type"] == "step" and e["kind"] != "prefill"
+        )
+        return results, wall, steps, obs.snapshot()
+
+    c_res, c_wall, c_steps, c_obs = batcher_drain(True)
+    f_res, f_wall, f_steps, _ = batcher_drain(False)
+    _, _, alone_steps, _ = batcher_drain(False, only_req0=True)
+    r0 = next(r for r in c_res if r.req_id == 0)
+    r1 = next(r for r in c_res if r.req_id == 1)
+    # Re-admission pin: with the cancel, the whole 2-request drain (the
+    # queued request included, START to FINISH) takes fewer decode
+    # dispatches than request 0's budget ALONE takes uncancelled — the
+    # queued request was admitted into the freed slot and completed
+    # before the cancelled request's old budget would have elapsed.
+    readmit_ok = bool(
+        r0.cancelled
+        and r1.n_generated == 16
+        and r1.error is None
+        and c_steps < alone_steps
+    )
+    within = saved_fraction >= 0.30 and prefix_ok and readmit_ok
+
+    return {
+        "metric": "cancel_tokens_saved_fraction",
+        "value": round(saved_fraction, 4),
+        "unit": "fraction of round decode tokens saved by early cancel",
+        "vs_baseline": None,  # no published cancellation baseline
+        "platform": platform,
+        "within_budget": within,
+        "budget": 0.30,
+        "model": f"llama-{size}",
+        "mock": {
+            "opponents": len(models),
+            "cancels": on_snap["cancels"],
+            "tokens_saved": on_snap["tokens_saved"],
+            "streamed_tokens": on_snap["streamed_tokens"],
+            "saved_fraction": saved_fraction,
+            "transcripts_prefix_identical": prefix_ok,
+            "wall_s_cancel_on": round(on_wall, 3),
+            "wall_s_cancel_off": round(off_wall, 3),
+        },
+        "batcher": {
+            "budget": budget,
+            "cancelled_at": int(r0.n_generated),
+            "tokens_saved": int(r0.tokens_saved),
+            "decode_steps_with_cancel": c_steps,
+            "decode_steps_without": f_steps,
+            "decode_steps_req0_alone_uncancelled": alone_steps,
+            "readmission_before_old_budget": readmit_ok,
+            "wall_s_with_cancel": round(c_wall, 3),
+            "wall_s_without": round(f_wall, 3),
+            "unexpected_recompiles": c_obs["retrace"][
+                "unexpected_recompiles"
+            ],
+        },
+        "escape_hatch": "--no-stream / --no-early-cancel "
+        "(ADVSPEC_STREAM=0 / ADVSPEC_EARLY_CANCEL=0)",
+    }
+
+
 def _run_obs_overhead(platform: str) -> dict:
     """Observability overhead bench: what fraction of the mock mixed
     workload's wall the recorder+metrics emit path costs. Budget < 3%
@@ -1319,6 +1500,7 @@ def main() -> int:
     obs_mode = _mode("obs-overhead")
     spec_mode = _mode("spec")
     tier_mode = _mode("tier")
+    cancel_mode = _mode("cancel")
     if "--no-speculative" in args:
         # Escape hatch mirror of --no-interleave: batcher-driven modes
         # (and any TPU child) decode token-at-a-time.
@@ -1340,6 +1522,8 @@ def main() -> int:
         mode_flag, runner = "--spec", _run_spec
     elif tier_mode:
         mode_flag, runner = "--tier", _run_tier
+    elif cancel_mode:
+        mode_flag, runner = "--cancel", _run_cancel
     else:
         mode_flag, runner = "", _run_bench
 
@@ -1373,7 +1557,14 @@ def main() -> int:
                     "(tunnel hang or compile error); CPU fallback"
                 ),
             )
-    if prefix_mode or interleave_mode or obs_mode or spec_mode or tier_mode:
+    if (
+        prefix_mode
+        or interleave_mode
+        or obs_mode
+        or spec_mode
+        or tier_mode
+        or cancel_mode
+    ):
         # Persist the perf trajectory point alongside the BENCH_r*
         # series the driver records.
         name = (
@@ -1386,6 +1577,8 @@ def main() -> int:
             else "BENCH_spec.json"
             if spec_mode
             else "BENCH_tier.json"
+            if tier_mode
+            else "BENCH_cancel.json"
         )
         out = os.path.join(
             os.path.dirname(os.path.abspath(__file__)), name

@@ -99,6 +99,16 @@ def _isolate_state(tmp_path, monkeypatch):
         enabled=False, host_mb=kvtier.DEFAULT_HOST_MB, store_dir=""
     )
     kvtier.reset_stats()
+    # Streaming config/stats are process-global by design (the CLI arms
+    # them per round); tests must not leak a --no-stream / cancel
+    # counts into each other. Defaults (stream + early-cancel on) are
+    # the product defaults — streaming tests exercise both sides.
+    from adversarial_spec_tpu.engine import streaming
+
+    monkeypatch.delenv("ADVSPEC_STREAM", raising=False)
+    monkeypatch.delenv("ADVSPEC_EARLY_CANCEL", raising=False)
+    streaming.configure(enabled=True, early_cancel=True)
+    streaming.reset_stats()
     # Observability state is process-global by design (the recorder and
     # metric handles outlive a round); tests must not leak an armed
     # events_out path, a shrunken ring, or recorded events.
@@ -126,6 +136,8 @@ def _isolate_state(tmp_path, monkeypatch):
         enabled=False, host_mb=kvtier.DEFAULT_HOST_MB, store_dir=""
     )
     kvtier.reset_stats()
+    streaming.configure(enabled=True, early_cancel=True)
+    streaming.reset_stats()
     obs.configure(
         enabled=True,
         recorder_size=obs.DEFAULT_RECORDER_SIZE,

@@ -263,9 +263,9 @@ class TestContinuousServing:
         calls = []
         orig = tpu_mod.TpuEngine._chat_continuous
 
-        def spy(self, lm, prompts, params, batch=None):
+        def spy(self, lm, prompts, params, batch=None, consumer=None):
             calls.append(len(prompts))
-            return orig(self, lm, prompts, params, batch)
+            return orig(self, lm, prompts, params, batch, consumer)
 
         tpu_mod.TpuEngine._chat_continuous = spy
         try:

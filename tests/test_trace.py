@@ -128,10 +128,15 @@ class TestMockPropagation:
             e for e in obs.recorder.events() if e["type"] == "span"
         ]
         for sid in ("tr-001-01/s00", "tr-001-01/s01"):
+            # ``cancelled`` closes an early-cancelled request envelope
+            # exactly like ``end`` (the agree opponent cancels under
+            # the streaming default) — the decomposition must hold for
+            # the truncated span set too.
             ends = {
                 e["name"]: e["wall_s"]
                 for e in spans
-                if e["span_id"] == sid and e["phase"] == "end"
+                if e["span_id"] == sid
+                and e["phase"] in ("end", "cancelled")
             }
             assert (
                 abs(ends["request"] - (ends["prefill"] + ends["decode"]))

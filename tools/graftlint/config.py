@@ -79,6 +79,11 @@ class GraftlintConfig:
     # fetching either inside the drive loop is a host sync, so the
     # promotion queue's fetch sites are tainted like any other device
     # value and sanctioned fetches carry reasoned inline disables.
+    # emitted_ref / out_ref are the stream-consumer seam's entry
+    # elements (the pipelined loop's async double-buffer fetch,
+    # engine/scheduler.py): fetching either is a host sync, sanctioned
+    # only at the resolved/depth-bound entry fetch where the flags
+    # already sync — the reasoned inline disables there must stay live.
     sync_device_names: list[str] = field(
         default_factory=lambda: [
             "first",
@@ -87,6 +92,8 @@ class GraftlintConfig:
             "spec_counts",
             "demote_kv",
             "promo_kv",
+            "emitted_ref",
+            "out_ref",
         ]
     )
     # --- GL-TRACE ----------------------------------------------------
@@ -121,6 +128,11 @@ class GraftlintConfig:
             "trace_mod.",
             "trace_scope",
             "slo_check",
+            # Streaming (engine/streaming.py): consumer delivery and
+            # cancel accounting are host side effects — inside a traced
+            # body they would fire once per compile shape, and a
+            # trace-time consumer callback could never cancel anything.
+            "stream_mod.",
         ]
     )
     # Extra dotted function names (module.func) to treat as trace roots

@@ -74,6 +74,13 @@ def summarize(events: list[dict]) -> str:
             f"{f['pages_freed']} page(s) freed, "
             f"{'requeued' if f['requeued'] else 'evicted'})"
         )
+    cancels = [e for e in events if e["type"] == "cancel"]
+    if cancels:
+        saved = sum(c["tokens_saved"] for c in cancels)
+        lines.append(
+            f"  {len(cancels)} early cancellation(s): {saved} decode "
+            "token(s) saved"
+        )
     quarantined = [
         e
         for e in events
@@ -103,7 +110,7 @@ def occupancy_timeline(events: list[dict], width: int = 16) -> str:
     per-tier residency as of that step (host/disk block counts trail
     the most recent swap), and the swaps themselves print inline."""
     steps = [
-        e for e in events if e["type"] in ("step", "swap", "span")
+        e for e in events if e["type"] in ("step", "swap", "span", "cancel")
     ]
     if not any(e["type"] == "step" for e in steps):
         return "(no step events)"
@@ -129,10 +136,25 @@ def occupancy_timeline(events: list[dict], width: int = 16) -> str:
                 notes.append(s["span_id"])
             elif s["trace_id"]:
                 notes.append(s["trace_id"])
-            glyph = ">" if s["phase"] == "begin" else "<"
+            glyph = (
+                ">"
+                if s["phase"] == "begin"
+                else "x" if s["phase"] == "cancelled" else "<"
+            )
             rows.append(
                 f"seq {s['seq']:>6} [{glyph * width}] "
                 f"{s['name'] + ':' + s['phase']:<13} " + " ".join(notes)
+            )
+            continue
+        if s["type"] == "cancel":
+            # A truncated request: the cancel row shows what was
+            # emitted and what the cancellation saved, inline where it
+            # happened in the step stream.
+            rows.append(
+                f"seq {s['seq']:>6} [{'x' * width}] "
+                f"{'cancel':<8} req={s['req_id']} slot={s['slot']} "
+                f"emitted={s['tokens_emitted']}tok "
+                f"saved={s['tokens_saved']}tok ({s['reason']})"
             )
             continue
         if s["type"] == "swap":
@@ -167,11 +189,13 @@ def occupancy_timeline(events: list[dict], width: int = 16) -> str:
         )
     n_steps = sum(1 for s in steps if s["type"] == "step")
     spanned = any(e["type"] == "span" for e in steps)
+    cancelled = any(e["type"] == "cancel" for e in steps)
     legend = (
         f"occupancy timeline ({n_steps} step(s), max live {max_live}; "
         "#=fused ==decode .=prefill"
         + ("; ~=tier swap, host/disk=resident blocks" if tiered else "")
         + ("; >=span begin <=span end" if spanned else "")
+        + ("; x=early cancel" if cancelled else "")
         + ")"
     )
     return "\n".join([legend] + rows)

@@ -62,14 +62,20 @@ def collect_requests(events: list[dict]) -> dict[str, dict]:
                 "stages": {},
                 "request_wall": None,
                 "end_seq": None,
+                "cancelled": False,
             },
         )
         rec["begin_seq"] = min(rec["begin_seq"], e["seq"])
-        if e["phase"] != "end":
+        if e["phase"] == "begin":
             continue
+        # ``cancelled`` closes a request envelope mid-decode (streaming
+        # early convergence) exactly like ``end`` does — it carries the
+        # service wall so far, so the decomposition check below covers
+        # cancelled requests too (their truncated span set still sums).
         if e["name"] == "request":
             rec["request_wall"] = e["wall_s"]
             rec["end_seq"] = e["seq"]
+            rec["cancelled"] = e["phase"] == "cancelled"
         elif e["name"] in STAGES:
             rec["stages"][e["name"]] = e["wall_s"]
     return out
@@ -118,7 +124,11 @@ def render_waterfall(
         wall = rec["request_wall"]
         head = f"{span_id}  (req {rec['req_id']}"
         head += (
-            f", service {wall:.4f}s)" if wall is not None else ", open)"
+            f", service {wall:.4f}s"
+            + (", CANCELLED" if rec.get("cancelled") else "")
+            + ")"
+            if wall is not None
+            else ", open)"
         )
         rows.append(head)
         offset = 0.0
