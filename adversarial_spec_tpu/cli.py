@@ -15,8 +15,10 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 
+from adversarial_spec_tpu.debate import journal as journal_mod
 from adversarial_spec_tpu.debate import prompts
 from adversarial_spec_tpu.debate.core import RoundConfig, run_round
 from adversarial_spec_tpu.debate.parsing import extract_tasks, generate_diff
@@ -27,6 +29,7 @@ from adversarial_spec_tpu.debate.profiles import (
     save_profile,
 )
 from adversarial_spec_tpu.debate.session import (
+    CorruptSessionState,
     InvalidSessionId,
     SessionState,
     save_checkpoint,
@@ -128,6 +131,17 @@ def create_parser() -> argparse.ArgumentParser:
     s.add_argument("--resume", help="Resume a previous session by id")
     s.add_argument("--profile", help="Load settings from a saved profile")
     s.add_argument("--name", help="Profile name (for save-profile)")
+    s.add_argument(
+        "--journal",
+        action=argparse.BooleanOptionalAction,
+        default=None,  # None = inherit ADVSPEC_JOURNAL (default on)
+        help="Crash-safe round journal for sessions: every opponent "
+        "completion is fsync'd to <session>.journal.jsonl the moment "
+        "it resolves, and --resume after a crash serves completed "
+        "opponents from the journal byte-identically instead of "
+        "re-decoding them (--no-journal disables; ADVSPEC_JOURNAL=0 "
+        "sets the process default)",
+    )
 
     o = parser.add_argument_group("output")
     o.add_argument("--json", "-j", action="store_true", help="JSON output")
@@ -219,6 +233,17 @@ def create_parser() -> argparse.ArgumentParser:
         type=float,
         default=None,
         help="Per-round wall-clock budget in seconds (default 600)",
+    )
+    d.add_argument(
+        "--request-deadline-s",
+        type=float,
+        default=None,  # None = inherit ADVSPEC_REQUEST_DEADLINE_S (off)
+        help="Per-REQUEST watchdog deadline in seconds: a single "
+        "hung/slow opponent request is evicted as a TIMEOUT fault at "
+        "this deadline (partial text kept, co-residents unaffected) "
+        "and re-admitted ONCE on a tightened budget, where --timeout "
+        "would have expired the whole round at once. 0 disables; "
+        "ADVSPEC_REQUEST_DEADLINE_S sets the process default",
     )
     d.add_argument(
         "--prefix-cache",
@@ -325,7 +350,8 @@ def create_parser() -> argparse.ArgumentParser:
             "Arm fault injection: kind@seam[:p=F][:after=N][:times=N]"
             "[:slot=K], comma-separated (kinds: oom, device_lost, "
             "preempted, timeout, bug; seams: generate, scheduler_chunk, "
-            "kv_alloc, kv_swap, checkpoint_load). Also via ADVSPEC_CHAOS"
+            "kv_alloc, kv_swap, checkpoint_load, crash). Also via "
+            "ADVSPEC_CHAOS"
         ),
     )
     z.add_argument(
@@ -436,6 +462,15 @@ def _read_spec_stdin() -> str:
     return spec
 
 
+def _env_request_deadline() -> float:
+    try:
+        return max(
+            0.0, float(os.environ.get("ADVSPEC_REQUEST_DEADLINE_S", "0") or "0")
+        )
+    except ValueError:
+        return 0.0
+
+
 def _sampling_from_args(args: argparse.Namespace) -> SamplingParams:
     return SamplingParams(
         max_new_tokens=args.max_new_tokens or 1024,
@@ -443,6 +478,15 @@ def _sampling_from_args(args: argparse.Namespace) -> SamplingParams:
         greedy=bool(args.greedy),
         seed=args.seed,
         timeout_s=max(0.0, float(600.0 if args.timeout is None else args.timeout)),
+        # Flag-else-env-default each invocation, like the obs knobs.
+        request_deadline_s=max(
+            0.0,
+            float(
+                _env_request_deadline()
+                if getattr(args, "request_deadline_s", None) is None
+                else args.request_deadline_s
+            ),
+        ),
     )
 
 
@@ -673,6 +717,24 @@ def run_critique(args: argparse.Namespace) -> int:
         context_files=args.context or [],
         sampling=_sampling_from_args(args),
     )
+    journal = None
+    if session_state is not None:
+        # Durability first (docs/resilience.md "Durability and
+        # recovery"): persist the session BEFORE the round runs — a
+        # crash mid-round must leave a resumable session file carrying
+        # the spec and round the crashed process was serving (the
+        # post-round save below then advances it). The journal rides
+        # the same sessions dir; flag-else-env-default per invocation.
+        use_journal = (
+            args.journal
+            if getattr(args, "journal", None) is not None
+            else journal_mod.env_enabled()
+        )
+        session_state.models = models
+        session_state.save()
+        if use_journal:
+            journal = journal_mod.RoundJournal(session_state.session_id)
+            cfg.journal = journal
     _err(
         f"Round {args.round}: querying {len(models)} model(s): "
         + ", ".join(models)
@@ -816,6 +878,22 @@ def run_critique(args: argparse.Namespace) -> int:
         )
         session_state.breakers = breakers.snapshot_for_resume()
         session_state.save()
+        if journal is not None:
+            # Round-commit AFTER the advanced session state is durable:
+            # a crash in the gap replays a committed round, which is
+            # deterministic and therefore harmless; the reverse order
+            # could lose the round.
+            try:
+                journal.log_round_commit(args.round, result.all_agreed)
+            except Exception as e:
+                _err(f"warning: round-journal commit failed: {e}")
+
+    served = int(result.tracer.counters.get("journal.served", 0))
+    if served:
+        _err(
+            f"recovery: {served} opponent(s) served from the round "
+            "journal (no engine work re-paid)"
+        )
 
     user_feedback = None
     if args.notify:
@@ -1258,7 +1336,7 @@ def main(argv: list[str] | None = None) -> int:
         return EXIT_ERROR
     except SystemExit as e:
         return int(e.code or 0)
-    except (FileNotFoundError, InvalidSessionId) as e:
+    except (FileNotFoundError, InvalidSessionId, CorruptSessionState) as e:
         _err(f"error: {e}")
         return EXIT_VALIDATION
     except Exception as e:

@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 from pathlib import Path
 
 from adversarial_spec_tpu import obs as obs_mod
+from adversarial_spec_tpu.debate import journal as journal_mod
 from adversarial_spec_tpu.debate import prompts
 from adversarial_spec_tpu.debate.parsing import (
     StreamScanner,
@@ -39,11 +40,17 @@ from adversarial_spec_tpu.engine import streaming as stream_mod
 from adversarial_spec_tpu.engine.dispatch import get_engine
 from adversarial_spec_tpu.engine.types import ChatRequest, Completion, SamplingParams
 from adversarial_spec_tpu.resilience import breaker as breaker_mod
-from adversarial_spec_tpu.resilience.faults import classify_message
+from adversarial_spec_tpu.resilience import faults as faults_mod
+from adversarial_spec_tpu.resilience.faults import FaultKind, classify_message
 from adversarial_spec_tpu.utils.tracing import Tracer
 
 MAX_RETRIES = 3
 RETRY_BASE_DELAY = 1.0
+# A watchdog-expired opponent gets ONE hedged re-admission on this
+# fraction of its original per-request deadline — the slot already
+# burnt a full deadline's worth of decode, so the second chance must
+# not double the round's worst-case wall.
+HEDGE_BUDGET_FACTOR = 0.5
 
 
 @dataclass
@@ -60,6 +67,12 @@ class RoundConfig:
     # Per-model circuit breakers; None = the process default registry.
     # Tests pass their own (fake clock, tight thresholds).
     breakers: breaker_mod.BreakerRegistry | None = None
+    # Crash-safe round journal (debate/journal.py RoundJournal, armed by
+    # the CLI when a session is active and --journal is on; None = no
+    # durability). run_round logs round-start + per-opponent completion
+    # records through it and serves already-completed opponents from a
+    # replay on resume.
+    journal: object | None = None
     # Injected for tests; defaults to real sleep for backoff.
     sleep = staticmethod(time.sleep)
 
@@ -121,6 +134,23 @@ def _early_cancel_consumer():
         return sc.feed(text) is None
 
     return consume
+
+
+def _journal_fault(e: BaseException) -> None:
+    """A journal failure must never kill the round: classify and count
+    it (the injector's ``crash`` seam keeps its name; real I/O errors
+    land at seam ``journal``), then move on — the round merely loses
+    durability for that one record."""
+    faults_mod.record(
+        faults_mod.classify(e), getattr(e, "seam", None) or "journal"
+    )
+
+
+def _journal_safe(fn, *args, **kwargs) -> None:
+    try:
+        fn(*args, **kwargs)
+    except Exception as e:
+        _journal_fault(e)
 
 
 def _to_response(
@@ -195,12 +225,67 @@ def run_round(
         for i, m in enumerate(models)
     ]
 
+    results: list[ModelResponse | None] = [None] * len(requests)
+
+    # Crash recovery (debate/journal.py): replay the session's
+    # write-ahead journal and serve opponents whose completion records
+    # are already durable — zero engine work, byte-identical
+    # transcripts (the record feeds the same ``_to_response`` the live
+    # path uses). Everything else — errored, partial, never started —
+    # re-issues below; the breaker snapshot restored onto the registry
+    # still vetoes models whose circuit was open when the process died.
+    # Journaling is best-effort by contract: any journal failure is
+    # contained (``_journal_safe``) and the round proceeds unjournaled.
+    journal = cfg.journal
+    replayed: dict[int, dict] = {}
+    if journal is not None:
+        try:
+            journal.ensure_round_start(
+                round_num,
+                spec,
+                models,
+                {
+                    "doc_type": cfg.doc_type,
+                    "focus": cfg.focus,
+                    "persona": cfg.persona,
+                    "preserve_intent": cfg.preserve_intent,
+                    "press": cfg.press,
+                },
+                trace_id=trace_id,
+            )
+            replayed = journal.replay(round_num, spec, models)
+        except Exception as e:
+            _journal_fault(e)
+            replayed = {}
+    for i, rec in sorted(replayed.items()):
+        comp, rec_latency = journal_mod.completion_from_record(rec)
+        results[i] = _to_response(
+            models[i], comp, rec_latency, requests[i].span_id
+        )
+        tracer.count("journal.served", 1)
+        tracer.count(
+            "journal.salvaged_decode_tokens",
+            float(results[i].usage.output_tokens),
+        )
+        if obs_mod.config().enabled:
+            obs_mod.emit(
+                obs_mod.JournalEvent(
+                    op="serve",
+                    rtype="completion",
+                    round_num=round_num,
+                    index=i,
+                    trace_id=trace_id,
+                    span_id=requests[i].span_id,
+                )
+            )
+
     # Group indices by engine so co-resident models batch together. A
     # model whose circuit breaker is open degrades HERE — no engine call,
     # no retry budget — and rejoins after its cooldown's half-open probe.
     groups: dict[int, tuple[object, list[int]]] = {}
-    results: list[ModelResponse | None] = [None] * len(requests)
     for i, req in enumerate(requests):
+        if results[i] is not None:
+            continue  # served from the journal above
         if not breakers.allow(req.model):
             remaining = breakers.cooldown_remaining(req.model)
             results[i] = ModelResponse(
@@ -214,6 +299,28 @@ def run_round(
             continue
         engine = get_engine(req.model)
         groups.setdefault(id(engine), (engine, []))[1].append(i)
+
+    if journal is not None and replayed:
+        n_reissued = sum(len(ix) for _, ix in groups.values())
+        obs_mod.emit(
+            obs_mod.RecoveryEvent(
+                round_num=round_num,
+                served=len(replayed),
+                reissued=n_reissued,
+                records=getattr(journal, "replay_records", len(replayed)),
+                skipped=getattr(journal, "replay_skipped", 0),
+                trace_id=trace_id,
+            )
+        )
+        if obs_mod.config().enabled:
+            obs_mod.metrics.counter(
+                "advspec_recovery_requests_total",
+                help="opponents resolved on a journal replay, by source",
+                source="journal",
+            ).inc(len(replayed))
+            obs_mod.metrics.counter(
+                "advspec_recovery_requests_total", source="reissued"
+            ).inc(n_reissued)
 
     # The round's ambient trace scope: every event emitted below this
     # frame — engine fan-in counters, scheduler steps, prefix-cache and
@@ -263,19 +370,66 @@ def run_round(
             stream_ok = stream_mod.armed() and stream_mod.consumer_supported(
                 engine
             )
+
+            def _chat(batch, sampling, engine=engine, stream_ok=stream_ok):
+                return (
+                    engine.chat(
+                        batch, sampling, consumer=_early_cancel_consumer()
+                    )
+                    if stream_ok
+                    else engine.chat(batch, sampling)
+                )
+
+            def _resolve(i: int, comp: Completion, latency: float) -> None:
+                """Final resolution of one opponent: build the response,
+                make the outcome durable (a clean completion becomes a
+                replayable journal record THE MOMENT it resolves; an
+                evicted request's salvaged partial text is journaled
+                for diagnosis, never replayed), close its span."""
+                results[i] = _to_response(
+                    requests[i].model, comp, latency, requests[i].span_id
+                )
+                if journal is not None:
+                    if comp.ok:
+                        _journal_safe(
+                            journal.log_completion,
+                            round_num,
+                            i,
+                            requests[i].model,
+                            comp,
+                            latency,
+                            trace_id=trace_id,
+                            span_id=requests[i].span_id,
+                        )
+                    elif comp.text:
+                        _journal_safe(
+                            journal.log_partial,
+                            round_num,
+                            i,
+                            requests[i].model,
+                            comp,
+                            trace_id=trace_id,
+                            span_id=requests[i].span_id,
+                        )
+                obs_mod.emit(
+                    obs_mod.SpanEvent(
+                        name="opponent",
+                        phase="end",
+                        req_id=i,
+                        trace_id=trace_id,
+                        span_id=requests[i].span_id,
+                    )
+                )
+
+            hedge_armed = cfg.sampling.request_deadline_s > 0
+            # (index, original completion, its latency): watchdog-
+            # expired opponents awaiting their one hedged re-admission.
+            hedge_pending: list[tuple[int, Completion, float]] = []
             pending = list(indices)
             for attempt in range(MAX_RETRIES):
                 batch = [requests[i] for i in pending]
                 t0 = time.monotonic()
-                completions = (
-                    engine.chat(
-                        batch,
-                        cfg.sampling,
-                        consumer=_early_cancel_consumer(),
-                    )
-                    if stream_ok
-                    else engine.chat(batch, cfg.sampling)
-                )
+                completions = _chat(batch, cfg.sampling)
                 latency = time.monotonic() - t0
                 tracer.add_span("engine_chat", latency)
                 still_pending = []
@@ -295,11 +449,28 @@ def run_round(
                             ok=False,
                             kind=classify_message(comp.error or ""),
                         )
+                    # A watchdog-expired request does NOT re-enter the
+                    # 3-attempt backoff ladder (its per-request deadline
+                    # already bounded it once; full retries would pay up
+                    # to 3 more deadlines plus backoff): it gets exactly
+                    # ONE hedged re-admission on a tightened budget
+                    # after this group resolves — and only while its
+                    # breaker still allows the model.
+                    if (
+                        hedge_armed
+                        and not comp.ok
+                        and classify_message(comp.error or "")
+                        is FaultKind.TIMEOUT
+                    ):
+                        if breakers.allow(requests[i].model):
+                            hedge_pending.append((i, comp, latency))
+                        else:
+                            _resolve(i, comp, latency)
                     # Retry only while the breaker still allows the
                     # model: a failed half-open probe reopens the circuit
                     # and must cost ONE attempt, not the full 3x backoff
                     # budget it exists to avoid.
-                    if (
+                    elif (
                         not comp.ok
                         and comp.transient
                         and attempt < MAX_RETRIES - 1
@@ -307,21 +478,7 @@ def run_round(
                     ):
                         still_pending.append(i)
                     else:
-                        results[i] = _to_response(
-                            requests[i].model,
-                            comp,
-                            latency,
-                            requests[i].span_id,
-                        )
-                        obs_mod.emit(
-                            obs_mod.SpanEvent(
-                                name="opponent",
-                                phase="end",
-                                req_id=i,
-                                trace_id=trace_id,
-                                span_id=requests[i].span_id,
-                            )
-                        )
+                        _resolve(i, comp, latency)
                 pending = still_pending
                 if not pending:
                     break
@@ -329,20 +486,51 @@ def run_round(
                     break  # round budget exhausted: no further retries
                 cfg.sleep(RETRY_BASE_DELAY * (2**attempt))
             for i in pending:  # exhausted retries
-                results[i] = ModelResponse(
-                    model=requests[i].model,
-                    error="retries exhausted",
-                    span_id=requests[i].span_id,
+                _resolve(i, Completion(error="retries exhausted"), 0.0)
+            if hedge_pending and (
+                deadline is not None and time.monotonic() >= deadline
+            ):
+                # Round budget exhausted: keep the watchdog partials.
+                for i, orig, orig_lat in hedge_pending:
+                    _resolve(i, orig, orig_lat)
+            elif hedge_pending:
+                # The single hedged re-admission: one more batched chat
+                # for every deadline-evicted opponent, under a deadline
+                # tightened to HEDGE_BUDGET_FACTOR of the original —
+                # the freed slots re-admit immediately, and a model
+                # that is genuinely hung (not merely slow) costs one
+                # tightened deadline more, never another full ladder.
+                tightened = dataclasses.replace(
+                    cfg.sampling,
+                    request_deadline_s=(
+                        cfg.sampling.request_deadline_s
+                        * HEDGE_BUDGET_FACTOR
+                    ),
                 )
-                obs_mod.emit(
-                    obs_mod.SpanEvent(
-                        name="opponent",
-                        phase="end",
-                        req_id=i,
-                        trace_id=trace_id,
-                        span_id=requests[i].span_id,
-                    )
-                )
+                batch = [requests[i] for i, _, _ in hedge_pending]
+                t0 = time.monotonic()
+                completions = _chat(batch, tightened)
+                latency = time.monotonic() - t0
+                tracer.add_span("engine_chat", latency)
+                for (i, orig, orig_lat), comp in zip(
+                    hedge_pending, completions
+                ):
+                    tracer.add_span(f"opponent/{requests[i].model}", latency)
+                    tracer.count(f"attempts.{requests[i].model}", 1)
+                    tracer.count(f"hedge.{requests[i].model}", 1)
+                    if comp.ok:
+                        breakers.record(requests[i].model, ok=True)
+                        _resolve(i, comp, latency)
+                    else:
+                        breakers.record(
+                            requests[i].model,
+                            ok=False,
+                            kind=classify_message(comp.error or ""),
+                        )
+                        # The hedge lost too: keep the ORIGINAL partial
+                        # (more salvaged text, the first failure's true
+                        # latency). No third attempt.
+                        _resolve(i, orig, orig_lat)
     finally:
         obs_mod.emit(
             obs_mod.SpanEvent(name="round", phase="end", trace_id=trace_id)

@@ -10,19 +10,38 @@ Behavioral parity with reference scripts/session.py:
   manual rollback (session.py:74-82).
 - Path-traversal guard on session ids (session.py:37-38, 45-46).
 
+Durability (docs/resilience.md "Durability and recovery"): every write
+here goes through ``obs.atomic_write_text`` (pid-suffixed tmp +
+``os.replace``) — a crash mid-write leaves the previous complete file
+intact, never a torn one, because ``--resume`` depends on this file. A
+session file that is nonetheless corrupt on disk (torn by an older
+writer, bad storage) is QUARANTINED to ``<name>.corrupt`` on load
+(DiskStore's discipline, engine/kvtier.py) and surfaced as a clear
+``CorruptSessionState`` naming the path and the recovery options,
+instead of a raw ``JSONDecodeError`` with no context.
+
 All directories are module-level constants precisely so tests can patch them
 (the reference's patch-the-module-constant fixture pattern, SURVEY §4).
+``ADVSPEC_SESSIONS_DIR`` overrides the sessions dir for subprocess
+harnesses (tools/chaos_run.py --crash, bench.py --mode recover) that
+must not touch the operator's real ``~/.config`` state.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import re
 import time
 from dataclasses import dataclass, field, asdict
 from pathlib import Path
 
-SESSIONS_DIR = Path.home() / ".config" / "adversarial-spec-tpu" / "sessions"
+from adversarial_spec_tpu.obs.events import atomic_write_text
+
+SESSIONS_DIR = Path(
+    os.environ.get("ADVSPEC_SESSIONS_DIR")
+    or Path.home() / ".config" / "adversarial-spec-tpu" / "sessions"
+)
 CHECKPOINTS_DIR = Path(".adversarial-spec-checkpoints")
 
 _SESSION_ID_RE = re.compile(r"^[A-Za-z0-9._-]+$")
@@ -30,6 +49,10 @@ _SESSION_ID_RE = re.compile(r"^[A-Za-z0-9._-]+$")
 
 class InvalidSessionId(ValueError):
     pass
+
+
+class CorruptSessionState(ValueError):
+    """A session file failed to parse; it has been quarantined aside."""
 
 
 def _validate_session_id(session_id: str) -> str:
@@ -71,7 +94,11 @@ class SessionState:
             self.created_at = now
         self.updated_at = now
         path = directory / f"{self.session_id}.json"
-        path.write_text(json.dumps(asdict(self), indent=2))
+        # Atomic: a crash anywhere in this write leaves the previous
+        # complete session file (the thing --resume replays) intact and
+        # no orphan tmp behind — the same crash-window contract
+        # --metrics-out and the events JSONL already honor.
+        atomic_write_text(str(path), json.dumps(asdict(self), indent=2))
         return path
 
     @classmethod
@@ -81,7 +108,30 @@ class SessionState:
         directory = Path(sessions_dir or SESSIONS_DIR)
         _validate_session_id(session_id)
         path = directory / f"{session_id}.json"
-        data = json.loads(path.read_text())
+        try:
+            data = json.loads(path.read_text())
+            if not isinstance(data, dict):
+                raise ValueError(
+                    f"top-level JSON is {type(data).__name__}, not an object"
+                )
+        except (json.JSONDecodeError, UnicodeDecodeError, ValueError) as e:
+            # Quarantine, then fail with the path and a way forward —
+            # corruption in ANY shape (truncated JSON, non-UTF-8 bytes
+            # from bad storage, a rewritten non-object) must not
+            # present as a stack trace, and leaving the file in place
+            # would make every retry hit the same wall (DiskStore's
+            # corrupt-entry discipline).
+            quarantine = path.with_name(path.name + ".corrupt")
+            try:
+                os.replace(path, quarantine)
+                where = f"quarantined to {quarantine}"
+            except OSError:
+                where = "quarantine failed; file left in place"
+            raise CorruptSessionState(
+                f"session file {path} is corrupt ({e}); {where}. "
+                f"Start over with --session {session_id}, or restore a "
+                f"spec snapshot from {CHECKPOINTS_DIR}/"
+            ) from e
         known = {f for f in cls.__dataclass_fields__}
         return cls(**{k: v for k, v in data.items() if k in known})
 
@@ -121,5 +171,7 @@ def save_checkpoint(
     directory.mkdir(parents=True, exist_ok=True)
     prefix = f"{_validate_session_id(session_id)}-" if session_id else ""
     path = directory / f"{prefix}round-{round_num}.md"
-    path.write_text(spec)
+    # Atomic like the session file: the checkpoint is the manual
+    # rollback of last resort — a crash mid-write must not destroy it.
+    atomic_write_text(str(path), spec)
     return path

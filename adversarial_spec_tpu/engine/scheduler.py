@@ -44,6 +44,15 @@ pages, and leaves the rest of the batch decoding. Transient faults
 is final, budgeted against the caller's existing deadline. The chaos
 injector's ``scheduler_chunk`` and ``kv_alloc`` seams live here.
 
+Per-request watchdog (``SchedRequest.deadline_s``, docs/resilience.md
+"Durability and recovery"): both drive loops check per-request
+deadlines once per iteration — pure host clock math — and evict an
+over-deadline slot as ``FaultKind.TIMEOUT`` through the same shared
+surgery, partial text delivered to its stream consumer, co-residents
+untouched, no batcher-level requeue (the debate layer owns the single
+hedged re-admission). Zero new sync points: the eviction rides the
+decode-fault path's existing sanctioned fetches.
+
 The round-synchronous debate path (engine/tpu.py) doesn't need this; it
 serves multi-session workloads (several debates sharing one model) and is
 exercised directly in tests/test_scheduler.py.
@@ -105,6 +114,12 @@ class SchedRequest:
     req_id: int
     prompt_ids: list[int]
     max_new_tokens: int
+    # Per-request watchdog deadline in seconds from submission (0 =
+    # none). Checked by the drive loops' watchdog
+    # (``_expire_request_deadlines``) — pure host clock math; the
+    # eviction itself rides the decode-fault surgery's EXISTING
+    # sanctioned fetches, so the watchdog adds zero new sync points.
+    deadline_s: float = 0.0
     # Causal-trace ids (obs/trace.py), carried by value from the debate
     # round that issued this request; every flight-recorder event the
     # batcher emits for it is stamped with them (explicitly where the
@@ -1121,6 +1136,13 @@ class ContinuousBatcher:
         # Host submit time per queued req_id: the 'queued' span's wall
         # (queue wait) measured at admission start.
         self._queued_t: dict[int, float] = {}
+        # Per-request watchdog deadlines: req_id -> absolute monotonic
+        # expiry, armed at submit for requests with ``deadline_s`` > 0.
+        # The ABSOLUTE time survives a transient-fault requeue on
+        # purpose — the watchdog bounds the request's total wall, not
+        # its current residency. Entries clear when the request
+        # finally resolves (finish/cancel/final fault/global timeout).
+        self._deadline_t: dict[int, float] = {}
         self._admission: _Admission | None = None
         self._seq_counter = 0
         self.capacity_tokens = n_pages * page_size
@@ -1254,6 +1276,10 @@ class ContinuousBatcher:
                 f"{self.capacity_tokens}; raise capacity_tokens"
             )
         self.queue.append(req)
+        if req.deadline_s > 0:
+            import time
+
+            self._deadline_t[req.req_id] = time.monotonic() + req.deadline_s
         if obs_mod.config().enabled:
             import time
 
@@ -1999,6 +2025,8 @@ class ContinuousBatcher:
                 )
             )
             return
+        # Final resolution: the watchdog stops tracking this request.
+        self._deadline_t.pop(req.req_id, None)
         obs_mod.emit(
             obs_mod.RequestEvent(
                 req_id=req.req_id,
@@ -2117,7 +2145,11 @@ class ContinuousBatcher:
         n = int(self.n_emitted[slot])
         # graftlint: disable=GL-SYNC -- fault decision point (partial-token rescue, same sanctioned sync as the count above)
         partial = np.asarray(self.out_buf[slot, :n])
-        self._evict_slot(slot, exc, "scheduler_chunk", n, partial)
+        # Faults that know their seam keep it (the watchdog's
+        # deadline evictions report at seam "watchdog"; injected
+        # scheduler_chunk faults already carry that name).
+        seam = getattr(exc, "seam", None) or "scheduler_chunk"
+        self._evict_slot(slot, exc, seam, n, partial)
 
     def _evict_slot(
         self,
@@ -2137,6 +2169,12 @@ class ContinuousBatcher:
         pages AND any in-flight draft pages."""
         req = self._slot_req[slot]
         st = self._slot_spec[slot]
+        # The partial transcript reaches the stream consumer BEFORE the
+        # slot frees: an evicted request's caller gets every token the
+        # budget bought (the watchdog's contract — partial text
+        # delivered, then the timeout fault). The cancel return is
+        # moot; the slot is going away regardless.
+        self._deliver_stream(slot, n, partial)
         pages_freed = self._release_slot(slot)
         interleave_mod.stats.record_sync()  # fault decision point
         obs_mod.record_sync("fault")
@@ -2302,6 +2340,7 @@ class ContinuousBatcher:
         prefill_s = self._slot_prefill_s[slot]
         decode_s = self._slot_decode_s[slot]
         self._release_slot(slot)
+        self._deadline_t.pop(req.req_id, None)
         stream_mod.stats.record_cancel(n, saved)
         self.results.append(
             SchedResult(
@@ -2431,6 +2470,7 @@ class ContinuousBatcher:
         # the hand-rolled version left it stale — and keeps every
         # release invariant in one place.
         self._release_slot(slot)
+        self._deadline_t.pop(req.req_id, None)
         if obs_mod.config().enabled:
             obs_mod.hot.req_finished.inc()
             obs_mod.hot.pool_util.set(
@@ -2587,10 +2627,72 @@ class ContinuousBatcher:
         self.queue.clear()
         # Queue-wait bookkeeping dies with the queue: a req_id reused
         # by a later drain must not inherit this round's submit time.
+        # Per-request deadlines likewise — everything just resolved.
         self._queued_t.clear()
+        self._deadline_t.clear()
         # Deadline evictions are triage material exactly like faults:
         # dump what the batcher was doing when the budget ran out.
         obs_mod.autodump("timeout")
+
+    def _watchdog_exc(self, req: SchedRequest, where: str) -> TimeoutError:
+        exc = TimeoutError(
+            "DEADLINE_EXCEEDED: per-request watchdog deadline "
+            f"{req.deadline_s:g}s expired ({where}, req {req.req_id})"
+        )
+        exc.seam = "watchdog"
+        # The request's total budget is spent: no batcher-level requeue
+        # (it would re-expire on arrival) — the single hedged
+        # re-admission with a TIGHTENED budget is the debate layer's
+        # decision (run_round), where the breaker can veto it.
+        self._retried.add(req.req_id)
+        return exc
+
+    def _expire_request_deadlines(self) -> None:
+        """Per-request watchdog (``SchedRequest.deadline_s``): called
+        once per drive-loop iteration in BOTH loops, pure host clock
+        math on the fast path (one dict check when no deadline is
+        armed). An over-deadline RESIDENT row evicts through the
+        decode-fault surgery — ``_handle_decode_fault`` → ``_evict_slot``
+        → ``_release_slot`` — whose EXISTING sanctioned fetches rescue
+        the partial tokens and deliver them to the stream consumer, so
+        the watchdog introduces zero new sync points and co-residents
+        keep decoding. An over-deadline in-flight ADMISSION aborts
+        (pages freed, request resolved at the admission seam); an
+        over-deadline QUEUED request resolves with zero tokens — a
+        watchdog must also cover work that never got scheduled."""
+        if not self._deadline_t:
+            return
+        import time
+
+        now = time.monotonic()
+        for slot in range(self.B):
+            req = self._slot_req[slot]
+            if req is None:
+                continue
+            dl = self._deadline_t.get(req.req_id)
+            if dl is None or now <= dl:
+                continue
+            exc = self._watchdog_exc(req, "mid-decode")
+            exc.slot = slot
+            self._handle_decode_fault(exc)
+        adm = self._admission
+        if adm is not None:
+            dl = self._deadline_t.get(adm.req.req_id)
+            if dl is not None and now > dl:
+                self._abort_admission(
+                    self._watchdog_exc(adm.req, "mid-prefill")
+                )
+        expired = [
+            r
+            for r in self.queue
+            if self._deadline_t.get(r.req_id, now) < now
+        ]
+        for req in expired:
+            self.queue.remove(req)
+            self._deadline_t.pop(req.req_id, None)
+            self._fault_request(
+                req, self._watchdog_exc(req, "queued"), "watchdog"
+            )
 
     # -- pipelined drive loop ---------------------------------------------
 
@@ -3025,6 +3127,10 @@ class ContinuousBatcher:
                 inflight.clear()
                 self._expire_timeout()
                 break
+            # Per-request watchdog: evict over-deadline work before
+            # admitting/dispatching more (host clock math; evictions
+            # ride the fault surgery's existing sanctioned fetches).
+            self._expire_request_deadlines()
             self._admit()
             adm = self._admission
             live = [s for s in range(self.B) if self._active_np[s]]
@@ -3389,6 +3495,9 @@ class ContinuousBatcher:
             if deadline is not None and time.monotonic() > deadline:
                 self._expire_timeout()
                 break
+            # Per-request watchdog (same placement as the pipelined
+            # loop): this loop full-syncs every chunk anyway.
+            self._expire_request_deadlines()
             self._admit()
             if self._admission is not None:
                 # One prompt chunk, then fall through to a decode chunk —

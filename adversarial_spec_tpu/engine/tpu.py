@@ -915,6 +915,10 @@ class TpuEngine:
                         req_id=i,
                         prompt_ids=ids,
                         max_new_tokens=params.max_new_tokens,
+                        # Per-request watchdog: a hung/slow request is
+                        # evicted as TIMEOUT at this deadline while
+                        # co-residents keep decoding (0 = disabled).
+                        deadline_s=params.request_deadline_s,
                         # Trace propagation: the opponent request's ids
                         # ride into per-slot batcher state so every
                         # event of every device step resolves back to

@@ -39,6 +39,16 @@ class SamplingParams:
     # Best-effort wall-clock budget for one chat call; engines stop decoding
     # (returning what they have) when exceeded. 0 = unlimited.
     timeout_s: float = 0.0
+    # Per-REQUEST watchdog deadline in seconds, measured from submission
+    # to the serving engine (0 = disabled). Where ``timeout_s`` bounds
+    # the whole call and expires EVERY resident row at once, this bounds
+    # one hung/slow request: the ContinuousBatcher evicts an
+    # over-deadline slot as ``FaultKind.TIMEOUT`` through the shared
+    # release surgery — partial text delivered to its stream consumer,
+    # co-residents unaffected — and the debate layer answers with a
+    # single breaker-aware hedged re-admission on a tightened budget
+    # (docs/resilience.md "Durability and recovery").
+    request_deadline_s: float = 0.0
 
 
 @dataclass(frozen=True)

@@ -42,6 +42,8 @@ from adversarial_spec_tpu.obs.events import (  # noqa: F401 (re-export)
     EVENT_FIELDS,
     FaultEvent,
     FlightRecorder,
+    JournalEvent,
+    RecoveryEvent,
     RequestEvent,
     SpanEvent,
     SpecEvent,
@@ -165,6 +167,7 @@ class HotMetrics:
         "spec_tokens_per_step",
         "spec_acceptance",
         "cancel_tokens_saved",
+        "journal_fsync",
         "_m",
         "_sync",
         "_fault",
@@ -241,6 +244,14 @@ class HotMetrics:
                 8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0, 1024.0,
                 2048.0, 4096.0,
             ),
+        )
+        # Round-journal durability tax (debate/journal.py): the wall of
+        # each fsync'd record append — the price of crash-safe rounds,
+        # kept visible so a slow disk shows up as a fat tail here
+        # instead of as mystery round latency.
+        self.journal_fsync = m.histogram(
+            "advspec_journal_fsync_seconds",
+            help="round-journal fsync'd append wall",
         )
         self._sync: dict = {}
         self._fault: dict = {}

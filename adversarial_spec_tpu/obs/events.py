@@ -36,6 +36,10 @@ Event vocabulary (the schema ``tools/obs_dump.py`` validates):
 - ``SpanEvent`` — a causal-trace stage boundary (begin/end, or
   ``cancelled`` closing a request envelope mid-decode) with the
   stage's measured wall on the end record.
+- ``JournalEvent`` — one durable round-journal append (record type,
+  fsync wall) or journal-serve decision (debate/journal.py).
+- ``RecoveryEvent`` — one journal replay at round start: how many
+  opponents were served from durable records vs re-issued.
 
 Causal tracing (obs/trace.py): EVERY event additionally carries
 ``trace_id`` (the debate round that caused it) and ``span_id`` (the
@@ -211,6 +215,41 @@ class SpanEvent:
     span_id: str = ""
 
 
+@dataclass(slots=True)
+class JournalEvent:
+    """One crash-safe round-journal operation (debate/journal.py).
+    ``append`` is a durable fsync'd record append (``fsync_s`` holds
+    the write+fsync wall — the durability tax the journal-fsync
+    histogram aggregates); ``serve`` marks one opponent resolved from
+    a replayed record with zero engine work."""
+
+    TYPE = "journal"
+    op: str = "append"  # append | serve
+    rtype: str = ""  # record type (round_start|completion|partial|round_commit)
+    round_num: int = 0
+    index: int = -1  # opponent index within the round (-1: round-level)
+    fsync_s: float = 0.0
+    trace_id: str = ""
+    span_id: str = ""
+
+
+@dataclass(slots=True)
+class RecoveryEvent:
+    """One journal replay at round start (``--resume`` after a crash):
+    ``served`` opponents resolved from durable completion records,
+    ``reissued`` re-enter the engine, ``records`` journal records were
+    readable and ``skipped`` were torn/foreign-version and ignored."""
+
+    TYPE = "recovery"
+    round_num: int = 0
+    served: int = 0
+    reissued: int = 0
+    records: int = 0
+    skipped: int = 0
+    trace_id: str = ""
+    span_id: str = ""
+
+
 EVENT_TYPES = (
     StepEvent,
     RequestEvent,
@@ -222,6 +261,8 @@ EVENT_TYPES = (
     SwapEvent,
     CancelEvent,
     SpanEvent,
+    JournalEvent,
+    RecoveryEvent,
 )
 
 # ``cancelled`` closes a request envelope mid-decode (streaming early

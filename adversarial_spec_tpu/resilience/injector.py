@@ -15,6 +15,12 @@ seam                fires just before
 ``kv_swap``         each tier-block promotion into an admission's pages
                     (engine/scheduler.py — the tiered-KV swap path)
 ``checkpoint_load`` parameter materialization (engine/tpu.py)
+``crash``           each round-journal fsync append (debate/journal.py)
+                    — the write-ahead durability path: a fault here is
+                    a record that never became durable, and the round
+                    must survive it (journal failure is contained, the
+                    kill-chaos harness proves the stronger SIGKILL
+                    variant)
 ==================  =====================================================
 
 Configure with ``--chaos`` on the CLI or ``ADVSPEC_CHAOS`` in the
@@ -52,6 +58,7 @@ SEAMS = (
     "kv_alloc",
     "kv_swap",
     "checkpoint_load",
+    "crash",
 )
 
 # Marker text per kind: mirrors what PJRT/XLA put in real messages so the

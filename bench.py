@@ -58,6 +58,12 @@ Modes:
                                   # byte-identical prefixes) + real-
                                   # batcher freed-slot re-admission;
                                   # writes BENCH_cancel.json
+  python bench.py --mode recover  # mid-round kill recovery: SIGKILL a
+                                  # subprocess round after 2 of 4
+                                  # opponents journal, resume, pin the
+                                  # fraction of round tokens salvaged
+                                  # (journal + KV disk store) vs a cold
+                                  # re-run; writes BENCH_recover.json
   --no-interleave                 # escape hatch for any batcher-driven
                                   # mode: run the legacy serialized loop
                                   # (equivalent to ADVSPEC_INTERLEAVE=0)
@@ -1223,6 +1229,169 @@ def _run_cancel(platform: str) -> dict:
     }
 
 
+def _run_recover(platform: str) -> dict:
+    """Mid-round kill recovery bench (deterministic CPU mock,
+    subprocess-driven — writes BENCH_recover.json):
+
+    A 4-opponent round is SIGKILLed the instant the 2nd opponent's
+    journal record becomes durable (``ADVSPEC_JOURNAL_KILL_AFTER``),
+    then resumed with ``--resume``; a cold re-run of the same round
+    with fresh state is the baseline. The headline is the fraction of
+    the round's ENGINE tokens (prefill actually computed + decode
+    actually produced) that recovery salvaged vs that cold re-run —
+    journal-served opponents pay zero engine work, and the
+    content-addressed KV disk store (PR 7) rehydrates the re-issued
+    opponents' shared prefix, so the budget is >= 50% salvaged
+    (``within_budget``). Transcripts must be byte-identical to the
+    cold run throughout. Escape hatch: ``--no-journal``
+    (``ADVSPEC_JOURNAL=0``).
+    """
+    import signal
+    import tempfile
+
+    # ONE subprocess-CLI driver for the whole kill-recovery tooling:
+    # the drill (tools/chaos_run.py --crash) and this bench must test
+    # the same recovery contract, so they share the helper instead of
+    # drifting apart.
+    from tools.chaos_run import _cli
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    spec_doc = (
+        "## Goals\nServe heavy traffic from millions of users, fast.\n"
+        "## Constraints\n"
+        "The allocator SHALL bound page reuse by refcount.\n" * 6
+    )
+    models = [f"mock://critic?v={k}" for k in range(1, 5)]
+    kill_after = 2
+
+    def _failed(stage: str, proc) -> dict:
+        # A failed child is a bench VERDICT, not a crash: surface the
+        # child's stderr in the payload instead of dying on its empty
+        # stdout (the bench_trend lesson from PR 8).
+        return {
+            "metric": "recover_tokens_salvaged_fraction",
+            "value": 0.0,
+            "unit": "fraction of round prefill+decode tokens salvaged "
+            "across a mid-round SIGKILL (journal + tier store) vs cold",
+            "vs_baseline": None,
+            "platform": platform,
+            "within_budget": False,
+            "budget": 0.5,
+            "error": (
+                f"{stage} subprocess failed rc={proc.returncode}: "
+                f"{proc.stderr[-400:]}"
+            ),
+            "escape_hatch": "--no-journal (ADVSPEC_JOURNAL=0)",
+        }
+
+    with tempfile.TemporaryDirectory(prefix="advspec-recover-") as td:
+
+        def run_cli(args, env, stdin=None):
+            return _cli(args, env, td, stdin=stdin)
+
+        base = {
+            **os.environ,
+            "PYTHONPATH": repo,
+            "JAX_PLATFORMS": "cpu",
+            # The tiered-KV disk store persists the crashed process's
+            # prefix blocks; the resumed process rehydrates from it.
+            "ADVSPEC_KV_TIER": "1",
+        }
+        critique = [
+            "critique",
+            "--models",
+            ",".join(models),
+            "--json",
+        ]
+        env_kill = {
+            **base,
+            "ADVSPEC_SESSIONS_DIR": os.path.join(td, "sessions"),
+            "ADVSPEC_KV_STORE_DIR": os.path.join(td, "store"),
+            "ADVSPEC_JOURNAL_KILL_AFTER": str(kill_after),
+        }
+        p_kill = run_cli(
+            [*critique, "--session", "recover"], env_kill, stdin=spec_doc
+        )
+        killed_ok = p_kill.returncode == -signal.SIGKILL
+        env_resume = dict(env_kill)
+        env_resume.pop("ADVSPEC_JOURNAL_KILL_AFTER")
+        p_resume = run_cli(["critique", "--resume", "recover", "--json"],
+                           env_resume)
+        if p_resume.returncode != 0:
+            return _failed("resume", p_resume)
+        resumed = json.loads(p_resume.stdout)
+        env_cold = {
+            **base,
+            "ADVSPEC_SESSIONS_DIR": os.path.join(td, "sessions-cold"),
+            "ADVSPEC_KV_STORE_DIR": os.path.join(td, "store-cold"),
+        }
+        p_cold = run_cli(
+            [*critique, "--session", "recover"], env_cold, stdin=spec_doc
+        )
+        if p_cold.returncode != 0:
+            return _failed("cold reference", p_cold)
+        cold = json.loads(p_cold.stdout)
+
+    def engine_tokens(payload: dict, salvaged_decode: float = 0.0) -> dict:
+        # Prefill the engine actually computed this round (journal-
+        # served opponents never reach the engine; tier-rehydrated
+        # prefix tokens are already netted out by the cache stats) +
+        # decode it actually produced (total output minus the decode
+        # that came back off the journal).
+        prefill = payload["perf"]["prefix_cache"]["prefilled_tokens"]
+        out_total = sum(
+            r["output_tokens"] for r in payload["results"]
+        )
+        return {
+            "prefill_tokens": int(prefill),
+            "decode_tokens": int(out_total - salvaged_decode),
+            "total": int(prefill + out_total - salvaged_decode),
+        }
+
+    salvaged_decode = resumed["perf"]["counters"].get(
+        "debate/journal.salvaged_decode_tokens", 0.0
+    )
+    served = int(
+        resumed["perf"]["counters"].get("debate/journal.served", 0)
+    )
+    paid_cold = engine_tokens(cold)
+    paid_resumed = engine_tokens(resumed, salvaged_decode)
+    salvaged_fraction = (
+        1.0 - paid_resumed["total"] / paid_cold["total"]
+        if paid_cold["total"]
+        else 0.0
+    )
+    transcripts_ok = all(
+        a["response"] == b["response"]
+        for a, b in zip(resumed["results"], cold["results"])
+    )
+    within = (
+        killed_ok
+        and served == kill_after
+        and transcripts_ok
+        and salvaged_fraction >= 0.5
+    )
+    return {
+        "metric": "recover_tokens_salvaged_fraction",
+        "value": round(salvaged_fraction, 4),
+        "unit": "fraction of round prefill+decode tokens salvaged "
+        "across a mid-round SIGKILL (journal + tier store) vs cold",
+        "vs_baseline": None,  # no published recovery baseline
+        "platform": platform,
+        "within_budget": within,
+        "budget": 0.5,
+        "opponents": len(models),
+        "kill_after_completions": kill_after,
+        "victim_sigkilled": killed_ok,
+        "journal_served": served,
+        "salvaged_decode_tokens": int(salvaged_decode),
+        "paid_cold": paid_cold,
+        "paid_recovered": paid_resumed,
+        "transcripts_byte_identical": transcripts_ok,
+        "escape_hatch": "--no-journal (ADVSPEC_JOURNAL=0)",
+    }
+
+
 def _run_obs_overhead(platform: str) -> dict:
     """Observability overhead bench: what fraction of the mock mixed
     workload's wall the recorder+metrics emit path costs. Budget < 3%
@@ -1501,6 +1670,7 @@ def main() -> int:
     spec_mode = _mode("spec")
     tier_mode = _mode("tier")
     cancel_mode = _mode("cancel")
+    recover_mode = _mode("recover")
     if "--no-speculative" in args:
         # Escape hatch mirror of --no-interleave: batcher-driven modes
         # (and any TPU child) decode token-at-a-time.
@@ -1524,6 +1694,8 @@ def main() -> int:
         mode_flag, runner = "--tier", _run_tier
     elif cancel_mode:
         mode_flag, runner = "--cancel", _run_cancel
+    elif recover_mode:
+        mode_flag, runner = "--recover", _run_recover
     else:
         mode_flag, runner = "", _run_bench
 
@@ -1540,9 +1712,10 @@ def main() -> int:
         os.rename(tmp, out_path)
         return 0
 
-    if obs_mode:
-        # Mock-only workload — no jax, no device, no TPU probe: the 3%
-        # budget is a CPU host-overhead pin by definition.
+    if obs_mode or recover_mode:
+        # Mock-only workloads — no jax, no device, no TPU probe: the
+        # obs budget is a CPU host-overhead pin by definition, and the
+        # recovery drill is subprocess-driven mock rounds.
         payload = runner("cpu")
     elif os.environ.get("BENCH_FORCE_CPU") == "1" or not _probe_tpu():
         payload = _run_cpu_fallback(runner)
@@ -1564,6 +1737,7 @@ def main() -> int:
         or spec_mode
         or tier_mode
         or cancel_mode
+        or recover_mode
     ):
         # Persist the perf trajectory point alongside the BENCH_r*
         # series the driver records.
@@ -1579,6 +1753,8 @@ def main() -> int:
             else "BENCH_tier.json"
             if tier_mode
             else "BENCH_cancel.json"
+            if cancel_mode
+            else "BENCH_recover.json"
         )
         out = os.path.join(
             os.path.dirname(os.path.abspath(__file__)), name

@@ -1,4 +1,4 @@
-"""Standalone chaos-suite runner.
+"""Standalone chaos-suite runner + kill-chaos recovery drill.
 
 Runs the fault-injection / resilience tests (pytest marker ``chaos``)
 outside the main suite — the quick gate after touching scheduler, engine,
@@ -9,21 +9,184 @@ a sweep buys wider coverage when you want it, without slowing tier-1).
 Reproduce a failing sweep seed N with ``ADVSPEC_CHAOS_FUZZ_SEED=N
 pytest tests/test_fuzz.py -k ChaosFuzz``.
 
+``--crash`` is the kill-chaos recovery drill (docs/resilience.md
+"Durability and recovery"): it spawns a REAL mock debate round in a
+subprocess, SIGKILLs it mid-round the instant the Nth opponent's
+journal record becomes durable (``ADVSPEC_JOURNAL_KILL_AFTER``),
+resumes the session in a second subprocess, and asserts the recovery
+contract — only unfinished opponents re-issue (no duplicated opponent
+work) and every journal-served transcript is byte-identical to an
+uninterrupted run of the same round.
+
 Usage:
     python tools/chaos_run.py                # pytest -m chaos
     python tools/chaos_run.py --sweep 5      # + 5 extra fuzz seeds
+    python tools/chaos_run.py --crash        # SIGKILL + resume drill
     python tools/chaos_run.py -- -x -k breaker   # extra pytest args
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
+import signal
 import subprocess
 import sys
+import tempfile
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
+
+_CRASH_SPEC = (
+    "## Goals\nServe heavy traffic from millions of users, fast.\n"
+    "## Constraints\nThe allocator SHALL bound page reuse by refcount.\n"
+)
+_CRASH_MODELS = [
+    "mock://critic?v=1",
+    "mock://critic?v=2",
+    "mock://critic?v=3",
+    "mock://critic?v=4",
+]
+_KILL_AFTER = 2  # SIGKILL once this many completion records are durable
+
+
+def _cli(args: list[str], env: dict, cwd: str, stdin: str | None = None):
+    # cwd is the drill's tempdir, NOT the repo: the CLI writes
+    # cwd-relative spec checkpoints, which must not litter the tree
+    # (PYTHONPATH in env makes the package importable from anywhere).
+    return subprocess.run(
+        [sys.executable, "-m", "adversarial_spec_tpu.cli", *args],
+        input=stdin,
+        text=True,
+        capture_output=True,
+        cwd=cwd,
+        env=env,
+    )
+
+
+def crash_drill(verbose: bool = True) -> int:
+    """SIGKILL a round mid-journal, resume, and check the recovery
+    contract. Returns 0 on success, 1 with reasons on stderr."""
+
+    def say(msg: str) -> None:
+        if verbose:
+            print(f"chaos_run --crash: {msg}", flush=True)
+
+    failures: list[str] = []
+    with tempfile.TemporaryDirectory(prefix="advspec-crash-") as td:
+        base = {
+            **os.environ,
+            "PYTHONPATH": str(REPO),
+            "JAX_PLATFORMS": "cpu",
+        }
+        # 1. The victim: a real round over 4 opponents, killed the
+        # moment opponent _KILL_AFTER's completion record is durable.
+        env1 = {
+            **base,
+            "ADVSPEC_SESSIONS_DIR": os.path.join(td, "sessions"),
+            "ADVSPEC_JOURNAL_KILL_AFTER": str(_KILL_AFTER),
+        }
+        p1 = _cli(
+            [
+                "critique",
+                "--session",
+                "crash-drill",
+                "--models",
+                ",".join(_CRASH_MODELS),
+                "--json",
+            ],
+            env1,
+            td,
+            stdin=_CRASH_SPEC,
+        )
+        if p1.returncode != -signal.SIGKILL:
+            failures.append(
+                f"victim expected SIGKILL exit, got rc={p1.returncode}: "
+                f"{p1.stderr[-300:]}"
+            )
+        say(f"victim killed mid-round (rc={p1.returncode})")
+
+        # 2. Resume: journal-served opponents must not re-issue.
+        env2 = dict(env1)
+        env2.pop("ADVSPEC_JOURNAL_KILL_AFTER")
+        p2 = _cli(
+            ["critique", "--resume", "crash-drill", "--json"], env2, td
+        )
+        if p2.returncode != 0:
+            failures.append(
+                f"resume failed rc={p2.returncode}: {p2.stderr[-300:]}"
+            )
+            print("\n".join(f"FAIL: {f}" for f in failures), file=sys.stderr)
+            return 1
+        resumed = json.loads(p2.stdout)
+
+        # 3. Reference: the same round uninterrupted, fresh state.
+        env3 = {
+            **base,
+            "ADVSPEC_SESSIONS_DIR": os.path.join(td, "sessions-ref"),
+        }
+        p3 = _cli(
+            [
+                "critique",
+                "--session",
+                "crash-drill",
+                "--models",
+                ",".join(_CRASH_MODELS),
+                "--json",
+            ],
+            env3,
+            td,
+            stdin=_CRASH_SPEC,
+        )
+        if p3.returncode != 0:
+            failures.append(
+                f"reference run failed rc={p3.returncode}: "
+                f"{p3.stderr[-300:]}"
+            )
+            print("\n".join(f"FAIL: {f}" for f in failures), file=sys.stderr)
+            return 1
+        reference = json.loads(p3.stdout)
+
+        counters = resumed["perf"]["counters"]
+        served = int(counters.get("debate/journal.served", 0))
+        if served != _KILL_AFTER:
+            failures.append(
+                f"expected {_KILL_AFTER} journal-served opponents, "
+                f"got {served}"
+            )
+        # No duplicated opponent work: journal-served models must have
+        # burned ZERO engine attempts in the resumed process.
+        for i, model in enumerate(_CRASH_MODELS):
+            attempts = counters.get(f"debate/attempts.{model}", 0)
+            want = 0 if i < _KILL_AFTER else 1
+            if attempts != want:
+                failures.append(
+                    f"{model}: {attempts} engine attempt(s) on resume, "
+                    f"expected {want}"
+                )
+        # Byte-identical transcripts for journal-served opponents (the
+        # mock is deterministic, so re-issued ones match too — but the
+        # journal-served equality is the recovery guarantee).
+        for i in range(len(_CRASH_MODELS)):
+            a = resumed["results"][i]["response"]
+            b = reference["results"][i]["response"]
+            if a != b:
+                kind = "journal-served" if i < _KILL_AFTER else "re-issued"
+                failures.append(
+                    f"opponent {i} ({kind}) transcript diverged from the "
+                    "uninterrupted run"
+                )
+        say(
+            f"resume served {served} opponent(s) from the journal, "
+            f"re-issued {len(_CRASH_MODELS) - served}; transcripts "
+            "byte-identical"
+        )
+    if failures:
+        print("\n".join(f"FAIL: {f}" for f in failures), file=sys.stderr)
+        return 1
+    say("recovery contract holds")
+    return 0
 
 
 def _pytest(extra: list[str], env_overrides: dict[str, str]) -> int:
@@ -57,9 +220,19 @@ def main(argv: list[str] | None = None) -> int:
         help="after the marked suite, re-run the scheduler chaos fuzz "
         "under N extra ADVSPEC_CHAOS_FUZZ_SEED values",
     )
+    ap.add_argument(
+        "--crash",
+        action="store_true",
+        help="kill-chaos recovery drill: SIGKILL a real subprocess round "
+        "mid-journal, resume, assert no duplicated opponent work and "
+        "byte-identical journal-served transcripts",
+    )
     args, extra = ap.parse_known_args(argv)
     if extra and extra[0] == "--":
         extra = extra[1:]
+
+    if args.crash:
+        return crash_drill()
 
     rc = _pytest(extra, {})
     if rc != 0:

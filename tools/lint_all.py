@@ -11,6 +11,11 @@ stage failed):
    tools/mutation_run.py consume must stay importable and structurally
    sound (non-empty marker tuples, tests + graftlint fixtures excluded
    from mutation targets).
+2b. **journal schema self-check** — the crash-safe round journal's
+   record schema (debate/journal.py RECORD_FIELDS): every record type
+   has a validating example and the validator provably fires on broken
+   records — a resume that silently misreads its journal is a lost
+   round.
 3. **bench-trend** (``--full`` only) — every committed BENCH_*.json
    must schema-validate and join into the perf-trajectory table
    (tools/bench_trend.py): a malformed bench file fails the gate
@@ -109,6 +114,25 @@ def _stage_mutmut_sanity() -> bool:
     return ok
 
 
+def _stage_journal_schema() -> bool:
+    try:
+        from adversarial_spec_tpu.debate import journal
+    except Exception as e:
+        print(f"lint_all: journal-schema: import failed: {e}", file=sys.stderr)
+        print("lint_all: journal-schema FAILED", file=sys.stderr)
+        return False
+    problems = journal.self_check()
+    for p in problems:
+        print(f"lint_all: journal-schema: {p}", file=sys.stderr)
+    ok = not problems
+    print(
+        f"lint_all: journal-schema {'OK' if ok else 'FAILED'} "
+        f"({len(journal.RECORD_TYPES)} record type(s))",
+        file=sys.stderr,
+    )
+    return ok
+
+
 def _stage_bench_trend() -> bool:
     from tools.bench_trend import collect
 
@@ -149,6 +173,7 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
     ok = _stage_graftlint()
     ok = _stage_mutmut_sanity() and ok
+    ok = _stage_journal_schema() and ok
     if args.full:
         ok = _stage_bench_trend() and ok
         ok = _stage_unroll() and ok

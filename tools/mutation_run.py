@@ -51,7 +51,11 @@ REPO = Path(__file__).resolve().parent.parent
 DEFAULT_TARGETS: dict[str, list[str]] = {
     "adversarial_spec_tpu/debate/parsing.py": ["tests/test_parsing.py"],
     "adversarial_spec_tpu/debate/usage.py": ["tests/test_usage.py"],
-    "adversarial_spec_tpu/debate/session.py": ["tests/test_session.py"],
+    "adversarial_spec_tpu/debate/session.py": [
+        "tests/test_session.py",
+        "tests/test_durability.py",
+    ],
+    "adversarial_spec_tpu/debate/journal.py": ["tests/test_durability.py"],
     "adversarial_spec_tpu/debate/profiles.py": ["tests/test_profiles.py"],
     "adversarial_spec_tpu/debate/core.py": ["tests/test_engine_mock.py"],
     "adversarial_spec_tpu/debate/telegram.py": ["tests/test_telegram.py"],
