@@ -31,11 +31,14 @@ SIGKILL-mid-round → resume loop.
 
 Durability mechanics: every append is a single JSON line written,
 flushed and ``os.fsync``'d before the caller proceeds (the fsync wall
-is the ``advspec_journal_fsync_seconds`` histogram). The reader
-tolerates a torn tail — a crash mid-append leaves at most one
-undecodable final line, which is discarded along with anything after
-it; records with a foreign ``v`` (version) or failing the field schema
-are skipped and counted, never fatal. Journal failures are contained
+is the ``advspec_journal_fsync_seconds`` histogram). A crash mid-append
+leaves at most one half-written line with no trailing newline; the
+NEXT append heals it with a leading newline so the torn garbage is
+confined to its own line, and the reader skips undecodable lines
+ALONE — records appended after a crash stay replayable through a
+second crash. Records with a foreign ``v`` (version) or failing the
+field schema are likewise skipped and counted, never fatal. Journal
+failures are contained
 by the caller (debate/core.py): a round must survive its journal — the
 chaos injector's ``crash`` seam fires before every append to prove it.
 
@@ -304,7 +307,23 @@ class RoundJournal:
                     pass
                 raise
         else:
+            # Heal a torn tail before appending: a crash mid-append
+            # leaves a half-written line with NO trailing newline, and
+            # appending straight onto it would fuse this record into
+            # the garbage — unreadable, and before the reader learned
+            # to skip mid-stream garbage it cost every later record in
+            # the round too. A leading newline confines the torn line
+            # to itself; the reader skips it alone.
+            heal = False
+            try:
+                with open(path, "rb") as rf:
+                    rf.seek(-1, os.SEEK_END)
+                    heal = rf.read(1) != b"\n"
+            except (OSError, ValueError):
+                heal = False  # missing or empty file: nothing to heal
             with open(path, "a", encoding="utf-8") as f:
+                if heal:
+                    f.write("\n")
                 f.write(line)
                 f.flush()
                 os.fsync(f.fileno())
@@ -431,25 +450,32 @@ class RoundJournal:
 
     def read(self) -> tuple[list[dict], int]:
         """Every valid record, in order, plus the count of lines that
-        were skipped. An UNDECODABLE line is a torn tail (the one crash
-        artifact an fsync'd append-only file can have): it and
-        everything after it are discarded. A decodable record that
-        fails validation or carries a foreign version is skipped alone
-        — the append completed; the record just isn't ours to act on."""
+        were skipped. An UNDECODABLE line is a tear artifact — a crash
+        mid-append (the one crash shape an fsync'd append-only file
+        has) — and is skipped ALONE: the appender heals a newline-less
+        torn tail before its next write, so every record after a tear
+        sits on its own durably-appended line and stays replayable (a
+        reader that discarded everything past the tear re-paid every
+        post-crash opponent on the NEXT crash). Records are
+        independently keyed (replay re-checks round/spec/model per
+        record), so skipping garbage alone never resurrects unordered
+        state. A decodable record that fails validation or carries a
+        foreign version likewise skips alone — the append completed;
+        the record just isn't ours to act on."""
         path = self.path
         if not path.is_file():
             return [], 0
         records: list[dict] = []
         skipped = 0
         lines = path.read_text(encoding="utf-8", errors="replace").splitlines()
-        for k, line in enumerate(lines):
+        for line in lines:
             if not line.strip():
                 continue
             try:
                 obj = json.loads(line)
             except json.JSONDecodeError:
-                skipped += sum(1 for l in lines[k:] if l.strip())
-                break
+                skipped += 1
+                continue
             if validate_record(obj):
                 skipped += 1
                 continue

@@ -16,6 +16,8 @@ from __future__ import annotations
 import json
 from pathlib import Path
 
+from adversarial_spec_tpu.obs.events import atomic_write_text
+
 PROFILES_DIR = Path.home() / ".config" / "adversarial-spec-tpu" / "profiles"
 GLOBAL_CONFIG_PATH = (
     Path.home() / ".config" / "adversarial-spec-tpu" / "config.json"
@@ -45,7 +47,9 @@ def save_profile(
     if unknown:
         raise ValueError(f"unknown profile fields: {sorted(unknown)}")
     path = directory / f"{name}.json"
-    path.write_text(json.dumps(settings, indent=2))
+    # tmp+replace (GL-ATOMIC): a crash mid-save must not tear a profile
+    # a later run then half-loads.
+    atomic_write_text(str(path), json.dumps(settings, indent=2))
     return path
 
 
@@ -109,5 +113,6 @@ def load_global_config(config_path: Path | None = None) -> dict:
 def save_global_config(config: dict, config_path: Path | None = None) -> Path:
     path = Path(config_path or GLOBAL_CONFIG_PATH)
     path.parent.mkdir(parents=True, exist_ok=True)
-    path.write_text(json.dumps(config, indent=2))
+    # tmp+replace (GL-ATOMIC): same torn-state discipline as profiles.
+    atomic_write_text(str(path), json.dumps(config, indent=2))
     return path

@@ -19,6 +19,8 @@ import json
 from dataclasses import dataclass, field, asdict
 from pathlib import Path
 
+from adversarial_spec_tpu.obs.events import atomic_write_text
+
 REGISTRY_PATH = Path.home() / ".config" / "adversarial-spec-tpu" / "registry.json"
 
 TPU_PREFIX = "tpu://"
@@ -98,7 +100,9 @@ def save_registry_entry(
         except (json.JSONDecodeError, OSError):
             data = {}
     data[spec.alias] = spec.to_dict()
-    path.write_text(json.dumps(data, indent=2))
+    # tmp+replace (GL-ATOMIC): a crash mid-save must not tear the
+    # registry every later ``tpu://`` resolve parses.
+    atomic_write_text(str(path), json.dumps(data, indent=2))
     return path
 
 
@@ -112,7 +116,8 @@ def remove_registry_entry(
     if alias not in data:
         return False
     del data[alias]
-    path.write_text(json.dumps(data, indent=2))
+    # tmp+replace (GL-ATOMIC): same discipline as save_registry_entry.
+    atomic_write_text(str(path), json.dumps(data, indent=2))
     return True
 
 

@@ -2893,7 +2893,12 @@ class ContinuousBatcher:
         for slot in live:
             t = self.allocator.table(self._slot_seq[slot])
             tables[slot, : len(t)] = np.asarray(t, np.int32) + 1
-        self.page_table = jnp.asarray(tables)
+        # Committed like every other persistent row-state creation
+        # (GL-COMMIT): the re-pushed table is a program input next
+        # dispatch, and an uncommitted fresh array vs the committed
+        # step output is two jit signatures — the PR 6 double-compile
+        # class, which this site reintroduced on the spec path.
+        self.page_table = self._commit(jnp.asarray(tables))
         return jnp.asarray(alloc, jnp.int32)
 
     def _dispatch_spec(

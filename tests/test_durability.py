@@ -108,6 +108,105 @@ class TestJournalUnit:
         assert skipped == 1
         assert sorted(j.replay(1, SPEC, ["m1"])) == [0]
 
+    def test_records_after_torn_tail_stay_replayable(self):
+        """A realistic tear (half-written line, NO trailing newline)
+        must not cost the records appended after it: the next append
+        heals the tear with a leading newline, the reader skips the
+        confined garbage alone, and a SECOND crash in the same round
+        still replays every post-tear completion — durability does not
+        silently stop at the first crash."""
+        j = RoundJournal("t-torn-multi")
+        j.ensure_round_start(1, SPEC, ["m1", "m2"], {})
+        j.log_completion(1, 0, "m1", _completion("alpha"), 0.1)
+        with open(j.path, "a") as f:
+            f.write('{"v": 1, "type": "completio')  # crash: no newline
+        # The resumed process re-issues the missing opponent and its
+        # completion must become durable DESPITE the tear before it.
+        j2 = RoundJournal("t-torn-multi")
+        j2.log_completion(1, 1, "m2", _completion("beta"), 0.1)
+        j2.log_round_commit(1, all_agreed=False)
+        records, skipped = j.read()
+        assert [r["type"] for r in records] == [
+            "round_start",
+            "completion",
+            "completion",
+            "round_commit",
+        ]
+        assert skipped == 1  # exactly the confined torn line
+        served = j.replay(1, SPEC, ["m1", "m2"])
+        assert sorted(served) == [0, 1]
+        assert served[1]["text"] == "beta"
+
+    def test_foreign_versions_interleaved_mid_stream(self):
+        """Foreign-version records INTERLEAVED between valid ones are
+        each skipped alone — unlike a tear, a complete append from a
+        future writer does not invalidate what follows it."""
+        j = RoundJournal("t-foreign-mid")
+        j.ensure_round_start(1, SPEC, ["m1", "m2", "m3"], {})
+        foreign = (
+            json.dumps(
+                {"v": JOURNAL_VERSION + 1, "type": "future", "x": 1}
+            )
+            + "\n"
+        )
+        j.log_completion(1, 0, "m1", _completion("a"), 0.1)
+        with open(j.path, "a") as f:
+            f.write(foreign)
+        j.log_completion(1, 1, "m2", _completion("b"), 0.1)
+        with open(j.path, "a") as f:
+            f.write(foreign)
+        j.log_completion(1, 2, "m3", _completion("c"), 0.1)
+        records, skipped = j.read()
+        assert skipped == 2
+        assert [r["type"] for r in records] == [
+            "round_start",
+            "completion",
+            "completion",
+            "completion",
+        ]
+        served = j.replay(1, SPEC, ["m1", "m2", "m3"])
+        assert sorted(served) == [0, 1, 2]
+
+    def test_round_commit_torn_at_fsync_boundary(self):
+        """A round_commit torn exactly at the fsync boundary (the line
+        half-written, no newline durable) never became a commit: the
+        reader discards it, the round's completions stay replayable,
+        and a resume of the SAME round appends no new marker — it
+        re-synthesizes from the journal and re-commits."""
+        j = RoundJournal("t-commit-torn")
+        j.ensure_round_start(1, SPEC, ["m1"], {})
+        j.log_completion(1, 0, "m1", _completion("alpha"), 0.1)
+        full = json.dumps(
+            {"v": JOURNAL_VERSION, "type": "round_commit", "round": 1,
+             "all_agreed": True}
+        )
+        with open(j.path, "a") as f:
+            f.write(full[: len(full) // 2])  # crash mid-write, no \n
+        records, skipped = j.read()
+        assert [r["type"] for r in records] == [
+            "round_start",
+            "completion",
+        ]
+        assert skipped == 1
+        # The resume path: same round, same spec — marker already
+        # durable (no fresh truncation), completion served from the
+        # journal with zero engine work, and the re-commit LANDS: the
+        # append heals the newline-less tear first, so the new commit
+        # sits on its own line instead of fusing into the garbage.
+        j2 = RoundJournal("t-commit-torn")
+        assert not j2.ensure_round_start(1, SPEC, ["m1"], {})
+        served = j2.replay(1, SPEC, ["m1"])
+        assert sorted(served) == [0]
+        j2.log_round_commit(1, all_agreed=True)
+        records, skipped = j2.read()
+        assert [r["type"] for r in records] == [
+            "round_start",
+            "completion",
+            "round_commit",
+        ]
+        assert records[-1]["all_agreed"] is True
+        assert skipped == 1  # the confined torn half-commit
+
     def test_foreign_version_skipped_not_fatal(self):
         j = RoundJournal("t5")
         j.ensure_round_start(1, SPEC, ["m1"], {})

@@ -1,5 +1,6 @@
-"""graftlint — rule-registry static analysis for JAX serving-path
-discipline (pure stdlib ``ast``; mypy/ruff are not installable here).
+"""graftlint v2 — rule-registry static analysis over an interprocedural
+dataflow engine, for JAX serving-path discipline (pure stdlib ``ast``;
+mypy/ruff are not installable here).
 
 The framework generalizes ``tools/astlint.py`` (kept as a thin compat
 entrypoint): a multi-pass linter with
@@ -7,6 +8,11 @@ entrypoint): a multi-pass linter with
 - a **rule registry** — every check is a ``Rule`` subclass with a stable
   id (``GL-*``), a rationale, and an embedded must-fail fixture that the
   self-test harness (``--self-test``) proves fires;
+- an **interprocedural dataflow engine** (tools/graftlint/dataflow.py) —
+  package-wide function table, call resolution, device-taint
+  propagation across assignments / call arguments / return summaries /
+  helper parameters, and bounded call-graph reachability; conservative
+  at unknown provenance;
 - **inline suppressions** — ``# graftlint: disable=GL-SYNC -- reason``
   on (or immediately above) the offending line; the reason is mandatory
   and a reasonless disable is itself a finding (GL-SUPPRESS) that does
@@ -14,10 +20,11 @@ entrypoint): a multi-pass linter with
 - a **committed baseline** (``tools/graftlint/baseline.json``) for
   grandfathered findings — new code must lint clean, old findings are
   pinned so they can only shrink;
-- human and ``--json`` output, ``--list-rules`` / ``--rule`` selection;
+- human and ``--json`` output (with per-rule wall seconds),
+  ``--list-rules`` / ``--rule`` selection;
 - configuration in one place: the ``[tool.graftlint]`` table in
-  pyproject.toml (sync allowlist, signature-preserving decorators,
-  device-value names, bucketer functions, refcount scope).
+  pyproject.toml — and GL-CONFIG flags any entry that stops matching
+  the code (allowlists cannot rot).
 
 Rule catalog (docs/static_analysis.md has the full rationale):
 
@@ -26,12 +33,22 @@ GL-IMPORT      ``from pkg.mod import NAME`` — NAME must exist there
 GL-ATTR        ``mod.NAME`` on package modules — NAME must be bound
 GL-ARITY       call arity / keyword validity for resolvable calls
 GL-SYNC        no host sync (explicit OR implicit) in the continuous
-               batcher outside sanctioned sync points
+               batcher outside sanctioned sync points; taint survives
+               helper extraction
 GL-TRACE       no Python side effects inside jit-traced bodies
 GL-RETRACE     jit call sites: static args bounded (pow2-bucketed),
                traced args never bare host scalars
 GL-REFCOUNT    allocator acquires must reach a release on all paths
+GL-COMMIT      fresh device state bound to persistent attrs must be
+               mesh-committed at creation (the double-compile class)
+GL-DONATE      donated buffers must be snapshotted before any stored
+               alias (the use-after-donate class)
+GL-ATOMIC      package file writes route through a sanctioned atomic
+               discipline (the torn-state class)
+GL-LIFECYCLE   every slot exit reaches the shared release surgery; no
+               hand-rolled ownership writes
 GL-SUPPRESS    suppression hygiene (reason mandatory, ids must exist)
+GL-CONFIG      [tool.graftlint] entries must match indexed code
 =============  ========================================================
 
 Usage::

@@ -73,29 +73,26 @@ class GraftlintConfig:
             "pads",
         ]
     )
-    # Bare local names that hold device values in the sync class.
-    # demote_kv / promo_kv are the tiered-KV swap arrays (the demotion
-    # gather handle and the promotion device_put — engine/kvtier.py):
-    # fetching either inside the drive loop is a host sync, so the
-    # promotion queue's fetch sites are tainted like any other device
-    # value and sanctioned fetches carry reasoned inline disables.
-    # emitted_ref / out_ref are the stream-consumer seam's entry
-    # elements (the pipelined loop's async double-buffer fetch,
-    # engine/scheduler.py): fetching either is a host sync, sanctioned
-    # only at the resolved/depth-bound entry fetch where the flags
-    # already sync — the reasoned inline disables there must stay live.
+    # Bare local names that hold device values in the sync class but
+    # whose provenance the dataflow engine cannot derive. Since the
+    # interprocedural port this list holds ONLY the pipelined double
+    # buffer's entry elements: the tuples round-trip through a deque
+    # (an opaque container the flow analysis does not model), so the
+    # unpacked refs in _fetch_entry are seeded by hand. Everything the
+    # list used to carry because taint died at an assignment or a call
+    # boundary (first, adm_logits, spec_counts, demote_kv, promo_kv) is
+    # now DERIVED — see tools/graftlint/dataflow.py.
     sync_device_names: list[str] = field(
         default_factory=lambda: [
-            "first",
             "active_ref",
-            "adm_logits",
-            "spec_counts",
-            "demote_kv",
-            "promo_kv",
             "emitted_ref",
             "out_ref",
         ]
     )
+    # Bounded depth for the interprocedural passes: summary recursion,
+    # call-site→parameter taint seeding rounds, and call-graph
+    # reachability hops.
+    dataflow_depth: int = 4
     # --- GL-TRACE ----------------------------------------------------
     # Dotted-call prefixes that are host side effects inside a traced
     # body (a trace-time call silently bakes a constant into the
@@ -171,6 +168,103 @@ class GraftlintConfig:
             "cache_ref=cache_unref",
             "swap_pin=swap_unpin",
         ]
+    )
+
+    # --- GL-COMMIT ---------------------------------------------------
+    # Classes whose persistent device attributes must be committed to
+    # the mesh sharding at creation, the attribute names, the calls
+    # that CREATE fresh (uncommitted) device state, the sanctioned
+    # committing wrappers, and holder constructors whose keyword args
+    # are persistent sinks (_Admission(cache=...)). ``pool`` is
+    # deliberately NOT in commit_attrs: its placement is owned by the
+    # paged kernels (init_page_pool), not the replicated row-state
+    # sharding.
+    commit_classes: list[str] = field(
+        default_factory=lambda: ["ContinuousBatcher"]
+    )
+    commit_attrs: list[str] = field(
+        default_factory=lambda: [
+            "page_table",
+            "cur_tok",
+            "cur_len",
+            "pad_lens",
+            "n_emitted",
+            "max_new",
+            "active",
+            "out_buf",
+            "ctx_buf",
+            "ctx_len",
+            "prev_tok",
+            "cache",
+        ]
+    )
+    commit_creators: list[str] = field(
+        default_factory=lambda: [
+            "init_cache",
+            "jnp.zeros",
+            "jnp.ones",
+            "jnp.full",
+            "jnp.arange",
+            "jnp.asarray",
+            "jnp.array",
+        ]
+    )
+    commit_wrappers: list[str] = field(
+        default_factory=lambda: ["_commit", "device_put"]
+    )
+    commit_holders: list[str] = field(
+        default_factory=lambda: ["_Admission"]
+    )
+    # --- GL-DONATE ---------------------------------------------------
+    # Calls that take an independent snapshot of a buffer (reading the
+    # snapshot after the original was donated is safe).
+    donate_snapshots: list[str] = field(
+        default_factory=lambda: [
+            "copy",
+            "jnp.copy",
+            "np.copy",
+            "np.array",
+            "np.asarray",
+            "deepcopy",
+        ]
+    )
+    # --- GL-ATOMIC ---------------------------------------------------
+    # The sanctioned write implementations (module:func or
+    # module:Class.method): every other file write inside the package
+    # must route through one of them.
+    atomic_funcs: list[str] = field(
+        default_factory=lambda: [
+            "adversarial_spec_tpu.obs.events:atomic_write_text",
+            "adversarial_spec_tpu.debate.journal:RoundJournal._write",
+            "adversarial_spec_tpu.engine.kvtier:DiskStore.put",
+        ]
+    )
+    # --- GL-LIFECYCLE ------------------------------------------------
+    # The slot state machine: every exit path must reach the shared
+    # release surgery, and the slot-ownership attributes may only be
+    # written by the surgery, the acquisition path, and the listed
+    # mutators (plus __init__).
+    lifecycle_class: str = "ContinuousBatcher"
+    lifecycle_release: str = "_release_slot"
+    lifecycle_exits: list[str] = field(
+        default_factory=lambda: [
+            "_finish_slot",
+            "_evict_slot",
+            "_cancel_slot",
+            "_expire_request_deadlines",
+        ]
+    )
+    lifecycle_owned_attrs: list[str] = field(
+        default_factory=lambda: [
+            "_slot_req",
+            "_slot_seq",
+            "_slot_consumer",
+            "_slot_streamed",
+            "_slot_gen",
+        ]
+    )
+    lifecycle_mutators: list[str] = field(
+        default_factory=lambda: ["_finish_admission", "_deliver_stream"]
     )
 
     def acquire_release(self) -> dict[str, str]:
@@ -276,3 +370,24 @@ def load_config(repo: Path) -> GraftlintConfig:
             raise ValueError(f"[tool.graftlint] unknown key {key!r}")
         setattr(cfg, attr, value)
     return cfg
+
+
+def config_drift(repo: Path) -> list[str]:
+    """Field-by-field drift between pyproject's ``[tool.graftlint]``
+    table and the in-code defaults (which exist so fixture trees lint
+    without a pyproject — they must never diverge from the committed
+    table). THE shared drift guard: tools/lint_all.py runs it as a
+    preflight stage and tests/test_tools.py pins it empty; per-module
+    copies of the same check are retired."""
+    import dataclasses
+
+    cfg = load_config(repo)
+    dflt = GraftlintConfig()
+    out: list[str] = []
+    for f in dataclasses.fields(cfg):
+        have, want = getattr(cfg, f.name), getattr(dflt, f.name)
+        if have != want:
+            out.append(
+                f"{f.name}: pyproject={have!r} != code default={want!r}"
+            )
+    return out

@@ -101,17 +101,23 @@ def get_rule(rule_id: str) -> Rule:
 
 
 class Context:
-    """Everything a rule pass sees: repo root, config, module index."""
+    """Everything a rule pass sees: repo root, config, module index.
+    ``full_run`` is True when the default roots (the whole repo) are
+    being linted — rules that prove absence over the package
+    (GL-CONFIG's stale-entry check) only run then; a ``--changed``
+    subset cannot prove anything absent."""
 
     def __init__(
         self,
         repo: Path,
         cfg: GraftlintConfig,
         index: dict[str, ModuleInfo],
+        full_run: bool = True,
     ):
         self.repo = repo
         self.cfg = cfg
         self.index = index
+        self.full_run = full_run
         self.findings: list[Finding] = []
         self.n_checked_calls = 0  # GL-ARITY call sites verified
 
@@ -235,6 +241,9 @@ class LintResult:
     n_files: int = 0
     n_checked_calls: int = 0
     rules_run: tuple[str, ...] = ()
+    # Per-rule wall seconds: slow passes must be visible as the rule
+    # set grows (interprocedural passes are not free).
+    rule_seconds: dict[str, float] = field(default_factory=dict)
 
     @property
     def exit_code(self) -> int:
@@ -256,6 +265,10 @@ class LintResult:
             },
             "files": self.n_files,
             "checked_calls": self.n_checked_calls,
+            "rule_seconds": {
+                r: round(s, 4)
+                for r, s in sorted(self.rule_seconds.items())
+            },
         }
 
 
@@ -276,9 +289,14 @@ def run(
     rules: list[str] | None = None,
     cfg: GraftlintConfig | None = None,
     baseline: Path | None = BASELINE_PATH,
+    full: bool | None = None,
 ) -> LintResult:
     """Lint ``paths`` (repo-default roots when empty) with the selected
-    rules (all when None). Raises SyntaxError on unparsable files."""
+    rules (all when None). Raises SyntaxError on unparsable files.
+    ``full`` marks a whole-repo run (default: True iff ``paths`` is
+    empty) — absence-proving rules (GL-CONFIG) only run then."""
+    import time
+
     cfg = cfg or load_config(repo)
     roots = (
         [Path(p).resolve() for p in paths]
@@ -287,14 +305,17 @@ def run(
     )
     files = collect_files(roots)
     index = build_index(files, repo, set(cfg.sig_preserving_decorators))
-    ctx = Context(repo, cfg, index)
+    ctx = Context(repo, cfg, index, full_run=not paths if full is None else full)
 
     selected = rules if rules is not None else sorted(_REGISTRY)
     unknown = [r for r in selected if r not in _REGISTRY]
     if unknown:
         raise KeyError(f"unknown rule id(s): {', '.join(unknown)}")
+    rule_seconds: dict[str, float] = {}
     for rule_id in selected:
+        t0 = time.perf_counter()
         _REGISTRY[rule_id].check(ctx)
+        rule_seconds[rule_id] = time.perf_counter() - t0
 
     # Dedup (several taint hits can land on one line), drop findings for
     # unselected ids (shared passes may emit siblings), and sort.
@@ -355,10 +376,16 @@ def run(
                         )
                 # Stale check only when every suppressed rule actually
                 # ran this invocation (a --rule subset must not call
-                # the others' suppressions stale).
+                # the others' suppressions stale) AND the lint covered
+                # the full roots — on a --changed path subset the taint
+                # engine may lack the cross-module context that derives
+                # a suppression's finding, and "no finding matched" on
+                # a subset proves nothing (the GL-CONFIG rule's gate,
+                # applied to suppressions).
                 if (
                     s.reason
                     and not s.used
+                    and ctx.full_run
                     and all(rid in selected_set for rid in s.ids)
                 ):
                     reported.append(
@@ -391,6 +418,7 @@ def run(
         n_files=len(files),
         n_checked_calls=ctx.n_checked_calls,
         rules_run=tuple(selected),
+        rule_seconds=rule_seconds,
     )
 
 
@@ -420,7 +448,12 @@ def lint_sources(
                 (dest.parent / "__init__.py").write_text("")
             dest.write_text(src, encoding="utf-8")
         result = run(
-            [str(root)], repo=root, rules=rules, cfg=cfg, baseline=None
+            [str(root)],
+            repo=root,
+            rules=rules,
+            cfg=cfg,
+            baseline=None,
+            full=True,  # a fixture tree is its own whole repo
         )
         return result.findings
     finally:

@@ -1,0 +1,440 @@
+"""Interprocedural dataflow over the module index (graftlint v2).
+
+The v1 rules were intraprocedural: GL-SYNC decided "is this a device
+value" from two hand-maintained name lists, and the moment a batcher
+method's body was extracted into a helper the taint died at the call
+boundary — the lists grew an entry per refactor (``demote_kv``,
+``spec_counts``, ``first`` … each existed only because the analysis
+could not see one assignment or one call deep). This module supplies
+the shared machinery the v2 rules (GL-SYNC, GL-COMMIT, GL-DONATE,
+GL-LIFECYCLE) build on:
+
+- **function table** — every module-level function and class method as
+  a ``FuncEntry`` with a stable ``(modname, funckey)`` key;
+- **call resolution** — the static target of ``name(...)``,
+  ``alias.func(...)`` and ``self.method(...)`` call sites, resolved
+  through the index's import maps;
+- **device-taint analysis** (``DeviceTaint``) — seed taint from
+  configured attribute names, then propagate through local assignments
+  (tuple-sensitive), through calls whose arguments carry taint, and
+  across call boundaries via bounded always-tainted return summaries
+  and call-site→parameter seeding (``propagate_params``);
+- **reachability** (``reaches``) — bounded-depth call-graph walks
+  (GL-LIFECYCLE's "every exit path reaches ``_release_slot``").
+
+Discipline: *conservative at unknown provenance* (GL-RETRACE's rule).
+A name or call the analysis cannot resolve is UNTAINTED — the engine
+exists to remove hand-maintained lists without minting false
+positives; anything it cannot prove device-derived stays the job of
+the (now much smaller) seed lists.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from tools.graftlint.index import ModuleInfo, dotted_name
+
+# Calls that CONSUME a device value and yield a host value (these are
+# the syncs GL-SYNC reports; their results carry no further taint).
+_SYNC_CONSUMER_BUILTINS = {"int", "float", "bool", "len"}
+# Dotted-prefix producers of fresh device values.
+_DEVICE_PRODUCER_PREFIXES = ("jnp.", "jax.numpy.")
+
+
+@dataclass(frozen=True)
+class FuncEntry:
+    """One function or method in the index."""
+
+    modname: str
+    classname: str  # "" for module-level functions
+    name: str
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+
+    @property
+    def funckey(self) -> str:
+        return f"{self.classname}.{self.name}" if self.classname else self.name
+
+    @property
+    def key(self) -> tuple[str, str]:
+        return (self.modname, self.funckey)
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.modname}:{self.funckey}"
+
+    def param_names(self) -> tuple[str, ...]:
+        a = self.node.args
+        pos = [p.arg for p in a.posonlyargs + a.args]
+        if self.classname and pos:
+            decs = {dotted_name(d) for d in self.node.decorator_list}
+            if "staticmethod" not in decs:
+                pos = pos[1:]  # self / cls
+        return tuple(pos) + tuple(p.arg for p in a.kwonlyargs)
+
+
+def function_table(index: dict[str, ModuleInfo]) -> dict[tuple[str, str], FuncEntry]:
+    """(modname, funckey) -> FuncEntry over the whole index."""
+    table: dict[tuple[str, str], FuncEntry] = {}
+    for modname, info in index.items():
+        for name, node in info.func_nodes.items():
+            table[(modname, name)] = FuncEntry(modname, "", name, node)
+        for cname, ci in info.classes.items():
+            for mname, mnode in ci.method_nodes.items():
+                table[(modname, f"{cname}.{mname}")] = FuncEntry(
+                    modname, cname, mname, mnode
+                )
+    return table
+
+
+def resolve_call(
+    info: ModuleInfo,
+    call: ast.Call,
+    *,
+    classname: str = "",
+    index: dict[str, ModuleInfo] | None = None,
+) -> tuple[str, str] | None:
+    """The (modname, funckey) a call's func expression statically
+    names, or None. ``classname`` enables ``self.method`` resolution
+    within the enclosing class."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        name = f.id
+        if name in info.func_nodes:
+            return (info.modname, name)
+        if name in info.from_imports:
+            src_mod, orig = info.from_imports[name]
+            if index is None or (
+                src_mod in index and orig in index[src_mod].func_nodes
+            ):
+                return (src_mod, orig)
+        return None
+    if isinstance(f, ast.Attribute):
+        base = f.value
+        if (
+            isinstance(base, ast.Name)
+            and base.id == "self"
+            and classname
+            and classname in info.classes
+            and f.attr in info.classes[classname].method_nodes
+        ):
+            return (info.modname, f"{classname}.{f.attr}")
+        if isinstance(base, ast.Name):
+            target = info.mod_imports.get(base.id)
+            if target is not None and (
+                index is None
+                or (target in index and f.attr in index[target].func_nodes)
+            ):
+                return (target, f.attr)
+    return None
+
+
+def bind_args(
+    entry: FuncEntry, call: ast.Call
+) -> list[tuple[str, ast.expr]]:
+    """(param_name, arg_expr) pairs for a call's statically bindable
+    arguments; *args/**kwargs entries are skipped (unknown binding)."""
+    params = entry.param_names()
+    bound: list[tuple[str, ast.expr]] = []
+    for i, arg in enumerate(call.args):
+        if isinstance(arg, ast.Starred):
+            continue
+        if i < len(params):
+            bound.append((params[i], arg))
+    for kw in call.keywords:
+        if kw.arg is not None:
+            bound.append((kw.arg, kw.value))
+    return bound
+
+
+def is_sync_consumer(call: ast.Call) -> bool:
+    """True for calls that fetch a device value to host (np.asarray,
+    jax.device_get, int/float/bool/len, .item(), .tolist()) — the
+    result is a HOST value and carries no device taint."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id in _SYNC_CONSUMER_BUILTINS
+    if isinstance(f, ast.Attribute):
+        if f.attr in ("item", "tolist", "device_get"):
+            return True
+        # asarray is a consumer only off numpy (jnp.asarray PRODUCES a
+        # device value).
+        return (
+            f.attr == "asarray"
+            and isinstance(f.value, ast.Name)
+            and f.value.id in ("np", "numpy")
+        )
+    return False
+
+
+class DeviceTaint:
+    """Device-value taint over the index, seeded by attribute / bare
+    names and propagated interprocedurally (bounded depth)."""
+
+    def __init__(
+        self,
+        index: dict[str, ModuleInfo],
+        seed_attrs: set[str],
+        seed_names: set[str],
+        *,
+        depth: int = 4,
+    ):
+        self.index = index
+        self.seed_attrs = seed_attrs
+        self.seed_names = seed_names
+        self.depth = max(1, depth)
+        self.table = function_table(index)
+        # (modname, funckey) -> extra tainted parameter names, seeded by
+        # propagate_params from tainted call-site arguments.
+        self.param_taint: dict[tuple[str, str], set[str]] = {}
+        self._envs: dict[tuple[str, str], set[str]] = {}
+        self._summaries: dict[tuple[str, str], bool] = {}
+
+    # -- per-function environments ------------------------------------
+
+    def env(self, entry: FuncEntry) -> set[str]:
+        """Tainted local names of ``entry`` (sticky, two-pass so
+        loop-carried assignments converge)."""
+        cached = self._envs.get(entry.key)
+        if cached is not None:
+            return cached
+        env: set[str] = set(self.param_taint.get(entry.key, ()))
+        self._envs[entry.key] = env  # publish early (recursion guard)
+        info = self.index[entry.modname]
+        for _ in range(2):
+            for node in ast.walk(entry.node):
+                self._flow_stmt(node, env, info, entry.classname)
+        return env
+
+    def _flow_stmt(
+        self, node: ast.AST, env: set[str], info, classname: str
+    ) -> None:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            v = node.value
+            if isinstance(t, ast.Name):
+                if self._expr(v, env, info, classname):
+                    env.add(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                elts = [e for e in t.elts if isinstance(e, ast.Name)]
+                if isinstance(v, (ast.Tuple, ast.List)) and len(
+                    v.elts
+                ) == len(t.elts):
+                    # Element-wise: `cache, logits = adm.cache, adm.x`
+                    # taints exactly the elements whose source is
+                    # tainted, not the whole row.
+                    for te, ve in zip(t.elts, v.elts):
+                        if isinstance(te, ast.Name) and self._expr(
+                            ve, env, info, classname
+                        ):
+                            env.add(te.id)
+                elif self._expr(v, env, info, classname):
+                    for e in elts:
+                        env.add(e.id)
+        elif isinstance(node, ast.AnnAssign) and isinstance(
+            node.target, ast.Name
+        ):
+            if node.value is not None and self._expr(
+                node.value, env, info, classname
+            ):
+                env.add(node.target.id)
+        elif isinstance(node, ast.AugAssign) and isinstance(
+            node.target, ast.Name
+        ):
+            if node.target.id in env or self._expr(
+                node.value, env, info, classname
+            ):
+                env.add(node.target.id)
+        elif isinstance(node, ast.NamedExpr) and isinstance(
+            node.target, ast.Name
+        ):
+            if self._expr(node.value, env, info, classname):
+                env.add(node.target.id)
+
+    # -- expression taint ---------------------------------------------
+
+    def tainted(self, expr: ast.expr, entry: FuncEntry) -> bool:
+        return self._expr(
+            expr,
+            self.env(entry),
+            self.index[entry.modname],
+            entry.classname,
+        )
+
+    def _expr(
+        self,
+        expr: ast.expr,
+        env: set[str],
+        info,
+        classname: str,
+        depth: int | None = None,
+    ) -> bool:
+        depth = self.depth if depth is None else depth
+        if isinstance(expr, ast.Constant):
+            return False
+        if isinstance(expr, ast.Name):
+            return expr.id in env or expr.id in self.seed_names
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in self.seed_attrs:
+                return True
+            return self._expr(expr.value, env, info, classname, depth)
+        if isinstance(expr, ast.Call):
+            name = dotted_name(expr.func)
+            if name.startswith(_DEVICE_PRODUCER_PREFIXES):
+                return True
+            if is_sync_consumer(expr):
+                return False  # host result (the sync itself is the finding)
+            # A call carrying taint in (receiver chain or any argument)
+            # returns taint out — read_tokens(self.pool, …),
+            # sample_tokens(last_logits, …).
+            for sub in (
+                [expr.func]
+                + list(expr.args)
+                + [kw.value for kw in expr.keywords]
+            ):
+                if isinstance(sub, ast.Starred):
+                    sub = sub.value
+                if self._expr(sub, env, info, classname, depth):
+                    return True
+            # Untainted args: consult the callee's return summary
+            # (bounded) — self._dispatch_spec() returns device counts
+            # no matter what it is passed.
+            if depth > 0:
+                target = resolve_call(
+                    info, expr, classname=classname, index=self.index
+                )
+                if target is not None and target in self.table:
+                    return self._summary(target, depth - 1)
+            return False
+        if isinstance(expr, ast.Lambda):
+            return False
+        # Containers, subscripts, arithmetic, comparisons,
+        # comprehensions: tainted iff any sub-expression is.
+        return any(
+            self._expr(child, env, info, classname, depth)
+            for child in ast.iter_child_nodes(expr)
+            if isinstance(child, ast.expr)
+        )
+
+    def _summary(self, key: tuple[str, str], depth: int) -> bool:
+        """Always-tainted return summary: does the function return a
+        device-tainted value even with untainted parameters?"""
+        cached = self._summaries.get(key)
+        if cached is not None:
+            return cached
+        self._summaries[key] = False  # recursion guard
+        entry = self.table[key]
+        info = self.index[entry.modname]
+        env: set[str] = set()
+        for _ in range(2):
+            for node in ast.walk(entry.node):
+                self._flow_stmt(node, env, info, entry.classname)
+        result = False
+        for node in ast.walk(entry.node):
+            if isinstance(node, ast.Return) and node.value is not None:
+                if self._expr(
+                    node.value, env, info, entry.classname, depth
+                ):
+                    result = True
+                    break
+        self._summaries[key] = result
+        return result
+
+    # -- interprocedural parameter seeding ----------------------------
+
+    def propagate_params(
+        self,
+        roots: list[FuncEntry],
+        accept,
+    ) -> list[FuncEntry]:
+        """Seed helper parameters from tainted call-site arguments,
+        starting at ``roots`` and following resolvable calls to entries
+        ``accept(entry)`` approves, for ``self.depth`` rounds. Returns
+        the helpers reached with at least one tainted parameter —
+        device taint surviving helper extraction."""
+        reached: dict[tuple[str, str], FuncEntry] = {}
+        frontier = list(roots)
+        for _ in range(self.depth):
+            next_frontier: list[FuncEntry] = []
+            for caller in frontier:
+                info = self.index[caller.modname]
+                for node in ast.walk(caller.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    target = resolve_call(
+                        info,
+                        node,
+                        classname=caller.classname,
+                        index=self.index,
+                    )
+                    if target is None or target not in self.table:
+                        continue
+                    callee = self.table[target]
+                    if not accept(callee):
+                        continue
+                    new = set()
+                    for param, arg in bind_args(callee, node):
+                        if self.tainted(arg, caller):
+                            new.add(param)
+                    have = self.param_taint.setdefault(target, set())
+                    if new - have:
+                        have |= new
+                        self._envs.pop(target, None)  # re-derive
+                    if new and target not in reached:
+                        reached[target] = callee
+                        next_frontier.append(callee)
+            if not next_frontier:
+                break
+            frontier = next_frontier
+        return list(reached.values())
+
+
+# -- call-graph reachability ------------------------------------------
+
+
+def reaches(
+    index: dict[str, ModuleInfo],
+    start: FuncEntry,
+    target_name: str,
+    *,
+    depth: int = 4,
+    table: dict[tuple[str, str], FuncEntry] | None = None,
+) -> bool:
+    """True when ``start`` transitively calls a function/method named
+    ``target_name`` within ``depth`` resolvable hops (also True for a
+    direct ``self.<target_name>()`` / ``<target_name>()`` call that the
+    resolver cannot bind to an indexed body). Pass a prebuilt
+    ``function_table`` when querying repeatedly — rebuilding it per
+    query walks the whole index each time."""
+    if table is None:
+        table = function_table(index)
+    seen: set[tuple[str, str]] = set()
+    frontier = [start]
+    for _ in range(depth):
+        next_frontier: list[FuncEntry] = []
+        for fn in frontier:
+            info = index[fn.modname]
+            for node in ast.walk(fn.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                called = (
+                    f.attr
+                    if isinstance(f, ast.Attribute)
+                    else f.id
+                    if isinstance(f, ast.Name)
+                    else ""
+                )
+                if called == target_name:
+                    return True
+                tgt = resolve_call(
+                    info, node, classname=fn.classname, index=index
+                )
+                if tgt is not None and tgt in table and tgt not in seen:
+                    seen.add(tgt)
+                    next_frontier.append(table[tgt])
+        if not next_frontier:
+            return False
+        frontier = next_frontier
+    return False

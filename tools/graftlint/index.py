@@ -32,17 +32,23 @@ class ClassInfo:
     name: str
     methods: dict[str, FuncSig] = field(default_factory=dict)
     bases: tuple[str, ...] = ()
+    # Method AST nodes — the interprocedural passes (tools/graftlint/
+    # dataflow.py) walk bodies and resolve self.method() call targets.
+    method_nodes: dict[str, ast.FunctionDef] = field(default_factory=dict)
 
 
 @dataclass
 class JitEntry:
     """A jit-compiled callable: calling it with an unbounded Python
-    scalar (static arg) or a bare host scalar (traced arg) retraces."""
+    scalar (static arg) or a bare host scalar (traced arg) retraces;
+    calling it donates the buffers bound to ``donate_argnames`` (reading
+    a donated buffer after the dispatch is use-after-free)."""
 
     name: str  # public callable name in its module
     modname: str
     impl: str  # the wrapped function's name (signature source)
     static_argnames: tuple[str, ...] = ()
+    donate_argnames: tuple[str, ...] = ()
 
 
 @dataclass
@@ -126,10 +132,11 @@ def sig_of(
     )
 
 
-def _jit_static_argnames(call: ast.Call) -> tuple[str, ...]:
-    """static_argnames tuple from a jax.jit / partial(jax.jit, ...) call."""
+def _jit_argnames(call: ast.Call, key: str) -> tuple[str, ...]:
+    """``static_argnames`` / ``donate_argnames`` tuple from a jax.jit /
+    partial(jax.jit, ...) call."""
     for kw in call.keywords:
-        if kw.arg == "static_argnames":
+        if kw.arg == key:
             v = kw.value
             if isinstance(v, (ast.Tuple, ast.List)):
                 return tuple(
@@ -142,9 +149,12 @@ def _jit_static_argnames(call: ast.Call) -> tuple[str, ...]:
     return ()
 
 
-def _jit_call_info(expr: ast.expr) -> tuple[tuple[str, ...], str] | None:
+def _jit_call_info(
+    expr: ast.expr,
+) -> tuple[tuple[str, ...], tuple[str, ...], str] | None:
     """Recognize ``X = partial(jax.jit, ...)(impl)`` / ``jax.jit(impl)``
-    value expressions: returns (static_argnames, impl_name) or None."""
+    value expressions: returns (static_argnames, donate_argnames,
+    impl_name) or None."""
     if not isinstance(expr, ast.Call):
         return None
     inner = expr.func
@@ -153,28 +163,43 @@ def _jit_call_info(expr: ast.expr) -> tuple[tuple[str, ...], str] | None:
         if head in ("functools.partial", "partial") and inner.args:
             if decorator_name(inner.args[0]) in ("jax.jit", "jit"):
                 if expr.args and isinstance(expr.args[0], ast.Name):
-                    return _jit_static_argnames(inner), expr.args[0].id
+                    return (
+                        _jit_argnames(inner, "static_argnames"),
+                        _jit_argnames(inner, "donate_argnames"),
+                        expr.args[0].id,
+                    )
     elif decorator_name(inner) in ("jax.jit", "jit"):
         if expr.args and isinstance(expr.args[0], ast.Name):
-            return _jit_static_argnames(expr), expr.args[0].id
+            return (
+                _jit_argnames(expr, "static_argnames"),
+                _jit_argnames(expr, "donate_argnames"),
+                expr.args[0].id,
+            )
     return None
 
 
 def _jit_decoration(
     fn: ast.FunctionDef | ast.AsyncFunctionDef,
-) -> tuple[str, ...] | None:
-    """static_argnames when ``fn`` is jit-decorated, else None."""
+) -> tuple[tuple[str, ...], tuple[str, ...]] | None:
+    """(static_argnames, donate_argnames) when ``fn`` is jit-decorated,
+    else None."""
     for dec in fn.decorator_list:
         name = decorator_name(dec)
         if name in ("jax.jit", "jit"):
             if isinstance(dec, ast.Call):
-                return _jit_static_argnames(dec)
-            return ()
+                return (
+                    _jit_argnames(dec, "static_argnames"),
+                    _jit_argnames(dec, "donate_argnames"),
+                )
+            return ((), ())
         if isinstance(dec, ast.Call):
             head = decorator_name(dec.func)
             if head in ("functools.partial", "partial") and dec.args:
                 if decorator_name(dec.args[0]) in ("jax.jit", "jit"):
-                    return _jit_static_argnames(dec)
+                    return (
+                        _jit_argnames(dec, "static_argnames"),
+                        _jit_argnames(dec, "donate_argnames"),
+                    )
     return None
 
 
@@ -194,13 +219,15 @@ def collect_module(
                 node, is_method=False, sig_preserving=sig_preserving
             )
             info.func_nodes[node.name] = node
-            static = _jit_decoration(node)
-            if static is not None:
+            jit = _jit_decoration(node)
+            if jit is not None:
+                static, donated = jit
                 info.jit_entries[node.name] = JitEntry(
                     name=node.name,
                     modname=modname,
                     impl=node.name,
                     static_argnames=static,
+                    donate_argnames=donated,
                 )
         elif isinstance(node, ast.ClassDef):
             info.bindings.add(node.name)
@@ -213,6 +240,7 @@ def collect_module(
                     ci.methods[sub.name] = sig_of(
                         sub, is_method=True, sig_preserving=sig_preserving
                     )
+                    ci.method_nodes[sub.name] = sub
             info.classes[node.name] = ci
         elif isinstance(node, ast.Assign):
             for t in node.targets:
@@ -224,13 +252,14 @@ def collect_module(
                             info.bindings.add(e.id)
             jit = _jit_call_info(node.value)
             if jit is not None and isinstance(node.targets[0], ast.Name):
-                static, impl = jit
+                static, donated, impl = jit
                 name = node.targets[0].id
                 info.jit_entries[name] = JitEntry(
                     name=name,
                     modname=modname,
                     impl=impl,
                     static_argnames=static,
+                    donate_argnames=donated,
                 )
         elif isinstance(node, ast.AnnAssign) and isinstance(
             node.target, ast.Name

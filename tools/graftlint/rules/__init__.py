@@ -7,6 +7,11 @@ from __future__ import annotations
 from tools.graftlint.core import Context, Rule, register
 
 from tools.graftlint.rules import (  # noqa: E402,F401
+    atomic,
+    commit,
+    configcheck,
+    donate,
+    lifecycle,
     refcount,
     retrace,
     sync,

@@ -1,0 +1,118 @@
+"""GL-ATOMIC — file writes inside the package must route through a
+sanctioned atomic/durable write implementation.
+
+The torn-state class PR 10 closed: a plain ``path.write_text`` /
+``open(path, "w")`` is not atomic — a crash mid-write leaves a half
+file that a reader (a resume, a Prometheus scraper, a session load)
+then parses. The repo has exactly three sanctioned write disciplines,
+each crash-safe by construction:
+
+- ``obs.atomic_write_text`` — pid-suffixed tmp + ``os.replace``;
+- the round journal's fsync append (``RoundJournal._write``) — the
+  append-only WAL whose one crash artifact (a torn tail) the tolerant
+  reader discards;
+- ``DiskStore.put`` — tmp + replace with a content hash the reader
+  verifies.
+
+Any other write-mode ``open()`` / ``write_text`` / ``write_bytes``
+under the configured package is a finding unless its enclosing
+function is listed in ``atomic_funcs`` (the sanctioned implementations
+themselves) or carries a reasoned inline suppression. Scope is the
+package only: tools and tests write scratch files freely.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import Context, Rule, register
+from tools.graftlint.dataflow import function_table
+
+
+def _write_mode(call: ast.Call) -> str:
+    """The write-mode string of an ``open()`` call ("" for reads or
+    non-constant modes)."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    if (
+        isinstance(mode, ast.Constant)
+        and isinstance(mode.value, str)
+        and any(c in mode.value for c in "wax+")
+    ):
+        return mode.value
+    return ""
+
+
+@register
+class AtomicRule(Rule):
+    id = "GL-ATOMIC"
+    title = "package file writes must use a sanctioned atomic discipline"
+    rationale = (
+        "A non-atomic write is a crash-shaped data corruption: the "
+        "process dies mid-write and the next reader parses half a "
+        "file. Every sanctioned implementation (tmp+replace, fsync'd "
+        "append) already exists — new write sites must reuse one, not "
+        "reinvent a torn-state bug."
+    )
+    fixtures = {
+        "pkg/saver.py": (
+            "import json\n"
+            "\n"
+            "def save_settings(path, settings):\n"
+            "    path.write_text(json.dumps(settings))\n"
+        ),
+    }
+    fixture_config = {"package": "pkg", "atomic_funcs": []}
+
+    def check(self, ctx: Context) -> None:
+        package = ctx.cfg.package
+        allowed = set(ctx.cfg.atomic_funcs)
+        funcs = function_table(ctx.index)
+        # Call line -> enclosing function qualname, for the allowlist.
+        for info in ctx.index.values():
+            if not (
+                info.modname == package
+                or info.modname.startswith(package + ".")
+            ):
+                continue
+            owners: dict[int, str] = {}
+            for (mod, fkey), fe in funcs.items():
+                if mod != info.modname:
+                    continue
+                for sub in ast.walk(fe.node):
+                    if isinstance(sub, ast.Call):
+                        owners[id(sub)] = fe.qualname
+            for node in ast.walk(info.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                what = ""
+                f = node.func
+                if isinstance(f, ast.Name) and f.id == "open":
+                    mode = _write_mode(node)
+                    if mode:
+                        what = f"open(..., {mode!r})"
+                elif isinstance(f, ast.Attribute) and f.attr in (
+                    "write_text",
+                    "write_bytes",
+                ):
+                    what = f".{f.attr}()"
+                if not what:
+                    continue
+                owner = owners.get(id(node), "")
+                if owner in allowed:
+                    continue
+                ctx.report(
+                    "GL-ATOMIC",
+                    info.path,
+                    node.lineno,
+                    f"{what} in {owner or info.modname} writes a file "
+                    "outside the sanctioned atomic disciplines — a "
+                    "crash mid-write leaves a torn file; route through "
+                    "obs.atomic_write_text / the journal's fsync append "
+                    "/ DiskStore.put, or suppress with a reason the "
+                    "write cannot tear",
+                )

@@ -1,0 +1,239 @@
+"""GL-COMMIT — fresh device state bound to persistent engine attributes
+must be committed to the mesh sharding at creation.
+
+The double-compile class this repo has paid for twice: a freshly
+created device array (``jnp.zeros``, ``init_cache``) carries
+UnspecifiedValue sharding, while the same attribute after one step is a
+mesh-committed program output — two jit signatures for one program, and
+XLA silently compiles it twice (PR 5's admission cache, then the
+identical bug again in PR 6's batcher row state). The fix is mechanical
+— route the creation through ``_commit`` / ``jax.device_put`` — so the
+check should be too.
+
+At every assignment ``self.<attr> = <expr>`` (``attr`` in
+``commit_attrs``) inside a ``commit_classes`` class, and at every
+keyword ``<attr>=<expr>`` of a ``commit_holders`` constructor call
+(``_Admission(cache=...)``), the value's ROOT must not be a bare
+creator call (``commit_creators``): it must be wrapped in a committing
+call (``commit_wrappers``), or be derived state (``.at[].set()``,
+``jnp.zeros_like`` — sharding propagates from the existing operand).
+Local flow is tracked: ``cache = init_cache(...)`` that later reaches
+``_Admission(cache=cache)`` is reported at the sink.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import Context, Rule, register
+from tools.graftlint.index import dotted_name
+
+
+def _creator_name(expr: ast.expr, creators: set[str]) -> str:
+    """The matching creator name when ``expr`` is a bare creation call
+    ("" otherwise)."""
+    if not isinstance(expr, ast.Call):
+        return ""
+    name = dotted_name(expr.func)
+    if name in creators:
+        return name
+    tail = name.rsplit(".", 1)[-1] if name else ""
+    if tail in creators:
+        return tail
+    return ""
+
+
+def _is_wrapper(expr: ast.expr, wrappers: set[str]) -> bool:
+    if not isinstance(expr, ast.Call):
+        return False
+    name = dotted_name(expr.func)
+    return bool(name) and name.rsplit(".", 1)[-1] in wrappers
+
+
+@register
+class CommitRule(Rule):
+    id = "GL-COMMIT"
+    title = "persistent device attrs committed to mesh sharding at creation"
+    rationale = (
+        "An uncommitted fresh array and a mesh-committed step output "
+        "present two jit signatures for the same program: XLA compiles "
+        "it twice, once per admission — compile time on the serving "
+        "path, invisible until the retrace watch catches it on real "
+        "hardware (the PR 5 admission-cache and PR 6 row-state bugs)."
+    )
+    fixtures = {
+        "pkg/batcher.py": (
+            "import jax.numpy as jnp\n"
+            "\n"
+            "class ContinuousBatcher:\n"
+            "    def __init__(self, B):\n"
+            "        self.active = jnp.zeros((B,), bool)\n"
+            "        self.out_buf = self._commit(jnp.zeros((B, 4)))\n"
+            "\n"
+            "    def _commit(self, x):\n"
+            "        return x\n"
+        ),
+    }
+
+    def check(self, ctx: Context) -> None:
+        cfg = ctx.cfg
+        classes = set(cfg.commit_classes)
+        attrs = set(cfg.commit_attrs)
+        creators = set(cfg.commit_creators)
+        wrappers = set(cfg.commit_wrappers)
+        holders = set(cfg.commit_holders)
+        for info in ctx.index.values():
+            if not any(c in info.classes for c in classes):
+                continue
+            for cname in classes & set(info.classes):
+                for mname, mnode in info.classes[
+                    cname
+                ].method_nodes.items():
+                    self._check_function(
+                        ctx,
+                        info,
+                        f"{cname}.{mname}",
+                        mnode,
+                        attrs,
+                        creators,
+                        wrappers,
+                        holders,
+                        check_self=True,
+                    )
+            for fname, fnode in info.func_nodes.items():
+                self._check_function(
+                    ctx,
+                    info,
+                    fname,
+                    fnode,
+                    attrs,
+                    creators,
+                    wrappers,
+                    holders,
+                    check_self=False,
+                )
+
+    def _status(self, expr: ast.expr, env: dict, creators, wrappers) -> str:
+        """"uncommitted" | "committed" | "other" for a value's root."""
+        if isinstance(expr, ast.Name):
+            return env.get(expr.id, "other")
+        if isinstance(expr, ast.IfExp):
+            for branch in (expr.body, expr.orelse):
+                if (
+                    self._status(branch, env, creators, wrappers)
+                    == "uncommitted"
+                ):
+                    return "uncommitted"
+            return "other"
+        if _is_wrapper(expr, wrappers):
+            return "committed"
+        if _creator_name(expr, creators):
+            return "uncommitted"
+        return "other"
+
+    def _check_function(
+        self,
+        ctx,
+        info,
+        where,
+        fn,
+        attrs,
+        creators,
+        wrappers,
+        holders,
+        *,
+        check_self,
+    ) -> None:
+        def warn(node: ast.AST, sink: str) -> None:
+            ctx.report(
+                "GL-COMMIT",
+                info.path,
+                node.lineno,
+                f"fresh device state reaches persistent {sink} in "
+                f"{where} without flowing through a committing wrapper "
+                f"({', '.join(sorted(wrappers))}) — an uncommitted "
+                "creation and a mesh-committed step output are two jit "
+                "signatures for one program (double compile); wrap the "
+                "creation or suppress with a reason",
+            )
+
+        def check_holder_calls(expr: ast.expr, env: dict) -> None:
+            for node in ast.walk(expr):
+                if not isinstance(node, ast.Call):
+                    continue
+                fname = dotted_name(node.func)
+                if fname and fname.rsplit(".", 1)[-1] in holders:
+                    for kw in node.keywords:
+                        if kw.arg in attrs and (
+                            self._status(
+                                kw.value, env, creators, wrappers
+                            )
+                            == "uncommitted"
+                        ):
+                            warn(
+                                kw.value,
+                                f"{fname}({kw.arg}=...) holder field",
+                            )
+
+        def process_block(block: list, env: dict) -> None:
+            # Statement-ordered, so each sink sees the bindings AS OF
+            # its program point: a later rebind of a local must neither
+            # poison an earlier (committed) holder use nor launder an
+            # earlier uncommitted one.
+            for stmt in block:
+                if isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    process_block(stmt.body, {})  # own scope
+                    continue
+                # Expressions evaluated by THIS statement, before its
+                # own binding takes effect.
+                for field_val in ast.iter_fields(stmt):
+                    _, value = field_val
+                    if isinstance(value, ast.expr):
+                        check_holder_calls(value, env)
+                    elif isinstance(value, list) and value and isinstance(
+                        value[0], ast.expr
+                    ):
+                        for v in value:
+                            check_holder_calls(v, env)
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                    t = stmt.targets[0]
+                    status = self._status(
+                        stmt.value, env, creators, wrappers
+                    )
+                    if isinstance(t, ast.Name):
+                        env[t.id] = status
+                    elif (
+                        check_self
+                        and isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                        and t.attr in attrs
+                        and status == "uncommitted"
+                    ):
+                        warn(stmt, f"attribute self.{t.attr}")
+                elif isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    if stmt.value is not None:
+                        env[stmt.target.id] = self._status(
+                            stmt.value, env, creators, wrappers
+                        )
+                # Child blocks in order (branch bindings merge
+                # last-wins — fine: the rule is per-program-point
+                # best-effort, and branches that disagree about
+                # committedness are exactly the code GL-COMMIT exists
+                # to make suspicious).
+                for name_ in ("body", "orelse", "finalbody"):
+                    child = getattr(stmt, name_, None)
+                    if (
+                        isinstance(child, list)
+                        and child
+                        and isinstance(child[0], ast.stmt)
+                    ):
+                        process_block(child, env)
+                for handler in getattr(stmt, "handlers", []):
+                    process_block(handler.body, env)
+
+        process_block(fn.body, {})

@@ -1,0 +1,204 @@
+"""GL-CONFIG — stale ``[tool.graftlint]`` allowlist / device-name
+entries are themselves findings.
+
+The inline-suppression machinery already refuses to let a mute outlive
+its finding (GL-SUPPRESS's stale check); this rule gives the pyproject
+table the same treatment. An allowlist entry that matches nothing in
+the indexed package — a sync-allowlisted method that was renamed, a
+device attribute that no longer exists, a refcount module that moved —
+is a silently disarmed (or silently meaningless) piece of config: the
+check it configured either stopped protecting anything or never will
+again. Allowlists must not rot as code moves.
+
+Runs only on FULL lints (default roots): on a ``--changed`` subset the
+package is deliberately not all indexed, and "matches nothing in the
+subset" proves nothing.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import Context, Rule, register
+from tools.graftlint.dataflow import function_table
+
+
+def _pyproject_line(repo, needle: str) -> int:
+    """Best-effort line of a config entry inside [tool.graftlint]."""
+    path = repo / "pyproject.toml"
+    if not path.exists():
+        return 1
+    in_table = False
+    for i, line in enumerate(
+        path.read_text(encoding="utf-8").splitlines(), start=1
+    ):
+        stripped = line.strip()
+        if stripped.startswith("["):
+            in_table = stripped == "[tool.graftlint]"
+            continue
+        if in_table and f'"{needle}"' in line or (
+            in_table and stripped.startswith(needle)
+        ):
+            return i
+    return 1
+
+
+@register
+class ConfigRule(Rule):
+    id = "GL-CONFIG"
+    title = "graftlint config entries must match indexed code"
+    rationale = (
+        "A sync-allowlist entry naming a renamed method, a device-name "
+        "taint seed for a deleted local, or a refcount module that "
+        "moved is config rot: the rule it configured silently stopped "
+        "meaning anything. Stale inline suppressions are findings; "
+        "stale table entries are too."
+    )
+    fixtures = {
+        "pkg/sched.py": (
+            "class Batcher:\n"
+            "    def _advance(self):\n"
+            "        return self.active\n"
+        ),
+    }
+    fixture_config = {
+        "package": "pkg",
+        "sync_class": "Batcher",
+        "sync_allowlist": ["_ghost_method"],
+        "sync_device_attrs": ["active"],
+        "sync_device_names": [],
+        "refcount_modules": [],
+        "refcount_pairs": [],
+        "retrace_bucketers": [],
+        "commit_classes": [],
+        "commit_attrs": [],
+        "commit_holders": [],
+        "atomic_funcs": [],
+        "lifecycle_class": "Batcher",
+        "lifecycle_exits": [],
+        "lifecycle_owned_attrs": [],
+        "lifecycle_mutators": [],
+    }
+
+    def check(self, ctx: Context) -> None:
+        if not ctx.full_run:
+            return
+        cfg = ctx.cfg
+        if cfg.package not in ctx.index:
+            return  # package not (fully) indexed: staleness unprovable
+
+        # -- what the indexed package actually contains ---------------
+        class_defs: dict[str, list] = {}
+        method_names: set[str] = set()
+        funcs = function_table(ctx.index)
+        for info in ctx.index.values():
+            for cname, ci in info.classes.items():
+                class_defs.setdefault(cname, []).append(ci)
+                method_names.update(ci.method_nodes)
+
+        def class_body_names(cname: str) -> tuple[set[str], set[str]]:
+            """(attribute names, bare names) appearing in the class."""
+            attrs: set[str] = set()
+            names: set[str] = set()
+            for ci in class_defs.get(cname, []):
+                for node in ci.method_nodes.values():
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Attribute):
+                            attrs.add(sub.attr)
+                        elif isinstance(sub, ast.Name):
+                            names.add(sub.id)
+            return attrs, names
+
+        def methods_of(cname: str) -> set[str]:
+            out: set[str] = set()
+            for ci in class_defs.get(cname, []):
+                out.update(ci.method_nodes)
+            return out
+
+        stale: list[tuple[str, str]] = []  # (knob, entry)
+
+        def need(ok: bool, knob: str, entry: str) -> None:
+            if not ok:
+                stale.append((knob, entry))
+
+        need(cfg.sync_class in class_defs, "sync_class", cfg.sync_class)
+        sync_methods = methods_of(cfg.sync_class)
+        sync_attrs, sync_names = class_body_names(cfg.sync_class)
+        for m in cfg.sync_allowlist:
+            need(m in sync_methods, "sync_allowlist", m)
+        for a in cfg.sync_device_attrs:
+            need(a in sync_attrs, "sync_device_attrs", a)
+        for n in cfg.sync_device_names:
+            need(n in sync_names, "sync_device_names", n)
+        for mod in cfg.refcount_modules:
+            need(mod in ctx.index, "refcount_modules", mod)
+        for pair in cfg.refcount_pairs:
+            for name in pair.split("="):
+                need(
+                    name.strip() in method_names,
+                    "refcount_pairs",
+                    name.strip(),
+                )
+        all_funcs = {fe.name for fe in funcs.values()}
+        for b in cfg.retrace_bucketers:
+            need(b in all_funcs, "retrace_bucketers", b)
+        for c in cfg.commit_classes:
+            need(c in class_defs, "commit_classes", c)
+        for h in cfg.commit_holders:
+            need(h in class_defs or h in all_funcs, "commit_holders", h)
+        commit_scope_attrs: set[str] = set()
+        for c in cfg.commit_classes:
+            commit_scope_attrs |= class_body_names(c)[0]
+        for h in cfg.commit_holders:
+            for ci in class_defs.get(h, []):
+                commit_scope_attrs.update(ci.methods)
+        # Holder keyword fields: dataclass field names are module-level
+        # AnnAssign targets inside the class body — approximate with
+        # "attribute or method or field name used anywhere in a commit
+        # class / holder".
+        for info in ctx.index.values():
+            for cname in set(cfg.commit_holders) & set(info.classes):
+                for node in ast.walk(info.tree):
+                    if (
+                        isinstance(node, ast.ClassDef)
+                        and node.name == cname
+                    ):
+                        for sub in node.body:
+                            if isinstance(
+                                sub, ast.AnnAssign
+                            ) and isinstance(sub.target, ast.Name):
+                                commit_scope_attrs.add(sub.target.id)
+        for a in cfg.commit_attrs:
+            need(a in commit_scope_attrs, "commit_attrs", a)
+        qualnames = {fe.qualname for fe in funcs.values()}
+        for q in cfg.atomic_funcs:
+            need(q in qualnames, "atomic_funcs", q)
+        need(
+            cfg.lifecycle_class in class_defs,
+            "lifecycle_class",
+            cfg.lifecycle_class,
+        )
+        lc_methods = methods_of(cfg.lifecycle_class)
+        lc_attrs, _ = class_body_names(cfg.lifecycle_class)
+        need(
+            cfg.lifecycle_release in lc_methods,
+            "lifecycle_release",
+            cfg.lifecycle_release,
+        )
+        for m in cfg.lifecycle_exits:
+            need(m in lc_methods, "lifecycle_exits", m)
+        for m in cfg.lifecycle_mutators:
+            need(m in lc_methods, "lifecycle_mutators", m)
+        for a in cfg.lifecycle_owned_attrs:
+            need(a in lc_attrs, "lifecycle_owned_attrs", a)
+
+        for knob, entry in stale:
+            ctx.report(
+                "GL-CONFIG",
+                ctx.repo / "pyproject.toml",
+                _pyproject_line(ctx.repo, entry),
+                f"[tool.graftlint] {knob} entry {entry!r} matches "
+                "nothing in the indexed package — the code moved or "
+                "was renamed; update or delete the entry (stale "
+                "allowlists silently disarm their rule)",
+            )

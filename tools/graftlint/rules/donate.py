@@ -1,0 +1,363 @@
+"""GL-DONATE — no raw reference to a donated buffer may outlive its
+dispatch.
+
+``donate_argnames`` hands a buffer's device memory to the compiled
+program: after the dispatch the Python object still exists, but its
+buffer is deleted. The idiomatic drive loop rebinds the name from the
+program's output (``pool, out_buf = step(pool, out_buf)``) — safe. The
+bug class (PR 9's streaming entry) is storing a RAW ALIAS of the buffer
+somewhere that survives into the next dispatch: the stored tuple
+element points at memory the next donation deletes, and the depth-bound
+fetch one iteration later reads garbage (or crashes) only under
+pipelining on real hardware. The committed fix was a ``jnp.copy``
+snapshot; this rule makes the snapshot mandatory.
+
+At every statically resolvable call to a jit entry with
+``donate_argnames`` (discovered in the index pass, same resolution as
+GL-RETRACE), the attribute/name bound to each donated parameter is
+collected — including transitively: a method that donates ``self.X``
+marks its own call sites as donating ``self.X`` (bounded by
+``dataflow_depth``). A read of a donated value in an ESCAPE position —
+element of a tuple/list/set/dict literal, argument to
+``.append``/``.add``/…, a ``return``/``yield`` — is a finding when a
+donating dispatch can execute after it (it shares a loop with one, or
+one follows it in the function), unless the read is wrapped in a
+snapshot call (``donate_snapshots``: ``jnp.copy`` & friends).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import Context, Rule, register
+from tools.graftlint.dataflow import FuncEntry, bind_args, function_table
+from tools.graftlint.index import ModuleInfo, dotted_name
+
+_STORE_METHODS = {
+    "append",
+    "appendleft",
+    "add",
+    "extend",
+    "insert",
+    "put",
+    "put_nowait",
+}
+
+
+def _donating_entries(
+    ctx: Context, funcs: dict
+) -> dict[str, tuple]:
+    """dotted name -> (entry, impl FuncEntry) for jit entries that
+    donate; argument binding reuses the shared dataflow machinery on
+    the impl's definition."""
+    out: dict[str, tuple] = {}
+    for modname, info in ctx.index.items():
+        for entry in info.jit_entries.values():
+            if not entry.donate_argnames:
+                continue
+            impl = funcs.get((modname, entry.impl))
+            if impl is not None:
+                out[f"{modname}.{entry.name}"] = (entry, impl)
+    return out
+
+
+def _resolve_entry(info: ModuleInfo, func: ast.expr, table: dict):
+    """Like dataflow.resolve_call, but against the jit-entry table:
+    assignment-bound entries (``step = partial(jax.jit, …)(impl)``) are
+    not function defs, so the shared resolver — which requires an
+    indexed body — deliberately cannot name them."""
+    if isinstance(func, ast.Name):
+        name = func.id
+        hit = table.get(f"{info.modname}.{name}")
+        if hit:
+            return hit
+        if name in info.from_imports:
+            src_mod, orig = info.from_imports[name]
+            return table.get(f"{src_mod}.{orig}")
+    elif isinstance(func, ast.Attribute) and isinstance(
+        func.value, ast.Name
+    ):
+        target = info.mod_imports.get(func.value.id)
+        if target is not None:
+            return table.get(f"{target}.{func.attr}")
+    return None
+
+
+def _donated_keys(
+    call: ast.Call, entry, impl: FuncEntry
+) -> list[tuple[str, str]]:
+    """Donated-value keys bound at this call site: ("attr", X) for
+    ``self.X`` arguments, ("name", x) for bare locals."""
+    donated = set(entry.donate_argnames)
+    keys: list[tuple[str, str]] = []
+    for param, arg in bind_args(impl, call):
+        if param not in donated:
+            continue
+        if (
+            isinstance(arg, ast.Attribute)
+            and isinstance(arg.value, ast.Name)
+            and arg.value.id == "self"
+        ):
+            keys.append(("attr", arg.attr))
+        elif isinstance(arg, ast.Name):
+            keys.append(("name", arg.id))
+    return keys
+
+
+def _match_key(node: ast.expr, key: tuple[str, str]) -> bool:
+    kind, name = key
+    if not isinstance(
+        getattr(node, "ctx", ast.Load()), ast.Load
+    ):
+        return False  # a rebind target is the idiom, not an alias
+    if kind == "attr":
+        return (
+            isinstance(node, ast.Attribute)
+            and node.attr == name
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+        )
+    return isinstance(node, ast.Name) and node.id == name
+
+
+@register
+class DonateRule(Rule):
+    id = "GL-DONATE"
+    title = "donated buffers must be snapshotted before any stored alias"
+    rationale = (
+        "A donated buffer's memory is deleted at dispatch; a raw alias "
+        "stored for a later fetch reads freed memory — but only under "
+        "pipelining on real hardware, which is why the class ships: "
+        "CPU tests pass, the TPU run corrupts. jnp.copy is a cheap "
+        "device-side op that overlaps compute; make it mandatory."
+    )
+    fixtures = {
+        "pkg/drive.py": (
+            "from functools import partial\n"
+            "import jax\n"
+            "\n"
+            "def _impl(pool, out_buf):\n"
+            "    return pool, out_buf\n"
+            "\n"
+            "step = partial(jax.jit, donate_argnames=('pool', 'out_buf'))"
+            "(_impl)\n"
+            "\n"
+            "def drive(pool, out_buf, n):\n"
+            "    entries = []\n"
+            "    for _ in range(n):\n"
+            "        entries.append((out_buf,))\n"
+            "        pool, out_buf = step(pool, out_buf)\n"
+            "    return entries\n"
+        ),
+    }
+
+    def check(self, ctx: Context) -> None:
+        funcs = function_table(ctx.index)
+        table = _donating_entries(ctx, funcs)
+        if not table:
+            return
+        snapshots = set(ctx.cfg.donate_snapshots)
+
+        # Pass 1: direct donating call sites per function, and per-
+        # method donated-self-attr summaries.
+        sites: dict[tuple[str, str], list[tuple[ast.Call, tuple, list]]] = {}
+        summaries: dict[tuple[str, str], set[str]] = {}
+        for fkey, fe in funcs.items():
+            info = ctx.index[fe.modname]
+            for node in ast.walk(fe.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                hit = _resolve_entry(info, node.func, table)
+                if hit is None:
+                    continue
+                entry, impl = hit
+                keys = _donated_keys(node, entry, impl)
+                if keys:
+                    sites.setdefault(fkey, []).append(
+                        (node, entry, keys)
+                    )
+                    if fe.classname:
+                        summaries.setdefault(fkey, set()).update(
+                            n for k, n in keys if k == "attr"
+                        )
+
+        # Pass 2 (bounded): a call to a method that donates self.X is a
+        # donating site for self.X at the caller.
+        for _ in range(max(1, ctx.cfg.dataflow_depth)):
+            changed = False
+            for fkey, fe in funcs.items():
+                if not fe.classname:
+                    continue
+                info = ctx.index[fe.modname]
+                for node in ast.walk(fe.node):
+                    if not (
+                        isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and isinstance(node.func.value, ast.Name)
+                        and node.func.value.id == "self"
+                    ):
+                        continue
+                    callee = (
+                        fe.modname,
+                        f"{fe.classname}.{node.func.attr}",
+                    )
+                    attrs = summaries.get(callee)
+                    if not attrs:
+                        continue
+                    keys = [("attr", a) for a in sorted(attrs)]
+                    existing = sites.setdefault(fkey, [])
+                    if not any(n is node for n, _, _ in existing):
+                        existing.append((node, None, keys))
+                        changed = True
+                    have = summaries.setdefault(fkey, set())
+                    if attrs - have:
+                        have.update(attrs)
+                        changed = True
+            if not changed:
+                break
+
+        for fkey, fsites in sites.items():
+            self._check_escapes(
+                ctx, funcs[fkey], fsites, snapshots
+            )
+
+    def _check_escapes(self, ctx, fe, fsites, snapshots) -> None:
+        info = ctx.index[fe.modname]
+        # Parent map + loop ranges for the position rule.
+        parents: dict[int, ast.AST] = {}
+        loops: list[tuple[int, int]] = []
+        for node in ast.walk(fe.node):
+            for child in ast.iter_child_nodes(node):
+                parents[id(child)] = node
+            if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+                loops.append(
+                    (node.lineno, getattr(node, "end_lineno", node.lineno))
+                )
+
+        def may_dispatch_after(line: int, dispatch_lines: list[int]) -> bool:
+            for d in dispatch_lines:
+                if d >= line:
+                    return True
+                for lo, hi in loops:
+                    if lo <= d <= hi and lo <= line <= hi:
+                        return True
+            return False
+
+        by_key: dict[tuple[str, str], list] = {}
+        for node, entry, keys in fsites:
+            for key in keys:
+                by_key.setdefault(key, []).append((node.lineno, entry))
+
+        for key, dispatches in by_key.items():
+            dispatch_lines = [ln for ln, _ in dispatches]
+            entry_names = sorted(
+                {e.name for _, e in dispatches if e is not None}
+            ) or ["a donating dispatch"]
+            label = (
+                f"self.{key[1]}" if key[0] == "attr" else key[1]
+            )
+            for node in ast.walk(fe.node):
+                if not isinstance(node, ast.expr) or not _match_key(
+                    node, key
+                ):
+                    continue
+                escape = self._escape_context(
+                    node, parents, snapshots, fe.node
+                )
+                if escape is None:
+                    continue
+                if not may_dispatch_after(node.lineno, dispatch_lines):
+                    continue
+                where = (
+                    f"{fe.classname}.{fe.name}"
+                    if fe.classname
+                    else fe.name
+                )
+                ctx.report(
+                    "GL-DONATE",
+                    info.path,
+                    node.lineno,
+                    f"{label} is donated to {', '.join(entry_names)} "
+                    f"and a raw reference escapes into {escape} in "
+                    f"{where} — the buffer is deleted at the next "
+                    "dispatch; snapshot it first (jnp.copy) or suppress "
+                    "with a reason naming why no dispatch can follow",
+                )
+
+    def _escape_context(
+        self,
+        node: ast.expr,
+        parents: dict,
+        snapshots: set,
+        fn_node: ast.AST,
+    ) -> str | None:
+        """The escape kind for a donated-value read, or None when the
+        read is safe (call argument, rebind target, snapshotted)."""
+        child = node
+        while True:
+            parent = parents.get(id(child))
+            if parent is None or isinstance(parent, ast.stmt):
+                if isinstance(parent, ast.Return):
+                    return "a return value"
+                if (
+                    isinstance(parent, ast.Assign)
+                    and child is parent.value
+                    and any(
+                        not isinstance(t, (ast.Name, ast.Tuple, ast.List))
+                        for t in parent.targets
+                    )
+                ):
+                    # self.other = self.out_buf — an attribute/subscript
+                    # alias that survives the next dispatch.
+                    return "an attribute store"
+                return None
+            if isinstance(parent, ast.Call):
+                name = dotted_name(parent.func)
+                tail = name.rsplit(".", 1)[-1] if name else ""
+                if tail in snapshots:
+                    return None  # snapshotted — safe
+                if (
+                    tail in _STORE_METHODS
+                    and child in parent.args
+                ):
+                    return f"a .{tail}() store"
+                return None  # plain call argument: consumed, not stored
+            if isinstance(
+                parent, (ast.Tuple, ast.List, ast.Set, ast.Dict)
+            ):
+                if self._is_staged_args(parent, parents, fn_node):
+                    return None
+                return "a container literal"
+            if isinstance(parent, (ast.Yield, ast.YieldFrom)):
+                return "a yield"
+            child = parent
+
+    @staticmethod
+    def _is_staged_args(
+        container: ast.expr, parents: dict, fn_node: ast.AST
+    ) -> bool:
+        """The staged-args idiom: ``args = (…, buf, …)`` where EVERY
+        later read of ``args`` is a ``*args`` splat into a call — the
+        tuple is consumed by the dispatch itself and rebuilt before the
+        next one, so it is not a surviving alias. (The PR 9 bug shape —
+        ``entry = (…); inflight.append(entry)`` — has a non-splat read
+        and still fires.)"""
+        stmt = parents.get(id(container))
+        if not (
+            isinstance(stmt, ast.Assign)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+            and stmt.value is container
+        ):
+            return False
+        name = stmt.targets[0].id
+        uses = [
+            n
+            for n in ast.walk(fn_node)
+            if isinstance(n, ast.Name)
+            and n.id == name
+            and isinstance(n.ctx, ast.Load)
+        ]
+        return bool(uses) and all(
+            isinstance(parents.get(id(u)), ast.Starred) for u in uses
+        )

@@ -1,0 +1,145 @@
+"""GL-LIFECYCLE — every slot-release path must go through the one
+shared surgery, and slot ownership state is written nowhere else.
+
+GL-REFCOUNT guards acquire/release PAIRS; this rule generalizes it to
+the scheduler's slot STATE MACHINE. The batcher's release surgery
+(``_release_slot``) is deliberately the single implementation shared
+by finish / evict / cancel / watchdog (the PR 6 lesson: two fault
+paths hand-rolled the same surgery and drifted — one left
+``_slot_seq`` stale). Two invariants, both interprocedural:
+
+1. **Exit reachability** — every configured slot-exit path
+   (``lifecycle_exits``: the finish/evict/cancel/watchdog entry
+   points) must reach ``lifecycle_release`` through the call graph
+   within ``dataflow_depth`` hops. A new exit path that forgets the
+   surgery is a finding at its ``def`` line.
+2. **Surgery ownership** — the slot-ownership attributes
+   (``lifecycle_owned_attrs``: ``_slot_req``, ``_slot_seq``, …) may be
+   written only by the release surgery, ``__init__``, and the
+   configured acquisition/mutator methods (``lifecycle_mutators``).
+   A hand-rolled partial release anywhere else is exactly the drift
+   the shared surgery exists to prevent.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.core import Context, Rule, register
+from tools.graftlint.dataflow import FuncEntry, function_table, reaches
+
+
+def _target_attr(target: ast.expr) -> str:
+    """The ``self.<attr>`` name a write targets, through subscripts:
+    ``self._slot_req[slot] = ...`` and ``self._slot_gen[slot] += 1``
+    both resolve to the attribute."""
+    node = target
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return ""
+
+
+@register
+class LifecycleRule(Rule):
+    id = "GL-LIFECYCLE"
+    title = "slot exits reach the shared release surgery; no side writes"
+    rationale = (
+        "Slot release has four callers (finish, evict, cancel, "
+        "watchdog) and one correct implementation. A fifth path that "
+        "hand-rolls the surgery — or forgets it — leaks pages, leaves "
+        "stale ownership, or delivers a dead slot's tokens to its new "
+        "owner; the drift is invisible until the state machines "
+        "disagree under load."
+    )
+    fixtures = {
+        "pkg/sched.py": (
+            "class ContinuousBatcher:\n"
+            "    def _release_slot(self, slot):\n"
+            "        self._slot_req[slot] = None\n"
+            "        self._slot_seq[slot] = None\n"
+            "    def _finish_slot(self, slot):\n"
+            "        self._release_slot(slot)\n"
+            "    def _cancel_slot(self, slot):\n"
+            "        # hand-rolled partial release: misses _slot_seq\n"
+            "        self._slot_req[slot] = None\n"
+        ),
+    }
+    fixture_config = {
+        "lifecycle_class": "ContinuousBatcher",
+        "lifecycle_release": "_release_slot",
+        "lifecycle_exits": ["_finish_slot", "_cancel_slot"],
+        "lifecycle_owned_attrs": ["_slot_req", "_slot_seq"],
+        "lifecycle_mutators": [],
+    }
+
+    def check(self, ctx: Context) -> None:
+        cfg = ctx.cfg
+        owned = set(cfg.lifecycle_owned_attrs)
+        release = cfg.lifecycle_release
+        allowed_writers = (
+            set(cfg.lifecycle_mutators) | {release, "__init__"}
+        )
+        table = function_table(ctx.index)  # shared across all exits
+        for info in ctx.index.values():
+            ci = info.classes.get(cfg.lifecycle_class)
+            if ci is None:
+                continue
+            for exit_name in cfg.lifecycle_exits:
+                node = ci.method_nodes.get(exit_name)
+                if node is None:
+                    continue  # GL-CONFIG flags the stale config entry
+                entry = FuncEntry(
+                    info.modname, cfg.lifecycle_class, exit_name, node
+                )
+                if not reaches(
+                    ctx.index,
+                    entry,
+                    release,
+                    depth=cfg.dataflow_depth,
+                    table=table,
+                ):
+                    ctx.report(
+                        "GL-LIFECYCLE",
+                        info.path,
+                        node.lineno,
+                        f"slot-exit path {cfg.lifecycle_class}."
+                        f"{exit_name} never reaches the shared release "
+                        f"surgery {release}() (within "
+                        f"{cfg.dataflow_depth} call hops) — an exit "
+                        "that skips the surgery leaks pages or leaves "
+                        "stale ownership; route it through "
+                        f"{release}() or suppress with a reason",
+                    )
+            for mname, mnode in ci.method_nodes.items():
+                if mname in allowed_writers:
+                    continue
+                for sub in ast.walk(mnode):
+                    targets: list[ast.expr] = []
+                    if isinstance(sub, ast.Assign):
+                        targets = list(sub.targets)
+                    elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                        targets = [sub.target]
+                    for t in targets:
+                        attr = _target_attr(t)
+                        if attr in owned:
+                            ctx.report(
+                                "GL-LIFECYCLE",
+                                info.path,
+                                sub.lineno,
+                                f"slot-ownership state self.{attr} "
+                                f"written in {cfg.lifecycle_class}."
+                                f"{mname}, outside the shared release "
+                                f"surgery ({release}) and the "
+                                "sanctioned mutators "
+                                f"({', '.join(sorted(allowed_writers))})"
+                                " — hand-rolled lifecycle writes are "
+                                "exactly the drift the shared surgery "
+                                "prevents; move the write or suppress "
+                                "with a reason",
+                            )

@@ -1,5 +1,5 @@
 """GL-SYNC — no host sync in the continuous batcher outside sanctioned
-sync points.
+sync points (interprocedural since graftlint v2).
 
 The pipelined drive loop's whole contract (docs/perf.md) is that the
 host never blocks on the device between chunks: it dispatches against a
@@ -14,12 +14,17 @@ implicit ones that stall identically but look innocent:
 - ``int(x)`` / ``float(x)`` / ``bool(x)`` on a device value
 - truthiness of a device value (``if x.any():`` blocks the host)
 
-"Device value" is decided by a configured taint set: attribute names
-that hold device arrays inside the sync class (``sync_device_attrs`` —
-``self.active``, ``adm.pads`` …) and bare local names known to be
-fetched device results (``sync_device_names``). Methods in
-``sync_allowlist`` (the sanctioned blanket-sync points) are exempt;
-individual sanctioned fetches elsewhere carry an inline
+"Device value" is decided by seed taint (``sync_device_attrs`` —
+``self.active``, ``adm.pads`` …; ``sync_device_names`` for the few
+container-laundered locals) plus the dataflow engine
+(tools/graftlint/dataflow.py): taint propagates through local
+assignments, through calls whose arguments carry it
+(``read_tokens(self.pool, …)``), across return summaries
+(``self._dispatch_spec()`` returns device counts), and into helper
+parameters at call sites — extracting a batcher snippet into a helper
+no longer launders its device values. Methods in ``sync_allowlist``
+(the sanctioned blanket-sync points) are exempt; individual sanctioned
+fetches elsewhere carry an inline
 ``# graftlint: disable=GL-SYNC -- <why this point may sync>``.
 """
 
@@ -28,6 +33,7 @@ from __future__ import annotations
 import ast
 
 from tools.graftlint.core import Context, Rule, register
+from tools.graftlint.dataflow import DeviceTaint, FuncEntry
 
 _NUMPY_NAMES = {"np", "numpy"}
 
@@ -46,17 +52,6 @@ def _is_identity_test(expr: ast.expr) -> bool:
     )
 
 
-def _is_device_tainted(
-    expr: ast.expr, device_attrs: set[str], device_names: set[str]
-) -> bool:
-    for sub in ast.walk(expr):
-        if isinstance(sub, ast.Attribute) and sub.attr in device_attrs:
-            return True
-        if isinstance(sub, ast.Name) and sub.id in device_names:
-            return True
-    return False
-
-
 @register
 class SyncRule(Rule):
     id = "GL-SYNC"
@@ -66,69 +61,120 @@ class SyncRule(Rule):
         "the drive loop serializes host and device again — the exact "
         "host-overhead-bound stall the pipelined loop exists to remove. "
         "The implicit forms don't say 'sync' anywhere, so only a "
-        "machine check keeps them out."
+        "machine check keeps them out — and since the interprocedural "
+        "port, extracting the fetch into a helper doesn't hide it."
     )
     fixtures = {
         "pkg/sched.py": (
             "import jax\n"
+            "import jax.numpy as jnp\n"
             "import numpy as np\n"
+            "\n"
+            "def gather(pool, idx):\n"
+            "    return pool[idx]\n"
             "\n"
             "class ContinuousBatcher:\n"
             "    def _advance_admission(self):\n"
             "        jax.block_until_ready(self.active)  # allowlisted\n"
+            "    def _counts(self):\n"
+            "        return jnp.stack([self.n_emitted])\n"
+            "    def _extracted_helper(self, buf):\n"
+            "        return np.asarray(buf)\n"
             "    def _hot_loop(self):\n"
             "        jax.block_until_ready(self.active)\n"
             "        a = np.asarray(self.active)\n"
             "        n = int(self.n_emitted[0])\n"
             "        v = self.out_buf.item()\n"
             "        g = jax.device_get(self.pool)\n"
+            "        rows = gather(self.pool, 0)\n"
+            "        b = rows.item()\n"
+            "        counts = self._counts()\n"
+            "        c = np.asarray(counts)\n"
+            "        d = self._extracted_helper(self.out_buf)\n"
             "        if self.active.any():\n"
             "            pass\n"
-            "        return a, n, v, g\n"
+            "        return a, n, v, g, b, c, d\n"
         ),
     }
 
     def check(self, ctx: Context) -> None:
         cfg = ctx.cfg
-        device_attrs = set(cfg.sync_device_attrs)
-        device_names = set(cfg.sync_device_names)
         allow = set(cfg.sync_allowlist)
+        taint = DeviceTaint(
+            ctx.index,
+            set(cfg.sync_device_attrs),
+            set(cfg.sync_device_names),
+            depth=cfg.dataflow_depth,
+        )
+        roots: list[FuncEntry] = []
+        sync_mods: set[str] = set()
         for info in ctx.index.values():
-            for node in info.tree.body:
-                if (
-                    not isinstance(node, ast.ClassDef)
-                    or node.name != cfg.sync_class
-                ):
+            ci = info.classes.get(cfg.sync_class)
+            if ci is None:
+                continue
+            sync_mods.add(info.modname)
+            for name, node in ci.method_nodes.items():
+                if name in allow:
                     continue
-                for method in node.body:
-                    if not isinstance(
-                        method, (ast.FunctionDef, ast.AsyncFunctionDef)
-                    ):
-                        continue
-                    if method.name in allow:
-                        continue
-                    self._check_method(
-                        ctx, info, method, device_attrs, device_names
-                    )
+                roots.append(
+                    FuncEntry(info.modname, cfg.sync_class, name, node)
+                )
+        if not roots:
+            return
 
-    def _check_method(
-        self, ctx, info, method, device_attrs, device_names
+        # Helper extraction must not launder taint: seed helper params
+        # from tainted call-site args — same-module functions and
+        # sync-class methods only, never jit-traced bodies (device
+        # programs are not host code) and never allowlisted methods.
+        jit_bodies = {
+            (m, n)
+            for m in sync_mods
+            for e in ctx.index[m].jit_entries.values()
+            for n in (e.name, e.impl)
+        }
+
+        def accept(entry: FuncEntry) -> bool:
+            if entry.modname not in sync_mods or entry.name in allow:
+                return False
+            if entry.classname and entry.classname != cfg.sync_class:
+                return False
+            if not entry.classname and (
+                (entry.modname, entry.name) in jit_bodies
+            ):
+                return False
+            return True
+
+        helpers = taint.propagate_params(roots, accept)
+        root_keys = {r.key for r in roots}
+        checked = roots + [h for h in helpers if h.key not in root_keys]
+        for entry in checked:
+            self._check_function(ctx, entry, taint)
+
+    def _check_function(
+        self, ctx: Context, entry: FuncEntry, taint: DeviceTaint
     ) -> None:
+        info = ctx.index[entry.modname]
+        where = (
+            f"{entry.classname}.{entry.name}"
+            if entry.classname
+            else f"helper {entry.name}"
+        )
+
         def tainted(expr: ast.expr) -> bool:
-            return _is_device_tainted(expr, device_attrs, device_names)
+            return taint.tainted(expr, entry)
 
         def warn(node: ast.AST, what: str) -> None:
             ctx.report(
                 "GL-SYNC",
                 info.path,
                 node.lineno,
-                f"{what} in {ctx.cfg.sync_class}.{method.name} syncs the "
+                f"{what} in {where} syncs the "
                 "host outside the sanctioned sync points "
                 f"({', '.join(sorted(ctx.cfg.sync_allowlist))}); fetch at "
                 "a sanctioned point or suppress with a reason",
             )
 
-        for sub in ast.walk(method):
+        for sub in ast.walk(entry.node):
             if isinstance(sub, ast.Call):
                 f = sub.func
                 # Explicit: jax.block_until_ready / block_until_ready.

@@ -25,8 +25,9 @@ stage failed):
    unroll cost measurement, tools/unroll_compile_check.py.
 
 Usage:
-    python tools/lint_all.py          # graftlint + mutmut sanity
-    python tools/lint_all.py --full   # + bench trend + unroll check
+    python tools/lint_all.py            # graftlint + mutmut sanity
+    python tools/lint_all.py --changed  # lint only files changed vs main
+    python tools/lint_all.py --full     # + bench trend + unroll check
 """
 
 from __future__ import annotations
@@ -40,14 +41,65 @@ REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
 
-def _stage_graftlint() -> bool:
+def lintable(names: list[str], repo: Path = REPO) -> list[str]:
+    """Repo-relative names filtered to existing .py files under the
+    lint roots (pure — the testable half of --changed)."""
+    from tools.graftlint.core import DEFAULT_ROOTS
+
+    roots = tuple(
+        r if r.endswith(".py") else r + "/" for r in DEFAULT_ROOTS
+    )
+    out = []
+    for name in names:
+        if not name.endswith(".py"):
+            continue
+        if not any(name == r or name.startswith(r) for r in roots):
+            continue
+        if (repo / name).is_file():
+            out.append(name)
+    return sorted(set(out))
+
+
+def changed_py_files(repo: Path = REPO, base: str = "main") -> list[str] | None:
+    """Lintable files changed vs ``base`` (committed + worktree +
+    untracked); None when git cannot answer (fall back to a full lint)."""
+    names: list[str] = []
+    try:
+        for args in (
+            ["git", "diff", "--name-only", base],
+            ["git", "ls-files", "--others", "--exclude-standard"],
+        ):
+            r = subprocess.run(
+                args, cwd=repo, capture_output=True, text=True, timeout=30
+            )
+            if r.returncode != 0:
+                return None
+            names += r.stdout.splitlines()
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return lintable(names, repo)
+
+
+def _stage_graftlint(paths: list[str] | None = None) -> bool:
     from tools.graftlint import core
 
     failures = core.self_test()
     for f in failures:
         print(f"lint_all: graftlint self-test: {f}", file=sys.stderr)
+    if paths is not None and not paths:
+        # --changed with nothing changed: the self-test above is the
+        # whole lint stage.
+        ok = not failures
+        print(
+            f"lint_all: graftlint {'OK' if ok else 'FAILED'} "
+            "(0 changed files)",
+            file=sys.stderr,
+        )
+        return ok
     try:
-        result = core.run()
+        result = core.run(
+            [str(REPO / p) for p in paths] if paths else None
+        )
     except (SyntaxError, ValueError) as e:
         print(f"lint_all: graftlint: {e}", file=sys.stderr)
         print("lint_all: graftlint FAILED", file=sys.stderr)
@@ -55,10 +107,37 @@ def _stage_graftlint() -> bool:
     for finding in result.findings:
         print(finding.render())
     ok = not failures and result.exit_code == 0
+    slowest = sorted(
+        result.rule_seconds.items(), key=lambda kv: -kv[1]
+    )[:3]
+    timing = ", ".join(f"{r} {s:.2f}s" for r, s in slowest)
     print(
         f"lint_all: graftlint {'OK' if ok else 'FAILED'} "
         f"({len(result.findings)} finding(s), "
-        f"{len(failures)} dead rule(s), {result.n_files} files)",
+        f"{len(failures)} dead rule(s), {result.n_files} files; "
+        f"slowest rules: {timing})",
+        file=sys.stderr,
+    )
+    return ok
+
+
+def _stage_graftlint_config() -> bool:
+    """THE pyproject-vs-code-defaults drift guard (hoisted here from
+    per-module test pins): the [tool.graftlint] table and the in-code
+    defaults must be the same config — the defaults exist so fixture
+    trees lint without a pyproject, not as a second opinion."""
+    from tools.graftlint.config import config_drift
+
+    try:
+        drift = config_drift(REPO)
+    except ValueError as e:
+        print(f"lint_all: graftlint-config: {e}", file=sys.stderr)
+        drift = ["<unreadable table>"]
+    for d in drift:
+        print(f"lint_all: graftlint-config: drift: {d}", file=sys.stderr)
+    ok = not drift
+    print(
+        f"lint_all: graftlint-config {'OK' if ok else 'FAILED'}",
         file=sys.stderr,
     )
     return ok
@@ -170,8 +249,41 @@ def main(argv: list[str] | None = None) -> int:
         action="store_true",
         help="also run the (slow) unroll compile check",
     )
+    ap.add_argument(
+        "--changed",
+        action="store_true",
+        help="lint only files changed vs --base (the tpu_session.sh "
+        "fast preflight); falls back to a full lint when git cannot "
+        "answer. Absence-proving checks (GL-CONFIG) skip on a subset.",
+    )
+    ap.add_argument(
+        "--base",
+        default="main",
+        help="base ref for --changed (default: main)",
+    )
     args = ap.parse_args(argv)
-    ok = _stage_graftlint()
+    paths: list[str] | None = None
+    if args.changed:
+        paths = changed_py_files(REPO, args.base)
+        if paths is None:
+            print(
+                "lint_all: --changed: git unavailable, full lint",
+                file=sys.stderr,
+            )
+        elif not paths:
+            print(
+                f"lint_all: --changed: no lintable files changed vs "
+                f"{args.base}; graftlint self-test + config stages only",
+                file=sys.stderr,
+            )
+        else:
+            print(
+                f"lint_all: --changed: {len(paths)} file(s) vs "
+                f"{args.base}",
+                file=sys.stderr,
+            )
+    ok = _stage_graftlint(paths)
+    ok = _stage_graftlint_config() and ok
     ok = _stage_mutmut_sanity() and ok
     ok = _stage_journal_schema() and ok
     if args.full:

@@ -11,11 +11,18 @@ cd "$(dirname "$0")"
 OUT="${1:-tpu_results/r04.jsonl}"
 mkdir -p "$(dirname "$OUT")"
 
-# Preflight: static gates before burning a TPU window. graftlint +
-# mutmut-config sanity are seconds; --full adds the unroll compile
-# check (minutes of CPU — fine while waiting for a window). A failure
-# aborts the session: a repo that doesn't lint clean should not spend
-# accelerator time.
+# Preflight: static gates before burning a TPU window, fastest first.
+# Stage 1 lints only the files changed vs main (seconds even as the
+# rule set grows) so a broken edit aborts before the full pass; stage 2
+# is the full gate — graftlint over the whole repo + mutmut-config
+# sanity, with --full adding the unroll compile check (minutes of CPU —
+# fine while waiting for a window). A failure aborts the session: a
+# repo that doesn't lint clean should not spend accelerator time.
+echo "$(date -u +%FT%TZ) session: preflight-fast (tools/lint_all.py --changed)"
+if ! JAX_PLATFORMS=cpu python tools/lint_all.py --changed; then
+  echo "$(date -u +%FT%TZ) session: fast preflight FAILED — aborting"
+  exit 1
+fi
 echo "$(date -u +%FT%TZ) session: preflight (tools/lint_all.py --full)"
 if ! JAX_PLATFORMS=cpu python tools/lint_all.py --full; then
   echo "$(date -u +%FT%TZ) session: preflight FAILED — aborting"
