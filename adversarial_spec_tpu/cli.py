@@ -350,8 +350,8 @@ def create_parser() -> argparse.ArgumentParser:
             "Arm fault injection: kind@seam[:p=F][:after=N][:times=N]"
             "[:slot=K], comma-separated (kinds: oom, device_lost, "
             "preempted, timeout, bug; seams: generate, scheduler_chunk, "
-            "kv_alloc, kv_swap, checkpoint_load, crash). Also via "
-            "ADVSPEC_CHAOS"
+            "kv_alloc, kv_swap, checkpoint_load, crash, replica). Also "
+            "via ADVSPEC_CHAOS"
         ),
     )
     z.add_argument(
@@ -377,6 +377,31 @@ def create_parser() -> argparse.ArgumentParser:
         "--no-breaker",
         action="store_true",
         help="Disable circuit breakers (always query every model)",
+    )
+    z.add_argument(
+        "--fleet",
+        action=argparse.BooleanOptionalAction,
+        default=None,  # None = inherit ADVSPEC_FLEET (default off)
+        help="Route requests across N replicated engines with "
+        "prefix-affinity placement (one replica per debate via "
+        "consistent hashing over --session), per-(replica, model) "
+        "breaker-aware failover, and shared-store KV recovery "
+        "(docs/fleet.md; ADVSPEC_FLEET=1 sets the process default)",
+    )
+    z.add_argument(
+        "--fleet-replicas",
+        type=int,
+        default=None,  # None = inherit ADVSPEC_FLEET_REPLICAS (default 2)
+        help="Engine replicas behind the fleet router (>= 2 to route; "
+        "ADVSPEC_FLEET_REPLICAS sets the process default)",
+    )
+    z.add_argument(
+        "--fleet-transport",
+        choices=["inproc", "worker"],
+        default=None,  # None = inherit ADVSPEC_FLEET_TRANSPORT (inproc)
+        help="Replica transport: fresh in-process engines (inproc) or "
+        "one subprocess per replica (worker — the SIGKILL-able "
+        "topology tools/chaos_run.py --replica-kill drills)",
     )
 
     r = parser.add_argument_group("registry")
@@ -606,6 +631,35 @@ def _configure_kv_tier(args: argparse.Namespace):
     return kvtier
 
 
+def _configure_fleet(args: argparse.Namespace):
+    """Arm the fleet layer from flags; returns the module for
+    reporting. Flag-else-env-default each invocation (one invocation =
+    one round), like obs/kvtier: one round's --fleet must not leak
+    into the next. Stats reset per invocation so ``perf.fleet``
+    accounts exactly this round's routing; the replicas themselves
+    persist on the process fleet engine (rebuilt when the topology
+    knobs change — fleet.fleet_engine keys on them)."""
+    from adversarial_spec_tpu import fleet
+
+    fleet.configure(
+        enabled=(
+            args.fleet if args.fleet is not None else fleet.env_enabled()
+        ),
+        replicas=(
+            args.fleet_replicas
+            if args.fleet_replicas is not None
+            else fleet.env_replicas()
+        ),
+        transport=(
+            args.fleet_transport
+            if args.fleet_transport is not None
+            else fleet.env_transport()
+        ),
+    )
+    fleet.reset_stats()
+    return fleet
+
+
 def _configure_speculative(args: argparse.Namespace):
     """Apply speculation flags to the process config (one CLI invocation
     is one round) so ``perf.spec`` accounts exactly this round's verify
@@ -694,6 +748,7 @@ def run_critique(args: argparse.Namespace) -> int:
     spec_cfg = _configure_speculative(args)
     kv_tier = _configure_kv_tier(args)
     streaming = _configure_streaming(args)
+    fleet = _configure_fleet(args)
     obs = _configure_obs(args)
     spec, session_state = load_or_resume_session(args)
     if session_state is not None and session_state.breakers:
@@ -716,6 +771,13 @@ def run_critique(args: argparse.Namespace) -> int:
         press=args.press,
         context_files=args.context or [],
         sampling=_sampling_from_args(args),
+        # Fleet placement identity: one key per SESSION, so every
+        # round of a session's debate lands on the replica holding its
+        # prefix KV (sessionless rounds fall back to the spec hash in
+        # run_round).
+        debate_id=(
+            session_state.session_id if session_state is not None else ""
+        ),
     )
     journal = None
     if session_state is not None:
@@ -791,6 +853,9 @@ def run_critique(args: argparse.Namespace) -> int:
     # Streaming telemetry: requests streamed, deliveries, cancels, and
     # the decode tokens early cancellation saved (engine/streaming.py).
     perf["stream"] = streaming.snapshot()
+    # Fleet telemetry: routed/affinity-hit/failover counts, replica
+    # lifecycle, reissued work across replica deaths (fleet/router.py).
+    perf["fleet"] = fleet.snapshot()
     # Observability report: flight-recorder occupancy, event mix, host
     # syncs by reason, retrace watch (unexpected recompiles flagged).
     perf["obs"] = obs.snapshot()
@@ -837,6 +902,19 @@ def run_critique(args: argparse.Namespace) -> int:
             f"early cancel: {stream_snap['cancels']} request(s) stopped "
             f"at their verdict marker, {stream_snap['tokens_saved']} "
             "decode token(s) saved"
+        )
+    fleet_snap = perf["fleet"]
+    if fleet_snap["enabled"] and fleet_snap["routed_requests"]:
+        _err(
+            f"fleet: {fleet_snap['routed_requests']} request(s) routed "
+            f"across {fleet_snap['replicas']} replica(s), affinity hit "
+            f"rate {fleet_snap['affinity_hit_rate']:.0%}"
+            + (
+                f", {fleet_snap['reissued_requests']} reissued after "
+                "replica loss"
+                if fleet_snap["reissued_requests"]
+                else ""
+            )
         )
     tier_snap = perf["kv_tier"]
     if tier_snap["enabled"] and (

@@ -73,6 +73,13 @@ class RoundConfig:
     # records through it and serves already-completed opponents from a
     # replay on resume.
     journal: object | None = None
+    # Fleet placement identity (fleet/hashring.py): ONE stable id per
+    # debate, so every round of this debate consistent-hashes onto the
+    # replica already holding its prefix KV. The CLI sets it to the
+    # session id; "" falls back to hashing the round's spec — rounds
+    # of an unnamed one-shot debate still co-locate with each other
+    # only while the spec's hash is stable.
+    debate_id: str = ""
     # Injected for tests; defaults to real sleep for backoff.
     sleep = staticmethod(time.sleep)
 
@@ -216,11 +223,16 @@ def run_round(
     # invocation sequence. The ids ride the requests by value; the
     # ambient scope below covers emitters that don't know their request.
     trace_id = obs_mod.trace.mint_trace(round_num)
+    # Fleet routing key (fleet/router.py): the whole debate shares one
+    # affinity key, so a fleet places all its rounds on one replica —
+    # where the document prefix's KV already lives.
+    affinity = cfg.debate_id or journal_mod.spec_sha(spec)[:16]
     requests = [
         dataclasses.replace(
             build_request(m, spec, round_num, cfg),
             trace_id=trace_id,
             span_id=obs_mod.trace.mint_span(trace_id, i),
+            affinity_key=affinity,
         )
         for i, m in enumerate(models)
     ]

@@ -489,8 +489,14 @@ class RoundJournal:
         spec, keyed by opponent index — the opponents a restarted
         process serves from the journal instead of the engine. Guards:
         the last round_start for the round must hash-match the resumed
-        spec (a revised spec invalidates every record), and each
-        completion must name the model currently at its index."""
+        spec (a revised spec invalidates every record); the resumed
+        opponent POOL must be the journaled pool as a multiset (a
+        changed model set refuses replay cleanly — every opponent
+        re-issues); and each record serves THE MODEL IT NAMES — at its
+        recorded index when the pool order held, re-homed to the
+        model's new index when the pool was merely permuted (an
+        unambiguous, single-occurrence model only; duplicate ids keep
+        the strict per-index match)."""
         records, skipped = self.read()
         self.replay_records = len(records)
         self.replay_skipped = skipped
@@ -500,13 +506,31 @@ class RoundJournal:
                 start = rec
         if start is None or start["spec_sha"] != spec_sha(spec):
             return {}
+        if sorted(start.get("models", [])) != sorted(models):
+            # A changed model SET invalidates the round's records: a
+            # completion for a model no longer (or newly) in the pool
+            # must not be half-served. Clean refusal — re-issue all.
+            return {}
         out: dict[int, dict] = {}
+        rehome: list[dict] = []
         for rec in records:
             if rec["type"] != "completion" or rec["round"] != round_num:
                 continue
             i = rec["index"]
             if 0 <= i < len(models) and rec["model"] == models[i]:
                 out[i] = rec
+            else:
+                rehome.append(rec)
+        # Permuted pool (same multiset, different order): serve each
+        # leftover record at ITS model's new index — the per-index
+        # model match still decides, just at the re-homed position.
+        for rec in rehome:
+            model = rec.get("model")
+            if models.count(model) != 1:
+                continue  # ambiguous under duplicates: re-issue
+            j = models.index(model)
+            if j not in out:
+                out[j] = rec
         if skipped and obs_mod.config().enabled:
             obs_mod.metrics.counter(
                 "advspec_journal_records_skipped_total",

@@ -13,38 +13,61 @@ from adversarial_spec_tpu.engine.types import Engine
 _ENGINE_CACHE: dict[str, Engine] = {}
 
 
-def get_engine(model: str) -> Engine:
-    """Return the (cached) engine that serves this model id."""
+def _provider_key(model: str) -> str:
     if model.startswith("mock://"):
-        key = "mock"
-    elif model.startswith("tpu://"):
-        key = "tpu"
-    else:
+        return "mock"
+    if model.startswith("tpu://"):
+        return "tpu"
+    raise ValueError(
+        f"unknown provider for model {model!r}: expected a 'mock://' or "
+        "'tpu://' id (remote HTTP providers are intentionally not part "
+        "of this framework — register a local checkpoint instead)"
+    )
+
+
+def new_engine(model: str) -> Engine:
+    """A FRESH engine instance for this model's provider — the replica
+    lifecycle seam (fleet/replica.py): each fleet replica must own its
+    engine (allocator, prefix cache, batchers), so replicas build here
+    instead of sharing the process cache below."""
+    key = _provider_key(model)
+    if key == "mock":
+        from adversarial_spec_tpu.engine.mock import MockEngine
+
+        return MockEngine()
+    # Deferred import: pulls in jax; mock-only flows never pay it.
+    from adversarial_spec_tpu.utils.jaxenv import configure_jax
+
+    configure_jax()
+    try:
+        from adversarial_spec_tpu.engine.tpu import TpuEngine
+    except ImportError as e:
         raise ValueError(
-            f"unknown provider for model {model!r}: expected a 'mock://' or "
-            "'tpu://' id (remote HTTP providers are intentionally not part "
-            "of this framework — register a local checkpoint instead)"
-        )
+            f"tpu:// engine unavailable in this installation: {e}"
+        ) from e
+    return TpuEngine()
+
+
+def get_engine(model: str) -> Engine:
+    """Return the engine that serves this model id: the process fleet
+    (one FleetEngine over N replicas) when the fleet is armed, else
+    the cached single engine per provider — all ``tpu://`` models
+    share one ``TpuEngine`` so co-resident opponents can batch onto
+    one mesh."""
+    from adversarial_spec_tpu import fleet as fleet_mod
+
+    key = _provider_key(model)  # validate the id either way
+    if fleet_mod.armed():
+        return fleet_mod.fleet_engine()
     if key not in _ENGINE_CACHE:
-        if key == "mock":
-            from adversarial_spec_tpu.engine.mock import MockEngine
-
-            _ENGINE_CACHE[key] = MockEngine()
-        else:
-            # Deferred import: pulls in jax; mock-only flows never pay it.
-            from adversarial_spec_tpu.utils.jaxenv import configure_jax
-
-            configure_jax()
-            try:
-                from adversarial_spec_tpu.engine.tpu import TpuEngine
-            except ImportError as e:
-                raise ValueError(
-                    f"tpu:// engine unavailable in this installation: {e}"
-                ) from e
-            _ENGINE_CACHE[key] = TpuEngine()
+        _ENGINE_CACHE[key] = new_engine(model)
     return _ENGINE_CACHE[key]
 
 
 def clear_engine_cache() -> None:
-    """Test hook: drop cached engines (and their loaded weights)."""
+    """Test hook: drop cached engines (and their loaded weights) and
+    tear down the process fleet."""
+    from adversarial_spec_tpu import fleet as fleet_mod
+
     _ENGINE_CACHE.clear()
+    fleet_mod.shutdown_fleet()

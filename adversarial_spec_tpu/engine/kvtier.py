@@ -41,8 +41,10 @@ and snapshots into ``perf.kv_tier``. Deliberately imports no jax.
 from __future__ import annotations
 
 import hashlib
+import itertools
 import json
 import os
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -385,6 +387,14 @@ class DiskStore:
         self.dir = os.path.join(root, fingerprint)
         self.quarantine_dir = os.path.join(self.dir, "quarantine")
         os.makedirs(self.dir, exist_ok=True)
+        # Serializes the replace-and-count section of put() across
+        # THREADS sharing this instance; concurrent PROCESSES (fleet
+        # replicas sharing one store dir) are already safe — each
+        # writes a unique temp name and the replaces are atomic, so
+        # the last identical copy wins and every instance's resident
+        # count stays consistent with its own scan.
+        self._put_lock = threading.Lock()
+        self._tmp_seq = itertools.count()
         self._resident = self._scan()
 
     def _scan(self) -> int:
@@ -442,15 +452,34 @@ class DiskStore:
             separators=(",", ":"),
         ).encode("utf-8")
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        tmp = f"{path}.{os.getpid()}.tmp"
+        # The temp name is unique per (process, thread, call): two
+        # concurrent writers of the SAME chain — two fleet replicas
+        # writing through one store, or two threads of one engine —
+        # must never interleave bytes into one temp file. Both finish
+        # a complete, identical entry and both os.replace atomically;
+        # the second replace installs identical content over the
+        # first, so the store ends with exactly one valid entry and
+        # no torn/quarantinable state.
+        tmp = (
+            f"{path}.{os.getpid()}.{threading.get_ident()}"
+            f".{next(self._tmp_seq)}.tmp"
+        )
         with open(tmp, "wb") as f:
             f.write(_MAGIC)
             f.write(bytes([_VERSION]))
             f.write(len(header).to_bytes(4, "little"))
             f.write(header)
             f.write(body)
-        os.replace(tmp, path)
-        self._resident += 1
+        with self._put_lock:
+            # Lost the race to a sibling thread: its entry already
+            # landed and was counted — replacing with identical bytes
+            # is harmless, but counting twice would drift the resident
+            # ledger off the on-disk scan.
+            existed = os.path.exists(path)
+            os.replace(tmp, path)
+            if existed:
+                return False
+            self._resident += 1
         return True
 
     def _quarantine(self, chain: str, reason: str) -> None:
@@ -782,8 +811,14 @@ class TieredStore:
         if self.host is not None:
             self.host.check_invariants()
         if self.disk is not None:
+            # One-sided on purpose: the store dir may be SHARED across
+            # fleet replicas (that is its point — overlapping prefixes
+            # rehydrate fleet-wide), so entries legitimately appear
+            # that this instance never counted. Tracking MORE than the
+            # scan finds is the local bookkeeping bug (double count /
+            # phantom entry) this check exists to catch.
             resident = self.disk._scan()
-            if resident != self.disk.resident_entries:
+            if resident < self.disk.resident_entries:
                 raise RuntimeError(
                     f"disk store count drift: {self.disk.resident_entries} "
                     f"tracked vs {resident} on disk"

@@ -66,6 +66,13 @@ class ChatRequest:
     # event an engine emits resolves back to one round + opponent.
     trace_id: str = ""
     span_id: str = ""
+    # Fleet placement key (fleet/hashring.py): one stable id per
+    # DEBATE (not per round — the point is that every round of the
+    # same debate consistent-hashes onto the replica already holding
+    # its prefix KV). Stamped by the debate layer; "" falls back to
+    # hashing the model id (no cross-round affinity, still sticky
+    # within a batch).
+    affinity_key: str = ""
 
 
 @dataclass

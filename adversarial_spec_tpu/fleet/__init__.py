@@ -1,0 +1,229 @@
+"""Fleet layer: replicated engines behind a prefix-affinity router.
+
+One engine process is a single point of failure — a dead process loses
+every in-flight round and all device/host KV residency. This package is
+ROADMAP item 3's first half: N engine replicas behind a router that
+
+- **routes by prefix affinity** (fleet/hashring.py): the debate's
+  affinity key consistent-hashes onto a replica, so every round of the
+  same debate lands where its prefix KV already lives, and a membership
+  change moves only ~1/N of the keyspace;
+- **fails over on health + breakers** (fleet/router.py): per-replica
+  heartbeats and per-(replica, model) circuit breakers
+  (resilience/breaker.py ``replica_key``) drain a slow or dead replica
+  — queued and in-flight requests re-route to the next replica on the
+  ring;
+- **recovers through the shared store**: replicas share the PR 7
+  content-addressed disk store, so a failed-over request rehydrates its
+  prefix KV on the new replica instead of re-prefilling, and the PR 10
+  round journal keeps already-completed opponents from re-issuing.
+
+Replicas come in two transports (fleet/replica.py): ``inproc`` wraps a
+fresh engine instance in this process (deterministic, tier-1-testable),
+``worker`` runs one per subprocess (``python -m
+adversarial_spec_tpu.fleet.worker``) — the SIGKILL-able topology the
+replica-kill chaos harness drives (``tools/chaos_run.py
+--replica-kill``).
+
+Process-wide config + stats follow the ``procconfig`` pattern shared
+with ``interleave``/``spec``/``kvtier``: the CLI arms per round
+(``--fleet``, ``--fleet-replicas``; env ``ADVSPEC_FLEET`` /
+``ADVSPEC_FLEET_REPLICAS`` / ``ADVSPEC_FLEET_TRANSPORT``) and snapshots
+into ``perf.fleet``. Deliberately imports no jax — the mock fleet runs
+entirely on CPU.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from adversarial_spec_tpu.engine import procconfig
+
+DEFAULT_REPLICAS = 2
+TRANSPORTS = ("inproc", "worker")
+
+
+def env_enabled() -> bool:
+    """The process default for the master switch (``ADVSPEC_FLEET``).
+    Default OFF: a single engine stays the shipped topology until the
+    operator opts a process into the fleet."""
+    return os.environ.get("ADVSPEC_FLEET", "0") == "1"
+
+
+def env_replicas() -> int:
+    """The process default replica count (``ADVSPEC_FLEET_REPLICAS``)."""
+    try:
+        return max(1, int(os.environ.get("ADVSPEC_FLEET_REPLICAS", DEFAULT_REPLICAS)))
+    except ValueError:
+        return DEFAULT_REPLICAS
+
+
+def env_transport() -> str:
+    """The process default transport (``ADVSPEC_FLEET_TRANSPORT``)."""
+    t = os.environ.get("ADVSPEC_FLEET_TRANSPORT", "inproc")
+    return t if t in TRANSPORTS else "inproc"
+
+
+@dataclass
+class FleetConfig:
+    """Process-wide knobs, set once per CLI round (or by tests)."""
+
+    enabled: bool = False
+    replicas: int = DEFAULT_REPLICAS
+    # "inproc" (fresh engine instances in this process) or "worker"
+    # (one subprocess per replica — SIGKILL-able, the chaos topology).
+    transport: str = "inproc"
+    # Per-request transport deadline for worker replicas, seconds: a
+    # worker that stays silent this long is treated as dead and its
+    # in-flight requests fail over (0 = wait forever).
+    request_timeout_s: float = 30.0
+
+
+def _coerce_transport(value) -> str:
+    v = str(value)
+    if v not in TRANSPORTS:
+        # Fail AT THE KNOB (the γ precedent): a typo'd transport must
+        # not silently fall back to inproc mid-deployment.
+        raise ValueError(
+            f"unknown fleet transport {v!r}; known: {', '.join(TRANSPORTS)}"
+        )
+    return v
+
+
+@dataclass
+class FleetStats(procconfig.StatsBase):
+    """Process-wide fleet counters, aggregated across every router the
+    process builds (one per config generation).
+
+    ``affinity_hits`` counts requests served by the ring's PRIMARY
+    choice for their key; ``routed_requests − affinity_hits`` is the
+    hop traffic (breaker-open skips + failover re-routes), so
+    ``affinity_hit_rate`` is the headline the fleet bench compares
+    against random routing. ``reissued_requests`` counts requests that
+    were re-routed after their replica died mid-flight — the work a
+    replica loss costs; ``duplicated_completions`` counts completions
+    that arrived for an already-resolved request and MUST stay zero
+    (the lose-a-replica-lose-nothing invariant the chaos harness
+    pins)."""
+
+    routed_requests: int = 0
+    affinity_hits: int = 0
+    failover_hops: int = 0
+    breaker_skips: int = 0
+    reissued_requests: int = 0
+    completed_requests: int = 0
+    duplicated_completions: int = 0
+    replicas_spawned: int = 0
+    replicas_retired: int = 0
+    heartbeats: int = 0
+    heartbeat_failures: int = 0
+
+    def snapshot(self) -> dict:
+        out = self.as_dict()
+        out["affinity_hit_rate"] = (
+            round(self.affinity_hits / self.routed_requests, 4)
+            if self.routed_requests
+            else 0.0
+        )
+        return out
+
+
+_state = procconfig.ProcState(
+    FleetConfig(
+        enabled=env_enabled(),
+        replicas=env_replicas(),
+        transport=env_transport(),
+    ),
+    FleetStats(),
+    coerce={
+        "replicas": lambda v: max(1, int(v)),
+        "transport": _coerce_transport,
+    },
+)
+_config = _state.config
+stats = _state.stats
+
+
+def config() -> FleetConfig:
+    return _state.config
+
+
+def configure(
+    enabled: bool | None = None,
+    replicas: int | None = None,
+    transport: str | None = None,
+    request_timeout_s: float | None = None,
+) -> FleetConfig:
+    return _state.configure(
+        enabled=enabled,
+        replicas=replicas,
+        transport=transport,
+        request_timeout_s=request_timeout_s,
+    )
+
+
+def reset_stats() -> None:
+    _state.reset_stats()
+
+
+def snapshot() -> dict:
+    """Stats + config, the ``perf.fleet`` payload."""
+    return _state.snapshot()
+
+
+def armed() -> bool:
+    """True when requests should route through the fleet (>= 2 replicas
+    — a 1-replica fleet is just an engine with extra steps, served by
+    the plain dispatch path)."""
+    return _config.enabled and _config.replicas >= 2
+
+
+# -- the process fleet engine ----------------------------------------------
+# Built lazily on first armed dispatch, rebuilt when the knobs that
+# shape the topology change (the TpuEngine batcher_key precedent), and
+# torn down explicitly by tests / the worker-transport harnesses.
+
+_engine = None
+_engine_key = None
+
+
+def fleet_engine():
+    """The process-wide FleetEngine for the current config (lazy; a
+    config change retires the old fleet and builds a fresh one)."""
+    global _engine, _engine_key
+    key = (_config.replicas, _config.transport, _config.request_timeout_s)
+    if _engine is not None and _engine_key != key:
+        _engine.shutdown()
+        _engine = None
+    if _engine is None:
+        from adversarial_spec_tpu.fleet.router import FleetEngine
+
+        _engine = FleetEngine(
+            replicas=_config.replicas,
+            transport=_config.transport,
+            request_timeout_s=_config.request_timeout_s,
+        )
+        _engine_key = key
+    return _engine
+
+
+def install_engine(engine) -> None:
+    """Replace the process fleet engine with a caller-built topology
+    (harnesses and tests that need explicit worker envs / log dirs /
+    kill triggers). The installed engine serves until the topology
+    knobs change or ``shutdown_fleet`` runs."""
+    global _engine, _engine_key
+    if _engine is not None and _engine is not engine:
+        _engine.shutdown()
+    _engine = engine
+    _engine_key = (_config.replicas, _config.transport, _config.request_timeout_s)
+
+
+def shutdown_fleet() -> None:
+    """Tear down the process fleet (tests; worker harness cleanup)."""
+    global _engine, _engine_key
+    if _engine is not None:
+        _engine.shutdown()
+    _engine = None
+    _engine_key = None

@@ -1,0 +1,407 @@
+"""Fleet router: prefix-affinity placement + breaker-aware failover.
+
+The router owns the fleet's three contracts:
+
+- **Placement.** Each request's affinity key (stamped by the debate
+  layer: one stable id per debate) consistent-hashes onto a replica
+  (fleet/hashring.py), so every round of one debate lands where its
+  prefix KV already lives and a membership change moves only ~1/N of
+  the keyspace. ``affinity=False`` (the bench's control arm) routes
+  round-robin instead.
+- **Failover.** Before dispatch the router consults the
+  per-(replica, model) circuit breaker
+  (``resilience.breaker.replica_key``) — a pair that keeps faulting is
+  skipped without a probe until its cooldown. A replica whose
+  TRANSPORT dies (:class:`fleet.replica.ReplicaDead`) is retired
+  through the one shared surgery (``_retire_replica``: out of the
+  ring, transport closed, telemetry) and every unresolved request
+  re-routes to the next replica in ring order. Completions that
+  arrived before the death are kept — a replica loss re-pays only the
+  in-flight remainder.
+- **Recovery.** Replicas share the content-addressed disk store
+  (engine/kvtier.py), so a failed-over request's prefix rehydrates on
+  its new replica instead of re-prefilling; the round journal
+  (debate/journal.py) keeps opponents that already COMPLETED from
+  ever re-issuing. Both are pinned end to end by ``tools/chaos_run.py
+  --replica-kill``.
+
+The chaos injector's ``replica`` seam fires before every group
+dispatch: an injected fault there exercises the breaker-skip path
+(the replica stays alive; its (replica, model) pairs absorb the
+failure) without killing any process.
+"""
+
+from __future__ import annotations
+
+from adversarial_spec_tpu import fleet as fleet_mod
+from adversarial_spec_tpu import obs as obs_mod
+from adversarial_spec_tpu.engine.types import ChatRequest, Completion, SamplingParams
+from adversarial_spec_tpu.fleet.hashring import HashRing
+from adversarial_spec_tpu.fleet.replica import (
+    InProcessReplica,
+    ReplicaDead,
+    WorkerReplica,
+)
+from adversarial_spec_tpu.resilience import breaker as breaker_mod
+from adversarial_spec_tpu.resilience import faults as faults_mod
+from adversarial_spec_tpu.resilience import injector
+
+
+class FleetRouter:
+    """Routes request groups across replicas; owns the replica
+    lifecycle state machine (alive → retired, one-way, through
+    ``_retire_replica`` only)."""
+
+    def __init__(
+        self,
+        replicas,
+        *,
+        breakers: breaker_mod.BreakerRegistry | None = None,
+        affinity: bool = True,
+        stats=None,
+    ):
+        self._replicas = {r.id: r for r in replicas}
+        self._ring = HashRing(self._replicas)
+        # Retired replicas and why — the lifecycle surgery's ledger,
+        # written ONLY by _retire_replica (GL-LIFECYCLE pins this).
+        self._dead: dict[str, str] = {}
+        self._affinity = bool(affinity)
+        self._rr = 0  # round-robin cursor (affinity=False control arm)
+        self._breakers = (
+            breakers if breakers is not None else breaker_mod.default_registry()
+        )
+        self.stats = stats if stats is not None else fleet_mod.stats
+        if obs_mod.config().enabled:
+            obs_mod.hot.fleet_replicas_alive.set(len(self._ring))
+
+    # -- membership --------------------------------------------------------
+
+    def alive_ids(self) -> list[str]:
+        return sorted(self._ring.nodes)
+
+    def replica(self, rid: str):
+        return self._replicas.get(rid)
+
+    def _retire_replica(self, rid: str, reason: str) -> None:
+        """THE lifecycle surgery: every path that removes a replica
+        from service funnels here (transport failure, heartbeat miss,
+        orderly shutdown) — ring membership, the dead-ledger, the
+        transport close, and the telemetry stay in one place."""
+        if rid in self._dead or rid not in self._replicas:
+            return
+        self._dead[rid] = reason
+        self._ring.remove(rid)
+        try:
+            self._replicas[rid].close()
+        except Exception:
+            pass  # a dead transport may fail its own close
+        self.stats.replicas_retired += 1
+        if obs_mod.config().enabled:
+            obs_mod.hot.replica_op("retire").inc()
+            obs_mod.hot.fleet_replicas_alive.set(len(self._ring))
+        obs_mod.emit(
+            obs_mod.ReplicaEvent(
+                replica=rid, op="retire", reason=reason, alive=len(self._ring)
+            )
+        )
+
+    def _on_replica_fault(self, rid: str, exc: BaseException) -> None:
+        """A replica's transport died mid-service: classify, count,
+        retire."""
+        faults_mod.record(faults_mod.classify(exc), "replica")
+        self._retire_replica(rid, "dead")
+
+    def _heartbeat_failure(self, rid: str) -> None:
+        self._retire_replica(rid, "heartbeat")
+
+    def shutdown(self) -> None:
+        for rid in self.alive_ids():
+            self._retire_replica(rid, "shutdown")
+        obs_mod.emit(obs_mod.ReplicaEvent(op="shutdown", alive=0))
+        if obs_mod.config().enabled:
+            obs_mod.hot.replica_op("shutdown").inc()
+
+    def health_check(self) -> None:
+        """One heartbeat round: ping every routable replica; a miss
+        drains it (retire + re-route of anything later submitted)."""
+        for rid in self.alive_ids():
+            rep = self._replicas[rid]
+            self.stats.heartbeats += 1
+            ok = False
+            try:
+                ok = rep.ping()
+            except Exception:
+                ok = False
+            if not ok:
+                self.stats.heartbeat_failures += 1
+                if obs_mod.config().enabled:
+                    obs_mod.hot.replica_op("heartbeat_miss").inc()
+                obs_mod.emit(
+                    obs_mod.ReplicaEvent(
+                        replica=rid,
+                        op="heartbeat_miss",
+                        alive=len(self._ring),
+                    )
+                )
+                self._heartbeat_failure(rid)
+
+    def check_invariants(self) -> None:
+        """Allocator/tier invariants on every routable replica."""
+        for rid in self.alive_ids():
+            self._replicas[rid].check()
+
+    def replica_stats(self) -> list[dict]:
+        return [self._replicas[rid].stats() for rid in self.alive_ids()]
+
+    # -- routing -----------------------------------------------------------
+
+    @staticmethod
+    def affinity_key(req: ChatRequest) -> str:
+        return req.affinity_key or req.model
+
+    def _choose(
+        self, req: ChatRequest, excluded: set[str]
+    ) -> tuple[str | None, str, bool]:
+        """Pick the replica for one request: (replica id | None,
+        route reason, is-affinity-primary). Walks the ring's
+        deterministic preference order (or round-robin with affinity
+        off), skipping excluded replicas (prior failover hops this
+        submit) and open (replica, model) breakers."""
+        key = self.affinity_key(req)
+        if self._affinity:
+            order = self._ring.preference(key)
+            reason = "affinity"
+        else:
+            alive = self.alive_ids()
+            self._rr += 1
+            cut = self._rr % len(alive) if alive else 0
+            order = alive[cut:] + alive[:cut]
+            reason = "random"
+        primary = order[0] if order else None
+        for rid in order:
+            if rid in excluded:
+                reason = "failover"
+                continue
+            if not self._breakers.allow(
+                breaker_mod.replica_key(rid, req.model)
+            ):
+                self.stats.breaker_skips += 1
+                reason = "breaker_open"
+                continue
+            return rid, reason, rid == primary and self._affinity
+        return None, reason, False
+
+    def _record_route(
+        self, i: int, req: ChatRequest, rid: str, hop: int, reason: str,
+        is_primary: bool,
+    ) -> None:
+        self.stats.routed_requests += 1
+        if is_primary:
+            self.stats.affinity_hits += 1
+        if hop > 0:
+            self.stats.failover_hops += 1
+        if obs_mod.config().enabled:
+            obs_mod.hot.route(reason).inc()
+            obs_mod.hot.fleet_affinity_ratio.set(
+                round(
+                    self.stats.affinity_hits / self.stats.routed_requests, 6
+                )
+            )
+        obs_mod.emit(
+            obs_mod.RouteEvent(
+                replica=rid,
+                req_id=i,
+                key=self.affinity_key(req),
+                model=req.model,
+                hop=hop,
+                reason=reason,
+                trace_id=req.trace_id,
+                span_id=req.span_id,
+            )
+        )
+
+    def _resolve(
+        self, rid: str, i: int, req: ChatRequest, comp: Completion, results
+    ) -> None:
+        """Finalize one request's completion — exactly once. A second
+        completion for an already-resolved request (a zombie replica
+        answering after its retirement) is counted and DROPPED: the
+        zero-duplicates invariant the chaos harness pins."""
+        if results[i] is not None:
+            self.stats.duplicated_completions += 1
+            return
+        results[i] = comp
+        self.stats.completed_requests += 1
+        pair = breaker_mod.replica_key(rid, req.model)
+        if comp.ok:
+            self._breakers.record(pair, ok=True)
+        else:
+            self._breakers.record(
+                pair,
+                ok=False,
+                kind=faults_mod.classify_message(comp.error or ""),
+            )
+
+    def submit(
+        self,
+        requests: list[ChatRequest],
+        params: SamplingParams,
+        consumer=None,
+    ) -> list[Completion]:
+        """Serve one request group across the fleet. Requests sharing
+        an affinity primary dispatch as one batch to it; a replica
+        death mid-group keeps the completions that landed and re-routes
+        only the remainder (hop+1), until every request resolves or no
+        routable replica remains."""
+        n = len(requests)
+        results: list[Completion | None] = [None] * n
+        hops = [0] * n
+        excluded: list[set[str]] = [set() for _ in range(n)]
+        pending = list(range(n))
+        while pending:
+            assign: dict[str, list[int]] = {}
+            for i in pending:
+                rid, reason, is_primary = self._choose(
+                    requests[i], excluded[i]
+                )
+                if rid is None:
+                    results[i] = Completion(
+                        error=(
+                            "UNAVAILABLE: fleet has no routable replica "
+                            f"for {requests[i].model} "
+                            f"({len(self._dead)} retired, "
+                            f"{self.stats.breaker_skips} breaker skip(s))"
+                        ),
+                        transient=False,
+                    )
+                    continue
+                self._record_route(
+                    i, requests[i], rid, hops[i], reason, is_primary
+                )
+                assign.setdefault(rid, []).append(i)
+            pending = []
+            for rid, idxs in assign.items():
+                rep = self._replicas[rid]
+                batch = [requests[i] for i in idxs]
+                got: dict[int, Completion] = {}
+                wrapped = None
+                if consumer is not None:
+                    # The consumer speaks the fleet batch's indexing;
+                    # remap each sub-batch row back to it.
+                    wrapped = (
+                        lambda j, text, idxs=idxs: consumer(idxs[j], text)
+                    )
+                try:
+                    # The replica chaos seam: an injected fault here is
+                    # a replica-level failure the breakers absorb — the
+                    # process stays up, the pair opens, routing drains.
+                    injector.fire("replica")
+                    rep.chat_batch(
+                        batch,
+                        params,
+                        consumer=wrapped,
+                        on_completion=lambda j, c: got.__setitem__(j, c),
+                    )
+                except ReplicaDead as e:
+                    for j, comp in e.partial.items():
+                        got.setdefault(j, comp)
+                    for j, comp in sorted(got.items()):
+                        self._resolve(rid, idxs[j], batch[j], comp, results)
+                    self._on_replica_fault(rid, e)
+                    for i in idxs:
+                        if results[i] is None:
+                            excluded[i].add(rid)
+                            hops[i] += 1
+                            self.stats.reissued_requests += 1
+                            pending.append(i)
+                    continue
+                except injector.InjectedFault as e:
+                    kind = faults_mod.classify(e)
+                    faults_mod.record(kind, "replica")
+                    for i in idxs:
+                        self._breakers.record(
+                            breaker_mod.replica_key(rid, requests[i].model),
+                            ok=False,
+                            kind=kind,
+                        )
+                        excluded[i].add(rid)
+                        hops[i] += 1
+                        pending.append(i)
+                    continue
+                for j, comp in sorted(got.items()):
+                    self._resolve(rid, idxs[j], batch[j], comp, results)
+                for i in idxs:
+                    if results[i] is None:
+                        # The transport returned without this request's
+                        # completion: treat as a failover hop.
+                        excluded[i].add(rid)
+                        hops[i] += 1
+                        self.stats.reissued_requests += 1
+                        pending.append(i)
+        return results  # type: ignore[return-value]
+
+
+class FleetEngine:
+    """The Engine-protocol face of a replica fleet: ``chat`` routes
+    through the fleet router; the debate layer cannot tell it from a
+    single engine (grouping, retries, breakers, journaling all work
+    unchanged — that is the point)."""
+
+    def __init__(
+        self,
+        replicas: int = 2,
+        transport: str = "inproc",
+        request_timeout_s: float = 30.0,
+        *,
+        engine_factory=None,
+        breakers: breaker_mod.BreakerRegistry | None = None,
+        affinity: bool = True,
+        worker_env: dict | None = None,
+        log_dir: str | None = None,
+        stats=None,
+    ):
+        n = max(1, int(replicas))
+        built = []
+        for k in range(n):
+            rid = f"r{k}"
+            if transport == "worker":
+                rep = WorkerReplica(
+                    rid,
+                    request_timeout_s=request_timeout_s,
+                    env=worker_env,
+                    log_dir=log_dir,
+                )
+            else:
+                rep = InProcessReplica(rid, engine_factory=engine_factory)
+            built.append(rep)
+            (stats if stats is not None else fleet_mod.stats).replicas_spawned += 1
+            if obs_mod.config().enabled:
+                obs_mod.hot.replica_op("spawn").inc()
+            obs_mod.emit(
+                obs_mod.ReplicaEvent(replica=rid, op="spawn", alive=k + 1)
+            )
+        self.router = FleetRouter(
+            built, breakers=breakers, affinity=affinity, stats=stats
+        )
+
+    def chat(
+        self,
+        requests: list[ChatRequest],
+        params: SamplingParams,
+        consumer=None,
+    ) -> list[Completion]:
+        self.router.health_check()
+        return self.router.submit(requests, params, consumer=consumer)
+
+    def validate(self, model: str) -> str | None:
+        last = f"fleet has no routable replica to validate {model!r}"
+        for rid in self.router.alive_ids():
+            try:
+                return self.router.replica(rid).validate(model)
+            except ReplicaDead as e:
+                last = str(e)
+                continue
+        return last
+
+    def shutdown(self) -> None:
+        self.router.shutdown()

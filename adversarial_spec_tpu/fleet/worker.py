@@ -1,0 +1,175 @@
+"""Fleet worker: one engine replica as a subprocess.
+
+``python -m adversarial_spec_tpu.fleet.worker --replica-id r0`` serves
+the line-delimited JSON protocol :class:`fleet.replica.WorkerReplica`
+speaks over stdin/stdout:
+
+- ``{"op": "chat", "requests": [...], "params": {...}}`` — serve the
+  group ONE REQUEST AT A TIME, writing ``{"i": <n>, "completion":
+  {...}}`` the moment each resolves, then ``{"done": true, "served":
+  <n>}``. Incremental delivery is the crash contract: a SIGKILL
+  mid-batch loses only the unserved remainder, and the router keeps
+  every line that landed.
+- ``{"op": "ping"}`` → ``{"pong": true}`` — the heartbeat probe.
+- ``{"op": "check"}`` → allocator + tier ``check_invariants`` on the
+  worker's engines (the chaos harness's clean-survivor assertion).
+- ``{"op": "stats"}`` → per-model serve counts plus the worker's
+  prefix-cache / kv-tier accounting (the store-coherent-recovery
+  assertion reads ``rehydrated_tokens`` here).
+- ``{"op": "validate", "model": ...}`` / ``{"op": "shutdown"}``.
+
+Trace ids ride the wire inside each request (``trace_id``/``span_id``
+fields), so every event this process emits resolves back to the round
+and opponent that caused it — the replica hop is invisible to causal
+tracing.
+
+``ADVSPEC_REPLICA_KILL_AFTER`` is the chaos trigger (mirroring the
+journal's ``ADVSPEC_JOURNAL_KILL_AFTER``): ``N`` or
+``<replica-id>:N`` SIGKILLs THIS process the instant its N-th
+completion line is flushed — a real kill at a deterministic
+mid-round point (``tools/chaos_run.py --replica-kill``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import traceback
+
+from adversarial_spec_tpu.engine.dispatch import new_engine
+from adversarial_spec_tpu.fleet.replica import (
+    check_engine_invariants,
+    completion_to_wire,
+    params_from_wire,
+    request_from_wire,
+)
+
+
+def _kill_after(replica_id: str) -> int:
+    """Parse ``ADVSPEC_REPLICA_KILL_AFTER`` (``N`` arms every worker,
+    ``<id>:N`` arms only the named replica). 0 = disarmed."""
+    raw = os.environ.get("ADVSPEC_REPLICA_KILL_AFTER", "")
+    if not raw:
+        return 0
+    target, sep, n = raw.rpartition(":")
+    if sep and target and target != replica_id:
+        return 0
+    try:
+        return max(0, int(n))
+    except ValueError:
+        return 0
+
+
+class _Worker:
+    def __init__(self, replica_id: str, out) -> None:
+        self.replica_id = replica_id
+        self.out = out
+        self._engines: dict[str, object] = {}
+        self.served: dict[str, int] = {}
+        self._n_served = 0
+        self._kill_after = _kill_after(replica_id)
+
+    def _engine_for(self, model: str):
+        key = model.partition("://")[0]
+        eng = self._engines.get(key)
+        if eng is None:
+            eng = self._engines[key] = new_engine(model)
+        return eng
+
+    def _write(self, obj: dict) -> None:
+        self.out.write(json.dumps(obj, separators=(",", ":")) + "\n")
+        self.out.flush()
+
+    def _chat(self, msg: dict) -> None:
+        requests = [request_from_wire(r) for r in msg.get("requests", [])]
+        params = params_from_wire(msg.get("params") or {})
+        for j, req in enumerate(requests):
+            try:
+                comp = self._engine_for(req.model).chat([req], params)[0]
+            except Exception as e:  # a request must not kill the worker
+                from adversarial_spec_tpu.engine.types import Completion
+                from adversarial_spec_tpu.resilience import faults
+
+                comp = Completion(
+                    error=f"{type(e).__name__}: {e}",
+                    transient=faults.is_transient(e),
+                )
+            self.served[req.model] = self.served.get(req.model, 0) + 1
+            self._write({"i": j, "completion": completion_to_wire(comp)})
+            self._n_served += 1
+            if self._kill_after and self._n_served >= self._kill_after:
+                # The chaos trigger: die HARD the instant this
+                # completion line is durable on the pipe — a real
+                # SIGKILL at a reproducible mid-round point.
+                os.kill(os.getpid(), signal.SIGKILL)
+        self._write({"done": True, "served": self._n_served})
+
+    def _stats(self) -> dict:
+        from adversarial_spec_tpu.engine import kvtier, prefix_cache
+
+        return {
+            "replica": self.replica_id,
+            "pid": os.getpid(),
+            "served": dict(self.served),
+            "prefix_cache": prefix_cache.snapshot(),
+            "kv_tier": kvtier.snapshot(),
+        }
+
+    def serve(self, lines) -> int:
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                msg = json.loads(line)
+                op = msg.get("op")
+                if op == "chat":
+                    self._chat(msg)
+                elif op == "ping":
+                    self._write({"pong": True, "replica": self.replica_id})
+                elif op == "validate":
+                    model = msg.get("model", "")
+                    try:
+                        err = self._engine_for(model).validate(model)
+                    except ValueError as e:
+                        # Unknown provider: a verdict, not a crash.
+                        err = str(e)
+                    self._write({"error": err})
+                elif op == "check":
+                    try:
+                        for eng in self._engines.values():
+                            check_engine_invariants(eng)
+                        self._write({"ok": True})
+                    except Exception as e:
+                        self._write({"ok": False, "error": str(e)})
+                elif op == "stats":
+                    self._write(self._stats())
+                elif op == "shutdown":
+                    self._write({"bye": True})
+                    return 0
+                else:
+                    self._write({"error": f"unknown op {op!r}"})
+            except BrokenPipeError:
+                return 1
+            except Exception:
+                # Protocol-level failure: report on stderr (the router
+                # treats a garbled line as replica death) and keep
+                # serving — a worker only exits on shutdown or EOF.
+                traceback.print_exc(file=sys.stderr)
+                self._write({"error": "internal worker error"})
+        return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--replica-id", default="r0")
+    args = ap.parse_args(argv)
+    worker = _Worker(args.replica_id, sys.stdout)
+    return worker.serve(sys.stdin)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

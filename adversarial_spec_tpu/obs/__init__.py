@@ -44,7 +44,9 @@ from adversarial_spec_tpu.obs.events import (  # noqa: F401 (re-export)
     FlightRecorder,
     JournalEvent,
     RecoveryEvent,
+    ReplicaEvent,
     RequestEvent,
+    RouteEvent,
     SpanEvent,
     SpecEvent,
     StepEvent,
@@ -168,6 +170,8 @@ class HotMetrics:
         "spec_acceptance",
         "cancel_tokens_saved",
         "journal_fsync",
+        "fleet_replicas_alive",
+        "fleet_affinity_ratio",
         "_m",
         "_sync",
         "_fault",
@@ -175,6 +179,8 @@ class HotMetrics:
         "_tier_hit",
         "_swap",
         "_cancel",
+        "_route",
+        "_replica_op",
     )
 
     def __init__(self, m: MetricsRegistry) -> None:
@@ -253,12 +259,27 @@ class HotMetrics:
             "advspec_journal_fsync_seconds",
             help="round-journal fsync'd append wall",
         )
+        # Fleet topology (fleet/router.py): routable replica count and
+        # the round's affinity hit ratio (requests the ring's PRIMARY
+        # choice actually served — failover and breaker-open hops
+        # lower it, which is exactly what the gauge is for).
+        self.fleet_replicas_alive = m.gauge(
+            "advspec_fleet_replicas_alive",
+            help="routable engine replicas in the fleet ring",
+        )
+        self.fleet_affinity_ratio = m.gauge(
+            "advspec_fleet_affinity_hit_ratio",
+            help="requests served by their affinity-primary replica "
+            "(this round)",
+        )
         self._sync: dict = {}
         self._fault: dict = {}
         self._breaker: dict = {}
         self._tier_hit: dict = {}
         self._swap: dict = {}
         self._cancel: dict = {}
+        self._route: dict = {}
+        self._replica_op: dict = {}
 
     def sync(self, reason: str):
         c = self._sync.get(reason)
@@ -312,6 +333,30 @@ class HotMetrics:
                 "advspec_cancelled_total",
                 help="mid-decode request cancellations by reason",
                 reason=reason,
+            )
+        return c
+
+    def route(self, reason: str):
+        """Fleet routing decisions by reason (affinity = the ring's
+        primary choice; breaker_open/failover = a re-route hop)."""
+        c = self._route.get(reason)
+        if c is None:
+            c = self._route[reason] = self._m.counter(
+                "advspec_fleet_routes_total",
+                help="fleet routing decisions by reason",
+                reason=reason,
+            )
+        return c
+
+    def replica_op(self, op: str):
+        """Fleet replica lifecycle transitions by op (fleet/router.py
+        state machine: spawn/ready/heartbeat_miss/retire/shutdown)."""
+        c = self._replica_op.get(op)
+        if c is None:
+            c = self._replica_op[op] = self._m.counter(
+                "advspec_fleet_replica_events_total",
+                help="fleet replica lifecycle transitions by op",
+                op=op,
             )
         return c
 

@@ -40,6 +40,12 @@ Event vocabulary (the schema ``tools/obs_dump.py`` validates):
   fsync wall) or journal-serve decision (debate/journal.py).
 - ``RecoveryEvent`` — one journal replay at round start: how many
   opponents were served from durable records vs re-issued.
+- ``ReplicaEvent`` — one fleet-replica lifecycle transition
+  (spawn/ready/heartbeat_miss/retire/shutdown) with the post-op alive
+  count (fleet/router.py).
+- ``RouteEvent`` — one fleet routing decision: which replica a request
+  landed on, the affinity key it hashed, and the failover hop count
+  (0 = the ring's primary choice).
 
 Causal tracing (obs/trace.py): EVERY event additionally carries
 ``trace_id`` (the debate round that caused it) and ``span_id`` (the
@@ -250,6 +256,47 @@ class RecoveryEvent:
     span_id: str = ""
 
 
+@dataclass(slots=True)
+class ReplicaEvent:
+    """One fleet-replica lifecycle transition (fleet/router.py state
+    machine). ``op`` names the edge: spawn (handle created), ready
+    (transport answered its first ping), heartbeat_miss (a health
+    probe failed), retire (the shared retirement surgery ran — the
+    replica left the ring and its in-flight work was re-routed),
+    shutdown (orderly fleet teardown). ``alive`` is the routable
+    replica count AFTER the op, so the timeline shows capacity
+    draining the moment it happens."""
+
+    TYPE = "replica"
+    replica: str = ""
+    op: str = "spawn"
+    reason: str = ""  # retire cause: dead | heartbeat | fault | shutdown
+    alive: int = 0  # routable replicas after this op
+    trace_id: str = ""
+    span_id: str = ""
+
+
+@dataclass(slots=True)
+class RouteEvent:
+    """One fleet routing decision (fleet/router.py). ``hop`` counts
+    failover re-routes for the request (0 = the consistent-hash ring's
+    primary choice for its affinity key); ``reason`` says why THIS
+    replica: affinity (primary), breaker_open (primary's per-
+    (replica, model) circuit was open), failover (an earlier hop's
+    replica died mid-request), random (affinity routing disabled —
+    the bench's control arm)."""
+
+    TYPE = "route"
+    replica: str = ""
+    req_id: int = -1
+    key: str = ""  # affinity key the ring hashed
+    model: str = ""
+    hop: int = 0
+    reason: str = "affinity"
+    trace_id: str = ""
+    span_id: str = ""
+
+
 EVENT_TYPES = (
     StepEvent,
     RequestEvent,
@@ -263,6 +310,8 @@ EVENT_TYPES = (
     SpanEvent,
     JournalEvent,
     RecoveryEvent,
+    ReplicaEvent,
+    RouteEvent,
 )
 
 # ``cancelled`` closes a request envelope mid-decode (streaming early
@@ -278,6 +327,21 @@ SWAP_OPS = (
     "store",
     "free",
     "quarantine",
+)
+
+REPLICA_OPS = (
+    "spawn",
+    "ready",
+    "heartbeat_miss",
+    "retire",
+    "shutdown",
+)
+
+ROUTE_REASONS = (
+    "affinity",
+    "breaker_open",
+    "failover",
+    "random",
 )
 
 REQUEST_STATES = (
@@ -354,6 +418,10 @@ def validate_event(obj) -> list[str]:
         errors.append(f"swap: unknown op {obj.get('op')!r}")
     if etype == "span" and obj.get("phase") not in SPAN_PHASES:
         errors.append(f"span: unknown phase {obj.get('phase')!r}")
+    if etype == "replica" and obj.get("op") not in REPLICA_OPS:
+        errors.append(f"replica: unknown op {obj.get('op')!r}")
+    if etype == "route" and obj.get("reason") not in ROUTE_REASONS:
+        errors.append(f"route: unknown reason {obj.get('reason')!r}")
     return errors
 
 

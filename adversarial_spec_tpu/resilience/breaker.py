@@ -36,6 +36,23 @@ CLOSED = "closed"
 OPEN = "open"
 HALF_OPEN = "half_open"
 
+# A half-open probe that fails with a NON-TRANSIENT fault (FaultKind.BUG
+# — a deterministic error no amount of waiting clears) re-opens HARD:
+# the cooldown scales by this factor, so the registry stops burning one
+# failed probe per cooldown on a model that cannot recover by itself,
+# while still re-probing eventually (a redeploy does fix bugs).
+HARD_OPEN_FACTOR = 8.0
+
+
+def replica_key(replica: str, model: str) -> str:
+    """The breaker key for one (replica, model) pair — the fleet
+    router's generalization of the per-model breaker: replica r0
+    failing a model must not ban the model on r1, and a model failing
+    everywhere still opens each pair (plus the debate layer's bare
+    per-model breaker). The registry is keyed by opaque strings, so
+    pairs and bare models coexist in one registry."""
+    return f"{replica}::{model}"
+
 
 class CircuitBreaker:
     """Breaker for ONE model. Not thread-safe on its own — the registry
@@ -58,6 +75,9 @@ class CircuitBreaker:
         self.failures = 0  # consecutive failures while closed
         self.opened_at: float | None = None
         self.last_fault: FaultKind | None = None
+        # Set when a half-open probe failed NON-transiently: the next
+        # re-probe waits HARD_OPEN_FACTOR cooldowns (see module note).
+        self.hard_open = False
         self._probe_inflight = False
         self._probe_started = 0.0
         # Monotonic per-target-state transition counts (telemetry source
@@ -84,6 +104,12 @@ class CircuitBreaker:
                 obs_mod.hot.breaker(state).inc()
             self.state = state
 
+    def effective_cooldown(self) -> float:
+        """The wait before the next half-open probe: the configured
+        cooldown, scaled up when the LAST probe failed non-transiently
+        (a BUG does not heal by waiting — probe rarely, not never)."""
+        return self.cooldown_s * (HARD_OPEN_FACTOR if self.hard_open else 1.0)
+
     def allow(self) -> bool:
         """May this model be queried right now? Transitions OPEN →
         HALF_OPEN when the cooldown has elapsed; in HALF_OPEN exactly one
@@ -91,7 +117,7 @@ class CircuitBreaker:
         if self.state == CLOSED:
             return True
         if self.state == OPEN:
-            if self._clock() - (self.opened_at or 0.0) >= self.cooldown_s:
+            if self._clock() - (self.opened_at or 0.0) >= self.effective_cooldown():
                 self._set(HALF_OPEN)
                 self._probe_inflight = True
                 self._probe_started = self._clock()
@@ -112,6 +138,7 @@ class CircuitBreaker:
         self._probe_inflight = False
         self.failures = 0
         self.last_fault = None
+        self.hard_open = False
         self._set(CLOSED)
 
     def record_failure(self, kind: FaultKind = FaultKind.BUG) -> None:
@@ -119,6 +146,13 @@ class CircuitBreaker:
         self.last_fault = kind
         if self.state == HALF_OPEN:
             # Failed probe: straight back to OPEN, cooldown restarts.
+            # A TRANSIENT probe fault (OOM, preemption, timeout) may
+            # clear by itself, so the normal cooldown re-probes; a
+            # NON-transient one (FaultKind.BUG — deterministic) opens
+            # HARD: re-probing every cooldown would burn one failed
+            # request per cycle proving the same bug, so the next probe
+            # waits HARD_OPEN_FACTOR cooldowns instead.
+            self.hard_open = not kind.transient
             self.opened_at = self._clock()
             self.failures = 0
             self._set(OPEN)
@@ -203,7 +237,10 @@ class BreakerRegistry:
         with self._lock:
             if b.state != OPEN or b.opened_at is None:
                 return 0.0
-            return max(0.0, b.cooldown_s - (self._clock() - b.opened_at))
+            return max(
+                0.0,
+                b.effective_cooldown() - (self._clock() - b.opened_at),
+            )
 
     def states(self) -> dict[str, dict]:
         """Per-model snapshot for the ``--json`` resilience report."""
@@ -247,7 +284,7 @@ class BreakerRegistry:
                 if b.state in (OPEN, HALF_OPEN) and b.opened_at is not None:
                     remaining = max(
                         0.0,
-                        b.cooldown_s - (self._clock() - b.opened_at),
+                        b.effective_cooldown() - (self._clock() - b.opened_at),
                     )
                 out[model] = {
                     # A probe that never reported is presumed lost: a
@@ -256,6 +293,7 @@ class BreakerRegistry:
                     "state": OPEN if b.state == HALF_OPEN else b.state,
                     "failures": b.failures,
                     "cooldown_remaining": remaining,
+                    "hard": b.hard_open,
                     "last_fault": b.last_fault.value if b.last_fault else None,
                 }
             return out
@@ -267,11 +305,14 @@ class BreakerRegistry:
                 b.failures = int(data.get("failures", 0))
                 last = data.get("last_fault")
                 b.last_fault = FaultKind(last) if last else None
+                b.hard_open = bool(data.get("hard", False))
                 if data.get("state") == OPEN:
                     # Not a transition (no counter): resumed state.
                     b.state = OPEN
                     remaining = float(data.get("cooldown_remaining", 0.0))
-                    b.opened_at = self._clock() - (b.cooldown_s - remaining)
+                    b.opened_at = self._clock() - (
+                        b.effective_cooldown() - remaining
+                    )
 
 
 # -- default process registry ---------------------------------------------

@@ -21,6 +21,12 @@ seam                fires just before
                     must survive it (journal failure is contained, the
                     kill-chaos harness proves the stronger SIGKILL
                     variant)
+``replica``         each fleet group dispatch (fleet/router.py) — a
+                    fault here is a replica-level failure the
+                    per-(replica, model) breakers absorb: the request
+                    re-routes, the pair's circuit counts the hit, and
+                    no process dies (the SIGKILL variant lives in
+                    ``tools/chaos_run.py --replica-kill``)
 ==================  =====================================================
 
 Configure with ``--chaos`` on the CLI or ``ADVSPEC_CHAOS`` in the
@@ -59,6 +65,7 @@ SEAMS = (
     "kv_swap",
     "checkpoint_load",
     "crash",
+    "replica",
 )
 
 # Marker text per kind: mirrors what PJRT/XLA put in real messages so the

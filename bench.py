@@ -64,6 +64,13 @@ Modes:
                                   # fraction of round tokens salvaged
                                   # (journal + KV disk store) vs a cold
                                   # re-run; writes BENCH_recover.json
+  python bench.py --mode fleet    # replicated engines: aggregate
+                                  # mock tokens/s of 3 replicas with
+                                  # prefix-affinity routing vs 1
+                                  # replica, affinity vs random
+                                  # cross-round cache hit-rate, plus
+                                  # the replica-kill recovery drill;
+                                  # writes BENCH_fleet.json
   --no-interleave                 # escape hatch for any batcher-driven
                                   # mode: run the legacy serialized loop
                                   # (equivalent to ADVSPEC_INTERLEAVE=0)
@@ -1392,6 +1399,165 @@ def _run_recover(platform: str) -> dict:
     }
 
 
+def _run_fleet(platform: str) -> dict:
+    """Fleet bench (deterministic CPU mock — writes BENCH_fleet.json):
+
+    A multi-debate workload (6 debates x 3 rounds x 3 opponents, each
+    debate its own document) runs through the fleet router three ways:
+
+    - **single** — 1 in-process replica (the pre-fleet topology's
+      capacity: every debate serializes onto one engine's busy clock);
+    - **multi/affinity** — 3 replicas, prefix-affinity routing (each
+      debate consistent-hashes onto one replica, so rounds 2+ re-hit
+      the prefix KV that replica already holds);
+    - **multi/random** — 3 replicas, round-robin routing (the control
+      arm: a debate's rounds scatter, so cross-round prefix reuse
+      mostly misses).
+
+    Busy seconds are the mock's synthetic tokens/1024 clock summed per
+    replica (prefill actually computed + decode produced), so the
+    aggregate-throughput model is deterministic: single-replica
+    tokens/s divides by the ONE replica's busy clock, fleet tokens/s
+    by the SLOWEST replica's (replicas serve debates concurrently).
+    Headline: the >= 2-replica aggregate speedup (budget > 1x), with
+    affinity's cross-round cache saved-fraction required to beat
+    random routing, transcripts byte-identical across all three arms,
+    and the replica-kill recovery drill (tools/chaos_run.py
+    --replica-kill: SIGKILL one of 2 worker replicas mid-round) green.
+    Escape hatch: --no-fleet (ADVSPEC_FLEET=0) keeps the single-engine
+    topology.
+    """
+    from adversarial_spec_tpu import fleet as fleet_mod
+    from adversarial_spec_tpu.engine import kvtier
+    from adversarial_spec_tpu.engine import prefix_cache as prefix_mod
+    from adversarial_spec_tpu.engine.types import ChatRequest, SamplingParams
+    from adversarial_spec_tpu.fleet.router import FleetEngine
+
+    n_debates, n_rounds, n_opp = 6, 3, 3
+    docs = [
+        f"## Spec {d}\n"
+        + "The allocator SHALL bound page reuse by refcount. " * 40
+        + f"\nDebate {d}'s own constraint body, revision zero.\n"
+        for d in range(n_debates)
+    ]
+    params = SamplingParams()
+
+    # The affinity phase measures DEVICE-cache reuse: tiering off so a
+    # random-routed miss is a genuine re-prefill, not a disk save.
+    kvtier.configure(enabled=False)
+
+    def run_arm(replicas: int, affinity: bool) -> dict:
+        prefix_mod.configure(enabled=True, max_pages=0)
+        prefix_mod.reset_stats()
+        fleet_mod.reset_stats()
+        engine = FleetEngine(
+            replicas=replicas, transport="inproc", affinity=affinity
+        )
+        transcripts = []
+        for r in range(1, n_rounds + 1):
+            for d in range(n_debates):
+                reqs = [
+                    ChatRequest(
+                        model=f"mock://critic?v={k}",
+                        system="You are an adversarial spec reviewer.",
+                        user=(
+                            f"Debate round {r}\n--- DOCUMENT ---\n"
+                            f"{docs[d]}\n--- END DOCUMENT ---"
+                        ),
+                        affinity_key=f"debate-{d}",
+                    )
+                    for k in range(n_opp)
+                ]
+                comps = engine.chat(reqs, params)
+                if not all(c.ok for c in comps):
+                    raise RuntimeError("mock fleet round failed")
+                transcripts.extend(c.text for c in comps)
+        busys = sorted(
+            (s["busy_s"] for s in engine.router.replica_stats()),
+            reverse=True,
+        )
+        snap = prefix_mod.snapshot()
+        fleet_snap = fleet_mod.snapshot()
+        engine.shutdown()
+        total = snap["prefilled_tokens"] + snap["saved_tokens"]
+        decode = sum(_estimate(t) for t in transcripts)
+        saved_fraction = snap["saved_tokens"] / total if total else 0.0
+        return {
+            "replicas": replicas,
+            "affinity": affinity,
+            "transcripts": transcripts,
+            "busy_s": [round(b, 6) for b in busys],
+            "tokens": int(snap["prefilled_tokens"] + decode),
+            "tokens_per_s": round(
+                (snap["prefilled_tokens"] + decode) / busys[0], 1
+            ),
+            "cache_saved_fraction": round(saved_fraction, 4),
+            "affinity_hit_rate": fleet_snap["affinity_hit_rate"],
+        }
+
+    def _estimate(text: str) -> int:
+        return max(1, len(text) // 4)
+
+    single = run_arm(1, affinity=True)
+    multi = run_arm(3, affinity=True)
+    random_arm = run_arm(3, affinity=False)
+
+    transcripts_ok = (
+        single["transcripts"] == multi["transcripts"]
+        and single["transcripts"] == random_arm["transcripts"]
+    )
+    speedup = (
+        multi["tokens_per_s"] / single["tokens_per_s"]
+        if single["tokens_per_s"]
+        else 0.0
+    )
+
+    # Phase 2: the replica-loss recovery drill (worker subprocesses,
+    # SIGKILL mid-round) — shared with tools/chaos_run.py so the bench
+    # and the drill can never test different contracts.
+    from tools.chaos_run import run_replica_kill
+
+    kill_failures, kill_payload = run_replica_kill(verbose=False)
+
+    for arm in (single, multi, random_arm):
+        arm.pop("transcripts")
+    within = (
+        speedup > 1.0
+        and multi["cache_saved_fraction"] > random_arm["cache_saved_fraction"]
+        and transcripts_ok
+        and not kill_failures
+    )
+    return {
+        "metric": "fleet_aggregate_speedup",
+        "value": round(speedup, 3),
+        "unit": "aggregate mock tokens/s, 3 replicas w/ prefix-affinity "
+        "routing vs 1 replica, equal workload",
+        "vs_baseline": None,  # no published fleet baseline
+        "platform": platform,
+        "within_budget": within,
+        "budget": 1.0,
+        "workload": {
+            "debates": n_debates,
+            "rounds": n_rounds,
+            "opponents": n_opp,
+        },
+        "single": single,
+        "multi_affinity": multi,
+        "multi_random": random_arm,
+        "affinity_vs_random_saved_fraction": [
+            multi["cache_saved_fraction"],
+            random_arm["cache_saved_fraction"],
+        ],
+        "transcripts_byte_identical": transcripts_ok,
+        "replica_kill": {
+            **kill_payload,
+            "failures": kill_failures,
+            "ok": not kill_failures,
+        },
+        "escape_hatch": "--no-fleet (ADVSPEC_FLEET=0)",
+    }
+
+
 def _run_obs_overhead(platform: str) -> dict:
     """Observability overhead bench: what fraction of the mock mixed
     workload's wall the recorder+metrics emit path costs. Budget < 3%
@@ -1671,6 +1837,7 @@ def main() -> int:
     tier_mode = _mode("tier")
     cancel_mode = _mode("cancel")
     recover_mode = _mode("recover")
+    fleet_mode = _mode("fleet")
     if "--no-speculative" in args:
         # Escape hatch mirror of --no-interleave: batcher-driven modes
         # (and any TPU child) decode token-at-a-time.
@@ -1696,6 +1863,8 @@ def main() -> int:
         mode_flag, runner = "--cancel", _run_cancel
     elif recover_mode:
         mode_flag, runner = "--recover", _run_recover
+    elif fleet_mode:
+        mode_flag, runner = "--fleet", _run_fleet
     else:
         mode_flag, runner = "", _run_bench
 
@@ -1712,10 +1881,11 @@ def main() -> int:
         os.rename(tmp, out_path)
         return 0
 
-    if obs_mode or recover_mode:
+    if obs_mode or recover_mode or fleet_mode:
         # Mock-only workloads — no jax, no device, no TPU probe: the
         # obs budget is a CPU host-overhead pin by definition, and the
-        # recovery drill is subprocess-driven mock rounds.
+        # recovery/fleet drills are mock rounds (in-process replicas
+        # plus SIGKILL-able subprocess workers).
         payload = runner("cpu")
     elif os.environ.get("BENCH_FORCE_CPU") == "1" or not _probe_tpu():
         payload = _run_cpu_fallback(runner)
@@ -1738,6 +1908,7 @@ def main() -> int:
         or tier_mode
         or cancel_mode
         or recover_mode
+        or fleet_mode
     ):
         # Persist the perf trajectory point alongside the BENCH_r*
         # series the driver records.
@@ -1755,6 +1926,8 @@ def main() -> int:
             else "BENCH_cancel.json"
             if cancel_mode
             else "BENCH_recover.json"
+            if recover_mode
+            else "BENCH_fleet.json"
         )
         out = os.path.join(
             os.path.dirname(os.path.abspath(__file__)), name

@@ -99,6 +99,22 @@ def _isolate_state(tmp_path, monkeypatch):
         enabled=False, host_mb=kvtier.DEFAULT_HOST_MB, store_dir=""
     )
     kvtier.reset_stats()
+    # Fleet config/stats are process-global by design (the replica
+    # topology outlives a round); tests must not leak an armed fleet,
+    # spawned replicas, or routing counts into each other. Fleet OFF
+    # is the product default — fleet coverage opts in explicitly in
+    # tests/test_fleet.py (clear_engine_cache above already tears the
+    # process fleet engine down).
+    from adversarial_spec_tpu import fleet
+
+    monkeypatch.delenv("ADVSPEC_FLEET", raising=False)
+    monkeypatch.delenv("ADVSPEC_FLEET_REPLICAS", raising=False)
+    monkeypatch.delenv("ADVSPEC_FLEET_TRANSPORT", raising=False)
+    monkeypatch.delenv("ADVSPEC_REPLICA_KILL_AFTER", raising=False)
+    fleet.configure(
+        enabled=False, replicas=fleet.DEFAULT_REPLICAS, transport="inproc"
+    )
+    fleet.reset_stats()
     # Streaming config/stats are process-global by design (the CLI arms
     # them per round); tests must not leak a --no-stream / cancel
     # counts into each other. Defaults (stream + early-cancel on) are
@@ -129,6 +145,10 @@ def _isolate_state(tmp_path, monkeypatch):
     obs.retrace.clear()
     yield
     dispatch.clear_engine_cache()
+    fleet.configure(
+        enabled=False, replicas=fleet.DEFAULT_REPLICAS, transport="inproc"
+    )
+    fleet.reset_stats()
     breaker.reset_default_registry()
     prefix_cache.configure(enabled=True, max_pages=0)
     prefix_cache.reset_stats()

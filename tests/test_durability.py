@@ -94,7 +94,63 @@ class TestJournalUnit:
         j.ensure_round_start(1, SPEC, ["m1", "m2"], {})
         j.log_completion(1, 0, "m1", _completion(), 0.1)
         served = j.replay(1, SPEC, ["OTHER", "m2"])
-        assert served == {}  # index 0 now names a different model
+        assert served == {}  # the model SET changed: clean full refusal
+
+    def test_permuted_pool_serves_each_completion_to_its_model(self):
+        """A resume whose opponent-pool ORDER changed (same models,
+        permuted) still serves every completion — re-homed to its
+        model's new index, decided by the per-index model match."""
+        j = RoundJournal("t3p")
+        models = ["m1", "m2", "m3"]
+        j.ensure_round_start(1, SPEC, models, {})
+        for i, m in enumerate(models):
+            j.log_completion(1, i, m, _completion(f"text-{m}"), 0.1)
+        permuted = ["m3", "m1", "m2"]
+        served = j.replay(1, SPEC, permuted)
+        assert sorted(served) == [0, 1, 2]
+        for new_idx, model in enumerate(permuted):
+            comp, _ = completion_from_record(served[new_idx])
+            assert comp.text == f"text-{model}"  # the RIGHT model's text
+
+    def test_permuted_pool_partial_records_rehome_too(self):
+        """Only some opponents completed before the crash: the ones
+        that did re-home; the rest re-issue at their new indices."""
+        j = RoundJournal("t3q")
+        j.ensure_round_start(1, SPEC, ["m1", "m2", "m3"], {})
+        j.log_completion(1, 0, "m1", _completion("text-m1"), 0.1)
+        j.log_completion(1, 2, "m3", _completion("text-m3"), 0.1)
+        served = j.replay(1, SPEC, ["m2", "m3", "m1"])
+        assert sorted(served) == [1, 2]  # m3 at 1, m1 at 2; m2 re-issues
+        assert completion_from_record(served[1])[0].text == "text-m3"
+        assert completion_from_record(served[2])[0].text == "text-m1"
+
+    def test_duplicate_model_ids_keep_the_strict_index_match(self):
+        """Duplicated ids make re-homing ambiguous: only records whose
+        recorded index still names their model replay."""
+        j = RoundJournal("t3r")
+        j.ensure_round_start(1, SPEC, ["dup", "dup", "m3"], {})
+        j.log_completion(1, 0, "dup", _completion("a"), 0.1)
+        j.log_completion(1, 1, "dup", _completion("b"), 0.1)
+        j.log_completion(1, 2, "m3", _completion("c"), 0.1)
+        served = j.replay(1, SPEC, ["dup", "m3", "dup"])
+        # dup@0 matches in place; m3 re-homes to 1; the second dup is
+        # ambiguous (count != 1) and re-issues.
+        assert sorted(served) == [0, 1]
+        assert completion_from_record(served[0])[0].text == "a"
+        assert completion_from_record(served[1])[0].text == "c"
+
+    def test_changed_model_set_refuses_replay_cleanly(self):
+        """A grown/shrunk/substituted pool invalidates the ROUND's
+        records wholesale — no crash, no half-replay."""
+        j = RoundJournal("t3s")
+        j.ensure_round_start(1, SPEC, ["m1", "m2"], {})
+        j.log_completion(1, 0, "m1", _completion(), 0.1)
+        j.log_completion(1, 1, "m2", _completion(), 0.1)
+        assert j.replay(1, SPEC, ["m1", "m2", "m3"]) == {}  # grown
+        assert j.replay(1, SPEC, ["m1"]) == {}  # shrunk
+        assert j.replay(1, SPEC, ["m1", "mX"]) == {}  # substituted
+        # The unchanged pool (any order) still replays everything.
+        assert sorted(j.replay(1, SPEC, ["m2", "m1"])) == [0, 1]
 
     def test_torn_tail_tolerated(self):
         j = RoundJournal("t4")

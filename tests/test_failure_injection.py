@@ -270,16 +270,81 @@ class TestCircuitBreaker:
         assert reg.allow("m")
 
     def test_failed_probe_reopens_with_fresh_cooldown(self):
+        """A TRANSIENT probe failure re-enters the normal cooldown
+        cycle — the fault may clear by itself, so re-probe on
+        schedule."""
         reg, clock = self._registry(threshold=1, cooldown=10.0)
-        reg.record("m", ok=False)
+        reg.record("m", ok=False, kind=FaultKind.OOM)
         clock[0] = 10.0
         assert reg.allow("m")
-        reg.record("m", ok=False)
+        reg.record("m", ok=False, kind=FaultKind.OOM)
         assert reg.breaker("m").state == OPEN
+        assert not reg.breaker("m").hard_open
         clock[0] = 19.0  # 9s into the NEW cooldown
         assert not reg.allow("m")
         clock[0] = 20.0
         assert reg.allow("m")
+
+    def test_non_transient_probe_failure_opens_hard(self):
+        """The satellite fix: a half-open probe failing with a
+        NON-transient FaultKind (BUG — deterministic, waiting does not
+        heal it) must not re-enter the normal cooldown like a
+        transient one: the next probe waits HARD_OPEN_FACTOR (8x)
+        cooldowns instead of burning one failed request per cycle."""
+        from adversarial_spec_tpu.resilience.breaker import HARD_OPEN_FACTOR
+
+        reg, clock = self._registry(threshold=1, cooldown=10.0)
+        reg.record("m", ok=False, kind=FaultKind.OOM)
+        clock[0] = 10.0
+        assert reg.allow("m")  # the probe
+        reg.record("m", ok=False, kind=FaultKind.BUG)  # deterministic
+        b = reg.breaker("m")
+        assert b.state == OPEN and b.hard_open
+        # One normal cooldown later: still hard-open, NO probe.
+        clock[0] = 20.0
+        assert not reg.allow("m")
+        assert reg.cooldown_remaining("m") == 10.0 * (HARD_OPEN_FACTOR - 1)
+        # The scaled cooldown elapses: probe again (bugs do get fixed
+        # by redeploys — rarely is not never).
+        clock[0] = 10.0 + 10.0 * HARD_OPEN_FACTOR
+        assert reg.allow("m")
+        # A successful probe clears the hard flag entirely.
+        reg.record("m", ok=True)
+        assert b.state == CLOSED and not b.hard_open
+
+    def test_hard_open_survives_the_session_snapshot(self):
+        """The hard flag and its scaled remaining cooldown cross the
+        process boundary with the rest of the breaker snapshot."""
+        reg, clock = self._registry(threshold=1, cooldown=10.0)
+        reg.record("m", ok=False, kind=FaultKind.OOM)
+        clock[0] = 10.0
+        assert reg.allow("m")
+        reg.record("m", ok=False, kind=FaultKind.BUG)
+        clock[0] = 30.0  # 20s into the 80s hard cooldown
+        snap = reg.snapshot_for_resume()
+        assert snap["m"]["hard"] is True
+        assert snap["m"]["cooldown_remaining"] == 60.0
+
+        reg2, clock2 = self._registry(threshold=1, cooldown=10.0)
+        reg2.restore(snap)
+        assert reg2.breaker("m").hard_open
+        clock2[0] = 59.0
+        assert not reg2.allow("m")
+        clock2[0] = 60.0
+        assert reg2.allow("m")
+
+    def test_replica_key_namespaces_pairs(self):
+        """The fleet generalization: (replica, model) pairs and bare
+        model ids coexist in one registry without crosstalk."""
+        from adversarial_spec_tpu.resilience.breaker import replica_key
+
+        reg, _ = self._registry(threshold=1)
+        pair = replica_key("r0", "tpu://m")
+        assert pair == "r0::tpu://m"
+        reg.record(pair, ok=False)
+        assert not reg.allow(pair)
+        assert reg.allow("tpu://m")  # the bare model is unaffected
+        assert reg.allow(replica_key("r1", "tpu://m"))  # other replicas too
 
     def test_transition_counters_and_states(self):
         reg, clock = self._registry(threshold=1, cooldown=5.0)

@@ -1,0 +1,707 @@
+"""Fleet layer tests: hash ring, replicas, router, failover, chaos.
+
+Most coverage runs on in-process replicas (fresh mock engines — fully
+deterministic, no subprocesses); the worker transport gets one focused
+protocol test plus the tier-1 replica-kill chaos smoke (the full drill
+from tools/chaos_run.py --replica-kill, marked ``chaos``), which pins
+the lose-a-replica-lose-nothing contract with real SIGKILLs.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from adversarial_spec_tpu import fleet as fleet_mod
+from adversarial_spec_tpu import obs as obs_mod
+from adversarial_spec_tpu.engine.types import ChatRequest, Completion, SamplingParams
+from adversarial_spec_tpu.fleet.hashring import HashRing
+from adversarial_spec_tpu.fleet.replica import (
+    InProcessReplica,
+    ReplicaDead,
+    WorkerReplica,
+)
+from adversarial_spec_tpu.fleet.router import FleetEngine, FleetRouter
+from adversarial_spec_tpu.resilience import breaker as breaker_mod
+from adversarial_spec_tpu.resilience import injector as injector_mod
+from adversarial_spec_tpu.resilience.injector import FaultInjector, parse_chaos_spec
+
+PARAMS = SamplingParams()
+
+
+def _req(model="mock://critic", key="debate-A", user=None, **kw):
+    return ChatRequest(
+        model=model,
+        system="You are a reviewer.",
+        user=(
+            user
+            if user is not None
+            else "Debate round 1\n--- DOCUMENT ---\nA spec body.\n"
+            "--- END DOCUMENT ---"
+        ),
+        affinity_key=key,
+        **kw,
+    )
+
+
+class TestHashRing:
+    def test_deterministic_and_sticky(self):
+        a = HashRing(["r0", "r1", "r2"])
+        b = HashRing(["r2", "r0", "r1"])  # insertion order irrelevant
+        for key in (f"debate-{i}" for i in range(20)):
+            assert a.primary(key) == b.primary(key)
+            assert a.primary(key) == a.primary(key)
+
+    def test_preference_is_distinct_and_complete(self):
+        ring = HashRing(["r0", "r1", "r2"])
+        pref = ring.preference("debate-x")
+        assert sorted(pref) == ["r0", "r1", "r2"]
+        assert pref[0] == ring.primary("debate-x")
+
+    def test_membership_change_moves_only_the_affected_arc(self):
+        """The consistent-hashing contract: removing one replica moves
+        ONLY the keys it owned; everyone else's cache stays warm."""
+        ring = HashRing(["r0", "r1", "r2"])
+        keys = [f"debate-{i}" for i in range(64)]
+        before = {k: ring.primary(k) for k in keys}
+        ring.remove("r1")
+        for k in keys:
+            if before[k] != "r1":
+                assert ring.primary(k) == before[k]
+        ring.add("r1")
+        assert {k: ring.primary(k) for k in keys} == before
+
+    def test_keys_spread_across_replicas(self):
+        ring = HashRing(["r0", "r1", "r2"])
+        owners = {ring.primary(f"debate-{i}") for i in range(64)}
+        assert owners == {"r0", "r1", "r2"}
+
+    def test_empty_ring(self):
+        ring = HashRing()
+        assert ring.primary("k") is None
+        assert ring.preference("k") == []
+
+
+class TestFleetConfig:
+    def test_env_defaults(self, monkeypatch):
+        monkeypatch.delenv("ADVSPEC_FLEET", raising=False)
+        assert fleet_mod.env_enabled() is False  # fleet is opt-in
+        monkeypatch.setenv("ADVSPEC_FLEET", "1")
+        assert fleet_mod.env_enabled() is True
+        monkeypatch.setenv("ADVSPEC_FLEET_REPLICAS", "5")
+        assert fleet_mod.env_replicas() == 5
+        monkeypatch.setenv("ADVSPEC_FLEET_TRANSPORT", "worker")
+        assert fleet_mod.env_transport() == "worker"
+        monkeypatch.setenv("ADVSPEC_FLEET_TRANSPORT", "bogus")
+        assert fleet_mod.env_transport() == "inproc"
+
+    def test_bad_transport_fails_at_the_knob(self):
+        with pytest.raises(ValueError, match="unknown fleet transport"):
+            fleet_mod.configure(transport="bogus")
+
+    def test_armed_needs_two_replicas(self):
+        fleet_mod.configure(enabled=True, replicas=1)
+        assert not fleet_mod.armed()
+        fleet_mod.configure(replicas=2)
+        assert fleet_mod.armed()
+        fleet_mod.configure(enabled=False)
+        assert not fleet_mod.armed()
+
+    def test_snapshot_payload(self):
+        snap = fleet_mod.snapshot()
+        for key in (
+            "routed_requests",
+            "affinity_hits",
+            "failover_hops",
+            "breaker_skips",
+            "reissued_requests",
+            "completed_requests",
+            "duplicated_completions",
+            "affinity_hit_rate",
+            "enabled",
+            "replicas",
+            "transport",
+        ):
+            assert key in snap
+
+
+class TestInProcessReplica:
+    def test_serves_and_accounts(self):
+        rep = InProcessReplica("r0")
+        comps = rep.chat_batch([_req(), _req(model="mock://agree")], PARAMS)
+        assert all(c.ok for c in comps)
+        assert rep.served == {"mock://critic": 1, "mock://agree": 1}
+        assert rep.busy_s > 0
+        rep.check()  # invariants clean
+        assert rep.stats()["replica"] == "r0"
+
+    def test_consumer_keeps_original_batch_indexing(self):
+        rep = InProcessReplica("r0")
+        seen = []
+
+        def consumer(row, text):
+            seen.append(row)
+            return True
+
+        rep.chat_batch([_req(), _req()], PARAMS, consumer=consumer)
+        # Each request is served as its own single-row engine call, but
+        # the consumer must see the fleet batch's indexing.
+        assert set(seen) == {0, 1}
+
+    def test_replicas_do_not_share_prefix_caches(self):
+        """The lifecycle seam: each replica owns a FRESH engine — the
+        second replica serving the same prompt pays the full prefill
+        (no cross-replica device-cache magic)."""
+        r0, r1 = InProcessReplica("r0"), InProcessReplica("r1")
+        c0 = r0.chat_batch([_req()], PARAMS)[0]
+        c1 = r1.chat_batch([_req()], PARAMS)[0]
+        assert c0.usage.cached_tokens == c1.usage.cached_tokens == 0
+
+    def test_closed_replica_raises(self):
+        rep = InProcessReplica("r0")
+        rep.close()
+        with pytest.raises(ReplicaDead):
+            rep.chat_batch([_req()], PARAMS)
+
+
+class _DyingReplica:
+    """Serves ``die_after`` requests of a batch, then dies — the
+    in-process stand-in for a SIGKILLed worker."""
+
+    def __init__(self, replica_id: str, die_after: int):
+        self.id = replica_id
+        self.die_after = die_after
+        self.closed = False
+
+    def ping(self) -> bool:
+        return not self.closed
+
+    def chat_batch(self, requests, params, consumer=None, on_completion=None):
+        partial = {}
+        for j, req in enumerate(requests[: self.die_after]):
+            comp = Completion(text=f"{self.id}:{req.model}")
+            partial[j] = comp
+            if on_completion is not None:
+                on_completion(j, comp)
+        raise ReplicaDead(self.id, "scripted death", partial)
+
+    def validate(self, model):
+        return None
+
+    def check(self) -> None:
+        pass
+
+    def stats(self) -> dict:
+        return {"replica": self.id, "served": {}, "busy_s": 0.0}
+
+    def close(self) -> None:
+        self.closed = True
+
+
+class TestRouterRouting:
+    def _engine(self, n=2, **kw):
+        return FleetEngine(replicas=n, transport="inproc", **kw)
+
+    def test_affinity_is_sticky_across_submits(self):
+        eng = self._engine(3)
+        for _ in range(3):
+            eng.chat([_req(key="debate-sticky")] * 2, PARAMS)
+        served = {
+            s["replica"]: sum(s["served"].values())
+            for s in eng.router.replica_stats()
+            if s["served"]
+        }
+        assert len(served) == 1  # one replica owns the debate
+        assert sum(served.values()) == 6
+        eng.shutdown()
+
+    def test_distinct_debates_spread(self):
+        eng = self._engine(3)
+        for d in range(12):
+            eng.chat([_req(key=f"debate-{d}")], PARAMS)
+        used = [s for s in eng.router.replica_stats() if s["served"]]
+        assert len(used) >= 2
+        eng.shutdown()
+
+    def test_random_mode_round_robins(self):
+        eng = self._engine(3, affinity=False)
+        eng.chat([_req(key="debate-same")] * 3, PARAMS)
+        used = [s for s in eng.router.replica_stats() if s["served"]]
+        assert len(used) == 3  # same key, three replicas: no stickiness
+        assert fleet_mod.stats.affinity_hits == 0
+        eng.shutdown()
+
+    def test_route_events_carry_trace_ids(self):
+        obs_mod.reset_stats()
+        eng = self._engine(2)
+        eng.chat(
+            [_req(trace_id="tr-001-01", span_id="tr-001-01/s00")], PARAMS
+        )
+        routes = [
+            e
+            for e in obs_mod.recorder.events()
+            if e["type"] == "route"
+        ]
+        assert routes and routes[0]["trace_id"] == "tr-001-01"
+        assert routes[0]["span_id"] == "tr-001-01/s00"
+        assert routes[0]["reason"] == "affinity" and routes[0]["hop"] == 0
+        eng.shutdown()
+
+    def test_breaker_open_pair_skips_replica(self):
+        reg = breaker_mod.BreakerRegistry(threshold=1, cooldown_s=1e9)
+        eng = self._engine(2, breakers=reg)
+        primary = eng.router._ring.preference("debate-A")[0]
+        reg.record(
+            breaker_mod.replica_key(primary, "mock://critic"), ok=False
+        )
+        comps = eng.chat([_req()], PARAMS)
+        assert comps[0].ok
+        assert fleet_mod.stats.breaker_skips >= 1
+        # The pair breaker drained the primary for this model only:
+        # the OTHER replica served it.
+        assert not eng.router.replica(primary).served
+        eng.shutdown()
+
+    def test_injected_replica_fault_fails_over(self):
+        injector_mod.install(
+            FaultInjector(parse_chaos_spec("device_lost@replica:times=1"))
+        )
+        reg = breaker_mod.BreakerRegistry(threshold=3)
+        eng = self._engine(2, breakers=reg)
+        comps = eng.chat([_req(), _req()], PARAMS)
+        assert all(c.ok for c in comps)
+        assert fleet_mod.stats.failover_hops == 2
+        # Both replicas still alive: the fault was replica-LEVEL, not
+        # a transport death.
+        assert len(eng.router.alive_ids()) == 2
+        # The faulted pair fed its breaker.
+        primary = eng.router._ring.preference("debate-A")[0]
+        pair = breaker_mod.replica_key(primary, "mock://critic")
+        assert reg.breaker(pair).failures == 2
+        eng.shutdown()
+
+    def test_no_routable_replica_resolves_with_error(self):
+        injector_mod.install(
+            FaultInjector(parse_chaos_spec("device_lost@replica:times=1"))
+        )
+        eng = self._engine(1)
+        comps = eng.chat([_req()], PARAMS)
+        assert not comps[0].ok
+        assert "no routable replica" in comps[0].error
+        eng.shutdown()
+
+    def test_replica_death_keeps_partials_and_reroutes_rest(self):
+        key = "debate-death"
+        primary = HashRing(["r0", "r1"]).preference(key)[0]
+        survivor = "r1" if primary == "r0" else "r0"
+        dying = _DyingReplica(primary, die_after=2)
+        healthy = InProcessReplica(survivor)
+        router = FleetRouter([dying, healthy])
+        reqs = [_req(model=f"mock://critic?v={k}", key=key) for k in range(4)]
+        comps = router.submit(reqs, PARAMS)
+        assert all(c.ok for c in comps)
+        # The two that landed before death are the dying replica's.
+        assert [c.text for c in comps[:2]] == [
+            f"{primary}:mock://critic?v=0",
+            f"{primary}:mock://critic?v=1",
+        ]
+        # The remainder re-routed; the survivor never saw the first two.
+        assert healthy.served == {
+            "mock://critic?v=2": 1,
+            "mock://critic?v=3": 1,
+        }
+        assert fleet_mod.stats.reissued_requests == 2
+        assert fleet_mod.stats.duplicated_completions == 0
+        assert router.alive_ids() == [survivor]
+        assert router._dead == {primary: "dead"}
+
+    def test_heartbeat_miss_retires(self):
+        obs_mod.reset_stats()
+        eng = self._engine(2)
+        victim = eng.router.alive_ids()[0]
+        eng.router.replica(victim).closed = True  # ping now fails
+        eng.router.health_check()
+        assert victim not in eng.router.alive_ids()
+        assert fleet_mod.stats.heartbeat_failures == 1
+        ops = [
+            (e["replica"], e["op"])
+            for e in obs_mod.recorder.events()
+            if e["type"] == "replica"
+        ]
+        assert (victim, "heartbeat_miss") in ops
+        assert (victim, "retire") in ops
+        eng.shutdown()
+
+    def test_retire_is_idempotent_and_shutdown_funnels_through_it(self):
+        eng = self._engine(2)
+        eng.router._retire_replica("r0", "dead")
+        eng.router._retire_replica("r0", "heartbeat")  # second is a no-op
+        assert eng.router._dead["r0"] == "dead"
+        eng.shutdown()
+        assert eng.router.alive_ids() == []
+        assert eng.router._dead["r1"] == "shutdown"
+
+
+class TestDispatchIntegration:
+    def test_get_engine_returns_fleet_when_armed(self):
+        from adversarial_spec_tpu.engine import dispatch
+
+        fleet_mod.configure(enabled=True, replicas=2, transport="inproc")
+        eng = dispatch.get_engine("mock://critic")
+        assert isinstance(eng, FleetEngine)
+        # One fleet serves every provider (that is the point).
+        assert dispatch.get_engine("mock://agree") is eng
+        fleet_mod.configure(enabled=False)
+        from adversarial_spec_tpu.engine.mock import MockEngine
+
+        assert isinstance(dispatch.get_engine("mock://critic"), MockEngine)
+
+    def test_one_replica_fleet_never_routes(self):
+        from adversarial_spec_tpu.engine import dispatch
+        from adversarial_spec_tpu.engine.mock import MockEngine
+
+        fleet_mod.configure(enabled=True, replicas=1)
+        assert isinstance(dispatch.get_engine("mock://critic"), MockEngine)
+
+    def test_topology_change_rebuilds_the_fleet(self):
+        fleet_mod.configure(enabled=True, replicas=2, transport="inproc")
+        first = fleet_mod.fleet_engine()
+        fleet_mod.configure(replicas=3)
+        second = fleet_mod.fleet_engine()
+        assert second is not first
+        assert first.router.alive_ids() == []  # old fleet shut down
+        assert len(second.router.alive_ids()) == 3
+
+    def test_validate_routes_to_a_replica(self):
+        fleet_mod.configure(enabled=True, replicas=2)
+        eng = fleet_mod.fleet_engine()
+        assert eng.validate("mock://critic") is None
+        assert eng.validate("nonsense") is not None
+
+
+class TestRunRoundFleet:
+    def test_round_routes_and_resolves(self):
+        from adversarial_spec_tpu.debate.core import RoundConfig, run_round
+
+        fleet_mod.configure(enabled=True, replicas=2)
+        cfg = RoundConfig(debate_id="fleet-round")
+        r = run_round(
+            "# spec", ["mock://critic?v=1", "mock://agree"], 1, cfg
+        )
+        assert all(resp.ok for resp in r.responses)
+        assert fleet_mod.stats.routed_requests == 2
+        assert fleet_mod.stats.completed_requests == 2
+
+    def test_rounds_of_one_debate_share_a_replica(self):
+        from adversarial_spec_tpu.debate.core import RoundConfig, run_round
+
+        fleet_mod.configure(enabled=True, replicas=3)
+        cfg = RoundConfig(debate_id="fleet-affinity")
+        for round_num in (1, 2):
+            run_round(
+                "# spec", ["mock://critic?v=1", "mock://critic?v=2"],
+                round_num, cfg,
+            )
+        eng = fleet_mod.fleet_engine()
+        used = [s for s in eng.router.replica_stats() if s["served"]]
+        assert len(used) == 1
+        assert sum(used[0]["served"].values()) == 4
+
+    def test_sessionless_round_keys_on_the_spec(self):
+        from adversarial_spec_tpu.debate.core import RoundConfig, run_round
+
+        obs_mod.reset_stats()
+        fleet_mod.configure(enabled=True, replicas=2)
+        run_round("# spec", ["mock://critic"], 1, RoundConfig())
+        routes = [
+            e for e in obs_mod.recorder.events() if e["type"] == "route"
+        ]
+        from adversarial_spec_tpu.debate.journal import spec_sha
+
+        assert routes[0]["key"] == spec_sha("# spec")[:16]
+
+    def test_streaming_early_cancel_survives_the_replica_hop(self):
+        from adversarial_spec_tpu.debate.core import RoundConfig, run_round
+        from adversarial_spec_tpu.engine import streaming
+
+        fleet_mod.configure(enabled=True, replicas=2)
+        r = run_round(
+            "# spec", ["mock://agree?agree_tail=50"], 1,
+            RoundConfig(debate_id="fleet-cancel"),
+        )
+        assert r.responses[0].ok and r.responses[0].agreed
+        # The consumer crossed the router with its indexing intact and
+        # cancelled mid-reply (the in-process transport streams).
+        assert streaming.stats.cancels == 1
+
+
+class TestFleetEvents:
+    def test_replica_and_route_events_validate(self):
+        from adversarial_spec_tpu.obs.events import (
+            ReplicaEvent,
+            RouteEvent,
+            event_to_dict,
+            validate_event,
+        )
+
+        good_rep = event_to_dict(
+            1, ReplicaEvent(replica="r0", op="retire", reason="dead", alive=1)
+        )
+        assert validate_event(json.loads(json.dumps(good_rep))) == []
+        good_route = event_to_dict(
+            2,
+            RouteEvent(
+                replica="r1", req_id=0, key="k", model="m", hop=1,
+                reason="failover",
+            ),
+        )
+        assert validate_event(json.loads(json.dumps(good_route))) == []
+        assert validate_event(
+            event_to_dict(3, ReplicaEvent(op="vanish"))
+        )
+        assert validate_event(
+            event_to_dict(4, RouteEvent(reason="luck"))
+        )
+
+
+class TestToolsRendering:
+    def _events(self):
+        from adversarial_spec_tpu.obs.events import (
+            ReplicaEvent,
+            RouteEvent,
+            SpanEvent,
+            StepEvent,
+            event_to_dict,
+        )
+
+        return [
+            event_to_dict(1, ReplicaEvent(replica="r0", op="spawn", alive=1)),
+            event_to_dict(
+                2,
+                RouteEvent(
+                    replica="r0", req_id=0, key="debate-A", model="m",
+                    trace_id="tr-001-01", span_id="tr-001-01/s00",
+                ),
+            ),
+            event_to_dict(3, StepEvent(kind="decode", n_live=1)),
+            event_to_dict(
+                4,
+                RouteEvent(
+                    replica="r1", req_id=0, key="debate-A", model="m",
+                    hop=1, reason="failover",
+                    trace_id="tr-001-01", span_id="tr-001-01/s00",
+                ),
+            ),
+            event_to_dict(
+                5,
+                ReplicaEvent(
+                    replica="r0", op="retire", reason="dead", alive=1
+                ),
+            ),
+            event_to_dict(6, StepEvent(kind="decode", n_live=1)),
+            event_to_dict(
+                7,
+                SpanEvent(
+                    name="request", phase="begin", req_id=0,
+                    trace_id="tr-001-01", span_id="tr-001-01/s00",
+                ),
+            ),
+            event_to_dict(
+                8,
+                SpanEvent(
+                    name="prefill", phase="end", req_id=0, wall_s=0.25,
+                    trace_id="tr-001-01", span_id="tr-001-01/s00",
+                ),
+            ),
+            event_to_dict(
+                9,
+                SpanEvent(
+                    name="decode", phase="end", req_id=0, wall_s=0.75,
+                    trace_id="tr-001-01", span_id="tr-001-01/s00",
+                ),
+            ),
+            event_to_dict(
+                10,
+                SpanEvent(
+                    name="request", phase="end", req_id=0, wall_s=1.0,
+                    trace_id="tr-001-01", span_id="tr-001-01/s00",
+                ),
+            ),
+        ]
+
+    def _write(self, tmp_path, events):
+        p = tmp_path / "ev.jsonl"
+        p.write_text(
+            "".join(json.dumps(e) + "\n" for e in events), encoding="utf-8"
+        )
+        return str(p)
+
+    def test_obs_dump_renders_replica_column_and_validates(
+        self, tmp_path, capsys
+    ):
+        from tools.obs_dump import main
+
+        path = self._write(tmp_path, self._events())
+        assert main([path, "--timeline"]) == 0
+        out = capsys.readouterr().out
+        assert "route>r0" in out and "route>r1" in out
+        assert "replica:retire" in out
+        assert "rep=r0" in out and "rep=r1" in out  # the replica column
+        assert "failover hop(s)" in out
+        assert "WARNING: replica r0 retire" in out
+
+    def test_trace_view_shows_the_failover_hop(self, tmp_path, capsys):
+        from tools.trace_view import main
+
+        path = self._write(tmp_path, self._events())
+        assert main([path]) == 0
+        out = capsys.readouterr().out
+        assert "via r0 -> r1 (failover)" in out
+
+    def test_bench_trend_picks_up_the_fleet_bench(self):
+        from pathlib import Path
+
+        from tools.bench_trend import validate_bench_file
+
+        bench = Path(__file__).resolve().parent.parent / "BENCH_fleet.json"
+        assert bench.is_file(), "BENCH_fleet.json must be committed"
+        row, problems = validate_bench_file(bench)
+        assert problems == []
+        assert row["mode"] == "fleet"
+        assert row["metric"] == "fleet_aggregate_speedup"
+
+
+class TestFleetLifecycleLint:
+    def test_exit_skipping_the_retirement_surgery_fires(self):
+        """GL-LIFECYCLE's fleet machine is LIVE on the real source: a
+        hand-rolled shutdown that skips _retire_replica (writing the
+        dead-ledger directly) is permanently caught."""
+        from pathlib import Path
+
+        from tools.graftlint.config import GraftlintConfig
+        from tools.graftlint.core import lint_sources
+
+        src = Path("adversarial_spec_tpu/fleet/router.py").read_text(
+            encoding="utf-8"
+        )
+        broken = src.replace(
+            "    def shutdown(self) -> None:\n"
+            "        for rid in self.alive_ids():\n"
+            "            self._retire_replica(rid, \"shutdown\")\n",
+            "    def shutdown(self) -> None:\n"
+            "        for rid in self.alive_ids():\n"
+            "            self._dead[rid] = \"shutdown\"\n",
+        )
+        assert broken != src, "shutdown surgery call not found to strip"
+        cfg = GraftlintConfig(package="pkg")
+        findings = lint_sources(
+            {"pkg/router.py": broken}, rules=["GL-LIFECYCLE"], cfg=cfg
+        )
+        msgs = [f.message for f in findings]
+        assert any(
+            "FleetRouter.shutdown never reaches" in m for m in msgs
+        ), msgs
+        assert any("self._dead" in m and "shutdown" in m for m in msgs)
+        # The committed source is clean under the same config.
+        assert (
+            lint_sources(
+                {"pkg/router.py": src}, rules=["GL-LIFECYCLE"], cfg=cfg
+            )
+            == []
+        )
+
+
+class TestCliFleet:
+    def _run(self, argv, monkeypatch, capsys, stdin="# spec\nBody.\n"):
+        import io
+
+        from adversarial_spec_tpu import cli
+
+        monkeypatch.setattr("sys.stdin", io.StringIO(stdin))
+        code = cli.main(argv)
+        out, err = capsys.readouterr()
+        return code, out, err
+
+    def test_fleet_flags_reach_perf_json(self, monkeypatch, capsys):
+        code, out, err = self._run(
+            [
+                "critique", "--models", "mock://critic,mock://agree",
+                "--fleet", "--fleet-replicas", "3", "--json",
+            ],
+            monkeypatch, capsys,
+        )
+        assert code == 0
+        perf = json.loads(out)["perf"]["fleet"]
+        assert perf["enabled"] is True
+        assert perf["replicas"] == 3
+        assert perf["routed_requests"] == 2
+        assert perf["completed_requests"] == 2
+        assert "fleet: 2 request(s) routed" in err
+
+    def test_fleet_does_not_leak_across_invocations(self, monkeypatch, capsys):
+        self._run(
+            [
+                "critique", "--models", "mock://critic",
+                "--fleet", "--fleet-replicas", "2", "--json",
+            ],
+            monkeypatch, capsys,
+        )
+        code, out, _ = self._run(
+            ["critique", "--models", "mock://critic", "--json"],
+            monkeypatch, capsys,
+        )
+        assert code == 0
+        perf = json.loads(out)["perf"]["fleet"]
+        assert perf["enabled"] is False  # env default (off) re-resolved
+        assert perf["routed_requests"] == 0
+
+
+@pytest.mark.chaos
+class TestWorkerTransport:
+    """One worker subprocess, full protocol round-trip."""
+
+    def test_worker_protocol(self, tmp_path):
+        rep = WorkerReplica(
+            "rw0", request_timeout_s=60.0, log_dir=str(tmp_path)
+        )
+        try:
+            assert rep.ping()
+            assert rep.validate("mock://critic") is None
+            got = []
+            comps = rep.chat_batch(
+                [_req(), _req(model="mock://agree")],
+                PARAMS,
+                on_completion=lambda j, c: got.append(j),
+            )
+            assert [c.ok for c in comps] == [True, True]
+            assert got == [0, 1]  # completions streamed incrementally
+            stats = rep.stats()
+            assert stats["served"] == {
+                "mock://critic": 1, "mock://agree": 1,
+            }
+            rep.check()  # allocator/tier invariants inside the worker
+        finally:
+            rep.close()
+        assert not rep.ping()
+
+
+@pytest.mark.chaos
+class TestReplicaKillChaos:
+    """The tier-1 fleet chaos smoke: the FULL drill from
+    tools/chaos_run.py --replica-kill — two worker replicas sharing one
+    KV store, the serving replica SIGKILLed after its 2nd completion,
+    round completed on the survivor with byte-identical transcripts,
+    zero duplicated opponent attempts, store rehydration, and clean
+    survivor invariants."""
+
+    def test_replica_kill_recovery_contract(self):
+        from tools.chaos_run import run_replica_kill
+
+        failures, payload = run_replica_kill(verbose=False)
+        assert failures == []
+        assert payload["transcripts_byte_identical"] is True
+        assert payload["duplicated_completions"] == 0
+        assert payload["reissued_requests"] == 2
+        assert payload["survivor_rehydrated_blocks"] > 0
+        assert payload["recovered_fraction"] == 0.5

@@ -257,6 +257,153 @@ class TestDiskStore:
         assert reopened.resident_entries == 3
 
 
+class TestDiskStoreConcurrentWriters:
+    """The property the SHARED fleet store depends on (docs/fleet.md):
+    N writers racing the same content-addressed chain — two threads of
+    one engine, or two replica processes writing through one store dir
+    — must end with EXACTLY ONE valid entry, no quarantine, and
+    consistent resident accounting. The tmp+rename discipline makes
+    the race harmless: every writer lands a complete identical entry
+    under a unique temp name and the replaces are atomic."""
+
+    def _race(self, tmp_path, stores, n_threads, payload):
+        """Hammer one chain from n_threads across the given store
+        instances, all released together by a barrier."""
+        import threading
+
+        chain = kvtier.chain_hash("", (7, 8, 9))
+        barrier = threading.Barrier(n_threads)
+        errors: list[BaseException] = []
+
+        def write(store):
+            try:
+                barrier.wait(timeout=10)
+                for _ in range(5):
+                    store.put(chain, (7, 8, 9), payload)
+            except BaseException as e:  # pragma: no cover - fail loudly
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=write, args=(stores[i % len(stores)],))
+            for i in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert errors == []
+        return chain
+
+    def _check_one_valid_entry(self, tmp_path, stores, chain, payload):
+        kvtier.reset_stats()
+        root = tmp_path / "store"
+        entries = [
+            p
+            for p in root.rglob("*.kvb")
+            if "quarantine" not in p.parts
+        ]
+        assert len(entries) == 1  # exactly one on-disk entry
+        assert not list(root.rglob("quarantine/*")), "nothing quarantined"
+        assert not [p for p in root.rglob("*") if ".tmp" in p.name]
+        for s in stores:
+            toks, got = s.get(chain, (7, 8, 9))  # fully verifies
+            assert toks == (7, 8, 9)
+            if payload is not None:
+                assert np.array_equal(got["k"], payload["k"])
+            # No writer double-counted: each instance tracks at most
+            # the single entry that exists (check_invariants' one-sided
+            # shared-store rule).
+            assert s.resident_entries <= s._scan() == 1
+        assert kvtier.stats.store_corrupt == 0
+
+    def test_threads_sharing_one_instance(self, tmp_path):
+        payload = {"k": np.arange(64, dtype=np.float32)}
+        store = kvtier.DiskStore(str(tmp_path / "store"), "fp-a")
+        chain = self._race(tmp_path, [store], n_threads=8, payload=payload)
+        self._check_one_valid_entry(tmp_path, [store], chain, payload)
+        assert store.resident_entries == 1  # counted exactly once
+
+    def test_two_instances_same_dir_like_two_processes(self, tmp_path):
+        """Two DiskStore instances over one dir — each fleet replica
+        process holds its own instance; same-pid here makes the temp
+        name collision HARDER than the cross-process case."""
+        payload = {"k": np.arange(64, dtype=np.float32)}
+        stores = [
+            kvtier.DiskStore(str(tmp_path / "store"), "fp-a")
+            for _ in range(2)
+        ]
+        chain = self._race(tmp_path, stores, n_threads=8, payload=payload)
+        self._check_one_valid_entry(tmp_path, stores, chain, payload)
+
+    def test_two_real_processes(self, tmp_path):
+        """The literal fleet shape: two PROCESSES write-through the
+        same chain simultaneously (rendezvous via a spin on a marker
+        file), then the parent verifies the single valid entry."""
+        import subprocess
+        import sys
+
+        script = r"""
+import sys, os, time
+sys.path.insert(0, sys.argv[1])
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+from adversarial_spec_tpu.engine import kvtier
+
+root, ready, go = sys.argv[2], sys.argv[3], sys.argv[4]
+store = kvtier.DiskStore(root, "fp-a")
+chain = kvtier.chain_hash("", (7, 8, 9))
+open(ready, "w").close()
+deadline = time.time() + 20
+while not os.path.exists(go):
+    if time.time() > deadline:
+        sys.exit(3)
+    time.sleep(0.001)
+for _ in range(5):
+    store.put(chain, (7, 8, 9), {"k": np.arange(64, dtype=np.float32)})
+print(store.resident_entries)
+"""
+        import os
+
+        repo = os.path.dirname(
+            os.path.dirname(
+                os.path.dirname(os.path.abspath(kvtier.__file__))
+            )
+        )
+        root = str(tmp_path / "store")
+        go = tmp_path / "go"
+        procs = []
+        readies = []
+        for i in range(2):
+            ready = tmp_path / f"ready-{i}"
+            readies.append(ready)
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable, "-c", script, repo, root,
+                        str(ready), str(go),
+                    ],
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    text=True,
+                )
+            )
+        import time
+
+        deadline = time.time() + 20
+        while not all(r.exists() for r in readies):
+            assert time.time() < deadline, "children never reached rendezvous"
+            time.sleep(0.005)
+        go.touch()  # both children race from here
+        outs = [p.communicate(timeout=30) for p in procs]
+        assert all(p.returncode == 0 for p in procs), outs
+        chain = kvtier.chain_hash("", (7, 8, 9))
+        verifier = kvtier.DiskStore(root, "fp-a")
+        payload = {"k": np.arange(64, dtype=np.float32)}
+        self._check_one_valid_entry(
+            tmp_path, [verifier], chain, payload
+        )
+
+
 class TestDiskFuzz:
     def test_write_rehydrate_corrupt_against_oracle(self, tmp_path):
         """Random block sets through write/rehydrate/quarantine must

@@ -1126,6 +1126,7 @@ class TestGraftlint:
             lifecycle_exits=[],
             lifecycle_owned_attrs=[],
             lifecycle_mutators=[],
+            fleet_lifecycle_class="",  # fixture has no fleet machine
         )
         sources = {
             "pkg/sched.py": (

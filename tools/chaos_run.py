@@ -18,10 +18,22 @@ contract — only unfinished opponents re-issue (no duplicated opponent
 work) and every journal-served transcript is byte-identical to an
 uninterrupted run of the same round.
 
+``--replica-kill`` is the FLEET variant (docs/fleet.md): a round runs
+across two subprocess worker replicas sharing one content-addressed KV
+store, the replica serving the round is SIGKILLed the instant its 2nd
+completion crosses the pipe (``ADVSPEC_REPLICA_KILL_AFTER``), and the
+drill asserts lose-a-replica-lose-nothing — the round completes on the
+survivor with byte-identical transcripts vs an uninterrupted fleet
+run, zero duplicated opponent attempts (per-worker serve counters +
+the round journal's one-record-per-index replay), the survivor
+rehydrating the shared document prefix from the disk store instead of
+re-prefilling, and allocator + tier invariants clean on the survivor.
+
 Usage:
     python tools/chaos_run.py                # pytest -m chaos
     python tools/chaos_run.py --sweep 5      # + 5 extra fuzz seeds
     python tools/chaos_run.py --crash        # SIGKILL + resume drill
+    python tools/chaos_run.py --replica-kill # fleet replica-loss drill
     python tools/chaos_run.py -- -x -k breaker   # extra pytest args
 """
 
@@ -37,6 +49,7 @@ import tempfile
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
 
 _CRASH_SPEC = (
     "## Goals\nServe heavy traffic from millions of users, fast.\n"
@@ -189,6 +202,209 @@ def crash_drill(verbose: bool = True) -> int:
     return 0
 
 
+_FLEET_MODELS = [f"mock://critic?v={k}" for k in range(1, 5)]
+_FLEET_KILL_AFTER = 2  # SIGKILL the serving replica after 2 completions
+_FLEET_DEBATE_ID = "replica-drill"
+
+
+def run_replica_kill(verbose: bool = True) -> tuple[list[str], dict]:
+    """The fleet replica-loss drill, in-process (this process hosts the
+    router; the replicas are SIGKILL-able subprocess workers). Returns
+    (failures, payload) — the payload feeds ``bench.py --mode fleet``'s
+    recovery phase, the failure list this CLI's verdict."""
+    from adversarial_spec_tpu import fleet as fleet_mod
+    from adversarial_spec_tpu.debate.core import RoundConfig, run_round
+    from adversarial_spec_tpu.debate.journal import RoundJournal
+    from adversarial_spec_tpu.fleet.hashring import HashRing
+    from adversarial_spec_tpu.fleet.router import FleetEngine
+
+    def say(msg: str) -> None:
+        if verbose:
+            print(f"chaos_run --replica-kill: {msg}", flush=True)
+
+    failures: list[str] = []
+    payload: dict = {
+        "opponents": len(_FLEET_MODELS),
+        "kill_after_completions": _FLEET_KILL_AFTER,
+    }
+    spec = _CRASH_SPEC * 4  # a document long enough to span store blocks
+    # The ring is deterministic (sha256): compute which replica the
+    # drill's debate id lands on, and arm the kill trigger for exactly
+    # that replica — the survivor stays disarmed.
+    primary = HashRing(["r0", "r1"]).preference(_FLEET_DEBATE_ID)[0]
+    survivor = "r1" if primary == "r0" else "r0"
+    payload["primary"] = primary
+    payload["survivor"] = survivor
+
+    def fleet_round(store_dir: str, sessions_dir: str, kill: bool, log_dir: str):
+        worker_env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "ADVSPEC_KV_TIER": "1",
+            "ADVSPEC_KV_HOST_MB": "64",
+            "ADVSPEC_KV_STORE_DIR": store_dir,
+        }
+        if kill:
+            worker_env["ADVSPEC_REPLICA_KILL_AFTER"] = (
+                f"{primary}:{_FLEET_KILL_AFTER}"
+            )
+        engine = FleetEngine(
+            replicas=2,
+            transport="worker",
+            request_timeout_s=60.0,
+            worker_env=worker_env,
+            log_dir=log_dir,
+        )
+        fleet_mod.install_engine(engine)
+        journal = RoundJournal("fleet-drill", journal_dir=Path(sessions_dir))
+        cfg = RoundConfig(journal=journal, debate_id=_FLEET_DEBATE_ID)
+        result = run_round(spec, _FLEET_MODELS, round_num=1, cfg=cfg)
+        return engine, journal, result
+
+    old_cfg = fleet_mod.config()
+    old = (old_cfg.enabled, old_cfg.replicas, old_cfg.transport)
+    fleet_mod.configure(enabled=True, replicas=2, transport="worker")
+    try:
+        with tempfile.TemporaryDirectory(prefix="advspec-fleet-") as td:
+            # Phase A — reference: the same fleet round, uninterrupted.
+            eng_a, _, ref = fleet_round(
+                os.path.join(td, "store-ref"),
+                os.path.join(td, "sessions-ref"),
+                kill=False,
+                log_dir=os.path.join(td, "logs-ref"),
+            )
+            fleet_mod.shutdown_fleet()
+            if not all(r.ok for r in ref.responses):
+                failures.append("reference fleet round had failures")
+            say(f"reference round complete ({len(ref.responses)} opponents)")
+
+            # Phase B — the kill: replica `primary` dies the instant
+            # its 2nd completion line crosses the pipe, mid-round.
+            fleet_mod.reset_stats()
+            eng_b, journal, got = fleet_round(
+                os.path.join(td, "store"),
+                os.path.join(td, "sessions"),
+                kill=True,
+                log_dir=os.path.join(td, "logs"),
+            )
+            stats = fleet_mod.stats
+
+            # 1. Zero lost debates: every opponent resolved, cleanly.
+            if not all(r.ok for r in got.responses):
+                failures.append(
+                    "round lost work across the replica kill: "
+                    + "; ".join(
+                        f"{r.model}: {r.error}" for r in got.responses if not r.ok
+                    )
+                )
+            # 2. Byte-identical transcripts vs the uninterrupted run.
+            mismatched = [
+                i
+                for i, (a, b) in enumerate(zip(got.responses, ref.responses))
+                if a.critique != b.critique
+            ]
+            if mismatched:
+                failures.append(
+                    f"transcripts diverged at opponent(s) {mismatched}"
+                )
+            # 3. The router's ledger: the in-flight remainder (and only
+            # it) re-issued; nothing resolved twice; one replica died.
+            expected_reissue = len(_FLEET_MODELS) - _FLEET_KILL_AFTER
+            if stats.reissued_requests != expected_reissue:
+                failures.append(
+                    f"expected {expected_reissue} reissued request(s), "
+                    f"got {stats.reissued_requests}"
+                )
+            if stats.duplicated_completions != 0:
+                failures.append(
+                    f"{stats.duplicated_completions} duplicated completion(s)"
+                )
+            if stats.replicas_retired != 1:
+                failures.append(
+                    f"expected 1 retired replica, got {stats.replicas_retired}"
+                )
+            if eng_b.router.alive_ids() != [survivor]:
+                failures.append(
+                    f"expected survivor {survivor}, alive: "
+                    f"{eng_b.router.alive_ids()}"
+                )
+            # 4. No duplicated opponent ATTEMPTS: the survivor served
+            # exactly the re-routed remainder, once each — never an
+            # opponent the dead replica already completed.
+            surv_stats = eng_b.router.replica(survivor).stats()
+            expect_served = {m: 1 for m in _FLEET_MODELS[_FLEET_KILL_AFTER:]}
+            if surv_stats.get("served") != expect_served:
+                failures.append(
+                    f"survivor served {surv_stats.get('served')}, "
+                    f"expected {expect_served}"
+                )
+            # 5. Journal replay counters: one durable completion per
+            # opponent index, each replayable exactly once.
+            replayed = journal.replay(1, spec, _FLEET_MODELS)
+            if sorted(replayed) != list(range(len(_FLEET_MODELS))):
+                failures.append(
+                    f"journal replay serves indices {sorted(replayed)}, "
+                    f"expected all of 0..{len(_FLEET_MODELS) - 1}"
+                )
+            # 6. Store-coherent recovery: the survivor rehydrated the
+            # shared document prefix from the disk store the dead
+            # replica wrote through — not a cold re-prefill.
+            tier = surv_stats.get("kv_tier", {})
+            if not tier.get("rehydrated_blocks"):
+                failures.append(
+                    "survivor rehydrated nothing from the shared store "
+                    f"(kv_tier: {tier})"
+                )
+            # 7. Clean survivors: allocator + tier invariants.
+            try:
+                eng_b.router.check_invariants()
+            except Exception as e:
+                failures.append(f"survivor invariants violated: {e}")
+
+            payload.update(
+                {
+                    "reissued_requests": stats.reissued_requests,
+                    "duplicated_completions": stats.duplicated_completions,
+                    "survivor_served": surv_stats.get("served"),
+                    "survivor_rehydrated_blocks": int(
+                        tier.get("rehydrated_blocks", 0)
+                    ),
+                    "transcripts_byte_identical": not mismatched,
+                    "recovered_fraction": round(
+                        (len(_FLEET_MODELS) - stats.reissued_requests)
+                        / len(_FLEET_MODELS),
+                        4,
+                    ),
+                    "invariants_clean": not any(
+                        "invariants" in f for f in failures
+                    ),
+                }
+            )
+            say(
+                f"{primary} SIGKILLed after {_FLEET_KILL_AFTER} completions; "
+                f"{stats.reissued_requests} request(s) re-routed to "
+                f"{survivor}; transcripts "
+                + ("byte-identical" if not mismatched else "DIVERGED")
+            )
+    finally:
+        fleet_mod.shutdown_fleet()
+        fleet_mod.configure(
+            enabled=old[0], replicas=old[1], transport=old[2]
+        )
+        fleet_mod.reset_stats()
+    return failures, payload
+
+
+def replica_kill_drill(verbose: bool = True) -> int:
+    failures, _ = run_replica_kill(verbose)
+    if failures:
+        print("\n".join(f"FAIL: {f}" for f in failures), file=sys.stderr)
+        return 1
+    if verbose:
+        print("chaos_run --replica-kill: recovery contract holds", flush=True)
+    return 0
+
+
 def _pytest(extra: list[str], env_overrides: dict[str, str]) -> int:
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
@@ -227,12 +443,22 @@ def main(argv: list[str] | None = None) -> int:
         "mid-journal, resume, assert no duplicated opponent work and "
         "byte-identical journal-served transcripts",
     )
+    ap.add_argument(
+        "--replica-kill",
+        action="store_true",
+        help="fleet replica-loss drill: SIGKILL one of 2 worker replicas "
+        "mid-round, assert the round completes on the survivor with "
+        "byte-identical transcripts, zero duplicated opponent attempts, "
+        "shared-store rehydration, and clean survivor invariants",
+    )
     args, extra = ap.parse_known_args(argv)
     if extra and extra[0] == "--":
         extra = extra[1:]
 
     if args.crash:
         return crash_drill()
+    if args.replica_kill:
+        return replica_kill_drill()
 
     rc = _pytest(extra, {})
     if rc != 0:

@@ -237,6 +237,10 @@ class GraftlintConfig:
             "adversarial_spec_tpu.obs.events:atomic_write_text",
             "adversarial_spec_tpu.debate.journal:RoundJournal._write",
             "adversarial_spec_tpu.engine.kvtier:DiskStore.put",
+            # The fleet worker's stderr log: an OS-owned append stream
+            # opened once at spawn for post-mortems — a torn line in a
+            # crash log is evidence, not corruption.
+            "adversarial_spec_tpu.fleet.replica:WorkerReplica._spawn",
         ]
     )
     # --- GL-LIFECYCLE ------------------------------------------------
@@ -266,6 +270,46 @@ class GraftlintConfig:
     lifecycle_mutators: list[str] = field(
         default_factory=lambda: ["_finish_admission", "_deliver_stream"]
     )
+    # The fleet router's replica state machine (fleet/router.py), the
+    # second GL-LIFECYCLE machine: every path that takes a replica out
+    # of service (transport death, heartbeat miss, shutdown) must reach
+    # the one retirement surgery, and the dead-replica ledger is
+    # written nowhere else. "" disables the machine (fixture trees).
+    fleet_lifecycle_class: str = "FleetRouter"
+    fleet_lifecycle_release: str = "_retire_replica"
+    fleet_lifecycle_exits: list[str] = field(
+        default_factory=lambda: [
+            "_on_replica_fault",
+            "_heartbeat_failure",
+            "shutdown",
+        ]
+    )
+    fleet_lifecycle_owned_attrs: list[str] = field(
+        default_factory=lambda: ["_dead"]
+    )
+    fleet_lifecycle_mutators: list[str] = field(default_factory=list)
+
+    def lifecycle_machines(self) -> list[tuple[str, str, list, list, list]]:
+        """The configured GL-LIFECYCLE state machines as (class,
+        release, exits, owned attrs, mutators); empty class names
+        disable a machine."""
+        machines = [
+            (
+                self.lifecycle_class,
+                self.lifecycle_release,
+                self.lifecycle_exits,
+                self.lifecycle_owned_attrs,
+                self.lifecycle_mutators,
+            ),
+            (
+                self.fleet_lifecycle_class,
+                self.fleet_lifecycle_release,
+                self.fleet_lifecycle_exits,
+                self.fleet_lifecycle_owned_attrs,
+                self.fleet_lifecycle_mutators,
+            ),
+        ]
+        return [m for m in machines if m[0]]
 
     def acquire_release(self) -> dict[str, str]:
         out: dict[str, str] = {}

@@ -78,6 +78,7 @@ class ConfigRule(Rule):
         "lifecycle_exits": [],
         "lifecycle_owned_attrs": [],
         "lifecycle_mutators": [],
+        "fleet_lifecycle_class": "",  # fixture has no fleet machine
     }
 
     def check(self, ctx: Context) -> None:
@@ -173,24 +174,40 @@ class ConfigRule(Rule):
         qualnames = {fe.qualname for fe in funcs.values()}
         for q in cfg.atomic_funcs:
             need(q in qualnames, "atomic_funcs", q)
-        need(
-            cfg.lifecycle_class in class_defs,
-            "lifecycle_class",
-            cfg.lifecycle_class,
-        )
-        lc_methods = methods_of(cfg.lifecycle_class)
-        lc_attrs, _ = class_body_names(cfg.lifecycle_class)
-        need(
-            cfg.lifecycle_release in lc_methods,
-            "lifecycle_release",
-            cfg.lifecycle_release,
-        )
-        for m in cfg.lifecycle_exits:
-            need(m in lc_methods, "lifecycle_exits", m)
-        for m in cfg.lifecycle_mutators:
-            need(m in lc_methods, "lifecycle_mutators", m)
-        for a in cfg.lifecycle_owned_attrs:
-            need(a in lc_attrs, "lifecycle_owned_attrs", a)
+        # Every configured lifecycle machine (the batcher's slot
+        # machine and the fleet router's replica machine) validates
+        # the same way; the knob-name prefix distinguishes findings.
+        for prefix, (cls_name, release, exits, owned, mutators) in zip(
+            ("lifecycle", "fleet_lifecycle"),
+            (
+                (
+                    cfg.lifecycle_class,
+                    cfg.lifecycle_release,
+                    cfg.lifecycle_exits,
+                    cfg.lifecycle_owned_attrs,
+                    cfg.lifecycle_mutators,
+                ),
+                (
+                    cfg.fleet_lifecycle_class,
+                    cfg.fleet_lifecycle_release,
+                    cfg.fleet_lifecycle_exits,
+                    cfg.fleet_lifecycle_owned_attrs,
+                    cfg.fleet_lifecycle_mutators,
+                ),
+            ),
+        ):
+            if not cls_name:
+                continue  # machine disabled (fixture trees)
+            need(cls_name in class_defs, f"{prefix}_class", cls_name)
+            lc_methods = methods_of(cls_name)
+            lc_attrs, _ = class_body_names(cls_name)
+            need(release in lc_methods, f"{prefix}_release", release)
+            for m in exits:
+                need(m in lc_methods, f"{prefix}_exits", m)
+            for m in mutators:
+                need(m in lc_methods, f"{prefix}_mutators", m)
+            for a in owned:
+                need(a in lc_attrs, f"{prefix}_owned_attrs", a)
 
         for knob, entry in stale:
             ctx.report(

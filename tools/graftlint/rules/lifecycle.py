@@ -2,11 +2,18 @@
 shared surgery, and slot ownership state is written nowhere else.
 
 GL-REFCOUNT guards acquire/release PAIRS; this rule generalizes it to
-the scheduler's slot STATE MACHINE. The batcher's release surgery
-(``_release_slot``) is deliberately the single implementation shared
-by finish / evict / cancel / watchdog (the PR 6 lesson: two fault
-paths hand-rolled the same surgery and drifted — one left
-``_slot_seq`` stale). Two invariants, both interprocedural:
+lifecycle STATE MACHINES — the scheduler's slot machine
+(``ContinuousBatcher._release_slot``) and the fleet router's replica
+machine (``FleetRouter._retire_replica``), each configured as a
+(class, release, exits, owned attrs, mutators) tuple via
+``GraftlintConfig.lifecycle_machines()``. The batcher's release
+surgery is deliberately the single implementation shared by finish /
+evict / cancel / watchdog (the PR 6 lesson: two fault paths
+hand-rolled the same surgery and drifted — one left ``_slot_seq``
+stale); the router's retirement surgery is the same discipline for
+replicas (transport death, heartbeat miss, and shutdown must all
+funnel through one exit). Two invariants per machine, both
+interprocedural:
 
 1. **Exit reachability** — every configured slot-exit path
    (``lifecycle_exits``: the finish/evict/cancel/watchdog entry
@@ -76,70 +83,78 @@ class LifecycleRule(Rule):
         "lifecycle_exits": ["_finish_slot", "_cancel_slot"],
         "lifecycle_owned_attrs": ["_slot_req", "_slot_seq"],
         "lifecycle_mutators": [],
+        "fleet_lifecycle_class": "",  # fixture has no fleet machine
     }
 
     def check(self, ctx: Context) -> None:
         cfg = ctx.cfg
-        owned = set(cfg.lifecycle_owned_attrs)
-        release = cfg.lifecycle_release
-        allowed_writers = (
-            set(cfg.lifecycle_mutators) | {release, "__init__"}
-        )
-        table = function_table(ctx.index)  # shared across all exits
-        for info in ctx.index.values():
-            ci = info.classes.get(cfg.lifecycle_class)
-            if ci is None:
-                continue
-            for exit_name in cfg.lifecycle_exits:
-                node = ci.method_nodes.get(exit_name)
-                if node is None:
-                    continue  # GL-CONFIG flags the stale config entry
-                entry = FuncEntry(
-                    info.modname, cfg.lifecycle_class, exit_name, node
-                )
-                if not reaches(
-                    ctx.index,
-                    entry,
-                    release,
-                    depth=cfg.dataflow_depth,
-                    table=table,
-                ):
-                    ctx.report(
-                        "GL-LIFECYCLE",
-                        info.path,
-                        node.lineno,
-                        f"slot-exit path {cfg.lifecycle_class}."
-                        f"{exit_name} never reaches the shared release "
-                        f"surgery {release}() (within "
-                        f"{cfg.dataflow_depth} call hops) — an exit "
-                        "that skips the surgery leaks pages or leaves "
-                        "stale ownership; route it through "
-                        f"{release}() or suppress with a reason",
-                    )
-            for mname, mnode in ci.method_nodes.items():
-                if mname in allowed_writers:
+        table = function_table(ctx.index)  # shared across all machines
+        for (
+            cls_name,
+            release,
+            exits,
+            owned_attrs,
+            mutators,
+        ) in cfg.lifecycle_machines():
+            owned = set(owned_attrs)
+            allowed_writers = set(mutators) | {release, "__init__"}
+            for info in ctx.index.values():
+                ci = info.classes.get(cls_name)
+                if ci is None:
                     continue
-                for sub in ast.walk(mnode):
-                    targets: list[ast.expr] = []
-                    if isinstance(sub, ast.Assign):
-                        targets = list(sub.targets)
-                    elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
-                        targets = [sub.target]
-                    for t in targets:
-                        attr = _target_attr(t)
-                        if attr in owned:
-                            ctx.report(
-                                "GL-LIFECYCLE",
-                                info.path,
-                                sub.lineno,
-                                f"slot-ownership state self.{attr} "
-                                f"written in {cfg.lifecycle_class}."
-                                f"{mname}, outside the shared release "
-                                f"surgery ({release}) and the "
-                                "sanctioned mutators "
-                                f"({', '.join(sorted(allowed_writers))})"
-                                " — hand-rolled lifecycle writes are "
-                                "exactly the drift the shared surgery "
-                                "prevents; move the write or suppress "
-                                "with a reason",
-                            )
+                for exit_name in exits:
+                    node = ci.method_nodes.get(exit_name)
+                    if node is None:
+                        continue  # GL-CONFIG flags the stale entry
+                    entry = FuncEntry(
+                        info.modname, cls_name, exit_name, node
+                    )
+                    if not reaches(
+                        ctx.index,
+                        entry,
+                        release,
+                        depth=cfg.dataflow_depth,
+                        table=table,
+                    ):
+                        ctx.report(
+                            "GL-LIFECYCLE",
+                            info.path,
+                            node.lineno,
+                            f"lifecycle exit path {cls_name}."
+                            f"{exit_name} never reaches the shared "
+                            f"release surgery {release}() (within "
+                            f"{cfg.dataflow_depth} call hops) — an "
+                            "exit that skips the surgery leaks "
+                            "resources or leaves stale ownership; "
+                            f"route it through {release}() or "
+                            "suppress with a reason",
+                        )
+                for mname, mnode in ci.method_nodes.items():
+                    if mname in allowed_writers:
+                        continue
+                    for sub in ast.walk(mnode):
+                        targets: list[ast.expr] = []
+                        if isinstance(sub, ast.Assign):
+                            targets = list(sub.targets)
+                        elif isinstance(
+                            sub, (ast.AugAssign, ast.AnnAssign)
+                        ):
+                            targets = [sub.target]
+                        for t in targets:
+                            attr = _target_attr(t)
+                            if attr in owned:
+                                ctx.report(
+                                    "GL-LIFECYCLE",
+                                    info.path,
+                                    sub.lineno,
+                                    f"lifecycle-owned state self.{attr} "
+                                    f"written in {cls_name}."
+                                    f"{mname}, outside the shared "
+                                    f"release surgery ({release}) and "
+                                    "the sanctioned mutators "
+                                    f"({', '.join(sorted(allowed_writers))})"
+                                    " — hand-rolled lifecycle writes "
+                                    "are exactly the drift the shared "
+                                    "surgery prevents; move the write "
+                                    "or suppress with a reason",
+                                )

@@ -98,6 +98,28 @@ def summarize(events: list[dict]) -> str:
             f"  WARNING: {len(unexpected)} unexpected jit recompile(s): "
             + ", ".join(sorted({c["program"] for c in unexpected}))
         )
+    hops = [
+        e for e in events if e["type"] == "route" and e["hop"] > 0
+    ]
+    if hops:
+        lines.append(
+            f"  {len(hops)} failover hop(s): "
+            + ", ".join(
+                f"req {h['req_id']}->{h['replica']} ({h['reason']})"
+                for h in hops
+            )
+        )
+    retired = [
+        e
+        for e in events
+        if e["type"] == "replica" and e["op"] in ("retire", "heartbeat_miss")
+    ]
+    for r in retired:
+        lines.append(
+            f"  WARNING: replica {r['replica']} {r['op']}"
+            + (f" ({r['reason']})" if r["reason"] else "")
+            + f", {r['alive']} left"
+        )
     return "\n".join(lines)
 
 
@@ -108,9 +130,15 @@ def occupancy_timeline(events: list[dict], width: int = 16) -> str:
     doing' view. When the dump carries SwapEvents (tiered KV,
     engine/kvtier.py) each step row is additionally annotated with the
     per-tier residency as of that step (host/disk block counts trail
-    the most recent swap), and the swaps themselves print inline."""
+    the most recent swap), and the swaps themselves print inline. A
+    fleet dump (Route/ReplicaEvents, fleet/router.py) adds a replica
+    column: each step row carries the replica most recently routed to
+    (``rep=``), and the routing decisions / replica lifecycle
+    transitions print inline where they happened."""
     steps = [
-        e for e in events if e["type"] in ("step", "swap", "span", "cancel")
+        e
+        for e in events
+        if e["type"] in ("step", "swap", "span", "cancel", "route", "replica")
     ]
     if not any(e["type"] == "step" for e in steps):
         return "(no step events)"
@@ -119,8 +147,10 @@ def occupancy_timeline(events: list[dict], width: int = 16) -> str:
     )
     scale = max(max_live, 1)
     tiered = any(e["type"] == "swap" for e in steps)
+    fleet = any(e["type"] in ("route", "replica") for e in steps)
     rows = []
     host_res = disk_res = 0
+    cur_replica = ""
     for s in steps:
         if s["type"] == "span":
             # Trace-span boundaries print inline so the timeline shows
@@ -157,6 +187,33 @@ def occupancy_timeline(events: list[dict], width: int = 16) -> str:
                 f"saved={s['tokens_saved']}tok ({s['reason']})"
             )
             continue
+        if s["type"] == "route":
+            cur_replica = s["replica"]
+            notes = [f"req={s['req_id']}"]
+            if s["hop"]:
+                notes.append(f"hop={s['hop']}")
+            notes.append(f"key={s['key'][:12]}")
+            rows.append(
+                f"seq {s['seq']:>6} [{'>' * width}] "
+                f"{'route>' + s['replica']:<13} "
+                f"{s['reason']} " + " ".join(notes)
+            )
+            continue
+        if s["type"] == "replica":
+            rows.append(
+                f"seq {s['seq']:>6} [{'!' * width}] "
+                f"{'replica:' + s['op']:<13} "
+                + " ".join(
+                    n
+                    for n in (
+                        s["replica"],
+                        s["reason"],
+                        f"alive={s['alive']}",
+                    )
+                    if n
+                )
+            )
+            continue
         if s["type"] == "swap":
             host_res, disk_res = s["host_resident"], s["disk_resident"]
             notes = [f"{s['blocks']} block(s)", f"{s['tokens']}tok"]
@@ -184,6 +241,8 @@ def occupancy_timeline(events: list[dict], width: int = 16) -> str:
         if tiered:
             notes.append(f"host={host_res}")
             notes.append(f"disk={disk_res}")
+        if fleet:
+            notes.append(f"rep={cur_replica or '?'}")
         rows.append(
             f"seq {s['seq']:>6} [{bar}] {s['kind']:<8} " + " ".join(notes)
         )
@@ -196,6 +255,7 @@ def occupancy_timeline(events: list[dict], width: int = 16) -> str:
         + ("; ~=tier swap, host/disk=resident blocks" if tiered else "")
         + ("; >=span begin <=span end" if spanned else "")
         + ("; x=early cancel" if cancelled else "")
+        + ("; rep=last routed replica, !=replica lifecycle" if fleet else "")
         + ")"
     )
     return "\n".join([legend] + rows)

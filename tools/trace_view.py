@@ -48,9 +48,23 @@ def collect_requests(events: list[dict]) -> dict[str, dict]:
     ``{span_id: {trace_id, req_id, begin_seq, stages: {name: wall},
     ended, extra}}``. A re-emitted stage (a requeued request prefilling
     twice) keeps the LAST end wall — the one the request actually paid
-    on its surviving attempt."""
+    on its surviving attempt. Fleet RouteEvents stamped with the span
+    collect under ``route`` (in seq order), so a failover hop — the
+    request leaving a dead replica for a survivor — is visible right
+    in the waterfall head instead of only in the raw dump."""
     out: dict[str, dict] = {}
+    routes: dict[str, list[dict]] = {}
     for e in events:
+        if e["type"] == "route" and e.get("span_id"):
+            routes.setdefault(e["span_id"], []).append(
+                {
+                    "replica": e["replica"],
+                    "hop": e["hop"],
+                    "reason": e["reason"],
+                    "seq": e["seq"],
+                }
+            )
+            continue
         if e["type"] != "span" or not e["span_id"]:
             continue
         rec = out.setdefault(
@@ -63,6 +77,7 @@ def collect_requests(events: list[dict]) -> dict[str, dict]:
                 "request_wall": None,
                 "end_seq": None,
                 "cancelled": False,
+                "route": [],
             },
         )
         rec["begin_seq"] = min(rec["begin_seq"], e["seq"])
@@ -78,6 +93,9 @@ def collect_requests(events: list[dict]) -> dict[str, dict]:
             rec["cancelled"] = e["phase"] == "cancelled"
         elif e["name"] in STAGES:
             rec["stages"][e["name"]] = e["wall_s"]
+    for span_id, hops in routes.items():
+        if span_id in out:
+            out[span_id]["route"] = sorted(hops, key=lambda h: h["seq"])
     return out
 
 
@@ -130,6 +148,15 @@ def render_waterfall(
             if wall is not None
             else ", open)"
         )
+        hops = rec.get("route") or []
+        if hops:
+            # The replica path: "via r0" normally; a failover shows the
+            # whole chain ("via r0 -> r1 (failover)") so a replica loss
+            # is readable straight off the waterfall.
+            path = " -> ".join(h["replica"] for h in hops)
+            head += f"  via {path}"
+            if hops[-1]["hop"] > 0:
+                head += f" ({hops[-1]['reason']})"
         rows.append(head)
         offset = 0.0
         for name in STAGES:
