@@ -55,6 +55,7 @@ ACTIONS = [
     "save-profile",
     "sessions",
     "registry",
+    "serve",
 ]
 
 DEFAULT_MODELS = ["mock://critic?agree_after=3"]
@@ -349,7 +350,7 @@ def create_parser() -> argparse.ArgumentParser:
         help=(
             "Arm fault injection: kind@seam[:p=F][:after=N][:times=N]"
             "[:slot=K], comma-separated (kinds: oom, device_lost, "
-            "preempted, timeout, bug; seams: generate, scheduler_chunk, "
+            "preempted, timeout, shed, bug; seams: generate, scheduler_chunk, "
             "kv_alloc, kv_swap, checkpoint_load, crash, replica). Also "
             "via ADVSPEC_CHAOS"
         ),
@@ -402,6 +403,66 @@ def create_parser() -> argparse.ArgumentParser:
         help="Replica transport: fresh in-process engines (inproc) or "
         "one subprocess per replica (worker — the SIGKILL-able "
         "topology tools/chaos_run.py --replica-kill drills)",
+    )
+
+    v = parser.add_argument_group("serve")
+    v.add_argument(
+        "--socket",
+        default=None,  # None = inherit ADVSPEC_SERVE_SOCKET
+        help="Unix socket path the serve daemon listens on (default "
+        "./advspec-serve.sock; ADVSPEC_SERVE_SOCKET sets the process "
+        "default). Transport: line-delimited JSON request/stream "
+        "(docs/serving.md)",
+    )
+    v.add_argument(
+        "--serve-queue-depth",
+        type=int,
+        default=None,  # None = inherit ADVSPEC_SERVE_QUEUE_DEPTH
+        help="Per-tenant outstanding-debate cap: admissions past it "
+        "shed with a typed queue_full refusal (default 8; "
+        "ADVSPEC_SERVE_QUEUE_DEPTH sets the process default)",
+    )
+    v.add_argument(
+        "--serve-backlog-tokens",
+        type=int,
+        default=None,  # None = inherit ADVSPEC_SERVE_BACKLOG_TOKENS
+        help="Estimated-token-backlog cap: admissions that would cross "
+        "it shed with a typed backlog refusal carrying retry_after_s "
+        "(default 65536; ADVSPEC_SERVE_BACKLOG_TOKENS sets the process "
+        "default). Brownout enters at 75%% of this cap",
+    )
+    v.add_argument(
+        "--serve-quota-tokens",
+        type=int,
+        default=None,  # None = inherit ADVSPEC_SERVE_QUOTA_TOKENS
+        help="Per-tenant token quota, debited with actual Usage tokens "
+        "on completion and refillable via the refill op (0 = unlimited, "
+        "the default; ADVSPEC_SERVE_QUOTA_TOKENS sets the process "
+        "default)",
+    )
+    v.add_argument(
+        "--serve-drain-deadline-s",
+        type=float,
+        default=None,  # None = inherit ADVSPEC_SERVE_DRAIN_DEADLINE_S
+        help="Seconds SIGTERM waits for in-flight debates before "
+        "shedding the queue (typed, journal-resumable) and cancelling "
+        "running units (default 5; ADVSPEC_SERVE_DRAIN_DEADLINE_S sets "
+        "the process default)",
+    )
+    v.add_argument(
+        "--serve-ttft-slo-ms",
+        type=float,
+        default=None,  # None = inherit ADVSPEC_SERVE_TTFT_SLO_MS
+        help="Interactive-tier TTFT SLO budget in milliseconds — the "
+        "batch-preemption policy's trigger (preempt at half the "
+        "budget; 0 = preempt the moment interactive work waits; "
+        "ADVSPEC_SERVE_TTFT_SLO_MS sets the process default)",
+    )
+    v.add_argument(
+        "--drain-report",
+        default=None,
+        help="Also write the SIGTERM drain report to this file "
+        "(atomic tmp+rename; the report always prints to stdout)",
     )
 
     r = parser.add_argument_group("registry")
@@ -736,6 +797,73 @@ def _configure_obs(args: argparse.Namespace):
     )
     obs.reset_stats()
     return obs
+
+
+def handle_serve(args: argparse.Namespace) -> int:
+    """``debate serve`` — the persistent multi-debate daemon
+    (adversarial_spec_tpu/serve). Unlike every other action, this one
+    configures the process-wide subsystems ONCE and then serves until
+    drained: the per-invocation reset cascade must never run mid-serve
+    (concurrent debates would lose their counters and trace scopes —
+    the collision docs/serving.md explains)."""
+    import os as _os
+
+    from adversarial_spec_tpu import serve as serve_mod
+    from adversarial_spec_tpu.serve.daemon import run_daemon
+
+    # One-time arming of the same knobs a critique round would arm.
+    _configure_resilience(args)
+    _configure_prefix_cache(args)
+    _configure_interleave(args)
+    _configure_speculative(args)
+    _configure_kv_tier(args)
+    _configure_streaming(args)
+    _configure_fleet(args)
+    _configure_obs(args)
+    serve_mod.configure(
+        max_queue_depth=(
+            args.serve_queue_depth
+            if args.serve_queue_depth is not None
+            else serve_mod.env_queue_depth()
+        ),
+        max_backlog_tokens=(
+            args.serve_backlog_tokens
+            if args.serve_backlog_tokens is not None
+            else serve_mod.env_backlog_tokens()
+        ),
+        tenant_quota_tokens=(
+            args.serve_quota_tokens
+            if args.serve_quota_tokens is not None
+            else serve_mod.env_quota_tokens()
+        ),
+        drain_deadline_s=(
+            args.serve_drain_deadline_s
+            if args.serve_drain_deadline_s is not None
+            else serve_mod.env_drain_deadline_s()
+        ),
+        interactive_ttft_slo_ms=(
+            args.serve_ttft_slo_ms
+            if args.serve_ttft_slo_ms is not None
+            else serve_mod.env_ttft_slo_ms()
+        ),
+    )
+    serve_mod.reset_stats()
+    socket_path = (
+        args.socket
+        or _os.environ.get("ADVSPEC_SERVE_SOCKET")
+        or "./advspec-serve.sock"
+    )
+    cfg = serve_mod.config()
+    _err(
+        f"advspec serve: listening on {socket_path} "
+        f"(queue depth {cfg.max_queue_depth}/tenant, backlog cap "
+        f"{cfg.max_backlog_tokens} tokens, drain deadline "
+        f"{cfg.drain_deadline_s}s); SIGTERM drains gracefully"
+    )
+    return run_daemon(
+        socket_path,
+        drain_report_path=args.drain_report,
+    )
 
 
 def run_critique(args: argparse.Namespace) -> int:
@@ -1400,6 +1528,8 @@ def main(argv: list[str] | None = None) -> int:
             return info
         if args.action == "critique":
             return run_critique(args)
+        if args.action == "serve":
+            return handle_serve(args)
         if args.action == "export-tasks":
             return handle_export_tasks(args)
         if args.action == "diff":

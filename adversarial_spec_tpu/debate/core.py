@@ -80,6 +80,12 @@ class RoundConfig:
     # of an unnamed one-shot debate still co-locate with each other
     # only while the spec's hash is stable.
     debate_id: str = ""
+    # Trace-minting scope (obs/trace.py daemon scopes): "" keeps the
+    # CLI's process-wide counter (tier-1 pins exact ids on it); the
+    # serve daemon sets its per-debate id so concurrent rounds mint
+    # from their OWN counters — deterministic per debate,
+    # collision-free across the debates of one long-lived process.
+    trace_scope: str = ""
     # Injected for tests; defaults to real sleep for backoff.
     sleep = staticmethod(time.sleep)
 
@@ -201,6 +207,12 @@ def run_round(
     reference's policy); retries re-batch only the failed requests, and a
     nonzero ``sampling.timeout_s`` bounds the whole round (no retry starts
     past the deadline).
+
+    REENTRANT: the serve daemon runs many of these concurrently, one
+    per debate thread. Everything mutable here is either local, lock-
+    protected (breakers), per-session (journal), or thread-local (the
+    ambient trace scope) — and ``cfg.trace_scope`` gives each debate
+    its own id counter so concurrent rounds never collide.
     """
     cfg = cfg or RoundConfig()
     # The debate layer's own tracer: per-opponent chat walls + attempt
@@ -222,7 +234,9 @@ def run_round(
     # mock and real serving paths carry byte-identical ids for the same
     # invocation sequence. The ids ride the requests by value; the
     # ambient scope below covers emitters that don't know their request.
-    trace_id = obs_mod.trace.mint_trace(round_num)
+    trace_id = obs_mod.trace.mint_trace(
+        round_num, scope=cfg.trace_scope or None
+    )
     # Fleet routing key (fleet/router.py): the whole debate shares one
     # affinity key, so a fleet places all its rounds on one replica —
     # where the document prefix's KV already lives.
@@ -452,14 +466,22 @@ def run_round(
                     tracer.add_span(f"opponent/{requests[i].model}", latency)
                     tracer.count(f"attempts.{requests[i].model}", 1)
                     # Every attempt's outcome feeds the model's breaker:
-                    # threshold consecutive failures open it.
+                    # threshold consecutive failures open it. EXCEPT a
+                    # serving-layer SHED (daemon quota/drain policy) —
+                    # the model did nothing wrong, and a drain storm
+                    # counting as N failures per opponent would open
+                    # every circuit in the pool (found by the SIGTERM
+                    # drain drill).
+                    fail_kind = (
+                        None
+                        if comp.ok
+                        else classify_message(comp.error or "")
+                    )
                     if comp.ok:
                         breakers.record(requests[i].model, ok=True)
-                    else:
+                    elif fail_kind is not FaultKind.SHED:
                         breakers.record(
-                            requests[i].model,
-                            ok=False,
-                            kind=classify_message(comp.error or ""),
+                            requests[i].model, ok=False, kind=fail_kind
                         )
                     # A watchdog-expired request does NOT re-enter the
                     # 3-attempt backoff ladder (its per-request deadline
@@ -534,11 +556,11 @@ def run_round(
                         breakers.record(requests[i].model, ok=True)
                         _resolve(i, comp, latency)
                     else:
-                        breakers.record(
-                            requests[i].model,
-                            ok=False,
-                            kind=classify_message(comp.error or ""),
-                        )
+                        hedge_kind = classify_message(comp.error or "")
+                        if hedge_kind is not FaultKind.SHED:
+                            breakers.record(
+                                requests[i].model, ok=False, kind=hedge_kind
+                            )
                         # The hedge lost too: keep the ORIGINAL partial
                         # (more salvaged text, the first failure's true
                         # latency). No third attempt.

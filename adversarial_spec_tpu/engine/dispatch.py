@@ -8,9 +8,15 @@ where ``tpu://`` slots in. Engines are cached: all ``tpu://`` models share one
 
 from __future__ import annotations
 
+import threading
+
 from adversarial_spec_tpu.engine.types import Engine
 
 _ENGINE_CACHE: dict[str, Engine] = {}
+# The serve daemon resolves engines from concurrent debate threads;
+# double-building a provider's engine (two allocators, two weight
+# sets) must not be a race outcome.
+_CACHE_LOCK = threading.Lock()
 
 
 def _provider_key(model: str) -> str:
@@ -53,15 +59,28 @@ def get_engine(model: str) -> Engine:
     (one FleetEngine over N replicas) when the fleet is armed, else
     the cached single engine per provider — all ``tpu://`` models
     share one ``TpuEngine`` so co-resident opponents can batch onto
-    one mesh."""
+    one mesh. While the serve daemon is up, the result is additionally
+    wrapped by the scheduler gate (serve/gate.py): same Engine
+    protocol, but chat calls interleave fair-share with every other
+    debate's — the round driver cannot tell, which is the point."""
     from adversarial_spec_tpu import fleet as fleet_mod
+    from adversarial_spec_tpu.serve import gate as serve_gate
 
     key = _provider_key(model)  # validate the id either way
     if fleet_mod.armed():
-        return fleet_mod.fleet_engine()
-    if key not in _ENGINE_CACHE:
-        _ENGINE_CACHE[key] = new_engine(model)
-    return _ENGINE_CACHE[key]
+        return serve_gate.wrap(fleet_mod.fleet_engine())
+    with _CACHE_LOCK:
+        if key not in _ENGINE_CACHE:
+            _ENGINE_CACHE[key] = new_engine(model)
+        engine = _ENGINE_CACHE[key]
+    return serve_gate.wrap(engine)
+
+
+def cached_engines() -> list[Engine]:
+    """The process's live inner engines (no gate wrappers) — the serve
+    daemon's ``check`` op walks these for allocator/tier invariants."""
+    with _CACHE_LOCK:
+        return list(_ENGINE_CACHE.values())
 
 
 def clear_engine_cache() -> None:

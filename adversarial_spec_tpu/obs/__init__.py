@@ -47,6 +47,7 @@ from adversarial_spec_tpu.obs.events import (  # noqa: F401 (re-export)
     ReplicaEvent,
     RequestEvent,
     RouteEvent,
+    ServeEvent,
     SpanEvent,
     SpecEvent,
     StepEvent,
@@ -172,6 +173,8 @@ class HotMetrics:
         "journal_fsync",
         "fleet_replicas_alive",
         "fleet_affinity_ratio",
+        "serve_backlog",
+        "serve_queue_wait",
         "_m",
         "_sync",
         "_fault",
@@ -181,6 +184,8 @@ class HotMetrics:
         "_cancel",
         "_route",
         "_replica_op",
+        "_serve_op",
+        "_serve_shed",
     )
 
     def __init__(self, m: MetricsRegistry) -> None:
@@ -272,6 +277,18 @@ class HotMetrics:
             help="requests served by their affinity-primary replica "
             "(this round)",
         )
+        # Serve daemon (adversarial_spec_tpu/serve): the scheduler's
+        # estimated token backlog (the admission-control pressure
+        # signal) and per-unit queue wait (admission -> dispatch — the
+        # fairness the stride scheduler is accountable for).
+        self.serve_backlog = m.gauge(
+            "advspec_serve_backlog_tokens",
+            help="serve scheduler estimated token backlog",
+        )
+        self.serve_queue_wait = m.histogram(
+            "advspec_serve_queue_wait_seconds",
+            help="opponent-unit wait from admission to dispatch",
+        )
         self._sync: dict = {}
         self._fault: dict = {}
         self._breaker: dict = {}
@@ -280,6 +297,8 @@ class HotMetrics:
         self._cancel: dict = {}
         self._route: dict = {}
         self._replica_op: dict = {}
+        self._serve_op: dict = {}
+        self._serve_shed: dict = {}
 
     def sync(self, reason: str):
         c = self._sync.get(reason)
@@ -357,6 +376,32 @@ class HotMetrics:
                 "advspec_fleet_replica_events_total",
                 help="fleet replica lifecycle transitions by op",
                 op=op,
+            )
+        return c
+
+    def serve_op(self, op: str):
+        """Serve-daemon lifecycle transitions by op (serve/sched.py
+        state machine: accepted/queued/running/finished/shed/preempted/
+        drained plus brownout_enter/brownout_exit)."""
+        c = self._serve_op.get(op)
+        if c is None:
+            c = self._serve_op[op] = self._m.counter(
+                "advspec_serve_requests_total",
+                help="serve-daemon request lifecycle transitions by op",
+                op=op,
+            )
+        return c
+
+    def serve_shed(self, reason: str):
+        """Typed load-shed rejections by reason (serve/protocol.py
+        SHED_REASONS) — the shed-not-collapse ledger the overload
+        chaos drill audits."""
+        c = self._serve_shed.get(reason)
+        if c is None:
+            c = self._serve_shed[reason] = self._m.counter(
+                "advspec_serve_shed_total",
+                help="serve-daemon typed load-shed rejections by reason",
+                reason=reason,
             )
         return c
 

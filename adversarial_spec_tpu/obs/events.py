@@ -6,10 +6,12 @@ the flight recorder says WHAT the batcher was doing step by step.
 Design constraints, in order:
 
 - **Cheap when idle.** ``append`` stores a dataclass in a
-  ``deque(maxlen=N)`` — no formatting, no I/O, no locks on the serving
-  path (the drive loop is single-threaded; cross-thread emitters like
-  the breaker registry serialize on their own locks before reaching
-  here). Formatting happens only at dump time.
+  ``deque(maxlen=N)`` — no formatting, no I/O. Formatting happens only
+  at dump time. Since the serve daemon made multi-threaded emitters
+  real (thread-per-debate round drivers emitting SpanEvents
+  concurrently), ``append`` takes one uncontended lock so ``seq`` and
+  ``dropped`` stay exact; the obs-overhead bench budget absorbs it
+  (BENCH_obs.json sat at 0.57% of a 3% budget before the lock).
 - **Bounded.** The ring holds the LAST ``size`` events; older ones are
   dropped and counted (``dropped``), never grown over.
 - **Deterministic.** Events carry a monotonic ``seq`` and NO wall-clock
@@ -46,6 +48,12 @@ Event vocabulary (the schema ``tools/obs_dump.py`` validates):
 - ``RouteEvent`` — one fleet routing decision: which replica a request
   landed on, the affinity key it hashed, and the failover hop count
   (0 = the ring's primary choice).
+- ``ServeEvent`` — one serve-daemon lifecycle/pressure transition
+  (adversarial_spec_tpu/serve): a debate accepted/shed at admission, an
+  opponent unit queued/running/finished/preempted/drained, a brownout
+  entry/exit — with the tenant, tier, and post-op backlog so the
+  timeline shows WHO was being served and WHO was shed when pressure
+  hit.
 
 Causal tracing (obs/trace.py): EVERY event additionally carries
 ``trace_id`` (the debate round that caused it) and ``span_id`` (the
@@ -59,6 +67,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import threading
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -297,6 +306,32 @@ class RouteEvent:
     span_id: str = ""
 
 
+@dataclass(slots=True)
+class ServeEvent:
+    """One serve-daemon transition (adversarial_spec_tpu/serve). ``op``
+    names the edge of the request lifecycle state machine (accepted →
+    queued → running → finished | shed | preempted | drained) or a
+    pressure transition (brownout_enter / brownout_exit). ``debate`` is
+    the daemon-assigned request id; ``index`` the opponent unit within
+    it (-1 = debate-level). ``reason`` carries the typed shed/preempt/
+    drain cause (serve/protocol.py SHED_REASONS and ``tier_pressure``
+    for policy preemptions); ``backlog_tokens`` is the scheduler's
+    estimated token backlog AFTER the op, so the timeline shows
+    pressure building and draining."""
+
+    TYPE = "serve"
+    op: str = "accepted"
+    tenant: str = ""
+    tier: str = "interactive"
+    debate: str = ""
+    index: int = -1
+    reason: str = ""
+    tokens: int = 0
+    backlog_tokens: int = 0
+    trace_id: str = ""
+    span_id: str = ""
+
+
 EVENT_TYPES = (
     StepEvent,
     RequestEvent,
@@ -312,6 +347,7 @@ EVENT_TYPES = (
     RecoveryEvent,
     ReplicaEvent,
     RouteEvent,
+    ServeEvent,
 )
 
 # ``cancelled`` closes a request envelope mid-decode (streaming early
@@ -343,6 +379,25 @@ ROUTE_REASONS = (
     "failover",
     "random",
 )
+
+# The serve-daemon request lifecycle (docs/serving.md state machine)
+# plus the brownout pressure transitions. graftlint's third
+# GL-LIFECYCLE machine enforces the code side of the same contract:
+# every exit op below maps to a path through the scheduler's one
+# release surgery.
+SERVE_OPS = (
+    "accepted",
+    "queued",
+    "running",
+    "finished",
+    "shed",
+    "preempted",
+    "drained",
+    "brownout_enter",
+    "brownout_exit",
+)
+
+SERVE_TIERS = ("interactive", "batch")
 
 REQUEST_STATES = (
     "queued",
@@ -422,6 +477,11 @@ def validate_event(obj) -> list[str]:
         errors.append(f"replica: unknown op {obj.get('op')!r}")
     if etype == "route" and obj.get("reason") not in ROUTE_REASONS:
         errors.append(f"route: unknown reason {obj.get('reason')!r}")
+    if etype == "serve":
+        if obj.get("op") not in SERVE_OPS:
+            errors.append(f"serve: unknown op {obj.get('op')!r}")
+        if obj.get("tier") not in SERVE_TIERS:
+            errors.append(f"serve: unknown tier {obj.get('tier')!r}")
     return errors
 
 
@@ -434,6 +494,10 @@ class FlightRecorder:
     seq: int = 0  # total events ever appended (monotonic)
     dropped: int = 0  # events pushed out of the ring
     _buf: deque = field(default_factory=deque)
+    # Serializes seq/dropped/_buf mutation: the serve daemon's debate
+    # threads emit concurrently (buffered + dropped == seq must hold
+    # exactly — the chaos fuzz pins it).
+    _lock: threading.Lock = field(default_factory=threading.Lock)
 
     def __post_init__(self) -> None:
         self._buf = deque(self._buf, maxlen=self.size)
@@ -441,10 +505,11 @@ class FlightRecorder:
     def append(self, ev) -> None:
         if not self.enabled:
             return
-        self.seq += 1
-        if len(self._buf) == self._buf.maxlen:
-            self.dropped += 1
-        self._buf.append((self.seq, ev))
+        with self._lock:
+            self.seq += 1
+            if len(self._buf) == self._buf.maxlen:
+                self.dropped += 1
+            self._buf.append((self.seq, ev))
 
     def __len__(self) -> int:
         return len(self._buf)
@@ -452,29 +517,37 @@ class FlightRecorder:
     def resize(self, size: int) -> None:
         size = max(1, int(size))
         if size != self.size:
-            self.size = size
-            # Shrinking ages out the oldest events — they are drops
-            # like any other (buffered + dropped == seq must hold).
-            self.dropped += max(0, len(self._buf) - size)
-            self._buf = deque(self._buf, maxlen=size)
+            with self._lock:
+                self.size = size
+                # Shrinking ages out the oldest events — they are drops
+                # like any other (buffered + dropped == seq must hold).
+                self.dropped += max(0, len(self._buf) - size)
+                self._buf = deque(self._buf, maxlen=size)
 
     def clear(self) -> None:
-        self._buf.clear()
-        self.seq = 0
-        self.dropped = 0
+        with self._lock:
+            self._buf.clear()
+            self.seq = 0
+            self.dropped = 0
 
     def events(self, trace_id: str | None = None) -> list[dict]:
         """Buffered events as dicts; ``trace_id`` scopes to one round's
-        causal story (the SLO auto-dump's view)."""
+        causal story (the SLO auto-dump's view). Snapshots the ring
+        under the lock first — a daemon auto-dump must not race a
+        concurrent debate thread's append mid-iteration."""
+        with self._lock:
+            items = list(self._buf)
         return [
             event_to_dict(seq, ev)
-            for seq, ev in self._buf
+            for seq, ev in items
             if trace_id is None or ev.trace_id == trace_id
         ]
 
     def counts_by_type(self) -> dict[str, int]:
+        with self._lock:
+            items = list(self._buf)
         out: dict[str, int] = {}
-        for _, ev in self._buf:
+        for _, ev in items:
             out[ev.TYPE] = out.get(ev.TYPE, 0) + 1
         return dict(sorted(out.items()))
 

@@ -20,34 +20,52 @@ Id model
   (``i`` = the request's index in the round). A span id embeds its
   trace id, so a span alone resolves to exactly one round + opponent.
 
+Daemon scopes (``advspec serve``)
+---------------------------------
+
+One process-wide counter is exactly right for the CLI's one-invocation-
+one-round world and exactly wrong for a long-lived daemon running many
+concurrent debates: two debates minting round 1 would collide on
+``tr-001-01``, and the per-invocation ``reset()`` cascade would zero a
+counter mid-flight for every other debate. ``mint_trace(scope=...)``
+is the daemon-safe variant: each scope (one debate/session id) gets
+its OWN counter and an 8-hex scope suffix —
+``tr-<round:03d>-<n:02d>-<8hex(scope)>`` — so ids are deterministic
+PER DEBATE, collision-free ACROSS debates, and a reset of one scope's
+counter (``reset_scope``) never touches another's.
+
 Propagation is by VALUE down the serving stack (``ChatRequest`` →
 ``SchedRequest`` → per-slot batcher state) and by AMBIENT context for
 emit sites that do not know their request (prefix-cache CacheEvents,
 tier SwapEvents, retrace CompileEvents): ``obs.emit`` stamps any event
 whose ``trace_id``/``span_id`` fields are empty from the ambient pair
-set here. The drive loop is single-threaded, so plain module state
-suffices — no contextvars, no locks (same concession the recorder
-makes).
+set here. The ambient pair is THREAD-LOCAL: the CLI's single-threaded
+drive loop behaves exactly as before, and the serve daemon's
+thread-per-debate round drivers each stamp their own round's ids
+instead of stomping a module global (the collision ISSUE 14 fixes).
+Minting takes a small lock for the same reason.
 
-``reset()`` clears BOTH the counter and the ambient pair; it rides
-``obs.reset_stats()`` so one CLI invocation's trace state can never
-leak into the next (one invocation = one round).
+``reset()`` clears the counters and the calling thread's ambient pair;
+it rides ``obs.reset_stats()`` so one CLI invocation's trace state can
+never leak into the next (one invocation = one round). The daemon
+deliberately does NOT run the per-invocation reset cascade mid-serve —
+it resets once at startup and relies on scoped minting after that.
 """
 
 from __future__ import annotations
 
 import hashlib
+import threading
 from contextlib import contextmanager
 
 
-class _Ambient:
+class _Ambient(threading.local):
     """The current (trace_id, span_id) pair ``obs.emit`` stamps from.
 
-    A tiny slotted object rather than two module globals so the emit
-    hot path pays one attribute load to reach both fields.
+    Thread-local: each serve-daemon debate thread carries its own
+    ambient pair (its round's ids), while the single-threaded CLI pays
+    one attribute load exactly as before.
     """
-
-    __slots__ = ("trace", "span")
 
     def __init__(self) -> None:
         self.trace = ""
@@ -56,24 +74,42 @@ class _Ambient:
 
 ambient = _Ambient()
 _trace_counter = 0
+# Per-scope counters for daemon minting (scope = one debate/session id).
+_scope_counters: dict[str, int] = {}
+_mint_lock = threading.Lock()
 
 
-def mint_trace(round_num: int = 0, seed: int | None = None) -> str:
+def _scope_suffix(scope: str) -> str:
+    return hashlib.sha256(scope.encode("utf-8")).hexdigest()[:8]
+
+
+def mint_trace(
+    round_num: int = 0, seed: int | None = None, scope: str | None = None
+) -> str:
     """Mint the next trace id for ``round_num``.
 
     Counter-based and deterministic: the n-th mint of a process (post
     ``reset()``) always yields the same id, so mock and real rounds of
     the same shape carry byte-identical ids. ``seed`` (optional) mixes
     an 8-hex suffix in for callers that need ids unique across
-    processes (a serving daemon would pass its instance seed); the CLI
-    round path leaves it None so tier-1 can pin exact ids.
+    processes; the CLI round path leaves it None so tier-1 can pin
+    exact ids. ``scope`` (optional, the serve daemon's variant) mints
+    from that scope's OWN counter with an 8-hex scope suffix — ids stay
+    deterministic per debate and collision-free across the concurrent
+    debates of one long-lived process.
     """
     global _trace_counter
-    _trace_counter += 1
-    tid = f"tr-{round_num:03d}-{_trace_counter:02d}"
+    with _mint_lock:
+        if scope is not None:
+            n = _scope_counters.get(scope, 0) + 1
+            _scope_counters[scope] = n
+            return f"tr-{round_num:03d}-{n:02d}-{_scope_suffix(scope)}"
+        _trace_counter += 1
+        n = _trace_counter
+    tid = f"tr-{round_num:03d}-{n:02d}"
     if seed is not None:
         suffix = hashlib.sha256(
-            f"{seed}:{round_num}:{_trace_counter}".encode()
+            f"{seed}:{round_num}:{n}".encode()
         ).hexdigest()[:8]
         tid = f"{tid}-{suffix}"
     return tid
@@ -104,7 +140,8 @@ def scope(trace_id: str, span_id: str = ""):
     """Temporarily set the ambient pair (restores the previous pair on
     exit, even through exceptions) — the scheduler wraps admission and
     per-slot work in this so prefix-cache/tier/retrace emits inside
-    stamp the request that caused them."""
+    stamp the request that caused them. Thread-local, so a daemon
+    debate thread's scope never leaks into a concurrent debate's."""
     prev_trace, prev_span = ambient.trace, ambient.span
     ambient.trace = trace_id
     ambient.span = span_id
@@ -115,10 +152,23 @@ def scope(trace_id: str, span_id: str = ""):
         ambient.span = prev_span
 
 
+def reset_scope(scope_id: str) -> None:
+    """Drop ONE scope's counter (a debate retired from the daemon) —
+    other scopes' counters are untouched, which is the whole point of
+    scoped minting (a per-invocation global reset mid-serve would
+    restart every concurrent debate's ids)."""
+    with _mint_lock:
+        _scope_counters.pop(scope_id, None)
+
+
 def reset() -> None:
-    """Per-invocation reset: counter back to zero, ambient cleared.
-    Rides ``obs.reset_stats()`` (no-leak across CLI invocations)."""
+    """Per-invocation reset: counters back to zero, the calling
+    thread's ambient cleared. Rides ``obs.reset_stats()`` (no-leak
+    across CLI invocations). The serve daemon calls this ONCE at
+    startup, never mid-serve."""
     global _trace_counter
-    _trace_counter = 0
+    with _mint_lock:
+        _trace_counter = 0
+        _scope_counters.clear()
     ambient.trace = ""
     ambient.span = ""

@@ -12,12 +12,19 @@ OOM            yes         RESOURCE_EXHAUSTED, HBM exhaustion mid-decode
 DEVICE_LOST    yes         UNAVAILABLE, dead ICI tunnel, OUT_OF_RANGE
 PREEMPTED      yes         PREEMPTED/ABORTED (maintenance, spot reclaim)
 TIMEOUT        yes         DEADLINE_EXCEEDED, wall-clock budget expiry
+SHED           no          serve-daemon POLICY refusals (quota/drain load
+                           shed) — the model did nothing wrong: never
+                           retried, never fed to its circuit breaker
 BUG            no          everything else — retrying a TypeError is noise
 =============  ==========  =================================================
 
 Transient faults are retried (debate backoff, scheduler retry-once);
-BUG is surfaced immediately. Injected faults (resilience/injector.py)
-carry their kind as an attribute so classification is exact, not textual.
+BUG is surfaced immediately. SHED is the serving layer speaking, not
+the model: ``run_round`` resolves it as an error WITHOUT recording a
+breaker failure (a drain storm must not open every opponent's circuit
+— found by the SIGTERM drain drill). Injected faults
+(resilience/injector.py) carry their kind as an attribute so
+classification is exact, not textual.
 
 The module also owns the process-wide fault counters: every classified
 fault is ``record()``-ed under ``<seam>.<kind>`` and the CLI drains
@@ -40,18 +47,25 @@ class FaultKind(str, Enum):
     DEVICE_LOST = "device_lost"
     PREEMPTED = "preempted"
     TIMEOUT = "timeout"
+    SHED = "shed"
     BUG = "bug"
 
     @property
     def transient(self) -> bool:
-        """Whether a retry has any chance of succeeding."""
-        return self is not FaultKind.BUG
+        """Whether a retry has any chance of succeeding. A SHED is a
+        deliberate policy answer — retrying into a draining/over-quota
+        daemon is noise, the client's retry_after_s is the contract."""
+        return self not in (FaultKind.BUG, FaultKind.SHED)
 
 
 # Ordered, lowercase substring markers: first matching kind wins. OOM is
 # checked first ("resource_exhausted" messages often also say the device
 # was unavailable while dying); BUG is the no-match default.
 _MARKERS: tuple[tuple[FaultKind, tuple[str, ...]], ...] = (
+    # Serve-layer policy refusals first: their messages are ours
+    # (serve/sched.py stamps "shed (<reason>):" / "drained:") and must
+    # never be mistaken for a device fault by the later markers.
+    (FaultKind.SHED, ("shed (", "drained:")),
     (
         FaultKind.OOM,
         ("resource_exhausted", "out of memory", "outofmemory"),

@@ -75,6 +75,9 @@ _KIND_MESSAGES = {
     FaultKind.DEVICE_LOST: "UNAVAILABLE: injected device loss",
     FaultKind.PREEMPTED: "ABORTED: injected preemption",
     FaultKind.TIMEOUT: "DEADLINE_EXCEEDED: injected timeout",
+    # The serve layer's typed-refusal shape (serve/sched.py stamps
+    # "shed (<reason>):") so the textual classify() path agrees.
+    FaultKind.SHED: "shed (injected): synthetic load-shed refusal",
     FaultKind.BUG: "injected programming error",
 }
 

@@ -64,6 +64,11 @@ Modes:
                                   # fraction of round tokens salvaged
                                   # (journal + KV disk store) vs a cold
                                   # re-run; writes BENCH_recover.json
+  python bench.py --mode serve    # advspec serve daemon: capacity
+                                  # point (debates/s), overload storm
+                                  # (typed sheds, brownout, zero
+                                  # accepted loss), SIGTERM drain
+                                  # drill; writes BENCH_serve.json
   python bench.py --mode fleet    # replicated engines: aggregate
                                   # mock tokens/s of 3 replicas with
                                   # prefix-affinity routing vs 1
@@ -1399,6 +1404,134 @@ def _run_recover(platform: str) -> dict:
     }
 
 
+def _run_serve(platform: str) -> dict:
+    """Serve-daemon bench (deterministic CPU mock — writes
+    BENCH_serve.json):
+
+    - **capacity point**: an in-process ``advspec serve`` daemon with
+      wide-open caps takes a closed burst of debates; the measured
+      completion rate (debates/s and charged tokens/s on the mock) is
+      the capacity the admission caps should be sized against — the
+      number "millions of users" divides by.
+    - **overload storm** (shared with ``tools/chaos_run.py
+      --overload`` so the bench and the drill can never test different
+      contracts): an open-loop burst at several times the backlog cap
+      must shed typed with zero accepted-request loss, brownout
+      entered, interactive p99 TTFT within the drill SLO.
+    - **SIGTERM drain drill** (shared with ``--drain``): a subprocess
+      daemon SIGTERMed mid-burst exits 0 with a clean drain report and
+      journal-resumable drained sessions.
+
+    Headline: capacity (debates/s). ``shed_fraction``,
+    ``brownout_transitions``, and ``capacity`` are the schema fields
+    tools/bench_trend.py validates for this mode. Escape hatch: none
+    needed — the daemon only runs when asked to (``debate serve``).
+    """
+    import asyncio
+    import threading
+
+    from adversarial_spec_tpu import serve as serve_mod
+    from adversarial_spec_tpu.serve.client import ServeClient
+    from adversarial_spec_tpu.serve.daemon import ServeDaemon
+
+    n_debates, n_opp = 32, 2
+    spec = (
+        "## Goals\nServe heavy traffic from millions of users, fast.\n"
+        "## Constraints\n" + "The daemon SHALL shed, not collapse. " * 24
+    )
+    models = [f"mock://critic?v={k}" for k in range(n_opp)]
+
+    # Phase 1 — capacity point: wide-open caps, closed burst, measure
+    # the drain rate the admission controller should be sized against.
+    serve_mod.reset_stats()
+    serve_mod.configure(
+        max_queue_depth=n_debates + 1,
+        max_backlog_tokens=10_000_000,
+        tenant_quota_tokens=0,
+        drain_deadline_s=5.0,
+    )
+    with tempfile.TemporaryDirectory(prefix="advspec-bench-serve-") as td:
+        sock = os.path.join(td, "serve.sock")
+        ready = threading.Event()
+        daemon = ServeDaemon(sock, sessions_dir=os.path.join(td, "s"))
+        th = threading.Thread(
+            target=lambda: asyncio.run(daemon.run(ready=ready)),
+            daemon=True,
+        )
+        th.start()
+        if not ready.wait(10):
+            raise RuntimeError("bench serve daemon did not come up")
+        client = ServeClient(sock, timeout_s=120)
+        try:
+            t0 = time.monotonic()
+            ids = [
+                client.submit_debate(
+                    spec,
+                    models,
+                    tenant=f"t{k % 4}",
+                    stream=False,
+                    max_new_tokens=512,
+                )
+                for k in range(n_debates)
+            ]
+            lost = 0
+            for rid in ids:
+                last = client.collect(rid, timeout_s=120)[-1]
+                if last["event"] != "result" or last.get("error") or any(
+                    r["error"] for r in last["results"]
+                ):
+                    lost += 1
+            capacity_wall = time.monotonic() - t0
+            cap_snap = serve_mod.snapshot()
+            client.drain()
+        finally:
+            client.close()
+            th.join(timeout=15)
+    debates_per_s = round(n_debates / capacity_wall, 2)
+    tokens_per_s = round(cap_snap["tokens_charged"] / capacity_wall, 1)
+
+    # Phases 2+3 — the chaos drills, verbatim (one contract).
+    from tools.chaos_run import run_drain_drill, run_overload
+
+    overload_failures, overload = run_overload(verbose=False)
+    drain_failures, drain = run_drain_drill(verbose=False)
+
+    within = (
+        lost == 0
+        and debates_per_s > 0
+        and not overload_failures
+        and not drain_failures
+    )
+    return {
+        "metric": "serve_capacity_debates_per_s",
+        "value": debates_per_s,
+        "unit": "mock debates/s through the serve daemon at the "
+        "capacity point (closed burst, wide-open admission caps)",
+        "vs_baseline": None,  # no published serving baseline
+        "platform": platform,
+        "within_budget": within,
+        "capacity": {
+            "debates": n_debates,
+            "opponents": n_opp,
+            "wall_s": round(capacity_wall, 3),
+            "debates_per_s": debates_per_s,
+            "tokens_per_s": tokens_per_s,
+            "lost": lost,
+        },
+        "shed_fraction": overload.get("shed_fraction", 0.0),
+        "brownout_transitions": int(
+            overload.get("brownout_entries", 0)
+            + overload.get("brownout_exits", 0)
+        ),
+        "overload": {**overload, "failures": overload_failures,
+                     "ok": not overload_failures},
+        "drain": {**drain, "failures": drain_failures,
+                  "ok": not drain_failures},
+        "escape_hatch": "the daemon only runs when asked to "
+        "(debate serve); one-shot CLI rounds are unchanged",
+    }
+
+
 def _run_fleet(platform: str) -> dict:
     """Fleet bench (deterministic CPU mock — writes BENCH_fleet.json):
 
@@ -1838,6 +1971,7 @@ def main() -> int:
     cancel_mode = _mode("cancel")
     recover_mode = _mode("recover")
     fleet_mode = _mode("fleet")
+    serve_mode = _mode("serve")
     if "--no-speculative" in args:
         # Escape hatch mirror of --no-interleave: batcher-driven modes
         # (and any TPU child) decode token-at-a-time.
@@ -1865,6 +1999,8 @@ def main() -> int:
         mode_flag, runner = "--recover", _run_recover
     elif fleet_mode:
         mode_flag, runner = "--fleet", _run_fleet
+    elif serve_mode:
+        mode_flag, runner = "--serve", _run_serve
     else:
         mode_flag, runner = "", _run_bench
 
@@ -1881,11 +2017,12 @@ def main() -> int:
         os.rename(tmp, out_path)
         return 0
 
-    if obs_mode or recover_mode or fleet_mode:
+    if obs_mode or recover_mode or fleet_mode or serve_mode:
         # Mock-only workloads — no jax, no device, no TPU probe: the
         # obs budget is a CPU host-overhead pin by definition, and the
-        # recovery/fleet drills are mock rounds (in-process replicas
-        # plus SIGKILL-able subprocess workers).
+        # recovery/fleet/serve drills are mock rounds (in-process
+        # replicas, SIGKILL-able subprocess workers, and the serve
+        # daemon's socket front).
         payload = runner("cpu")
     elif os.environ.get("BENCH_FORCE_CPU") == "1" or not _probe_tpu():
         payload = _run_cpu_fallback(runner)
@@ -1909,6 +2046,7 @@ def main() -> int:
         or cancel_mode
         or recover_mode
         or fleet_mode
+        or serve_mode
     ):
         # Persist the perf trajectory point alongside the BENCH_r*
         # series the driver records.
@@ -1928,6 +2066,8 @@ def main() -> int:
             else "BENCH_recover.json"
             if recover_mode
             else "BENCH_fleet.json"
+            if fleet_mode
+            else "BENCH_serve.json"
         )
         out = os.path.join(
             os.path.dirname(os.path.abspath(__file__)), name

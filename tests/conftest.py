@@ -125,6 +125,38 @@ def _isolate_state(tmp_path, monkeypatch):
     monkeypatch.delenv("ADVSPEC_EARLY_CANCEL", raising=False)
     streaming.configure(enabled=True, early_cancel=True)
     streaming.reset_stats()
+    # Serve-daemon state is process-global by design (the daemon arms
+    # it once at startup); tests must not leak tightened admission
+    # caps, quotas, counters, or — critically — an installed scheduler
+    # gate (a leaked gate would route every later test's engine calls
+    # through a dead scheduler).
+    from adversarial_spec_tpu import serve
+    from adversarial_spec_tpu.serve import gate as serve_gate
+
+    for var in (
+        "ADVSPEC_SERVE_QUEUE_DEPTH",
+        "ADVSPEC_SERVE_BACKLOG_TOKENS",
+        "ADVSPEC_SERVE_QUOTA_TOKENS",
+        "ADVSPEC_SERVE_DRAIN_DEADLINE_S",
+        "ADVSPEC_SERVE_TTFT_SLO_MS",
+        "ADVSPEC_SERVE_SOCKET",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    serve_gate.uninstall()
+    serve.configure(
+        max_queue_depth=serve.DEFAULT_QUEUE_DEPTH,
+        max_backlog_tokens=serve.DEFAULT_BACKLOG_TOKENS,
+        tenant_quota_tokens=0,
+        drain_deadline_s=serve.DEFAULT_DRAIN_DEADLINE_S,
+        brownout_enter_fraction=serve.DEFAULT_BROWNOUT_ENTER_FRACTION,
+        brownout_exit_fraction=serve.DEFAULT_BROWNOUT_EXIT_FRACTION,
+        brownout_gamma=serve.DEFAULT_BROWNOUT_GAMMA,
+        preempt_grace_s=0.0,
+        interactive_ttft_slo_ms=0.0,
+        max_dispatch_batch=4,
+        max_debates_in_flight=32,
+    )
+    serve.reset_stats()
     # Observability state is process-global by design (the recorder and
     # metric handles outlive a round); tests must not leak an armed
     # events_out path, a shrunken ring, or recorded events.
@@ -144,6 +176,17 @@ def _isolate_state(tmp_path, monkeypatch):
     # for warm per-round accounting; tests want cold-start isolation).
     obs.retrace.clear()
     yield
+    serve_gate.uninstall()
+    serve.configure(
+        max_queue_depth=serve.DEFAULT_QUEUE_DEPTH,
+        max_backlog_tokens=serve.DEFAULT_BACKLOG_TOKENS,
+        tenant_quota_tokens=0,
+        drain_deadline_s=serve.DEFAULT_DRAIN_DEADLINE_S,
+        preempt_grace_s=0.0,
+        interactive_ttft_slo_ms=0.0,
+        max_dispatch_batch=4,
+    )
+    serve.reset_stats()
     dispatch.clear_engine_cache()
     fleet.configure(
         enabled=False, replicas=fleet.DEFAULT_REPLICAS, transport="inproc"

@@ -705,6 +705,7 @@ class TestMutationHardening:
             "save-profile",
             "sessions",
             "registry",
+            "serve",
         ]
         assert cli.DEFAULT_MODELS == ["mock://critic?agree_after=3"]
 

@@ -166,9 +166,15 @@ class TestFaultTaxonomy:
         assert classify_message("zoom level invalid") is FaultKind.BUG
         assert classify_message("boom: oops") is FaultKind.BUG
 
-    def test_only_bug_is_permanent(self):
+    def test_only_bug_and_shed_are_permanent(self):
+        """BUG (retrying a TypeError is noise) and SHED (a deliberate
+        serving-policy answer — the client's retry_after_s is the
+        retry contract, not our backoff ladder) never retry; every
+        device-side kind does."""
         for kind in FaultKind:
-            assert kind.transient == (kind is not FaultKind.BUG)
+            assert kind.transient == (
+                kind not in (FaultKind.BUG, FaultKind.SHED)
+            )
 
     def test_injected_faults_classify_exactly_and_textually(self):
         for kind in FaultKind:

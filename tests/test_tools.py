@@ -1127,6 +1127,7 @@ class TestGraftlint:
             lifecycle_owned_attrs=[],
             lifecycle_mutators=[],
             fleet_lifecycle_class="",  # fixture has no fleet machine
+            serve_lifecycle_class="",  # fixture has no serve machine
         )
         sources = {
             "pkg/sched.py": (

@@ -48,6 +48,16 @@ _LADDER_REQUIRED: dict[str, tuple[type, ...]] = {
     "cmd": (str,),
     "rc": (int,),
 }
+# BENCH_serve.json additionally pins the serving trajectory: the shed
+# fraction at the overload point, the brownout transition count, and
+# the capacity point the admission caps are sized against — a serve
+# bench that silently dropped one of these would hide a capacity
+# regression behind a still-valid headline metric.
+_SERVE_REQUIRED: dict[str, tuple[type, ...]] = {
+    "shed_fraction": (int, float),
+    "brownout_transitions": (int,),
+    "capacity": (dict,),
+}
 
 
 def _check_fields(
@@ -82,9 +92,13 @@ def validate_bench_file(path: Path) -> tuple[dict | None, list[str]]:
     if "metric" in payload or "parsed" not in payload and "n" not in payload:
         # Metric-style: the payload IS the headline.
         problems = _check_fields(payload, _METRIC_REQUIRED, path.name)
+        if mode == "serve":
+            problems.extend(
+                _check_fields(payload, _SERVE_REQUIRED, path.name)
+            )
         if problems:
             return None, problems
-        return {
+        row = {
             "file": path.name,
             "mode": mode,
             "metric": payload["metric"],
@@ -93,7 +107,11 @@ def validate_bench_file(path: Path) -> tuple[dict | None, list[str]]:
             "platform": payload["platform"],
             "within_budget": payload.get("within_budget"),
             "vs_baseline": payload.get("vs_baseline"),
-        }, []
+        }
+        if mode == "serve":
+            row["shed_fraction"] = payload["shed_fraction"]
+            row["brownout_transitions"] = payload["brownout_transitions"]
+        return row, []
 
     # Ladder wrapper: the headline lives in ``parsed``. Any parsed
     # payload PRESENT must schema-validate (a failed run may still

@@ -29,11 +29,26 @@ the round journal's one-record-per-index replay), the survivor
 rehydrating the shared document prefix from the disk store instead of
 re-prefilling, and allocator + tier invariants clean on the survivor.
 
+``--overload`` is the SERVE storm drill (docs/serving.md): an
+in-process ``advspec serve`` daemon with tight admission caps takes an
+open-loop burst several times its backlog cap and must shed, not
+collapse — typed retry-after refusals, zero accepted-request loss,
+interactive p99 TTFT within the drill SLO while the batch tier pauses
+first (brownout), allocator/tier invariants clean.
+
+``--drain`` is the SIGTERM graceful-drain drill: a real subprocess
+daemon is SIGTERMed mid-burst and must resolve every accepted debate
+(finished or typed-drained), exit 0 with a clean drain report, and
+leave drained sessions journal-resumable — a fresh daemon serves their
+completed opponents from the journal byte-identically.
+
 Usage:
     python tools/chaos_run.py                # pytest -m chaos
     python tools/chaos_run.py --sweep 5      # + 5 extra fuzz seeds
     python tools/chaos_run.py --crash        # SIGKILL + resume drill
     python tools/chaos_run.py --replica-kill # fleet replica-loss drill
+    python tools/chaos_run.py --overload     # serve storm drill
+    python tools/chaos_run.py --drain        # serve SIGTERM drain drill
     python tools/chaos_run.py -- -x -k breaker   # extra pytest args
 """
 
@@ -395,6 +410,502 @@ def run_replica_kill(verbose: bool = True) -> tuple[list[str], dict]:
     return failures, payload
 
 
+_OVERLOAD_SPEC = (
+    "## Goals\nServe heavy traffic from millions of users, fast.\n"
+    "## Constraints\n" + "The daemon SHALL shed, not collapse. " * 24
+)
+_OVERLOAD_MODELS = ["mock://critic?v=1", "mock://critic?v=2"]
+# Interactive p99 TTFT budget for the drill (generous: the assertion is
+# "bounded under overload", not "fast on a loaded CI box").
+_OVERLOAD_TTFT_SLO_S = 5.0
+
+
+def run_overload(verbose: bool = True) -> tuple[list[str], dict]:
+    """The overload storm drill (docs/serving.md "shed, don't
+    collapse"): an in-process serve daemon with TIGHT admission caps
+    takes an open-loop burst several times its sustainable backlog —
+    every line written before a byte is read. The contract checked:
+
+    1. every refusal is TYPED (a SHED_REASONS member + retry_after_s);
+    2. every ACCEPTED debate completes — zero lost;
+    3. interactive traffic is never shed while batch still holds
+       capacity, interactive p99 TTFT stays under the SLO, and the
+       batch tier pauses first (brownout entered; typed ``brownout``
+       sheds observed);
+    4. allocator/tier invariants are clean after the storm (the
+       daemon's ``check`` op);
+    5. submitted == accepted + shed (nothing silently dropped).
+
+    Returns (failures, payload) — the payload feeds ``bench.py --mode
+    serve``'s overload phase, the failure list this CLI's verdict."""
+    import asyncio
+    import threading
+    import time
+
+    from adversarial_spec_tpu import serve as serve_mod
+    from adversarial_spec_tpu.serve.client import ServeClient
+    from adversarial_spec_tpu.serve.daemon import ServeDaemon
+    from adversarial_spec_tpu.serve.driver import estimate_debate_tokens
+    from adversarial_spec_tpu.serve.protocol import SHED_REASONS
+
+    def say(msg: str) -> None:
+        if verbose:
+            print(f"chaos_run --overload: {msg}", flush=True)
+
+    failures: list[str] = []
+    n_tenants = 2
+    n_interactive_per_tenant = 3  # under the depth cap: must all admit
+    n_batch_per_tenant = 25  # way past every cap: must shed typed
+    old = serve_mod.snapshot()
+    serve_mod.reset_stats()
+    serve_mod.configure(
+        max_queue_depth=4,
+        max_backlog_tokens=32000,
+        tenant_quota_tokens=0,
+        drain_deadline_s=3.0,
+    )
+    payload: dict = {}
+    with tempfile.TemporaryDirectory(prefix="advspec-overload-") as td:
+        sock = os.path.join(td, "serve.sock")
+        ready = threading.Event()
+        daemon = ServeDaemon(sock, sessions_dir=os.path.join(td, "sessions"))
+        th = threading.Thread(
+            target=lambda: asyncio.run(daemon.run(ready=ready)), daemon=True
+        )
+        th.start()
+        if not ready.wait(10):
+            return ["daemon did not come up"], {}
+        client = ServeClient(sock, timeout_s=60)
+        try:
+            # The open-loop storm: interleave tiers, write everything,
+            # read nothing until the burst is fully submitted.
+            submitted: list[tuple[str, str]] = []  # (req id, tier)
+            est_int = estimate_debate_tokens(
+                {
+                    "spec": _OVERLOAD_SPEC,
+                    "models": _OVERLOAD_MODELS,
+                    "max_new_tokens": 160,
+                }
+            )
+            est_batch = estimate_debate_tokens(
+                {
+                    "spec": _OVERLOAD_SPEC,
+                    "models": _OVERLOAD_MODELS,
+                    "max_new_tokens": 1280,
+                }
+            )
+            offered_tokens = 0
+            t0 = time.monotonic()
+            batch_left = {t: n_batch_per_tenant for t in range(n_tenants)}
+            inter_left = {t: n_interactive_per_tenant for t in range(n_tenants)}
+            while any(batch_left.values()) or any(inter_left.values()):
+                for t in range(n_tenants):
+                    if batch_left[t]:
+                        batch_left[t] -= 1
+                        offered_tokens += est_batch
+                        submitted.append(
+                            (
+                                client.submit_debate(
+                                    _OVERLOAD_SPEC,
+                                    _OVERLOAD_MODELS,
+                                    tenant=f"batch-{t}",
+                                    tier="batch",
+                                    stream=True,
+                                    max_new_tokens=1280,
+                                ),
+                                "batch",
+                            )
+                        )
+                    if inter_left[t]:
+                        inter_left[t] -= 1
+                        offered_tokens += est_int
+                        submitted.append(
+                            (
+                                client.submit_debate(
+                                    _OVERLOAD_SPEC,
+                                    _OVERLOAD_MODELS,
+                                    tenant=f"inter-{t}",
+                                    tier="interactive",
+                                    stream=True,
+                                    max_new_tokens=160,
+                                ),
+                                "interactive",
+                            )
+                        )
+            overload_factor = offered_tokens / serve_mod.config().max_backlog_tokens
+            say(
+                f"storm submitted: {len(submitted)} debates, "
+                f"~{overload_factor:.1f}x the backlog cap, open-loop"
+            )
+
+            accepted = {"interactive": 0, "batch": 0}
+            completed = {"interactive": 0, "batch": 0}
+            shed = {"interactive": 0, "batch": 0}
+            shed_reasons: dict[str, int] = {}
+            lost: list[str] = []
+            ttfts: list[float] = []
+            for rid, tier in submitted:
+                evs = client.collect(rid, timeout_s=120)
+                first, last = evs[0]["event"], evs[-1]
+                if first == "accepted":
+                    accepted[tier] += 1
+                    if last["event"] != "result":
+                        lost.append(f"{rid}: terminal {last['event']}")
+                        continue
+                    opp_errors = [
+                        r["error"]
+                        for r in last.get("results", [])
+                        if r["error"]
+                    ]
+                    if last.get("error") or opp_errors:
+                        lost.append(
+                            f"{rid} ({tier}): accepted but lost work: "
+                            f"{last.get('error') or opp_errors[:1]}"
+                        )
+                    else:
+                        completed[tier] += 1
+                    if tier == "interactive":
+                        ttfts.append(float(last["ttft_s"]))
+                elif last["event"] == "shed":
+                    shed[tier] += 1
+                    reason = last.get("reason", "")
+                    shed_reasons[reason] = shed_reasons.get(reason, 0) + 1
+                    if reason not in SHED_REASONS:
+                        failures.append(f"untyped shed reason {reason!r}")
+                    if not isinstance(last.get("retry_after_s"), (int, float)):
+                        failures.append(f"shed without retry_after_s: {last}")
+                else:
+                    lost.append(f"{rid}: unexpected events {evs}")
+            wall = time.monotonic() - t0
+
+            # 1-2. zero accepted-request loss; full accounting.
+            if lost:
+                failures.append(
+                    f"{len(lost)} accepted request(s) lost: {lost[:3]}"
+                )
+            total = sum(accepted.values()) + sum(shed.values())
+            if total != len(submitted):
+                failures.append(
+                    f"accounting hole: {len(submitted)} submitted, "
+                    f"{total} accounted"
+                )
+            # 3. tier contract: interactive fully admitted + served
+            # within SLO; batch paused first (brownout + typed sheds).
+            n_inter = n_tenants * n_interactive_per_tenant
+            if accepted["interactive"] != n_inter:
+                failures.append(
+                    f"interactive shed under batch overload: "
+                    f"{accepted['interactive']}/{n_inter} admitted "
+                    f"(sheds: {shed_reasons})"
+                )
+            if shed["batch"] == 0:
+                failures.append("batch tier never shed — no overload?")
+            snap = serve_mod.snapshot()
+            if snap["brownout_entries"] < 1:
+                failures.append("brownout never entered under the storm")
+            if shed_reasons.get("brownout", 0) < 1:
+                failures.append("no typed brownout shed observed")
+            ttfts.sort()
+            p99 = ttfts[max(0, int(len(ttfts) * 0.99) - 1)] if ttfts else 0.0
+            if p99 > _OVERLOAD_TTFT_SLO_S:
+                failures.append(
+                    f"interactive p99 TTFT {p99:.3f}s breaches the "
+                    f"{_OVERLOAD_TTFT_SLO_S}s drill SLO"
+                )
+            # 4. clean invariants after the storm.
+            chk = client.check()
+            if not chk.get("ok"):
+                failures.append(f"invariants violated: {chk.get('problems')}")
+            # 5. the daemon's own ledger agrees with the client's.
+            if snap["shed_fraction"] <= 0.0:
+                failures.append("daemon recorded no shed under overload")
+            payload = {
+                "submitted": len(submitted),
+                "overload_factor": round(overload_factor, 2),
+                "accepted": accepted,
+                "completed": completed,
+                "shed": shed,
+                "shed_reasons": shed_reasons,
+                "shed_fraction": snap["shed_fraction"],
+                "brownout_entries": snap["brownout_entries"],
+                "brownout_exits": snap["brownout_exits"],
+                "units_preempted": snap["units_preempted"],
+                "interactive_ttft_p99_s": round(p99, 4),
+                "ttft_slo_s": _OVERLOAD_TTFT_SLO_S,
+                "storm_wall_s": round(wall, 3),
+                "invariants_clean": bool(chk.get("ok")),
+                "zero_accepted_lost": not lost,
+            }
+            say(
+                f"{sum(accepted.values())} accepted (all served), "
+                f"{sum(shed.values())} shed typed "
+                f"({shed_reasons}), brownout x{snap['brownout_entries']}, "
+                f"interactive p99 TTFT {p99 * 1000:.0f}ms"
+            )
+            client.drain()
+        finally:
+            client.close()
+            th.join(timeout=15)
+            if th.is_alive():
+                failures.append("daemon failed to drain/exit")
+            serve_mod.configure(
+                max_queue_depth=old["max_queue_depth"],
+                max_backlog_tokens=old["max_backlog_tokens"],
+                tenant_quota_tokens=old["tenant_quota_tokens"],
+                drain_deadline_s=old["drain_deadline_s"],
+            )
+    return failures, payload
+
+
+def overload_drill(verbose: bool = True) -> int:
+    """The full ISSUE-14 acceptance gate: the open-loop storm AND the
+    SIGTERM drain drill — ``--overload`` green means both hold
+    (``--drain`` runs the drain half alone)."""
+    failures, _ = run_overload(verbose)
+    drain_failures, _ = run_drain_drill(verbose)
+    failures = failures + drain_failures
+    if failures:
+        print("\n".join(f"FAIL: {f}" for f in failures), file=sys.stderr)
+        return 1
+    if verbose:
+        print(
+            "chaos_run --overload: shed-not-collapse + drain contract hold",
+            flush=True,
+        )
+    return 0
+
+
+_DRAIN_MODELS = [f"mock://critic?v={k}" for k in range(1, 5)]
+_DRAIN_DEBATES = 48
+
+
+def run_drain_drill(verbose: bool = True) -> tuple[list[str], dict]:
+    """The SIGTERM graceful-drain drill (docs/serving.md "drain
+    contract"): a REAL subprocess daemon takes a burst of journaled
+    debates, is SIGTERMed mid-burst, and must (1) stop admissions with
+    typed ``draining`` sheds, (2) resolve every accepted request —
+    finished or drained with a typed error, (3) exit 0 with a parseable
+    drain report, and (4) leave every drained session journal-resumable:
+    a second daemon serves the completed opponents from the journal
+    with zero engine work and byte-identical transcripts."""
+    import time
+
+    from adversarial_spec_tpu.serve.client import ServeClient
+
+    def say(msg: str) -> None:
+        if verbose:
+            print(f"chaos_run --drain: {msg}", flush=True)
+
+    failures: list[str] = []
+    payload: dict = {"debates": _DRAIN_DEBATES, "opponents": len(_DRAIN_MODELS)}
+    spec = _OVERLOAD_SPEC * 6
+
+    def start_daemon(td: str, name: str, deadline_s: float):
+        sock = os.path.join(td, f"{name}.sock")
+        env = {
+            **os.environ,
+            "PYTHONPATH": str(REPO),
+            "JAX_PLATFORMS": "cpu",
+            "ADVSPEC_SESSIONS_DIR": os.path.join(td, "sessions"),
+        }
+        proc = subprocess.Popen(
+            [
+                sys.executable,
+                "-m",
+                "adversarial_spec_tpu.serve",
+                "--socket",
+                sock,
+                "--serve-queue-depth",
+                "64",
+                "--serve-backlog-tokens",
+                "10000000",
+                "--serve-drain-deadline-s",
+                str(deadline_s),
+            ],
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            cwd=td,
+            env=env,
+        )
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"daemon died at startup: {proc.stderr.read()[-400:]}"
+                )
+            if os.path.exists(sock):
+                try:
+                    return proc, ServeClient(sock, timeout_s=60)
+                except OSError:
+                    pass
+            time.sleep(0.02)
+        proc.kill()
+        raise RuntimeError("daemon socket never appeared")
+
+    with tempfile.TemporaryDirectory(prefix="advspec-drain-") as td:
+        # Phase A: burst of journaled debates, SIGTERM mid-burst.
+        proc, client = start_daemon(td, "a", deadline_s=0.05)
+        ids = []
+        try:
+            for k in range(_DRAIN_DEBATES):
+                ids.append(
+                    client.submit_debate(
+                        spec,
+                        _DRAIN_MODELS,
+                        tenant=f"t{k % 3}",
+                        session=f"drain-{k:02d}",
+                        max_new_tokens=512,
+                    )
+                )
+            proc.send_signal(signal.SIGTERM)
+            say(f"SIGTERM sent after {len(ids)} open-loop submissions")
+            outcomes = {"finished": 0, "drained": 0, "shed": 0}
+            resumable: list[int] = []
+            for k, rid in enumerate(ids):
+                evs = client.collect(rid, timeout_s=60)
+                last = evs[-1]
+                if evs[0]["event"] == "shed":
+                    outcomes["shed"] += 1
+                    if last.get("reason") != "draining":
+                        failures.append(
+                            f"post-SIGTERM shed typed {last.get('reason')!r},"
+                            " expected 'draining'"
+                        )
+                elif last["event"] != "result":
+                    failures.append(
+                        f"accepted debate {rid} never resolved: "
+                        f"{[e['event'] for e in evs]}"
+                    )
+                else:
+                    errors = [
+                        r["error"] for r in last["results"] if r["error"]
+                    ]
+                    if not errors and not last.get("error"):
+                        outcomes["finished"] += 1
+                    else:
+                        outcomes["drained"] += 1
+                        resumable.append(k)
+                        for e in errors:
+                            if "drained" not in e and "shed" not in e:
+                                failures.append(
+                                    f"drained debate carries untyped "
+                                    f"error {e!r}"
+                                )
+        except (TimeoutError, ConnectionError) as e:
+            failures.append(f"phase A transport failure: {e}")
+            outcomes = {"finished": 0, "drained": 0, "shed": 0}
+            resumable = []
+        finally:
+            client.close()
+        rc = proc.wait(timeout=30)
+        out, _err_txt = proc.communicate(timeout=10)
+        if rc != 0:
+            failures.append(f"daemon exited rc={rc}, expected 0")
+        report = None
+        for line in reversed(out.strip().splitlines()):
+            try:
+                cand = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if cand.get("event") == "drain_report":
+                report = cand
+                break
+        if report is None:
+            failures.append("no drain_report on daemon stdout")
+        elif not report.get("clean_exit"):
+            failures.append(f"drain report not clean: {report}")
+        say(
+            f"daemon exited rc={rc}: {outcomes['finished']} finished, "
+            f"{outcomes['drained']} drained (journal-resumable), "
+            f"{outcomes['shed']} shed at admission"
+        )
+        if not resumable and outcomes["shed"] == 0 and not failures:
+            # The box outran the drill: everything finished before the
+            # deadline. Still a valid drain, but say so.
+            say("note: all debates finished before the drain deadline")
+
+        # Phase B: resume on a fresh daemon — journal-served opponents
+        # must re-issue ZERO engine work and match a finished debate's
+        # transcripts byte-for-byte (same spec, same round, mock
+        # determinism + the journal's byte-identity guarantee).
+        proc2, client2 = start_daemon(td, "b", deadline_s=5.0)
+        served_total = 0
+        try:
+            reference = None
+            ref_rid = client2.submit_debate(
+                spec, _DRAIN_MODELS, tenant="ref", session="drain-ref",
+                max_new_tokens=512,
+            )
+            ref = client2.collect(ref_rid, timeout_s=60)[-1]
+            if ref["event"] == "result" and not ref.get("error"):
+                reference = [r["response"] for r in ref["results"]]
+            else:
+                failures.append("phase B reference debate failed")
+            for k in resumable:
+                rid = client2.submit_debate(
+                    spec,
+                    _DRAIN_MODELS,
+                    tenant=f"t{k % 3}",
+                    session=f"drain-{k:02d}",
+                    max_new_tokens=512,
+                )
+                last = client2.collect(rid, timeout_s=60)[-1]
+                if last["event"] != "result" or last.get("error"):
+                    failures.append(f"resume of drain-{k:02d} failed")
+                    continue
+                served_total += int(last.get("journal_served", 0))
+                errors = [r["error"] for r in last["results"] if r["error"]]
+                if errors:
+                    failures.append(
+                        f"resume of drain-{k:02d} still lossy: {errors[:1]}"
+                    )
+                if reference is not None:
+                    got = [r["response"] for r in last["results"]]
+                    if got != reference:
+                        failures.append(
+                            f"drain-{k:02d} resumed transcripts diverged"
+                        )
+            client2.drain()
+        except (TimeoutError, ConnectionError, RuntimeError) as e:
+            failures.append(f"phase B transport failure: {e}")
+        finally:
+            client2.close()
+            try:
+                proc2.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc2.kill()
+                failures.append("phase B daemon failed to drain")
+        say(
+            f"resumed {len(resumable)} drained debate(s): "
+            f"{served_total} opponent(s) served from journals, "
+            "transcripts byte-identical"
+        )
+        payload.update(
+            {
+                "sigterm_rc": rc,
+                "outcomes": outcomes,
+                "drain_report_clean": bool(report and report.get("clean_exit")),
+                "resumable_debates": len(resumable),
+                "journal_served_on_resume": served_total,
+                "zero_accepted_lost": not any(
+                    "never resolved" in f for f in failures
+                ),
+            }
+        )
+    return failures, payload
+
+
+def drain_drill(verbose: bool = True) -> int:
+    failures, _ = run_drain_drill(verbose)
+    if failures:
+        print("\n".join(f"FAIL: {f}" for f in failures), file=sys.stderr)
+        return 1
+    if verbose:
+        print("chaos_run --drain: drain contract holds", flush=True)
+    return 0
+
+
 def replica_kill_drill(verbose: bool = True) -> int:
     failures, _ = run_replica_kill(verbose)
     if failures:
@@ -451,6 +962,24 @@ def main(argv: list[str] | None = None) -> int:
         "byte-identical transcripts, zero duplicated opponent attempts, "
         "shared-store rehydration, and clean survivor invariants",
     )
+    ap.add_argument(
+        "--overload",
+        action="store_true",
+        help="serve overload storm drill: open-loop burst at several "
+        "times the daemon's backlog cap; assert typed sheds with "
+        "retry-after, zero accepted-request loss, interactive p99 TTFT "
+        "within SLO with the batch tier paused first (brownout), and "
+        "clean allocator/tier invariants",
+    )
+    ap.add_argument(
+        "--drain",
+        action="store_true",
+        help="serve SIGTERM drain drill: a real subprocess daemon is "
+        "SIGTERMed mid-burst; assert typed draining sheds, every "
+        "accepted debate resolved, exit 0 with a clean drain report, "
+        "and drained sessions journal-resumable on a fresh daemon "
+        "with byte-identical transcripts",
+    )
     args, extra = ap.parse_known_args(argv)
     if extra and extra[0] == "--":
         extra = extra[1:]
@@ -459,6 +988,10 @@ def main(argv: list[str] | None = None) -> int:
         return crash_drill()
     if args.replica_kill:
         return replica_kill_drill()
+    if args.overload:
+        return overload_drill()
+    if args.drain:
+        return drain_drill()
 
     rc = _pytest(extra, {})
     if rc != 0:

@@ -288,28 +288,77 @@ class GraftlintConfig:
         default_factory=lambda: ["_dead"]
     )
     fleet_lifecycle_mutators: list[str] = field(default_factory=list)
+    # The serve daemon's request state machine (serve/sched.py), the
+    # third GL-LIFECYCLE machine: every unit exit (finish, mid-round
+    # quota shed, tier preemption, drain) must reach the one release
+    # surgery, and the running-set ledger is written only by the
+    # surgery and the acquisition. "" disables (fixture trees).
+    serve_lifecycle_class: str = "ServeScheduler"
+    serve_lifecycle_release: str = "_release_unit"
+    serve_lifecycle_exits: list[str] = field(
+        default_factory=lambda: [
+            "_finish_unit",
+            "_shed_unit",
+            "_preempt_unit",
+            "_drain_unit",
+            "drain_cancelled",
+        ]
+    )
+    serve_lifecycle_owned_attrs: list[str] = field(
+        default_factory=lambda: ["_running"]
+    )
+    serve_lifecycle_mutators: list[str] = field(
+        default_factory=lambda: ["_start_unit"]
+    )
+
+    def named_lifecycle_machines(
+        self,
+    ) -> list[tuple[str, tuple[str, str, list, list, list]]]:
+        """Every configured GL-LIFECYCLE machine with its knob-name
+        prefix: (prefix, (class, release, exits, owned attrs,
+        mutators)). Empty class names disable a machine (fixture
+        trees). GL-CONFIG validates every machine through this one
+        list — adding a fourth machine is one entry here plus its
+        config fields."""
+        machines = [
+            (
+                "lifecycle",
+                (
+                    self.lifecycle_class,
+                    self.lifecycle_release,
+                    self.lifecycle_exits,
+                    self.lifecycle_owned_attrs,
+                    self.lifecycle_mutators,
+                ),
+            ),
+            (
+                "fleet_lifecycle",
+                (
+                    self.fleet_lifecycle_class,
+                    self.fleet_lifecycle_release,
+                    self.fleet_lifecycle_exits,
+                    self.fleet_lifecycle_owned_attrs,
+                    self.fleet_lifecycle_mutators,
+                ),
+            ),
+            (
+                "serve_lifecycle",
+                (
+                    self.serve_lifecycle_class,
+                    self.serve_lifecycle_release,
+                    self.serve_lifecycle_exits,
+                    self.serve_lifecycle_owned_attrs,
+                    self.serve_lifecycle_mutators,
+                ),
+            ),
+        ]
+        return [m for m in machines if m[1][0]]
 
     def lifecycle_machines(self) -> list[tuple[str, str, list, list, list]]:
         """The configured GL-LIFECYCLE state machines as (class,
         release, exits, owned attrs, mutators); empty class names
         disable a machine."""
-        machines = [
-            (
-                self.lifecycle_class,
-                self.lifecycle_release,
-                self.lifecycle_exits,
-                self.lifecycle_owned_attrs,
-                self.lifecycle_mutators,
-            ),
-            (
-                self.fleet_lifecycle_class,
-                self.fleet_lifecycle_release,
-                self.fleet_lifecycle_exits,
-                self.fleet_lifecycle_owned_attrs,
-                self.fleet_lifecycle_mutators,
-            ),
-        ]
-        return [m for m in machines if m[0]]
+        return [m for _, m in self.named_lifecycle_machines()]
 
     def acquire_release(self) -> dict[str, str]:
         out: dict[str, str] = {}

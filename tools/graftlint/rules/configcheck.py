@@ -79,6 +79,7 @@ class ConfigRule(Rule):
         "lifecycle_owned_attrs": [],
         "lifecycle_mutators": [],
         "fleet_lifecycle_class": "",  # fixture has no fleet machine
+        "serve_lifecycle_class": "",  # fixture has no serve machine
     }
 
     def check(self, ctx: Context) -> None:
@@ -175,29 +176,16 @@ class ConfigRule(Rule):
         for q in cfg.atomic_funcs:
             need(q in qualnames, "atomic_funcs", q)
         # Every configured lifecycle machine (the batcher's slot
-        # machine and the fleet router's replica machine) validates
-        # the same way; the knob-name prefix distinguishes findings.
-        for prefix, (cls_name, release, exits, owned, mutators) in zip(
-            ("lifecycle", "fleet_lifecycle"),
-            (
-                (
-                    cfg.lifecycle_class,
-                    cfg.lifecycle_release,
-                    cfg.lifecycle_exits,
-                    cfg.lifecycle_owned_attrs,
-                    cfg.lifecycle_mutators,
-                ),
-                (
-                    cfg.fleet_lifecycle_class,
-                    cfg.fleet_lifecycle_release,
-                    cfg.fleet_lifecycle_exits,
-                    cfg.fleet_lifecycle_owned_attrs,
-                    cfg.fleet_lifecycle_mutators,
-                ),
-            ),
-        ):
-            if not cls_name:
-                continue  # machine disabled (fixture trees)
+        # machine, the fleet router's replica machine, the serve
+        # scheduler's request machine) validates the same way; the
+        # knob-name prefix distinguishes findings.
+        for prefix, (
+            cls_name,
+            release,
+            exits,
+            owned,
+            mutators,
+        ) in cfg.named_lifecycle_machines():
             need(cls_name in class_defs, f"{prefix}_class", cls_name)
             lc_methods = methods_of(cls_name)
             lc_attrs, _ = class_body_names(cls_name)

@@ -84,6 +84,7 @@ class LifecycleRule(Rule):
         "lifecycle_owned_attrs": ["_slot_req", "_slot_seq"],
         "lifecycle_mutators": [],
         "fleet_lifecycle_class": "",  # fixture has no fleet machine
+        "serve_lifecycle_class": "",  # fixture has no serve machine
     }
 
     def check(self, ctx: Context) -> None:

@@ -120,6 +120,35 @@ def summarize(events: list[dict]) -> str:
             + (f" ({r['reason']})" if r["reason"] else "")
             + f", {r['alive']} left"
         )
+    serve = [e for e in events if e["type"] == "serve"]
+    if serve:
+        sheds: dict[str, int] = {}
+        preempted = drained = brownouts = 0
+        for s in serve:
+            if s["op"] == "shed":
+                sheds[s["reason"]] = sheds.get(s["reason"], 0) + 1
+            elif s["op"] == "preempted":
+                preempted += 1
+            elif s["op"] == "drained":
+                drained += 1
+            elif s["op"] == "brownout_enter":
+                brownouts += 1
+        if sheds:
+            lines.append(
+                f"  {sum(sheds.values())} typed load-shed refusal(s): "
+                + ", ".join(f"{r}={n}" for r, n in sorted(sheds.items()))
+            )
+        if preempted:
+            lines.append(
+                f"  {preempted} batch unit(s) preempted for tier pressure"
+            )
+        if drained:
+            lines.append(
+                f"  {drained} unit(s) drained at shutdown "
+                "(journal-resumable)"
+            )
+        if brownouts:
+            lines.append(f"  WARNING: brownout entered {brownouts} time(s)")
     return "\n".join(lines)
 
 
@@ -134,11 +163,17 @@ def occupancy_timeline(events: list[dict], width: int = 16) -> str:
     fleet dump (Route/ReplicaEvents, fleet/router.py) adds a replica
     column: each step row carries the replica most recently routed to
     (``rep=``), and the routing decisions / replica lifecycle
-    transitions print inline where they happened."""
+    transitions print inline where they happened. A serve-daemon dump
+    (ServeEvents, adversarial_spec_tpu/serve) adds a TENANT column:
+    each step row carries the tenant whose unit most recently started
+    running (``ten=``) so interleaved concurrent debates read apart,
+    and the admission/shed/preempt/brownout transitions print inline
+    with their typed reasons and post-op backlog."""
     steps = [
         e
         for e in events
-        if e["type"] in ("step", "swap", "span", "cancel", "route", "replica")
+        if e["type"]
+        in ("step", "swap", "span", "cancel", "route", "replica", "serve")
     ]
     if not any(e["type"] == "step" for e in steps):
         return "(no step events)"
@@ -148,10 +183,41 @@ def occupancy_timeline(events: list[dict], width: int = 16) -> str:
     scale = max(max_live, 1)
     tiered = any(e["type"] == "swap" for e in steps)
     fleet = any(e["type"] in ("route", "replica") for e in steps)
+    serving = any(e["type"] == "serve" for e in steps)
     rows = []
     host_res = disk_res = 0
     cur_replica = ""
+    cur_tenant = ""
     for s in steps:
+        if s["type"] == "serve":
+            # Daemon lifecycle/pressure transitions inline: WHO was
+            # admitted/shed/preempted, under WHAT backlog. The running
+            # op also drives the step rows' tenant column.
+            glyph = {
+                "shed": "x",
+                "preempted": "x",
+                "drained": "x",
+                "brownout_enter": "!",
+                "brownout_exit": "!",
+            }.get(s["op"], "+")
+            if s["op"] == "running":
+                cur_tenant = s["tenant"]
+            notes = []
+            if s["tenant"]:
+                notes.append(f"{s['tenant']}/{s['tier']}")
+            if s["debate"]:
+                notes.append(
+                    s["debate"]
+                    + (f"#{s['index']}" if s["index"] >= 0 else "")
+                )
+            if s["reason"]:
+                notes.append(f"({s['reason']})")
+            notes.append(f"backlog={s['backlog_tokens']}")
+            rows.append(
+                f"seq {s['seq']:>6} [{glyph * width}] "
+                f"{'serve:' + s['op']:<13} " + " ".join(notes)
+            )
+            continue
         if s["type"] == "span":
             # Trace-span boundaries print inline so the timeline shows
             # WHERE in the step stream each request's stages opened and
@@ -243,6 +309,8 @@ def occupancy_timeline(events: list[dict], width: int = 16) -> str:
             notes.append(f"disk={disk_res}")
         if fleet:
             notes.append(f"rep={cur_replica or '?'}")
+        if serving:
+            notes.append(f"ten={cur_tenant or '?'}")
         rows.append(
             f"seq {s['seq']:>6} [{bar}] {s['kind']:<8} " + " ".join(notes)
         )
@@ -256,6 +324,12 @@ def occupancy_timeline(events: list[dict], width: int = 16) -> str:
         + ("; >=span begin <=span end" if spanned else "")
         + ("; x=early cancel" if cancelled else "")
         + ("; rep=last routed replica, !=replica lifecycle" if fleet else "")
+        + (
+            "; ten=last running tenant, +=serve admit/finish, "
+            "x=shed/preempt/drain, !=brownout"
+            if serving
+            else ""
+        )
         + ")"
     )
     return "\n".join([legend] + rows)
