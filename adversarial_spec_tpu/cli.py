@@ -288,6 +288,25 @@ def create_parser() -> argparse.ArgumentParser:
         "ADVSPEC_KV_STORE_DIR sets the process default)",
     )
     d.add_argument(
+        "--weight-res",
+        action=argparse.BooleanOptionalAction,
+        default=None,  # None = inherit ADVSPEC_WEIGHT_RES (default on)
+        help="Weight residency paging: an opponent model evicted from "
+        "HBM demotes its (quantized) shards to host RAM and promotes "
+        "back with one committed device_put on its next turn, instead "
+        "of paying a full checkpoint re-materialization per swap "
+        "(--no-weight-res restores naive evict-reload; "
+        "ADVSPEC_WEIGHT_RES=0 sets the process default)",
+    )
+    d.add_argument(
+        "--weight-host-mb",
+        type=int,
+        default=None,  # None = inherit ADVSPEC_WEIGHT_HOST_MB
+        help="Host-RAM budget in MiB for demoted model weights "
+        "(LRU overflow frees; 0 disables paging; default 2048, "
+        "ADVSPEC_WEIGHT_HOST_MB sets the process default)",
+    )
+    d.add_argument(
         "--interleave",
         action=argparse.BooleanOptionalAction,
         default=None,  # None = inherit ADVSPEC_INTERLEAVE (default on)
@@ -478,9 +497,10 @@ def create_parser() -> argparse.ArgumentParser:
     r.add_argument("--tp", type=int, default=0, help="Tensor-parallel degree")
     r.add_argument(
         "--quant",
-        choices=["", "int8"],
+        choices=list(model_registry.QUANT_FORMATS),
         default="",
-        help="Weight-only quantization for this model",
+        help="Weight-only quantization for this model (int4 packs two "
+        "weights per byte — docs/weight_residency.md)",
     )
     r.add_argument(
         "--kv",
@@ -692,6 +712,31 @@ def _configure_kv_tier(args: argparse.Namespace):
     return kvtier
 
 
+def _configure_weightres(args: argparse.Namespace):
+    """Arm weight-residency paging from flags; returns the module for
+    reporting. Flag-else-env-default each invocation (one invocation =
+    one round), like obs/kvtier: one round's --no-weight-res or host
+    budget must not leak into the next. Stats reset per invocation so
+    ``perf.weights`` accounts exactly this round's loads/swaps; the
+    ledger itself lives on the engine and persists round to round."""
+    from adversarial_spec_tpu.engine import weightres
+
+    weightres.configure(
+        enabled=(
+            args.weight_res
+            if getattr(args, "weight_res", None) is not None
+            else weightres.env_enabled()
+        ),
+        host_mb=(
+            args.weight_host_mb
+            if getattr(args, "weight_host_mb", None) is not None
+            else weightres.env_host_mb()
+        ),
+    )
+    weightres.reset_stats()
+    return weightres
+
+
 def _configure_fleet(args: argparse.Namespace):
     """Arm the fleet layer from flags; returns the module for
     reporting. Flag-else-env-default each invocation (one invocation =
@@ -817,6 +862,7 @@ def handle_serve(args: argparse.Namespace) -> int:
     _configure_interleave(args)
     _configure_speculative(args)
     _configure_kv_tier(args)
+    _configure_weightres(args)
     _configure_streaming(args)
     _configure_fleet(args)
     _configure_obs(args)
@@ -875,6 +921,7 @@ def run_critique(args: argparse.Namespace) -> int:
     interleave = _configure_interleave(args)
     spec_cfg = _configure_speculative(args)
     kv_tier = _configure_kv_tier(args)
+    weightres = _configure_weightres(args)
     streaming = _configure_streaming(args)
     fleet = _configure_fleet(args)
     obs = _configure_obs(args)
@@ -978,6 +1025,10 @@ def run_critique(args: argparse.Namespace) -> int:
     # rehydrations, store writes + quarantines, swap walls
     # (engine/kvtier.py).
     perf["kv_tier"] = kv_tier.snapshot()
+    # Weight-residency telemetry: loads vs promotions (the reload the
+    # host tier avoided), demote/promote walls, swap-overlap fraction,
+    # coalesced groups/units (engine/weightres.py).
+    perf["weights"] = weightres.snapshot()
     # Streaming telemetry: requests streamed, deliveries, cancels, and
     # the decode tokens early cancellation saved (engine/streaming.py).
     perf["stream"] = streaming.snapshot()
@@ -1221,6 +1272,7 @@ def handle_export_tasks(args: argparse.Namespace) -> int:
     _configure_interleave(args)
     _configure_speculative(args)
     _configure_kv_tier(args)
+    _configure_weightres(args)
     _configure_streaming(args)
     obs = _configure_obs(args)
     spec = _read_spec_stdin()

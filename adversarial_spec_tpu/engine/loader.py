@@ -331,8 +331,18 @@ def materialize_params(
     seed: int = 0,
     max_seq_len: int = 0,
     device_put=None,
+    quant: str = "",
 ) -> tuple[Params, ModelConfig]:
-    """checkpoint == "random" → synthetic init; else HF safetensors dir."""
+    """checkpoint == "random" → synthetic init; else HF safetensors dir.
+
+    ``quant`` ("int8" / "int4", ops/quant.py) quantizes the matmul
+    weights AT materialization, so every consumer (native-cache writer,
+    residency estimate, serving path) sees one layout — the quantized
+    shards are also what the weight-residency manager demotes to host
+    RAM (engine/weightres.py), at a half/quarter of the bf16 bytes.
+    """
+    from adversarial_spec_tpu.ops.quant import quantize_params
+
     cfg = get_config(family, size, max_seq_len=max_seq_len)
     if checkpoint == "random":
         params = init_params(jax.random.key(seed), cfg, dtype=dtype)
@@ -340,7 +350,10 @@ def materialize_params(
             params = jax.tree_util.tree_map_with_path(
                 lambda path, x: device_put(path, np.asarray(x)), params
             )
-        return params, cfg
-    return load_hf_checkpoint(
+        return (quantize_params(params, fmt=quant) if quant else params), cfg
+    params = load_hf_checkpoint(
         checkpoint, cfg, family, dtype=dtype, device_put=device_put
-    ), cfg
+    )
+    if quant:
+        params = quantize_params(params, fmt=quant)
+    return params, cfg

@@ -64,6 +64,18 @@ envelope) carries SYNTHETIC walls on the tokens/1024 second-scale —
 the tools/trace_view.py waterfall and its checked decomposition pin
 byte-deterministically on CPU, SLO breach capture included.
 
+Weight-residency parity works the same way (engine/weightres.py): under
+an EXPLICIT ``ADVSPEC_HBM_BUDGET_BYTES`` (the bench/test trigger — the
+simulation stays off otherwise, so pre-residency mock event streams are
+byte-identical), each distinct mock model id occupies a nominal 64 MiB
+of "HBM": a round's model groups serve RESIDENT-FIRST, an over-budget
+load demotes (or, with ``--no-weight-res``, frees) the LRU model, and a
+demoted model's next turn promotes instead of re-loading — with
+synthetic walls on exact binary fractions (load = bytes/1 GiB/s,
+promote = load/8, demote = load/16), so the thrash-vs-resident
+weight-load seconds, swap events, and the ``perf.weights`` payload pin
+byte-deterministically on CPU.
+
 Interleave parity works the same way (engine/interleave.py): the first
 request of a ``chat`` batch prefills with nothing resident to overlap
 (stalled), every later request's prefill rides the residents' decode
@@ -81,9 +93,18 @@ from urllib.parse import parse_qs, urlparse
 from adversarial_spec_tpu import obs as obs_mod
 from adversarial_spec_tpu.debate.usage import Usage
 from adversarial_spec_tpu.engine import streaming as stream_mod
+from adversarial_spec_tpu.engine import weightres as weightres_mod
 from adversarial_spec_tpu.engine.types import ChatRequest, Completion, SamplingParams
 
 _ROUND_RE = re.compile(r"Debate round (\d+)")
+
+# Weight-residency simulation scale: nominal HBM bytes per distinct
+# mock model, and the synthetic transfer rates (exact binary fractions
+# so every derived wall pins with == on CPU). A "load" moves the bytes
+# at 1 GiB/s, a promotion at 8 GiB/s (host RAM is that much closer than
+# a checkpoint conversion), a demotion at 16 GiB/s (async gather).
+_MODEL_BYTES = 64 << 20
+_GIB = 1 << 30
 
 # Streaming delivery granularity: the reply streams to the consumer in
 # fixed-width character chunks. Width 5 on purpose — "[AGREE]" is 7
@@ -133,6 +154,71 @@ class MockEngine:
         self._allocator = None
         self._prefix = None
         self._seq = 0
+        # Weight-residency accounting (lazy: only under an explicit
+        # ADVSPEC_HBM_BUDGET_BYTES — see module docstring).
+        self._weights = None
+
+    @property
+    def ledger(self):
+        """The residency ledger (the engine-seam name the chaos/check
+        paths share with TpuEngine); None until the simulation armed."""
+        return self._weights
+
+    def _sim_residency(self, requests: list[ChatRequest]) -> None:
+        """Drive the weight-residency state machine for this chat's
+        model groups, deterministically (see module docstring): groups
+        serve resident-first, over-budget loads demote-or-free the LRU
+        model, demoted models promote on their next turn. Accounting
+        only — replies are computed per request in submission order
+        either way, so transcripts are byte-identical with the
+        simulation on, off, or thrashing."""
+        budget = weightres_mod.mock_budget_bytes()
+        if budget is None:
+            return
+        if self._weights is None:
+            self._weights = weightres_mod.WeightLedger()
+        led = self._weights
+        models: list[str] = []
+        for r in requests:
+            if r.model not in models:
+                models.append(r.model)
+        models = led.resident_first(models)
+        for gi, model in enumerate(models):
+            if led.is_resident(model):
+                led.touch(model)
+                continue
+            # Make room first (the engine's evict-before-materialize
+            # rule): every over-budget resident demotes or frees.
+            while (
+                led.resident_models
+                and (led.resident_models + 1) * _MODEL_BYTES > budget
+            ):
+                victim = led.lru_resident_alias()
+                if victim is None:
+                    break
+                if weightres_mod.paging_armed():
+                    led.demote_model(
+                        victim,
+                        None,
+                        _MODEL_BYTES,
+                        _MODEL_BYTES / (16 * _GIB),
+                    )
+                else:
+                    led.free_model(victim)
+            # Groups after the first ride the previous group's decode
+            # (the engine's prefetch-thread overlap, deterministically).
+            overlapped = gi > 0
+            if led.is_host(model):
+                led.promote_model(
+                    model,
+                    _MODEL_BYTES,
+                    _MODEL_BYTES / (8 * _GIB),
+                    overlapped=overlapped,
+                )
+            else:
+                led.admit_load(
+                    model, _MODEL_BYTES, _MODEL_BYTES / _GIB
+                )
 
     def validate(self, model: str) -> str | None:
         if not model.startswith("mock://"):
@@ -454,6 +540,7 @@ class MockEngine:
         # analog of admit-while-decoding.
         if obs_mod.config().enabled:
             obs_mod.hot.mock_chat_requests.inc(len(requests))
+        self._sim_residency(requests)
         return [
             self._one(
                 req, params, overlapped=i > 0, req_index=i,

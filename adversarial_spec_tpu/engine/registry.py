@@ -25,6 +25,11 @@ REGISTRY_PATH = Path.home() / ".config" / "adversarial-spec-tpu" / "registry.jso
 
 TPU_PREFIX = "tpu://"
 
+# The ``quant`` field's vocabulary ("" = full precision). Lives here —
+# not in ops/quant.py, which implements the formats — so validation and
+# the CLI stay jax-free (importing ops.quant pulls in jax.numpy).
+QUANT_FORMATS = ("", "int8", "int4")
+
 
 @dataclass
 class ModelSpec:
@@ -40,7 +45,10 @@ class ModelSpec:
     # 0 = keep the model config's native context length (e.g. 131072 for
     # llama-3.2 1b/3b); nonzero overrides it.
     max_seq_len: int = 0
-    quant: str = ""  # "" = full precision, "int8" = weight-only int8
+    # "" = full precision; "int8" / "int4" = weight-only quantization
+    # (ops/quant.py QUANT_FORMATS) — int4 packs two weights per byte,
+    # the format that fits a multi-model opponent pool resident.
+    quant: str = ""
     kv: str = "dense"  # "dense" | "paged" — KV-cache layout for decode
     kv_dtype: str = ""  # "" = model dtype, "int8" = quantized KV cache
 
@@ -160,6 +168,12 @@ def validate_tpu_model(
             spec = resolve_model_spec(model, registry_path)
     except (ValueError, KeyError) as e:
         return str(e).strip("'\"")
+    if spec.quant not in QUANT_FORMATS:
+        return (
+            f"model {model} registers unknown quantization "
+            f"{spec.quant!r}; known: "
+            + ", ".join(repr(q) for q in QUANT_FORMATS)
+        )
     if spec.checkpoint != "random":
         ckpt = Path(spec.checkpoint)
         if not ckpt.exists():

@@ -43,6 +43,7 @@ from adversarial_spec_tpu.engine import prefix_cache as prefix_mod
 from adversarial_spec_tpu.engine import registry as registry_mod
 from adversarial_spec_tpu.engine import spec as spec_mod
 from adversarial_spec_tpu.engine import streaming as stream_mod
+from adversarial_spec_tpu.engine import weightres as weightres_mod
 from adversarial_spec_tpu.engine.generate import (
     MIN_BUCKET,
     bucket_length,
@@ -134,6 +135,25 @@ def _trim_prompt(ids: list[int], limit: int) -> list[int]:
 
 
 @dataclass
+class HostWeights:
+    """A demoted model's host-resident shards plus everything needed to
+    re-activate it with one committed ``device_put`` (the weight
+    ledger's opaque payload — engine/weightres.py). ``shardings`` is
+    the ORIGINAL params' sharding tree: promotion restores the exact
+    jit signature the model compiled under, so re-promotion compiles
+    nothing (the PR 5/6 committed-sharding discipline applied to
+    params)."""
+
+    spec: ModelSpec
+    cfg: ModelConfig
+    tokenizer: object
+    mesh: object
+    np_params: dict
+    shardings: dict
+    bytes_device: int
+
+
+@dataclass
 class LoadedModel:
     spec: ModelSpec
     cfg: ModelConfig
@@ -170,7 +190,19 @@ class TpuEngine:
         # or prefetch): counted alongside _models in every budget sum so
         # two concurrent loads can't each conclude they fit alone.
         self._loading: dict[str, int] = {}
-        self._pinned: set[str] = set()  # never evicted (mid-decode)
+        # The weight-residency state machine (engine/weightres.py):
+        # resident/host/freed bookkeeping, eviction pins (mid-decode
+        # models are acquire_weights-pinned, never victims), and the
+        # host payloads evicted models demote into instead of paying a
+        # full re-materialization on their next turn.
+        self.ledger = weightres_mod.WeightLedger()
+        # Demotions whose device→host gather is still in flight: the
+        # victim is already out of _models (budget math stops counting
+        # it) but not yet committed to the ledger's host tier. A load
+        # of THAT alias must wait for the commit (then promote) instead
+        # of racing a cold re-materialization against the gather;
+        # loads of every other alias never block on the transfer.
+        self._demoting: dict[str, threading.Event] = {}
         self.prefetch_hits = 0  # prefetched loads actually consumed
 
     def _committed_bytes_locked(self) -> int:
@@ -212,6 +244,11 @@ class TpuEngine:
                 lm.prefetched = False
                 lm.last_used = time.monotonic()
                 return lm
+        self._wait_demoting(alias)
+        if self.ledger.is_host(alias):
+            # Demoted weights are host-resident: re-activate with one
+            # committed device_put instead of a full materialization.
+            return self._promote_sync(alias)
         return self._load_sync(alias)
 
     def _load_sync(
@@ -271,6 +308,9 @@ class TpuEngine:
                 # _models / _inflight, never neither.
                 self._models[alias] = lm
                 self._inflight.pop(alias, None)
+            self.ledger.admit_load(
+                alias, lm.bytes_per_chip, time.monotonic() - t_load
+            )
             return lm
         finally:
             with self._lock:
@@ -292,7 +332,7 @@ class TpuEngine:
 
         def build():
             p = init_params(jax.random.key(0), cfg, dtype)
-            return quantize_params(p) if spec.quant == "int8" else p
+            return quantize_params(p, fmt=spec.quant) if spec.quant else p
 
         shapes = jax.eval_shape(build)
         shardings = param_shardings(mesh, shapes)
@@ -315,20 +355,29 @@ class TpuEngine:
         also block single models legitimately larger than the estimate.
         """
         budget = hbm_budget_bytes()
-        with self._lock:
-            while self._models:
+        while True:
+            with self._lock:
                 resident = self._committed_bytes_locked()
-                if resident + needed_bytes <= budget:
+                if not self._models or resident + needed_bytes <= budget:
                     break
                 victims = [
-                    a for a in self._models if a not in self._pinned
+                    a for a in self._models if not self.ledger.pinned(a)
                 ]
                 if not victims:
                     break
                 oldest = min(
                     victims, key=lambda a: self._models[a].last_used
                 )
-                del self._models[oldest]
+                lm, ev = self._pop_for_demotion_locked(oldest)
+            # The device→host gather runs OUTSIDE the engine lock: a
+            # concurrent hit on an already-resident model must not
+            # stall behind a GB-scale transfer. Budget math is already
+            # right — the pop removed the victim from the committed
+            # sum, and the _demoting event (registered under the same
+            # lock hold) makes a racing load of the VICTIM wait for
+            # the ledger commit instead of cold-loading against it.
+            self._demote_popped(oldest, lm, ev)
+        with self._lock:
             resident = self._committed_bytes_locked()
             if reserve_as is not None:
                 # Reserve atomically with the final fit check: a
@@ -341,6 +390,168 @@ class TpuEngine:
                 f"{budget >> 20} MiB — loading anyway (OOM will retry "
                 "as transient)",
                 file=sys.stderr,
+            )
+
+    def _pop_for_demotion_locked(
+        self, alias: str
+    ) -> tuple[LoadedModel, threading.Event]:
+        """Take one model out of the loaded dict for demotion. The
+        batcher's device state (pool pages, row buffers) goes with the
+        weights: a demoted model must hold ZERO HBM, and an unbounded
+        per-model batcher cache is a leak in a long-lived serve daemon
+        (its KV survives only through the tiered store's write-through,
+        which already flushed at drain end). Caller holds
+        ``self._lock``; the returned event is registered under the same
+        hold, so a racing load of this alias observes the model in
+        exactly one of _models / _demoting / the ledger's host tier."""
+        lm = self._models.pop(alias)
+        lm.batcher = None
+        lm.batcher_key = None
+        ev = threading.Event()
+        self._demoting[alias] = ev
+        return lm, ev
+
+    def _demote_popped(
+        self, alias: str, lm: LoadedModel, ev: threading.Event
+    ) -> None:
+        """Finish one eviction outside the engine lock. With weight
+        paging armed the (typically quantized) shards demote to the
+        host tier — the device→host copies are STARTED async for every
+        leaf before any is resolved, so the gather overlaps itself;
+        with paging off this is the classic free-and-reload
+        eviction."""
+        try:
+            if not weightres_mod.paging_armed():
+                self.ledger.free_model(alias)
+                return
+            t0 = time.monotonic()
+            for leaf in jax.tree.leaves(lm.params):
+                try:
+                    leaf.copy_to_host_async()
+                except AttributeError:  # non-jax leaf (tests)
+                    pass
+            np_params = jax.tree.map(np.asarray, lm.params)
+            shardings = jax.tree.map(
+                lambda x: getattr(x, "sharding", None), lm.params
+            )
+            holder = HostWeights(
+                spec=lm.spec,
+                cfg=lm.cfg,
+                tokenizer=lm.tokenizer,
+                mesh=lm.mesh,
+                np_params=np_params,
+                shardings=shardings,
+                bytes_device=lm.bytes_per_chip,
+            )
+            bytes_host = sum(
+                leaf.nbytes for leaf in jax.tree.leaves(np_params)
+            )
+            self.ledger.demote_model(
+                alias, holder, bytes_host, time.monotonic() - t0
+            )
+        finally:
+            with self._lock:
+                self._demoting.pop(alias, None)
+            ev.set()
+
+    def _wait_demoting(self, alias: str) -> None:
+        """Block until an in-flight demotion of ``alias`` (if any)
+        commits to the ledger — the racing loader then promotes the
+        freshly demoted shards instead of cold-loading against the
+        gather. Never blocks for other aliases."""
+        with self._lock:
+            ev = self._demoting.get(alias)
+        if ev is not None:
+            ev.wait()
+
+    def _promote_sync(
+        self,
+        alias: str,
+        prefetched: bool = False,
+        evict: bool = True,
+        reserved: bool = False,
+    ) -> LoadedModel:
+        """Re-activate a host-demoted model: one async ``device_put``
+        of the saved shards into their ORIGINAL shardings (committed —
+        promoted params present the same jit signature the model
+        compiled under, so nothing recompiles), dispatched without
+        blocking so a prefetch-thread promotion overlaps the current
+        model's decode. A fault mid-swap (the ``weight_swap`` chaos
+        seam fires here) leaves the host entry untouched: only the
+        waiting admission degrades, and the swap is declared
+        (``swap_fault`` WeightEvent), never silent."""
+        holder = self.ledger.peek_host(alias)
+        if holder is None or not isinstance(holder.payload, HostWeights):
+            return self._load_sync(
+                alias, prefetched=prefetched, reserved=reserved
+            )
+        hw: HostWeights = holder.payload
+        try:
+            injector.fire("weight_swap")
+            if evict:
+                self._evict_for(hw.bytes_device, reserve_as=alias)
+            elif not reserved:
+                with self._lock:
+                    self._loading[alias] = hw.bytes_device
+            t0 = time.monotonic()
+            params = jax.tree.map(
+                lambda arr, sh: (
+                    jax.device_put(arr, sh) if sh is not None
+                    else jnp.asarray(arr)
+                ),
+                hw.np_params,
+                hw.shardings,
+            )
+            lm = LoadedModel(
+                spec=hw.spec,
+                cfg=hw.cfg,
+                params=params,
+                tokenizer=hw.tokenizer,
+                mesh=hw.mesh,
+                last_used=time.monotonic(),
+                bytes_per_chip=hw.bytes_device,
+                prefetched=prefetched,
+            )
+            with self._lock:
+                self._models[alias] = lm
+                self._inflight.pop(alias, None)
+            self.ledger.promote_model(
+                alias,
+                hw.bytes_device,
+                time.monotonic() - t0,
+                overlapped=prefetched,
+            )
+            return lm
+        except BaseException:
+            # Conservation: the host entry was never consumed — the
+            # next _load retries the promotion; the fault evicts only
+            # the admission that was waiting on this swap.
+            self.ledger.note_swap_fault(alias)
+            raise
+        finally:
+            with self._lock:
+                self._loading.pop(alias, None)
+
+    def check_residency_invariants(self) -> None:
+        """Ledger conservation plus the ledger↔engine mirror: the
+        ledger's resident set must be exactly the engine's loaded-model
+        dict, and no demoted model may still hold a batcher (chaos
+        drills and tests call this after every drill step)."""
+        # Settle in-flight demotions first: mid-gather a victim is
+        # transiently in neither _models nor the host tier (by design),
+        # which is drift only if it persists past the commit.
+        with self._lock:
+            pending = list(self._demoting.values())
+        for ev in pending:
+            ev.wait()
+        self.ledger.check_invariants()
+        with self._lock:
+            resident = set(self.ledger.resident_aliases())
+            loaded = set(self._models)
+        if resident != loaded:
+            raise RuntimeError(
+                f"weight ledger/engine drift: ledger resident "
+                f"{sorted(resident)} != loaded models {sorted(loaded)}"
             )
 
     def _maybe_prefetch(self, alias: str) -> None:
@@ -394,10 +605,27 @@ class TpuEngine:
         error reporting.
         """
         try:
-            spec = registry_mod.resolve_model_spec(f"tpu://{alias}")
-            dtype = _DTYPES.get(spec.dtype, jnp.bfloat16)
-            mesh = make_mesh(spec.mesh)
-            estimate = self._estimate_per_chip_bytes(spec, dtype, mesh)
+            # A demotion of this alias may still be gathering: wait for
+            # its ledger commit (cheap — this is the background thread)
+            # so the prefetch promotes the shards instead of racing a
+            # cold load against the transfer.
+            self._wait_demoting(alias)
+            host_entry = self.ledger.peek_host(alias)
+            if host_entry is not None and isinstance(
+                host_entry.payload, HostWeights
+            ):
+                # Host-demoted weights: the prefetch is a PROMOTION —
+                # the async host→device transfer rides under the
+                # current model's decode, which is the entire point of
+                # overlapped swap (swap-overlap fraction in
+                # perf.weights counts exactly these).
+                estimate = host_entry.payload.bytes_device
+            else:
+                host_entry = None
+                spec = registry_mod.resolve_model_spec(f"tpu://{alias}")
+                dtype = _DTYPES.get(spec.dtype, jnp.bfloat16)
+                mesh = make_mesh(spec.mesh)
+                estimate = self._estimate_per_chip_bytes(spec, dtype, mesh)
             with self._lock:
                 fits = (
                     self._committed_bytes_locked() + estimate
@@ -408,6 +636,10 @@ class TpuEngine:
                     # foreground load's budget math must see these
                     # bytes before this thread starts materializing.
                     self._loading[alias] = estimate
+            if fits and host_entry is not None:
+                return self._promote_sync(
+                    alias, prefetched=True, evict=False, reserved=True
+                )
             if fits:
                 return self._load_sync(
                     alias,
@@ -441,7 +673,7 @@ class TpuEngine:
         import sys
 
         injector.fire("checkpoint_load")
-        quantize = spec.quant == "int8"
+        quantize = bool(spec.quant)
         cfg = get_config(spec.family, spec.size, max_seq_len=spec.max_seq_len)
         cache_path = None
         if spec.checkpoint != "random":
@@ -470,7 +702,11 @@ class TpuEngine:
                         jax.random.key(0), cfg, dtype,
                         transposed_head=t_head,
                     )
-                    return quantize_params(p) if quantize else p
+                    return (
+                        quantize_params(p, fmt=spec.quant)
+                        if quantize
+                        else p
+                    )
 
                 shapes = jax.eval_shape(build)
                 shardings = param_shardings(mesh, shapes)
@@ -497,9 +733,8 @@ class TpuEngine:
             dtype=dtype,
             max_seq_len=spec.max_seq_len,
             device_put=make_device_put(mesh, dtype),
+            quant=spec.quant,
         )
-        if quantize:
-            params = quantize_params(params)
         if cache_path is not None:
             try:  # write side is best-effort too
                 ckpt_mod.save_native(params, cache_path)
@@ -530,7 +765,15 @@ class TpuEngine:
             alias = registry_mod.parse_tpu_model_id(req.model)
             groups.setdefault(alias, []).append(i)
 
-        aliases = list(groups)
+        # Residency-aware group order: serve the groups whose weights
+        # are ALREADY resident before any group that forces a swap —
+        # under a pool-larger-than-HBM budget this turns "one swap per
+        # group" into "at most (pool − resident) swaps per round".
+        # Groups decode independently, so reordering cannot change any
+        # row's greedy tokens; the output list is re-indexed by the
+        # original request positions either way.
+        aliases = self.ledger.resident_first(list(groups))
+        groups = {a: groups[a] for a in aliases}
         out: list[Completion | None] = [None] * len(requests)
         for gi, (alias, indices) in enumerate(groups.items()):
             batch = [requests[i] for i in indices]
@@ -589,21 +832,43 @@ class TpuEngine:
         consumer=None,
     ) -> list[Completion]:
         # Pin BEFORE loading: from the moment this model can be resident
-        # it must not be an eviction victim of a concurrent background
-        # load (eviction only drops the dict entry; a foreground
-        # reference would keep the bytes alive while the budget math
-        # believes them freed).
-        with self._lock:
-            self._pinned.add(alias)
+        # it must not be an eviction/demotion victim of a concurrent
+        # background load (eviction only drops the dict entry; a
+        # foreground reference would keep the bytes alive while the
+        # budget math believes them freed). acquire/release is the
+        # ledger's refcount pair — GL-REFCOUNT enforces the
+        # try/finally shape.
+        self.ledger.acquire_weights(alias)
         try:
             lm = self._load(alias)
             if prefetch_next is not None:
-                self._maybe_prefetch(prefetch_next)
+                self._stage_next(prefetch_next)
             injector.fire("generate")
             return self._chat_loaded(lm, batch, params, consumer)
         finally:
+            self.ledger.release_weights(alias)
+
+    def _stage_next(self, alias: str) -> None:
+        """Make the NEXT group's swap overlap this group's decode: when
+        the next model is host-demoted and HBM is full, demote the LRU
+        resident NOW (the current group's model is pinned and can't be
+        the victim) so the background promotion fits — without this,
+        a budget-saturated pool can never overlap a promotion, because
+        the prefetch thread refuses to evict on anyone's behalf. Only
+        the cheap host-resident case stages eagerly (its byte estimate
+        is already known); cold loads keep the fit-check-only prefetch
+        policy."""
+        entry = self.ledger.peek_host(alias)
+        if entry is not None and isinstance(entry.payload, HostWeights):
+            needed = entry.payload.bytes_device
             with self._lock:
-                self._pinned.discard(alias)
+                fits = (
+                    self._committed_bytes_locked() + needed
+                    <= hbm_budget_bytes()
+                )
+            if not fits:
+                self._evict_for(needed)
+        self._maybe_prefetch(alias)
 
     def _chat_loaded(
         self,

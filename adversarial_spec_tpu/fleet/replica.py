@@ -114,6 +114,14 @@ def check_engine_invariants(engine) -> None:
     prefix = getattr(engine, "_prefix", None)
     if prefix is not None and getattr(prefix, "tiers", None) is not None:
         prefix.tiers.check_invariants()
+    # Weight-residency conservation (engine/weightres.py): both engines
+    # expose ``ledger`` (the mock's is None until its simulation arms);
+    # the real engine's stricter ledger↔engine mirror rides along.
+    if getattr(engine, "ledger", None) is not None:
+        if hasattr(engine, "check_residency_invariants"):
+            engine.check_residency_invariants()
+        else:
+            engine.ledger.check_invariants()
 
 
 class InProcessReplica:

@@ -52,6 +52,7 @@ from adversarial_spec_tpu.obs.events import (  # noqa: F401 (re-export)
     SpecEvent,
     StepEvent,
     SwapEvent,
+    WeightEvent,
     atomic_write_text,
     validate_event,
 )
@@ -175,6 +176,7 @@ class HotMetrics:
         "fleet_affinity_ratio",
         "serve_backlog",
         "serve_queue_wait",
+        "weight_resident",
         "_m",
         "_sync",
         "_fault",
@@ -186,6 +188,7 @@ class HotMetrics:
         "_replica_op",
         "_serve_op",
         "_serve_shed",
+        "_weight_swap",
     )
 
     def __init__(self, m: MetricsRegistry) -> None:
@@ -289,6 +292,13 @@ class HotMetrics:
             "advspec_serve_queue_wait_seconds",
             help="opponent-unit wait from admission to dispatch",
         )
+        # Weight residency (engine/weightres.py): how many opponent
+        # models are device-resident right now — the "one debate pool
+        # per TPU" unit-economics gauge.
+        self.weight_resident = m.gauge(
+            "advspec_weight_resident_models",
+            help="opponent models resident in device HBM",
+        )
         self._sync: dict = {}
         self._fault: dict = {}
         self._breaker: dict = {}
@@ -299,6 +309,7 @@ class HotMetrics:
         self._replica_op: dict = {}
         self._serve_op: dict = {}
         self._serve_shed: dict = {}
+        self._weight_swap: dict = {}
 
     def sync(self, reason: str):
         c = self._sync.get(reason)
@@ -404,6 +415,20 @@ class HotMetrics:
                 reason=reason,
             )
         return c
+
+    def weight_swap_latency(self, direction: str):
+        """Weight-residency swap wall histogram by direction (load:
+        cold materialization; in: host→device promotion; out:
+        device→host demotion) — residency thrash shows up here as a
+        fat ``load`` column that should have been ``in``."""
+        h = self._weight_swap.get(direction)
+        if h is None:
+            h = self._weight_swap[direction] = self._m.histogram(
+                "advspec_weight_swap_seconds",
+                help="weight residency swap wall by direction",
+                direction=direction,
+            )
+        return h
 
     def swap_latency(self, direction: str):
         """KV swap wall histogram by direction (in: promote/rehydrate

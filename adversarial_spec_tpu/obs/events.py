@@ -48,6 +48,11 @@ Event vocabulary (the schema ``tools/obs_dump.py`` validates):
 - ``RouteEvent`` — one fleet routing decision: which replica a request
   landed on, the affinity key it hashed, and the failover hop count
   (0 = the ring's primary choice).
+- ``WeightEvent`` — one weight-residency transition
+  (engine/weightres.py): a model loaded cold, demoted to the host
+  tier, promoted back, freed, or a promotion aborted by a fault —
+  with the post-op resident/host model counts so residency thrash is
+  visible in the timeline, not inferred from round latency.
 - ``ServeEvent`` — one serve-daemon lifecycle/pressure transition
   (adversarial_spec_tpu/serve): a debate accepted/shed at admission, an
   opponent unit queued/running/finished/preempted/drained, a brownout
@@ -307,6 +312,28 @@ class RouteEvent:
 
 
 @dataclass(slots=True)
+class WeightEvent:
+    """One weight-residency state transition (engine/weightres.py).
+    ``op`` names the edge of the residency state machine (load: cold
+    materialization; demote: device→host shard paging; promote:
+    host→device re-activation; free: eviction without paging / host
+    LRU overflow; swap_fault: a promotion aborted mid-swap — the host
+    entry survives untouched). ``resident``/``host`` are the per-tier
+    model counts AFTER the op; ``wall_s`` the swap's measured wall
+    (synthetic deterministic seconds from the mock engine)."""
+
+    TYPE = "weight"
+    op: str = "load"
+    alias: str = ""
+    nbytes: int = 0
+    wall_s: float = 0.0
+    resident: int = 0
+    host: int = 0
+    trace_id: str = ""  # round whose group drove the swap (ambient)
+    span_id: str = ""
+
+
+@dataclass(slots=True)
 class ServeEvent:
     """One serve-daemon transition (adversarial_spec_tpu/serve). ``op``
     names the edge of the request lifecycle state machine (accepted →
@@ -347,6 +374,7 @@ EVENT_TYPES = (
     RecoveryEvent,
     ReplicaEvent,
     RouteEvent,
+    WeightEvent,
     ServeEvent,
 )
 
@@ -363,6 +391,17 @@ SWAP_OPS = (
     "store",
     "free",
     "quarantine",
+)
+
+# The weight-residency state machine's edges (engine/weightres.py) —
+# graftlint's fourth GL-LIFECYCLE machine enforces the code side of
+# the same contract (every transition through one ledger surgery).
+WEIGHT_OPS = (
+    "load",
+    "demote",
+    "promote",
+    "free",
+    "swap_fault",
 )
 
 REPLICA_OPS = (
@@ -473,6 +512,8 @@ def validate_event(obj) -> list[str]:
         errors.append(f"swap: unknown op {obj.get('op')!r}")
     if etype == "span" and obj.get("phase") not in SPAN_PHASES:
         errors.append(f"span: unknown phase {obj.get('phase')!r}")
+    if etype == "weight" and obj.get("op") not in WEIGHT_OPS:
+        errors.append(f"weight: unknown op {obj.get('op')!r}")
     if etype == "replica" and obj.get("op") not in REPLICA_OPS:
         errors.append(f"replica: unknown op {obj.get('op')!r}")
     if etype == "route" and obj.get("reason") not in ROUTE_REASONS:

@@ -59,14 +59,16 @@ def param_sharding_rules(path) -> P:
     if not names:
         raise ValueError(f"cannot name pytree path {path}")
     name = names[-1]
-    # int8-quantized weights are dict leaves {"q", "scale"} under the
-    # weight's name (ops/quant.py): "q" shards like the weight; "scale"
+    # Quantized weights are dict leaves under the weight's name
+    # (ops/quant.py): int8 {"q", "scale"}, int4 {"q4", "scale"}. "q"
+    # and "q4" shard like the weight (int4 packing halves the
+    # contraction axis — the axis ASSIGNMENT is unchanged); "scale"
     # ([..., 1, out]) keeps only the output-axis sharding — its kept
     # contraction axis has size 1 and must stay unsharded.
-    if name in ("q", "scale") and len(names) >= 2:
+    if name in ("q", "q4", "scale") and len(names) >= 2:
         parent = _PARAM_RULES.get(names[-2])
         if parent is not None:
-            if name == "q":
+            if name in ("q", "q4"):
                 return parent
             spec = list(parent)
             spec[-2] = None

@@ -14,6 +14,13 @@ seam                fires just before
 ``kv_alloc``        page reservation at admission (engine/scheduler.py)
 ``kv_swap``         each tier-block promotion into an admission's pages
                     (engine/scheduler.py — the tiered-KV swap path)
+``weight_swap``     each weight promotion of a host-demoted model back
+                    into HBM (engine/tpu.py) — a fault here aborts the
+                    swap with the host entry untouched: only the
+                    admission waiting on the swap degrades, the
+                    residency ledger stays conservation-clean, and the
+                    aborted swap is a declared WeightEvent
+                    (``tools/chaos_run.py --weight-swap`` is the drill)
 ``checkpoint_load`` parameter materialization (engine/tpu.py)
 ``crash``           each round-journal fsync append (debate/journal.py)
                     — the write-ahead durability path: a fault here is
@@ -63,6 +70,7 @@ SEAMS = (
     "scheduler_chunk",
     "kv_alloc",
     "kv_swap",
+    "weight_swap",
     "checkpoint_load",
     "crash",
     "replica",

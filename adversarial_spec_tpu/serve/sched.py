@@ -22,7 +22,14 @@ tenant of short ones at exactly the token exchange rate. Tiers are
 strict priority: interactive always dispatches before batch — "batch
 starves first" is the contract, not an accident. Same-model units at
 the head of the fair order coalesce into one dispatch batch (N rows
-of one batched decode on the real engine).
+of one batched decode on the real engine), and when the fair head
+would force a WEIGHT SWAP (a different model than the one dispatching
+— engine/weightres.py), same-model units deeper in the dispatching
+tenant's own queue are pulled forward first: a swap is allowed only
+after the resident model's queued work is exhausted. The pull is
+bounded to the tenant's own queue, so inter-tenant stride fairness is
+untouched (passes advance by tokens paid regardless of intra-tenant
+order, and a tenant's opponent units are independent requests).
 
 **Brownout**: when the backlog ledger crosses
 ``brownout_enter_fraction x max_backlog_tokens`` the daemon DECLARES
@@ -67,6 +74,7 @@ from dataclasses import dataclass
 
 from adversarial_spec_tpu import obs as obs_mod
 from adversarial_spec_tpu import serve as serve_mod
+from adversarial_spec_tpu.engine import weightres as weightres_mod
 from adversarial_spec_tpu.engine.types import ChatRequest, Completion, SamplingParams
 from adversarial_spec_tpu.serve.protocol import SHED_REASONS, TIERS
 
@@ -431,6 +439,11 @@ class ServeScheduler:
             while len(batch) < cfg.max_dispatch_batch:
                 nxt = self._peek_matching(first)
                 if nxt is None:
+                    # The fair head would force a model swap: pull
+                    # same-model work forward from the dispatching
+                    # tenant's own queue before allowing it.
+                    nxt = self._steal_same_model(first)
+                if nxt is None:
                     break
                 batch.append(nxt)
             for u in batch:
@@ -455,6 +468,35 @@ class ServeScheduler:
             if remaining is not None and remaining <= 0:
                 return None  # quota shed happens on its own pick
             return q.popleft()
+        return None
+
+    def _steal_same_model(self, first: Unit) -> Unit | None:
+        """Weight-swap-aware coalescing (engine/weightres.py): when the
+        next fair-order unit runs a DIFFERENT model, scan the
+        dispatching tenant's own queue for a same-(engine, model,
+        params) unit and pull it into this dispatch — same-model
+        opponent units coalesce before a swap is allowed. Scoped to
+        ``first``'s own (tier, tenant) queue so stride fairness between
+        tenants is untouched; counted into ``perf.weights``
+        (``coalesced_units``) so the reorder is declared, not
+        inferred."""
+        if not weightres_mod.config().enabled:
+            return None
+        remaining = self._quota_remaining(first.tenant)
+        if remaining is not None and remaining <= 0:
+            return None
+        q = self._queues[first.tier].get(first.tenant)
+        if not q:
+            return None
+        for i, unit in enumerate(q):
+            if (
+                unit.engine is first.engine
+                and unit.request.model == first.request.model
+                and unit.params == first.params
+            ):
+                del q[i]
+                weightres_mod.stats.coalesced_units += 1
+                return unit
         return None
 
     def _start_unit(self, unit: Unit) -> None:

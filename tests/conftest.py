@@ -99,6 +99,19 @@ def _isolate_state(tmp_path, monkeypatch):
         enabled=False, host_mb=kvtier.DEFAULT_HOST_MB, store_dir=""
     )
     kvtier.reset_stats()
+    # Weight-residency config/stats are process-global by design (the
+    # ledger lives on each engine); tests must not leak a host budget,
+    # swap counts, or — critically — an explicit HBM budget (the mock
+    # engine's residency simulation arms only under
+    # ADVSPEC_HBM_BUDGET_BYTES, keeping pre-residency mock event
+    # streams byte-identical).
+    from adversarial_spec_tpu.engine import weightres
+
+    monkeypatch.delenv("ADVSPEC_WEIGHT_RES", raising=False)
+    monkeypatch.delenv("ADVSPEC_WEIGHT_HOST_MB", raising=False)
+    monkeypatch.delenv("ADVSPEC_HBM_BUDGET_BYTES", raising=False)
+    weightres.configure(enabled=True, host_mb=weightres.DEFAULT_HOST_MB)
+    weightres.reset_stats()
     # Fleet config/stats are process-global by design (the replica
     # topology outlives a round); tests must not leak an armed fleet,
     # spawned replicas, or routing counts into each other. Fleet OFF
@@ -199,6 +212,8 @@ def _isolate_state(tmp_path, monkeypatch):
         enabled=False, host_mb=kvtier.DEFAULT_HOST_MB, store_dir=""
     )
     kvtier.reset_stats()
+    weightres.configure(enabled=True, host_mb=weightres.DEFAULT_HOST_MB)
+    weightres.reset_stats()
     streaming.configure(enabled=True, early_cancel=True)
     streaming.reset_stats()
     obs.configure(

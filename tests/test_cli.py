@@ -339,6 +339,31 @@ class TestExportTasks:
 
 
 class TestRegistry:
+    def test_validate_and_parser_stay_jax_free(self):
+        """Registry preflight (quant vocabulary included) and parser
+        construction must not import jax: mock-only and registry-
+        management CLI flows pay no multi-second jax init. Subprocess —
+        the suite's own process loaded jax long ago."""
+        import subprocess
+        import sys as _sys
+
+        proc = subprocess.run(
+            [
+                _sys.executable,
+                "-c",
+                "import sys\n"
+                "from adversarial_spec_tpu.engine import registry\n"
+                "assert registry.validate_tpu_model('tpu://random-tiny') "
+                "is None\n"
+                "from adversarial_spec_tpu import cli\n"
+                "cli.create_parser()\n"
+                "assert 'jax' not in sys.modules, 'jax imported'\n",
+            ],
+            capture_output=True,
+            text=True,
+        )
+        assert proc.returncode == 0, proc.stderr[-2000:]
+
     def test_add_list_remove(self, monkeypatch, capsys):
         code, out, _ = run_cli(
             [
@@ -928,8 +953,9 @@ class TestMutationHardeningRound2:
             assert p.parse_args(["registry", "--family", fam]).family == fam
         for kv in ("dense", "paged"):
             assert p.parse_args(["registry", "--kv", kv]).kv == kv
-        for q in ("", "int8"):
+        for q in ("", "int8", "int4"):
             assert p.parse_args(["registry", "--quant", q]).quant == q
+        for q in ("", "int8"):  # KV quantization has no int4 format
             assert p.parse_args(["registry", "--kv-dtype", q]).kv_dtype == q
 
     def test_validate_uses_registry_path_once(self, monkeypatch):

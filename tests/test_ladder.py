@@ -127,6 +127,27 @@ def test_tier_child_smoke(tmp_path):
         assert row["pool_tokens"] > 0
 
 
+def test_residency_child_smoke(tmp_path):
+    """Phase D (weight residency): the child must record every
+    (pool, budget) sweep point with the paging-vs-thrash walls and the
+    swap-overlap fraction the residency story is judged by."""
+    import tpu_ladder
+
+    out = tmp_path / "smoke.jsonl"
+    proc = _run_child(["--child-residency", str(out)], out)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    steps = load(str(out), include_smoke=True)
+    for required in tpu_ladder.RES_STEPS:
+        assert required in steps, (required, sorted(steps))
+    # The 4-pool/2-budget acceptance point must actually swap, and
+    # paging must beat naive evict-reload on weight-load seconds.
+    row = steps["res_pool4b2"]
+    assert row["promotions"] > 0
+    assert row["load_wall_thrash_s"] > row["load_wall_resident_s"]
+    # The no-pressure control must not swap at all.
+    assert steps["res_pool2b2"]["demotions"] == 0
+
+
 def test_batcher_spec_child_smoke(tmp_path):
     """Phase B' (batcher γ sweep): the child must drain the bench-shaped
     pool through the ContinuousBatcher under the env γ and record the
@@ -186,6 +207,7 @@ class TestOrchestrator:
                 *tpu_ladder.ENV_STEPS,
                 *tpu_ladder.BATCHER_SPEC_STEPS,
                 *tpu_ladder.TIER_STEPS,
+                *tpu_ladder.RES_STEPS,
             ],
         )
         monkeypatch.setattr(bench, "_probe_tpu", lambda **kw: True)
@@ -207,6 +229,7 @@ class TestOrchestrator:
                 list(tpu_ladder.ENV_STEPS)
                 + list(tpu_ladder.BATCHER_SPEC_STEPS)
                 + list(tpu_ladder.TIER_STEPS)
+                + list(tpu_ladder.RES_STEPS)
             )
             if s != "gamma16"
         ]
@@ -222,13 +245,20 @@ class TestOrchestrator:
                     else "--child-batcher-spec"
                     if "--child-batcher-spec" in cmd
                     else "--child-tier"
+                    if "--child-tier" in cmd
+                    else "--child-residency"
                 )
                 i = cmd.index(flag)
-                if flag == "--child-tier":
-                    # The tier child records every remaining tier step.
-                    launched.append("tier")
+                if flag in ("--child-tier", "--child-residency"):
+                    # These children record every remaining phase step.
+                    phase_steps = (
+                        tpu_ladder.TIER_STEPS
+                        if flag == "--child-tier"
+                        else tpu_ladder.RES_STEPS
+                    )
+                    launched.append(flag.removeprefix("--child-"))
                     with open(cmd[i + 1], "a") as f:
-                        for s in tpu_ladder.TIER_STEPS:
+                        for s in phase_steps:
                             f.write(json.dumps({"step": s}) + "\n")
                     return
                 step = cmd[i + 2]

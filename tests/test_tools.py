@@ -1128,6 +1128,7 @@ class TestGraftlint:
             lifecycle_mutators=[],
             fleet_lifecycle_class="",  # fixture has no fleet machine
             serve_lifecycle_class="",  # fixture has no serve machine
+            weightres_lifecycle_class="",  # nor a weight-ledger machine
         )
         sources = {
             "pkg/sched.py": (

@@ -1,0 +1,859 @@
+"""Weight-residency tests (ISSUE 15): the ledger state machine, the
+TpuEngine demote/promote path, mock parity, scheduler coalescing, CLI
+plumbing, and the graftlint registrations that pin the discipline.
+
+The real-engine tests reuse the same tiny aliases and sampling shapes
+as tests/test_tpu_engine.py so the jit cache absorbs most of the
+compile cost across the suite."""
+
+import json
+
+import pytest
+
+from adversarial_spec_tpu import obs
+from adversarial_spec_tpu.engine import weightres
+from adversarial_spec_tpu.engine.registry import (
+    ModelSpec,
+    save_registry_entry,
+)
+from adversarial_spec_tpu.engine.types import ChatRequest, SamplingParams
+from adversarial_spec_tpu.obs.events import validate_event
+
+PARAMS = SamplingParams(max_new_tokens=8, greedy=True)
+
+
+@pytest.fixture(autouse=True)
+def _spec_off(monkeypatch):
+    """Engine-seam tests: speculation only multiplies jit programs
+    (the precedent of tests/test_tpu_engine.py's module fixture)."""
+    from adversarial_spec_tpu.engine import spec as spec_mod
+
+    prev = spec_mod.config()
+    prev_enabled, prev_gamma = prev.enabled, prev.gamma
+    monkeypatch.setenv("ADVSPEC_SPECULATIVE", "0")
+    spec_mod.configure(enabled=False)
+    yield
+    spec_mod.configure(enabled=prev_enabled, gamma=prev_gamma)
+
+
+def _req(model, user="hello"):
+    return ChatRequest(model=model, system="sys", user=user)
+
+
+# -- the ledger state machine ----------------------------------------------
+
+
+class TestLedger:
+    def test_load_demote_promote_free_conservation(self):
+        led = weightres.WeightLedger(weightres.stats)
+        led.admit_load("a", 100, 0.5)
+        assert led.is_resident("a")
+        led.demote_model("a", "payload", 50, 0.1)
+        assert led.is_host("a")
+        assert led.peek_host("a").payload == "payload"
+        led.promote_model("a", 100, 0.05)
+        assert led.is_resident("a")
+        led.demote_model("a", "payload", 50)
+        led.free_model("a")
+        assert led.state("a") is None
+        led.check_invariants()
+        assert led.demoted == 2
+        assert led.promoted == 1
+        assert led.freed_host == 1
+
+    def test_host_budget_overflow_frees_lru(self):
+        led = weightres.WeightLedger(weightres.stats)
+        for i, alias in enumerate(("a", "b", "c")):
+            led.admit_load(alias, 100)
+        # Budget fits two 50-byte host entries: the third demotion must
+        # free the LRU host entry (a — demoted first, never touched).
+        freed = []
+        for alias in ("a", "b", "c"):
+            freed += led.demote_model(
+                alias, None, 50, host_budget_bytes=100
+            )
+        assert freed == ["a"]
+        assert led.host_aliases() == ["b", "c"]
+        led.check_invariants()
+
+    def test_oversized_single_entry_freed(self):
+        led = weightres.WeightLedger(weightres.stats)
+        led.admit_load("big", 100)
+        freed = led.demote_model("big", None, 500, host_budget_bytes=100)
+        assert freed == ["big"]
+        assert led.state("big") is None
+        led.check_invariants()
+
+    def test_pre_pin_merges_into_admission(self):
+        led = weightres.WeightLedger(weightres.stats)
+        led.acquire_weights("a")  # pinned before the load finishes
+        led.admit_load("a", 10)
+        assert led.pinned("a")
+        assert led.lru_resident_alias() is None  # everything pinned
+        led.release_weights("a")
+        assert not led.pinned("a")
+        assert led.lru_resident_alias() == "a"
+        led.check_invariants()
+
+    def test_swap_fault_leaves_host_entry(self):
+        led = weightres.WeightLedger(weightres.stats)
+        led.admit_load("a", 10)
+        led.demote_model("a", "shards", 5)
+        led.note_swap_fault("a")
+        assert led.is_host("a")
+        assert led.peek_host("a").payload == "shards"
+        led.check_invariants()
+
+    def test_double_publish_races_conserve(self):
+        """Two racing loads (or promotions) of one alias both commit —
+        the engine's ``_models`` dict tolerates the overwrite, so the
+        ledger must too: the loser's admission retires the winner's
+        through the surgery instead of double-counting it."""
+        led = weightres.WeightLedger(weightres.stats)
+        led.acquire_weights("a")
+        led.admit_load("a", 10)
+        led.admit_load("a", 10)  # racing loader published second
+        assert led.is_resident("a")
+        assert led.pinned("a")  # the pin survives the re-publication
+        led.check_invariants()
+        led.release_weights("a")
+        led.demote_model("a", "shards", 5)
+        # Both promoters passed peek_host before either committed.
+        led.promote_model("a", 10)
+        led.promote_model("a", 10)
+        assert led.is_resident("a")
+        led.check_invariants()
+        assert led.promoted == 1  # one demotion, one promotion counted
+
+    def test_clear_frees_everything(self):
+        led = weightres.WeightLedger(weightres.stats)
+        led.admit_load("a", 10)
+        led.admit_load("b", 10)
+        led.demote_model("a", None, 5)
+        led.clear()
+        assert led.resident_models == 0
+        assert led.host_models == 0
+        led.check_invariants()
+
+    def test_fuzz_random_ops_conserve(self):
+        """200 random walk steps over the machine: invariants hold
+        after every transition."""
+        import random
+
+        rng = random.Random(15)
+        led = weightres.WeightLedger(weightres.stats)
+        aliases = [f"m{i}" for i in range(5)]
+        for _ in range(200):
+            alias = rng.choice(aliases)
+            state = led.state(alias)
+            op = rng.random()
+            if state is None:
+                led.admit_load(alias, rng.randrange(1, 100))
+            elif state == weightres.RESIDENT:
+                if op < 0.5:
+                    led.demote_model(
+                        alias, None, rng.randrange(1, 60),
+                        host_budget_bytes=120,
+                    )
+                elif op < 0.7:
+                    led.free_model(alias)
+                else:
+                    led.touch(alias)
+            else:  # host
+                if op < 0.5:
+                    led.promote_model(alias, rng.randrange(1, 100))
+                elif op < 0.7:
+                    led.free_model(alias)
+                else:
+                    led.note_swap_fault(alias)
+            led.check_invariants()
+
+    def test_weight_events_validate(self):
+        obs.reset_stats()
+        led = weightres.WeightLedger(weightres.stats)
+        led.admit_load("a", 10, 0.1)
+        led.demote_model("a", None, 5, 0.01)
+        led.promote_model("a", 10, 0.02)
+        led.note_swap_fault("a")
+        led.free_model("a")
+        events = [
+            e for e in obs.recorder.events() if e["type"] == "weight"
+        ]
+        assert [e["op"] for e in events] == [
+            "load", "demote", "promote", "swap_fault", "free",
+        ]
+        for e in events:
+            assert validate_event(e) == [], e
+        # Post-op residency counts ride every event.
+        assert events[1]["resident"] == 0 and events[1]["host"] == 1
+
+    def test_snapshot_derived_fields(self):
+        weightres.reset_stats()
+        weightres.stats.loads = 1
+        weightres.stats.load_s = 1.0
+        weightres.stats.promotions = 3
+        weightres.stats.promote_s = 0.5
+        weightres.stats.promotions_overlapped = 2
+        snap = weightres.snapshot()
+        assert snap["weight_load_wall_s"] == 1.5
+        assert snap["swap_overlap_fraction"] == round(2 / 3, 4)
+        assert snap["reload_avoided_rate"] == 0.75
+        assert snap["enabled"] is True  # config fields appended
+
+
+class TestConfig:
+    def test_env_defaults(self, monkeypatch):
+        monkeypatch.setenv("ADVSPEC_WEIGHT_RES", "0")
+        assert weightres.env_enabled() is False
+        monkeypatch.setenv("ADVSPEC_WEIGHT_HOST_MB", "123")
+        assert weightres.env_host_mb() == 123
+        monkeypatch.setenv("ADVSPEC_WEIGHT_HOST_MB", "garbage")
+        assert weightres.env_host_mb() == weightres.DEFAULT_HOST_MB
+
+    def test_paging_armed(self):
+        weightres.configure(enabled=True, host_mb=0)
+        assert not weightres.paging_armed()
+        weightres.configure(enabled=False, host_mb=100)
+        assert not weightres.paging_armed()
+        weightres.configure(enabled=True, host_mb=100)
+        assert weightres.paging_armed()
+
+    def test_mock_budget_only_under_explicit_env(self, monkeypatch):
+        assert weightres.mock_budget_bytes() is None
+        monkeypatch.setenv("ADVSPEC_HBM_BUDGET_BYTES", "1024")
+        assert weightres.mock_budget_bytes() == 1024
+        monkeypatch.setenv("ADVSPEC_HBM_BUDGET_BYTES", "nope")
+        assert weightres.mock_budget_bytes() is None
+
+
+# -- mock-engine parity -----------------------------------------------------
+
+
+class TestMockResidency:
+    def _round(self, eng, n_models=4, rnd=1):
+        from adversarial_spec_tpu.engine.mock import MockEngine  # noqa
+
+        reqs = [
+            _req(f"mock://critic?pool={m}", f"doc\nDebate round {rnd}")
+            for m in range(n_models)
+        ]
+        return [c.text for c in eng.chat(reqs, SamplingParams())]
+
+    def test_simulation_off_without_budget_env(self):
+        from adversarial_spec_tpu.engine.mock import MockEngine
+
+        eng = MockEngine()
+        self._round(eng)
+        assert eng.ledger is None
+        assert weightres.stats.loads == 0
+
+    def test_thrash_vs_resident_deterministic(self, monkeypatch):
+        from adversarial_spec_tpu.engine import mock as mock_mod
+        from adversarial_spec_tpu.engine.mock import MockEngine
+
+        monkeypatch.setenv(
+            "ADVSPEC_HBM_BUDGET_BYTES", str(2 * mock_mod._MODEL_BYTES)
+        )
+
+        def arm(paging):
+            weightres.configure(enabled=paging, host_mb=1024)
+            weightres.reset_stats()
+            eng = MockEngine()
+            texts = [self._round(eng, rnd=r) for r in (1, 2, 3, 4)]
+            eng.ledger.check_invariants()
+            return texts, weightres.snapshot()
+
+        on_texts, on_snap = arm(True)
+        off_texts, off_snap = arm(False)
+        # Residency is accounting only: transcripts byte-identical.
+        assert on_texts == off_texts
+        # Paging on: 4 cold loads ever, swaps promote from host.
+        assert on_snap["loads"] == 4
+        assert on_snap["promotions"] == 6  # rounds 2-4: 2 swaps each
+        assert on_snap["demotions"] == 8
+        assert on_snap["swap_overlap_fraction"] == 1.0
+        # Rounds 2 and 4 reorder ([2,3] resident); round 3's resident
+        # set ({0,1} after round 2's swaps) already matches submission
+        # order.
+        assert on_snap["coalesced_groups"] == 2
+        # Paging off: every swap re-loads, nothing promotes.
+        assert off_snap["loads"] == 10
+        assert off_snap["promotions"] == 0
+        assert off_snap["freed_models"] == 8
+        # The synthetic walls pin exactly (binary fractions) — and the
+        # >=2x acceptance arithmetic holds on them.
+        assert on_snap["weight_load_wall_s"] == 4 * 0.0625 + 6 * 0.0078125
+        assert off_snap["weight_load_wall_s"] == 10 * 0.0625
+        assert (
+            off_snap["weight_load_wall_s"] / on_snap["weight_load_wall_s"]
+            >= 2.0
+        )
+
+    def test_event_stream_byte_deterministic(self, monkeypatch):
+        from adversarial_spec_tpu.engine import mock as mock_mod
+        from adversarial_spec_tpu.engine.mock import MockEngine
+
+        monkeypatch.setenv(
+            "ADVSPEC_HBM_BUDGET_BYTES", str(2 * mock_mod._MODEL_BYTES)
+        )
+
+        def run():
+            weightres.configure(enabled=True, host_mb=1024)
+            weightres.reset_stats()
+            obs.reset_stats()
+            eng = MockEngine()
+            for r in (1, 2):
+                self._round(eng, rnd=r)
+            return obs.recorder.to_jsonl()
+
+        assert run() == run()
+        jsonl = run()
+        weight_lines = [
+            json.loads(ln)
+            for ln in jsonl.splitlines()
+            if '"weight"' in ln
+        ]
+        assert any(e["op"] == "promote" for e in weight_lines)
+        for e in weight_lines:
+            assert validate_event(e) == [], e
+
+
+# -- the real engine --------------------------------------------------------
+
+
+class TestEngineResidency:
+    def _load_bytes(self, eng, alias):
+        return eng.ledger._entries[alias].bytes_device
+
+    def test_demote_promote_byte_identical(self, monkeypatch):
+        from adversarial_spec_tpu.engine.tpu import TpuEngine
+
+        eng = TpuEngine()
+        base = eng.chat([_req("tpu://random-tiny")], PARAMS)[0]
+        one = self._load_bytes(eng, "random-tiny")
+        monkeypatch.setenv("ADVSPEC_HBM_BUDGET_BYTES", str(int(one * 1.5)))
+        eng.chat([_req("tpu://random-mistral-tiny")], PARAMS)
+        assert eng.ledger.is_host("random-tiny")
+        assert eng.ledger.is_resident("random-mistral-tiny")
+        assert "random-tiny" not in eng._models
+        again = eng.chat([_req("tpu://random-tiny")], PARAMS)[0]
+        assert again.text == base.text
+        assert eng.ledger.is_host("random-mistral-tiny")
+        eng.check_residency_invariants()
+        assert weightres.stats.promotions >= 1
+
+    def test_paging_off_frees_instead(self, monkeypatch):
+        from adversarial_spec_tpu.engine.tpu import TpuEngine
+
+        weightres.configure(enabled=False)
+        eng = TpuEngine()
+        eng.chat([_req("tpu://random-tiny")], PARAMS)
+        one = self._load_bytes(eng, "random-tiny")
+        monkeypatch.setenv("ADVSPEC_HBM_BUDGET_BYTES", str(int(one * 1.5)))
+        eng.chat([_req("tpu://random-mistral-tiny")], PARAMS)
+        assert eng.ledger.state("random-tiny") is None
+        assert weightres.stats.freed_models == 1
+        assert weightres.stats.demotions == 0
+        eng.check_residency_invariants()
+
+    def test_resident_first_group_order(self, monkeypatch):
+        """A round whose group order would force an avoidable swap is
+        reordered resident-first — and the reorder is counted."""
+        from adversarial_spec_tpu.engine.tpu import TpuEngine
+
+        eng = TpuEngine()
+        eng.chat([_req("tpu://random-tiny")], PARAMS)
+        one = self._load_bytes(eng, "random-tiny")
+        monkeypatch.setenv("ADVSPEC_HBM_BUDGET_BYTES", str(int(one * 1.5)))
+        eng.chat([_req("tpu://random-mistral-tiny")], PARAMS)
+        # mistral is resident, tiny is host; a [tiny, mistral] round
+        # must serve mistral first (no swap) and only then promote.
+        served = []
+        orig = TpuEngine._chat_one_model
+
+        def spy(self, alias, *a, **k):
+            served.append(alias)
+            return orig(self, alias, *a, **k)
+
+        monkeypatch.setattr(TpuEngine, "_chat_one_model", spy)
+        before = weightres.stats.coalesced_groups
+        comps = eng.chat(
+            [_req("tpu://random-tiny"), _req("tpu://random-mistral-tiny")],
+            PARAMS,
+        )
+        assert all(c.ok for c in comps)
+        assert served == ["random-mistral-tiny", "random-tiny"]
+        assert weightres.stats.coalesced_groups == before + 1
+
+    def test_int4_model_serves_and_pages_quantized(self, monkeypatch):
+        """Quantized resident checkpoints end to end: an int4-registered
+        model serves through the ContinuousBatcher path with packed
+        dict-leaf params, and its QUANTIZED shards are what demote to
+        host and promote back — byte-identical transcripts across the
+        round trip."""
+        from adversarial_spec_tpu.engine.tpu import TpuEngine
+        from adversarial_spec_tpu.ops.quant import is_quantized_int4
+
+        save_registry_entry(
+            ModelSpec(
+                alias="res-int4-tiny", family="llama", size="tiny",
+                dtype="float32", quant="int4", kv="paged", mesh={"dp": 1},
+            )
+        )
+        eng = TpuEngine()
+        base = eng.chat([_req("tpu://res-int4-tiny")], PARAMS)[0]
+        assert base.ok, base.error
+        lm = eng._models["res-int4-tiny"]
+        assert is_quantized_int4(lm.params["layers"]["wq"])
+        assert is_quantized_int4(lm.params["lm_head"])
+        one = self._load_bytes(eng, "res-int4-tiny")
+        monkeypatch.setenv("ADVSPEC_HBM_BUDGET_BYTES", str(int(one * 1.5)))
+        eng.chat([_req("tpu://random-tiny")], PARAMS)
+        assert eng.ledger.is_host("res-int4-tiny")
+        # The host tier holds the PACKED shards (demotion must not
+        # dequantize): the payload's matmul weights are still int4
+        # dict leaves, q4 half the contraction extent in int8.
+        import numpy as np
+
+        entry = eng.ledger.peek_host("res-int4-tiny")
+        host_wq = entry.payload.np_params["layers"]["wq"]
+        assert set(host_wq) == {"q4", "scale"}
+        assert host_wq["q4"].dtype == np.int8
+        again = eng.chat([_req("tpu://res-int4-tiny")], PARAMS)[0]
+        assert again.text == base.text
+        assert is_quantized_int4(
+            eng._models["res-int4-tiny"].params["layers"]["wq"]
+        )
+        eng.check_residency_invariants()
+
+    def test_no_leak_many_models_one_process(self, monkeypatch):
+        """Satellite: a long-lived process cycling MANY models keeps a
+        bounded resident set, a byte-bounded host tier, and drops every
+        demoted model's batcher state with its weights."""
+        import gc
+        import weakref
+
+        from adversarial_spec_tpu.engine.tpu import TpuEngine, hbm_budget_bytes
+
+        aliases = []
+        for i in range(6):
+            alias = f"leak-{i}"
+            save_registry_entry(
+                ModelSpec(
+                    alias=alias, family="llama", size="tiny",
+                    dtype="float32", kv="paged", mesh={"dp": 1},
+                )
+            )
+            aliases.append(alias)
+        eng = TpuEngine()
+        eng.chat([_req(f"tpu://{aliases[0]}")], PARAMS)
+        one = self._load_bytes(eng, aliases[0])
+        # Resident budget: 2 models; host budget: ~3 models' shards.
+        monkeypatch.setenv("ADVSPEC_HBM_BUDGET_BYTES", str(int(one * 2.5)))
+        host_mb = max(1, (3 * one) >> 20)
+        weightres.configure(enabled=True, host_mb=host_mb)
+        batcher_refs = []
+        for alias in aliases:
+            comps = eng.chat([_req(f"tpu://{alias}")], PARAMS)
+            assert comps[0].ok, comps[0].error
+            lm = eng._models.get(alias)
+            if lm is not None and lm.batcher is not None:
+                batcher_refs.append(weakref.ref(lm.batcher))
+        eng.check_residency_invariants()
+        # Resident set bounded by the byte budget.
+        resident = sum(
+            m.bytes_per_chip for m in eng._models.values()
+        )
+        assert resident <= hbm_budget_bytes()
+        assert eng.ledger.resident_models <= 2
+        # Host tier byte-bounded: overflow freed, not accumulated.
+        assert eng.ledger.host_bytes <= host_mb << 20
+        assert weightres.stats.freed_models > 0
+        # Demoted models' batchers (page pools = HBM) are collectable:
+        # only still-resident models may hold one.
+        gc.collect()
+        live = sum(1 for r in batcher_refs if r() is not None)
+        assert live <= len(eng._models), (
+            f"{live} batchers alive for {len(eng._models)} resident "
+            "models — demotion leaked batcher state"
+        )
+
+    def test_repromotion_zero_unexpected_recompiles(self, monkeypatch):
+        """The committed-sharding discipline applied to params: a
+        promoted model's arrays restore their original shardings, so
+        the SAME jit programs serve them — the retrace watch must see
+        zero unexpected recompiles across demote → promote → serve."""
+        from adversarial_spec_tpu.engine.tpu import TpuEngine
+
+        save_registry_entry(
+            ModelSpec(alias="cont-tiny", family="llama", size="tiny",
+                      kv="paged", dtype="float32", mesh={"dp": 1})
+        )
+        eng = TpuEngine()
+        base = eng.chat([_req("tpu://cont-tiny", "alpha beta")], PARAMS)
+        assert base[0].ok, base[0].error
+        one = self._load_bytes(eng, "cont-tiny")
+        # random-tiny is bf16 (half the f32 bytes): 1.2x leaves no room
+        # for even the half-size newcomer beside cont-tiny.
+        monkeypatch.setenv("ADVSPEC_HBM_BUDGET_BYTES", str(int(one * 1.2)))
+        eng.chat([_req("tpu://random-tiny")], PARAMS)
+        assert eng.ledger.is_host("cont-tiny")
+        # Everything is compiled now; a re-promotion must add nothing.
+        obs.retrace.clear()
+        again = eng.chat([_req("tpu://cont-tiny", "alpha beta")], PARAMS)
+        assert again[0].ok and again[0].text == base[0].text
+        snap = obs.retrace.snapshot()
+        assert snap["unexpected_recompiles"] == 0, snap
+
+    @pytest.mark.chaos
+    def test_swap_fault_evicts_only_waiting_admission(
+        self, monkeypatch, tmp_path
+    ):
+        """A fault mid-promotion degrades ONLY the group waiting on the
+        swap; the ledger stays conservation-clean with the victim still
+        host-resident, and the autodump reconstructs the failed swap."""
+        from adversarial_spec_tpu.engine.tpu import TpuEngine
+        from adversarial_spec_tpu.resilience import injector
+
+        events_out = tmp_path / "ev.jsonl"
+        obs.configure(enabled=True, events_out=str(events_out))
+        eng = TpuEngine()
+        eng.chat([_req("tpu://random-tiny")], PARAMS)
+        one = self._load_bytes(eng, "random-tiny")
+        monkeypatch.setenv("ADVSPEC_HBM_BUDGET_BYTES", str(int(one * 1.5)))
+        base = eng.chat(
+            [_req("tpu://random-tiny"), _req("tpu://random-mistral-tiny")],
+            PARAMS,
+        )
+        assert all(c.ok for c in base)
+        victim = next(
+            a for a in ("random-tiny", "random-mistral-tiny")
+            if eng.ledger.is_host(a)
+        )
+        injector.install(
+            injector.FaultInjector(
+                injector.parse_chaos_spec("oom@weight_swap:times=1")
+            )
+        )
+        try:
+            comps = eng.chat(
+                [
+                    _req("tpu://random-tiny"),
+                    _req("tpu://random-mistral-tiny"),
+                ],
+                PARAMS,
+            )
+        finally:
+            injector.install(None)
+        by_model = {
+            "random-tiny": comps[0],
+            "random-mistral-tiny": comps[1],
+        }
+        assert not by_model[victim].ok
+        assert by_model[victim].transient
+        other = next(a for a in by_model if a != victim)
+        assert by_model[other].ok, by_model[other].error
+        assert eng.ledger.is_host(victim)
+        eng.check_residency_invariants()
+        assert weightres.stats.swap_faults == 1
+        dump = tmp_path / "ev.fault.jsonl"
+        assert dump.exists()
+        lines = [
+            json.loads(ln)
+            for ln in dump.read_text().splitlines()
+            if ln
+        ]
+        for ln in lines:
+            assert validate_event(ln) == [], ln
+        faults = [e for e in lines if e["type"] == "fault"]
+        swap_faults = [
+            e
+            for e in lines
+            if e["type"] == "weight" and e["op"] == "swap_fault"
+        ]
+        assert faults and swap_faults
+        assert swap_faults[-1]["alias"] == victim
+        # The retry round heals: same shards promote byte-identically.
+        again = eng.chat(
+            [_req("tpu://random-tiny"), _req("tpu://random-mistral-tiny")],
+            PARAMS,
+        )
+        assert [c.text for c in again] == [c.text for c in base]
+        eng.check_residency_invariants()
+
+
+# -- serve-scheduler coalescing --------------------------------------------
+
+
+class TestServeCoalesce:
+    def _unit(self, model, engine, tenant="t", debate="d", index=0):
+        from adversarial_spec_tpu.serve.sched import Unit
+
+        return Unit(
+            tenant=tenant,
+            tier="interactive",
+            debate=debate,
+            index=index,
+            engine=engine,
+            request=_req(model),
+            params=SamplingParams(),
+        )
+
+    def test_same_model_pulled_ahead_of_swap(self):
+        from adversarial_spec_tpu import serve as serve_mod
+        from adversarial_spec_tpu.serve.sched import ServeScheduler
+
+        serve_mod.configure(max_dispatch_batch=4)
+        eng = object()
+        sched = ServeScheduler()
+        sched.submit_units(
+            [
+                self._unit("mock://m1", eng, index=0),
+                self._unit("mock://m2", eng, index=1),
+                self._unit("mock://m1", eng, index=2),
+            ]
+        )
+        before = weightres.stats.coalesced_units
+        batch = sched.next_batch(timeout=0.01)
+        # m1's two units coalesce into one dispatch; the m2 swap waits.
+        assert [u.request.model for u in batch] == [
+            "mock://m1",
+            "mock://m1",
+        ]
+        assert weightres.stats.coalesced_units == before + 1
+        nxt = sched.next_batch(timeout=0.01)
+        assert [u.request.model for u in nxt] == ["mock://m2"]
+
+    def test_steal_disabled_with_weightres_off(self):
+        from adversarial_spec_tpu import serve as serve_mod
+        from adversarial_spec_tpu.serve.sched import ServeScheduler
+
+        weightres.configure(enabled=False)
+        serve_mod.configure(max_dispatch_batch=4)
+        eng = object()
+        sched = ServeScheduler()
+        sched.submit_units(
+            [
+                self._unit("mock://m1", eng, index=0),
+                self._unit("mock://m2", eng, index=1),
+                self._unit("mock://m1", eng, index=2),
+            ]
+        )
+        batch = sched.next_batch(timeout=0.01)
+        assert [u.request.model for u in batch] == ["mock://m1"]
+
+
+# -- CLI plumbing -----------------------------------------------------------
+
+
+class TestCliWeights:
+    def _run(self, monkeypatch, capsys, extra=()):
+        import io
+        import sys as _sys
+
+        from adversarial_spec_tpu.cli import main as cli_main
+
+        monkeypatch.setattr(
+            _sys, "stdin", io.StringIO("## Spec\nA tiny spec.\n")
+        )
+        rc = cli_main(
+            [
+                "critique",
+                "--models",
+                "mock://agree",
+                "--json",
+                *extra,
+            ]
+        )
+        assert rc == 0
+        return json.loads(capsys.readouterr().out)
+
+    def test_perf_weights_block(self, monkeypatch, capsys):
+        out = self._run(monkeypatch, capsys)
+        weights = out["perf"]["weights"]
+        assert weights["enabled"] is True
+        assert weights["host_mb"] == weightres.DEFAULT_HOST_MB
+        for key in (
+            "loads",
+            "promotions",
+            "demotions",
+            "swap_overlap_fraction",
+            "weight_load_wall_s",
+            "coalesced_units",
+        ):
+            assert key in weights
+
+    def test_flags_and_no_leak(self, monkeypatch, capsys):
+        out = self._run(
+            monkeypatch,
+            capsys,
+            extra=["--no-weight-res", "--weight-host-mb", "64"],
+        )
+        assert out["perf"]["weights"]["enabled"] is False
+        assert out["perf"]["weights"]["host_mb"] == 64
+        # Next invocation re-resolves to env defaults: no leak.
+        out = self._run(monkeypatch, capsys)
+        assert out["perf"]["weights"]["enabled"] is True
+        assert (
+            out["perf"]["weights"]["host_mb"] == weightres.DEFAULT_HOST_MB
+        )
+
+    def test_env_defaults(self, monkeypatch, capsys):
+        monkeypatch.setenv("ADVSPEC_WEIGHT_RES", "0")
+        monkeypatch.setenv("ADVSPEC_WEIGHT_HOST_MB", "96")
+        out = self._run(monkeypatch, capsys)
+        assert out["perf"]["weights"]["enabled"] is False
+        assert out["perf"]["weights"]["host_mb"] == 96
+
+
+# -- tools: obs_dump + bench_trend -----------------------------------------
+
+
+class TestTools:
+    def test_obs_dump_renders_weight_rows(self):
+        from tools.obs_dump import occupancy_timeline, summarize
+
+        obs.reset_stats()
+        led = weightres.WeightLedger(weightres.stats)
+        obs.emit(obs.StepEvent(kind="decode", n_live=1))
+        led.admit_load("m1", 64 << 20, 0.5)
+        led.demote_model("m1", None, 32 << 20, 0.01)
+        led.note_swap_fault("m1")
+        events = obs.recorder.events()
+        timeline = occupancy_timeline(events)
+        assert "w:load" in timeline and "w:demote" in timeline
+        assert "w:swap_fault" in timeline
+        assert "res=" in timeline and "host=" in timeline
+        summary = summarize(events)
+        assert "weight residency:" in summary
+        assert "swap(s) aborted" in summary
+
+    def test_bench_trend_validates_residency_schema(self, tmp_path):
+        from tools.bench_trend import validate_bench_file
+
+        good = {
+            "metric": "residency_load_wall_ratio",
+            "value": 2.5,
+            "unit": "x",
+            "platform": "cpu",
+            "load_wall_resident_s": 0.1,
+            "load_wall_thrash_s": 0.25,
+            "swap_overlap_fraction": 1.0,
+            "transcripts_byte_identical": {"mock": True, "real": True},
+            "unexpected_recompiles": 0,
+        }
+        p = tmp_path / "BENCH_residency.json"
+        p.write_text(json.dumps(good))
+        row, problems = validate_bench_file(p)
+        assert problems == [] and row is not None
+        # Missing a pinned field = violation.
+        bad = dict(good)
+        del bad["swap_overlap_fraction"]
+        p.write_text(json.dumps(bad))
+        _, problems = validate_bench_file(p)
+        assert problems
+        # A false transcript arm = violation.
+        bad = dict(good)
+        bad["transcripts_byte_identical"] = {"mock": True, "real": False}
+        p.write_text(json.dumps(bad))
+        _, problems = validate_bench_file(p)
+        assert any("false arm" in x for x in problems)
+
+    def test_committed_bench_residency_valid(self):
+        from pathlib import Path
+
+        from tools.bench_trend import validate_bench_file
+
+        path = (
+            Path(__file__).resolve().parent.parent
+            / "BENCH_residency.json"
+        )
+        row, problems = validate_bench_file(path)
+        assert problems == []
+        assert row["mode"] == "residency"
+
+
+# -- graftlint registrations ------------------------------------------------
+
+
+class TestGraftlintWeightres:
+    def test_lifecycle_live_fire_pin(self):
+        """Stripping the ledger's release surgery fires GL-LIFECYCLE on
+        the real source — the fourth machine is live, not decorative."""
+        from pathlib import Path
+
+        from tools.graftlint.core import lint_sources
+
+        path = "adversarial_spec_tpu/engine/weightres.py"
+        src = (Path(__file__).resolve().parent.parent / path).read_text()
+        assert lint_sources({path: src}, rules=["GL-LIFECYCLE"]) == []
+        assert "self._retire_model(" in src
+        mutated = src.replace(
+            "self._retire_model(", "(lambda *a, **k: None)("
+        )
+        findings = lint_sources({path: mutated}, rules=["GL-LIFECYCLE"])
+        assert findings, (
+            "stripping _retire_model produced no GL-LIFECYCLE finding "
+            "— the weightres machine is unguarded"
+        )
+        assert "WeightLedger" in " ".join(f.message for f in findings)
+
+    def test_refcount_pair_live(self):
+        """An acquire_weights with no covering release fires
+        GL-REFCOUNT — the residency pin pair is enforced, and the real
+        tpu.py call site is clean."""
+        from pathlib import Path
+
+        from tools.graftlint.core import lint_sources
+
+        leaky = (
+            "def serve(ledger, alias, chat):\n"
+            "    ledger.acquire_weights(alias)\n"
+            "    result = chat(alias)  # can raise: pin leaks\n"
+            "    ledger.release_weights(alias)\n"
+            "    return result\n"
+        )
+        from tools.graftlint.config import GraftlintConfig
+
+        cfg = GraftlintConfig()
+        cfg.refcount_modules = ["pkg.leaky"]
+        findings = lint_sources(
+            {"pkg/leaky.py": leaky}, rules=["GL-REFCOUNT"], cfg=cfg
+        )
+        assert any("acquire_weights" in f.message for f in findings)
+        real = "adversarial_spec_tpu/engine/tpu.py"
+        src = (Path(__file__).resolve().parent.parent / real).read_text()
+        assert "acquire_weights(" in src
+        assert (
+            lint_sources({real: src}, rules=["GL-REFCOUNT"]) == []
+        )
+
+    def test_dequant_helpers_are_traced_roots(self):
+        """Satellite pin: the int4/int8 dequant helpers are reached by
+        GL-TRACE's jit-root closure (they trace into the forwards, so
+        an impure call added to them would be caught)."""
+        from pathlib import Path
+
+        from tools.graftlint.config import load_config
+        from tools.graftlint.core import (
+            DEFAULT_ROOTS,
+            Context,
+            build_index,
+            collect_files,
+        )
+        from tools.graftlint.rules.trace import traced_functions
+
+        repo = Path(__file__).resolve().parent.parent
+        cfg = load_config(repo)
+        files = collect_files([repo / r for r in DEFAULT_ROOTS])
+        index = build_index(
+            files, repo, set(cfg.sig_preserving_decorators)
+        )
+        ctx = Context(repo, cfg, index)
+        quant_roots = {
+            fn
+            for (mod, fn) in traced_functions(ctx)
+            if mod.endswith("ops.quant")
+        }
+        assert "matmul" in quant_roots
+        assert "unpack_int4" in quant_roots

@@ -58,6 +58,19 @@ _SERVE_REQUIRED: dict[str, tuple[type, ...]] = {
     "brownout_transitions": (int,),
     "capacity": (dict,),
 }
+# BENCH_residency.json additionally pins the weight-paging trajectory:
+# total weight-load seconds resident-vs-thrash (the >=2x headline), the
+# swap-overlap fraction (promotions that rode another model's decode),
+# byte-identical transcripts across arms, and zero unexpected
+# recompiles on re-promotion — a residency bench silently dropping one
+# of these would hide a paging regression behind a valid headline.
+_RESIDENCY_REQUIRED: dict[str, tuple[type, ...]] = {
+    "load_wall_resident_s": (int, float),
+    "load_wall_thrash_s": (int, float),
+    "swap_overlap_fraction": (int, float),
+    "transcripts_byte_identical": (dict,),
+    "unexpected_recompiles": (int,),
+}
 
 
 def _check_fields(
@@ -96,6 +109,16 @@ def validate_bench_file(path: Path) -> tuple[dict | None, list[str]]:
             problems.extend(
                 _check_fields(payload, _SERVE_REQUIRED, path.name)
             )
+        if mode == "residency":
+            problems.extend(
+                _check_fields(payload, _RESIDENCY_REQUIRED, path.name)
+            )
+            ident = payload.get("transcripts_byte_identical")
+            if isinstance(ident, dict) and not all(ident.values()):
+                problems.append(
+                    f"{path.name}: transcripts_byte_identical has a "
+                    f"false arm: {ident}"
+                )
         if problems:
             return None, problems
         row = {

@@ -36,6 +36,18 @@ collapse — typed retry-after refusals, zero accepted-request loss,
 interactive p99 TTFT within the drill SLO while the batch tier pauses
 first (brownout), allocator/tier invariants clean.
 
+``--weight-swap`` is the WEIGHT-RESIDENCY fault drill
+(docs/weight_residency.md): two tiny real models share a 1-model HBM
+budget so every round swaps, and an injected fault fires exactly at
+the ``weight_swap`` seam (mid-promotion of host-demoted shards). The
+drill asserts the aborted swap evicts ONLY the waiting admission (the
+co-scheduled group's completions are untouched), the residency ledger
+stays conservation-clean (the faulted model is still host-resident —
+never lost, never double-counted), the flight-recorder JSONL autodump
+reconstructs the failed swap (a ``swap_fault`` WeightEvent + the
+classified FaultEvent), and the NEXT round's retry promotes the same
+shards to a byte-identical transcript.
+
 ``--drain`` is the SIGTERM graceful-drain drill: a real subprocess
 daemon is SIGTERMed mid-burst and must resolve every accepted debate
 (finished or typed-drained), exit 0 with a clean drain report, and
@@ -49,6 +61,7 @@ Usage:
     python tools/chaos_run.py --replica-kill # fleet replica-loss drill
     python tools/chaos_run.py --overload     # serve storm drill
     python tools/chaos_run.py --drain        # serve SIGTERM drain drill
+    python tools/chaos_run.py --weight-swap  # weight-swap fault drill
     python tools/chaos_run.py -- -x -k breaker   # extra pytest args
 """
 
@@ -916,6 +929,179 @@ def replica_kill_drill(verbose: bool = True) -> int:
     return 0
 
 
+def run_weight_swap(verbose: bool = True) -> tuple[list[str], dict]:
+    """The weight-swap fault drill (see module docstring): a fault
+    mid-promotion must cost one degraded admission and one retry —
+    never a lost model, a corrupted ledger, or a silent swap."""
+    import jax  # noqa: F401 — force CPU backend init before the engine
+
+    from adversarial_spec_tpu import obs
+    from adversarial_spec_tpu.engine import spec as spec_mod
+    from adversarial_spec_tpu.engine import weightres
+    from adversarial_spec_tpu.engine.tpu import TpuEngine
+    from adversarial_spec_tpu.engine.types import ChatRequest, SamplingParams
+    from adversarial_spec_tpu.obs.events import validate_event
+    from adversarial_spec_tpu.resilience import injector
+
+    def say(msg: str) -> None:
+        if verbose:
+            print(f"chaos_run --weight-swap: {msg}", flush=True)
+
+    failures: list[str] = []
+    payload: dict = {}
+    tmp = tempfile.mkdtemp(prefix="chaos_weight_swap_")
+    events_out = os.path.join(tmp, "events.jsonl")
+    obs.configure(enabled=True, events_out=events_out, dump_on_fault=True)
+    obs.reset_stats()
+    weightres.configure(enabled=True, host_mb=4096)
+    weightres.reset_stats()
+    spec_mod.configure(enabled=False)
+    sampling = SamplingParams(max_new_tokens=12, greedy=True, seed=0)
+
+    def round_reqs():
+        return [
+            ChatRequest(
+                model=f"tpu://{a}",
+                system="You are an adversarial spec critic.",
+                user="Critique the document.\nDebate round 1",
+            )
+            for a in ("random-tiny", "random-mistral-tiny")
+        ]
+
+    eng = TpuEngine()
+    say("round 0: sizing the budget off the first model alone")
+    probe = eng.chat(round_reqs()[:1], sampling)
+    if not all(c.ok for c in probe):
+        return [f"sizing round failed: {[c.error for c in probe]}"], payload
+    one = max(
+        e.bytes_device for e in eng.ledger._entries.values()
+    )
+    # Fits ONE model: loading the second must demote the first, and
+    # every later round swaps through the host tier.
+    os.environ["ADVSPEC_HBM_BUDGET_BYTES"] = str(int(one * 1.5))
+    try:
+        say("round 1 (1-model budget): forcing the demotion")
+        base = eng.chat(round_reqs(), sampling)
+        if not all(c.ok for c in base):
+            failures.append(f"round 1 failed: {[c.error for c in base]}")
+        demoted = [
+            a for a in ("random-tiny", "random-mistral-tiny")
+            if eng.ledger.is_host(a)
+        ]
+        if not demoted:
+            failures.append("no model demoted under the 1-model budget")
+        victim = demoted[0] if demoted else "random-tiny"
+        say(f"round 2: injected fault at the {victim} promotion")
+        injector.install(
+            injector.FaultInjector(
+                injector.parse_chaos_spec("device_lost@weight_swap:times=1")
+            )
+        )
+        r2 = eng.chat(round_reqs(), sampling)
+        injector.install(None)
+        by_model = {
+            req.model.split("//")[1]: comp
+            for req, comp in zip(round_reqs(), r2)
+        }
+        hurt = by_model[victim]
+        other = next(
+            c for a, c in by_model.items() if a != victim
+        )
+        if hurt.ok:
+            failures.append(
+                "faulted promotion's admission did not degrade"
+            )
+        elif not hurt.transient:
+            failures.append(
+                f"injected swap fault classified non-transient: "
+                f"{hurt.error}"
+            )
+        if not other.ok:
+            failures.append(
+                "co-scheduled group was evicted by someone else's "
+                f"swap fault: {other.error}"
+            )
+        if not eng.ledger.is_host(victim):
+            failures.append(
+                f"aborted swap lost the host entry for {victim} "
+                f"(state={eng.ledger.state(victim)!r})"
+            )
+        try:
+            eng.check_residency_invariants()
+        except RuntimeError as e:
+            failures.append(f"residency ledger invariant violated: {e}")
+        if weightres.stats.swap_faults != 1:
+            failures.append(
+                f"expected 1 swap fault, saw {weightres.stats.swap_faults}"
+            )
+        # The autodump must reconstruct the failed swap.
+        dump = os.path.join(tmp, "events.fault.jsonl")
+        if not os.path.exists(dump):
+            failures.append("fault autodump was not written")
+        else:
+            lines = [
+                json.loads(ln)
+                for ln in Path(dump).read_text().splitlines()
+                if ln
+            ]
+            bad = [p for ln in lines for p in validate_event(ln)]
+            if bad:
+                failures.append(f"autodump schema violations: {bad[:3]}")
+            sf = [
+                e for e in lines
+                if e["type"] == "weight" and e["op"] == "swap_fault"
+            ]
+            if not sf:
+                failures.append("autodump lacks the swap_fault event")
+            elif sf[-1]["alias"] != victim:
+                failures.append(
+                    f"swap_fault names {sf[-1]['alias']!r}, not the "
+                    f"victim {victim!r}"
+                )
+            if not any(e["type"] == "fault" for e in lines):
+                failures.append("autodump lacks the classified fault")
+            payload["autodump_events"] = len(lines)
+        say("round 3: the retry must promote the same shards")
+        r3 = eng.chat(round_reqs(), sampling)
+        if not all(c.ok for c in r3):
+            failures.append(f"retry round failed: {[c.error for c in r3]}")
+        if [c.text for c in r3] != [c.text for c in base]:
+            failures.append(
+                "retry transcripts are not byte-identical to the "
+                "pre-fault round"
+            )
+        try:
+            eng.check_residency_invariants()
+        except RuntimeError as e:
+            failures.append(f"post-retry ledger invariant violated: {e}")
+        payload.update(
+            victim=victim,
+            swap_faults=weightres.stats.swap_faults,
+            promotions=weightres.stats.promotions,
+            transcripts_byte_identical=(
+                [c.text for c in r3] == [c.text for c in base]
+            ),
+        )
+    finally:
+        os.environ.pop("ADVSPEC_HBM_BUDGET_BYTES", None)
+        injector.install(None)
+    return failures, payload
+
+
+def weight_swap_drill(verbose: bool = True) -> int:
+    failures, _ = run_weight_swap(verbose)
+    if failures:
+        print("\n".join(f"FAIL: {f}" for f in failures), file=sys.stderr)
+        return 1
+    if verbose:
+        print(
+            "chaos_run --weight-swap: aborted-swap containment + "
+            "ledger conservation + autodump reconstruction hold",
+            flush=True,
+        )
+    return 0
+
+
 def _pytest(extra: list[str], env_overrides: dict[str, str]) -> int:
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
@@ -972,6 +1158,15 @@ def main(argv: list[str] | None = None) -> int:
         "clean allocator/tier invariants",
     )
     ap.add_argument(
+        "--weight-swap",
+        action="store_true",
+        help="weight-residency fault drill: inject a fault mid-promotion "
+        "of host-demoted model shards; assert only the waiting admission "
+        "degrades, the residency ledger stays conservation-clean, the "
+        "JSONL autodump reconstructs the failed swap, and the retry "
+        "promotes byte-identically",
+    )
+    ap.add_argument(
         "--drain",
         action="store_true",
         help="serve SIGTERM drain drill: a real subprocess daemon is "
@@ -992,6 +1187,8 @@ def main(argv: list[str] | None = None) -> int:
         return overload_drill()
     if args.drain:
         return drain_drill()
+    if args.weight_swap:
+        return weight_swap_drill()
 
     rc = _pytest(extra, {})
     if rc != 0:

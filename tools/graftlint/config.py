@@ -161,12 +161,17 @@ class GraftlintConfig:
     # would leave the allocator convinced a swap is forever in flight
     # (and _release refusing to free the page) — the demote/promote
     # release-path discipline, statically enforced.
+    # acquire_weights pins a model's weights against demotion for the
+    # duration of its serve (engine/weightres.py): a raise between pin
+    # and unpin would leave the model unevictable forever — the weight
+    # residency release-path discipline, statically enforced.
     refcount_pairs: list[str] = field(
         default_factory=lambda: [
             "new_sequence=free_sequence",
             "adopt=free_sequence",
             "cache_ref=cache_unref",
             "swap_pin=swap_unpin",
+            "acquire_weights=release_weights",
         ]
     )
 
@@ -310,6 +315,28 @@ class GraftlintConfig:
     serve_lifecycle_mutators: list[str] = field(
         default_factory=lambda: ["_start_unit"]
     )
+    # The weight-residency ledger's model state machine
+    # (engine/weightres.py), the fourth GL-LIFECYCLE machine: every
+    # path that takes a model out of its residency state (demotion,
+    # promotion's host-side consume, free, teardown) must reach the one
+    # retirement surgery, and the entries ledger is written only by the
+    # surgery and the _admit_model acquisition. "" disables (fixtures).
+    weightres_lifecycle_class: str = "WeightLedger"
+    weightres_lifecycle_release: str = "_retire_model"
+    weightres_lifecycle_exits: list[str] = field(
+        default_factory=lambda: [
+            "demote_model",
+            "promote_model",
+            "free_model",
+            "clear",
+        ]
+    )
+    weightres_lifecycle_owned_attrs: list[str] = field(
+        default_factory=lambda: ["_entries"]
+    )
+    weightres_lifecycle_mutators: list[str] = field(
+        default_factory=lambda: ["_admit_model"]
+    )
 
     def named_lifecycle_machines(
         self,
@@ -349,6 +376,16 @@ class GraftlintConfig:
                     self.serve_lifecycle_exits,
                     self.serve_lifecycle_owned_attrs,
                     self.serve_lifecycle_mutators,
+                ),
+            ),
+            (
+                "weightres_lifecycle",
+                (
+                    self.weightres_lifecycle_class,
+                    self.weightres_lifecycle_release,
+                    self.weightres_lifecycle_exits,
+                    self.weightres_lifecycle_owned_attrs,
+                    self.weightres_lifecycle_mutators,
                 ),
             ),
         ]

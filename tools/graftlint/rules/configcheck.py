@@ -80,6 +80,7 @@ class ConfigRule(Rule):
         "lifecycle_mutators": [],
         "fleet_lifecycle_class": "",  # fixture has no fleet machine
         "serve_lifecycle_class": "",  # fixture has no serve machine
+        "weightres_lifecycle_class": "",  # nor a weight-ledger machine
     }
 
     def check(self, ctx: Context) -> None:

@@ -91,6 +91,20 @@ def summarize(events: list[dict]) -> str:
             f"  WARNING: {len(quarantined)} corrupt KV store entr"
             f"{'y' if len(quarantined) == 1 else 'ies'} quarantined"
         )
+    weights = [e for e in events if e["type"] == "weight"]
+    if weights:
+        ops: dict[str, int] = {}
+        for w in weights:
+            ops[w["op"]] = ops.get(w["op"], 0) + 1
+        lines.append(
+            "  weight residency: "
+            + ", ".join(f"{op}={n}" for op, n in sorted(ops.items()))
+        )
+        if ops.get("swap_fault"):
+            lines.append(
+                f"  WARNING: {ops['swap_fault']} weight swap(s) aborted "
+                "mid-promotion (host entries intact; admission retried)"
+            )
     compiles = [e for e in events if e["type"] == "compile"]
     unexpected = [c for c in compiles if c["unexpected"]]
     if unexpected:
@@ -173,7 +187,16 @@ def occupancy_timeline(events: list[dict], width: int = 16) -> str:
         e
         for e in events
         if e["type"]
-        in ("step", "swap", "span", "cancel", "route", "replica", "serve")
+        in (
+            "step",
+            "swap",
+            "weight",
+            "span",
+            "cancel",
+            "route",
+            "replica",
+            "serve",
+        )
     ]
     if not any(e["type"] == "step" for e in steps):
         return "(no step events)"
@@ -280,6 +303,24 @@ def occupancy_timeline(events: list[dict], width: int = 16) -> str:
                 )
             )
             continue
+        if s["type"] == "weight":
+            # Weight-residency transitions inline: WHICH model swapped,
+            # what it cost, and the post-op resident/host pool split —
+            # residency thrash reads as a run of w:load rows that
+            # should have been w:promote.
+            notes = [s["alias"] or "?"]
+            if s["nbytes"]:
+                notes.append(f"{s['nbytes'] >> 20}MiB")
+            if s["wall_s"]:
+                notes.append(f"{s['wall_s']:.4f}s")
+            notes.append(f"res={s['resident']}")
+            notes.append(f"host={s['host']}")
+            glyph = "!" if s["op"] == "swap_fault" else "w"
+            rows.append(
+                f"seq {s['seq']:>6} [{glyph * width}] "
+                f"{'w:' + s['op']:<13} " + " ".join(notes)
+            )
+            continue
         if s["type"] == "swap":
             host_res, disk_res = s["host_resident"], s["disk_resident"]
             notes = [f"{s['blocks']} block(s)", f"{s['tokens']}tok"]
@@ -321,6 +362,11 @@ def occupancy_timeline(events: list[dict], width: int = 16) -> str:
         f"occupancy timeline ({n_steps} step(s), max live {max_live}; "
         "#=fused ==decode .=prefill"
         + ("; ~=tier swap, host/disk=resident blocks" if tiered else "")
+        + (
+            "; w=weight swap, res/host=resident models"
+            if any(e["type"] == "weight" for e in steps)
+            else ""
+        )
         + ("; >=span begin <=span end" if spanned else "")
         + ("; x=early cancel" if cancelled else "")
         + ("; rep=last routed replica, !=replica lifecycle" if fleet else "")

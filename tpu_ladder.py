@@ -551,6 +551,14 @@ TIER_STEPS = ("tier_restart",) + tuple(
     f"tier_pool{p}" for p in TIER_POOL_TOKENS
 )
 
+# Phase D (weight residency, engine/weightres.py): opponent-pool size
+# vs HBM budget — (pool models, budget models). (2,2) is the no-swap
+# control; (4,2) the paper's 4-opponent pool under half residency (the
+# BENCH_residency acceptance point); (4,3) the one-spare-slot shape
+# where the prefetch thread can overlap every promotion.
+RES_SWEEP = ((2, 2), (4, 2), (4, 3))
+RES_STEPS = tuple(f"res_pool{p}b{b}" for p, b in RES_SWEEP)
+
 
 def _child_tier(out_path: str) -> int:
     """Phase C: tiered-KV measurements through the real batcher, one
@@ -691,6 +699,124 @@ def _child_tier(out_path: str) -> int:
     return 0
 
 
+def _child_residency(out_path: str) -> int:
+    """Phase D: weight-residency sweep (pool size vs HBM budget) — one
+    warm child, a fresh TpuEngine per sweep point (residency is the
+    engine-lifetime state under test). Smoke mode drives the four tiny
+    families on CPU; hardware runs register four synthetic 1b pool
+    members so the swapped bytes are production-shaped."""
+    from adversarial_spec_tpu.utils.jaxenv import configure_jax
+
+    configure_jax()
+    import jax
+
+    from adversarial_spec_tpu.engine import spec as spec_mod
+    from adversarial_spec_tpu.engine import weightres
+    from adversarial_spec_tpu.engine.tpu import TpuEngine
+    from adversarial_spec_tpu.engine.types import ChatRequest, SamplingParams
+
+    smoke = _smoke()
+    if jax.devices()[0].platform == "cpu" and not smoke:
+        _append(out_path, {"step": "res_abort_cpu"})
+        return 1
+    if smoke:
+        pool = [
+            "random-tiny",
+            "random-gemma-tiny",
+            "random-mistral-tiny",
+            "random-qwen-tiny",
+        ]
+        n_decode = SMOKE_DECODE
+    else:
+        from adversarial_spec_tpu.engine.registry import (
+            ModelSpec,
+            save_registry_entry,
+        )
+
+        pool = [f"res-1b-{i}" for i in range(4)]
+        for alias in pool:
+            save_registry_entry(
+                ModelSpec(alias=alias, family="llama", size="1b")
+            )
+        n_decode = 32
+    done = _done_steps(out_path)
+    spec_mod.configure(enabled=False)  # isolate the residency effect
+    sampling = SamplingParams(max_new_tokens=n_decode, greedy=True, seed=0)
+
+    def arm(aliases, budget: int | None, paging: bool, n_rounds=4):
+        if budget is None:
+            os.environ.pop("ADVSPEC_HBM_BUDGET_BYTES", None)
+        else:
+            os.environ["ADVSPEC_HBM_BUDGET_BYTES"] = str(budget)
+        weightres.configure(enabled=paging, host_mb=8192)
+        weightres.reset_stats()
+        eng = TpuEngine()
+        t0 = time.monotonic()
+        for rnd in range(1, n_rounds + 1):
+            reqs = [
+                ChatRequest(
+                    model=f"tpu://{a}",
+                    system="You are an adversarial spec critic.",
+                    user=f"Critique the document.\nDebate round {rnd}",
+                )
+                for a in aliases
+            ]
+            outs = eng.chat(reqs, sampling)
+            if not all(c.ok for c in outs):
+                raise RuntimeError(
+                    f"residency arm failed: {[c.error for c in outs]}"
+                )
+            eng.check_residency_invariants()
+        sizes = {
+            a: e.bytes_device or e.bytes_host
+            for a, e in eng.ledger._entries.items()
+        }
+        return time.monotonic() - t0, weightres.snapshot(), sizes
+
+    # Unconstrained probe once: per-model bytes for the budget math.
+    _, _, sizes = arm(pool, None, True, n_rounds=1)
+    by_size = sorted(sizes.values(), reverse=True)
+    try:
+        for p, b in RES_SWEEP:
+            step = f"res_pool{p}b{b}"
+            if step in done:
+                continue
+            budget = int(sum(by_size[:b]) * 1.05)
+            wall_on, snap_on, _ = arm(pool[:p], budget, True)
+            wall_off, snap_off, _ = arm(pool[:p], budget, False)
+            _append(
+                out_path,
+                {
+                    "step": step,
+                    "pool_models": p,
+                    "budget_models": b,
+                    "budget_bytes": budget,
+                    "load_wall_resident_s": round(
+                        snap_on["weight_load_wall_s"], 4
+                    ),
+                    "load_wall_thrash_s": round(
+                        snap_off["weight_load_wall_s"], 4
+                    ),
+                    "load_wall_ratio": round(
+                        snap_off["weight_load_wall_s"]
+                        / max(snap_on["weight_load_wall_s"], 1e-9),
+                        3,
+                    ),
+                    "swap_overlap_fraction": snap_on[
+                        "swap_overlap_fraction"
+                    ],
+                    "promotions": snap_on["promotions"],
+                    "demotions": snap_on["demotions"],
+                    "thrash_loads": snap_off["loads"],
+                    "wall_on_s": round(wall_on, 3),
+                    "wall_off_s": round(wall_off, 3),
+                },
+            )
+    finally:
+        os.environ.pop("ADVSPEC_HBM_BUDGET_BYTES", None)
+    return 0
+
+
 def _clean_env(knobs: dict[str, str] | None = None) -> dict[str, str]:
     """Child env for a measurement: ambient ADVSPEC_* tuning knobs are
     stripped so the harvest records CANONICAL defaults (an operator's
@@ -800,10 +926,35 @@ def orchestrate(out_path: str) -> int:
             print("ladder: tier phase stalled; abandoning", file=sys.stderr)
             return 2
 
+    # Phase D (weight residency): pool-size vs HBM-budget sweep, one
+    # warm child (fresh engines inside — residency is per-engine).
+    if any(s not in _done_steps(out_path) for s in RES_STEPS):
+        if not _probe_tpu(timeout_s=60.0):
+            print(
+                "ladder: tunnel gone before residency phase",
+                file=sys.stderr,
+            )
+            return 2
+        child = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--child-residency", out_path],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True, env=_clean_env(), cwd=REPO,
+        )
+        if not _wait_progress(out_path, child, stall_s=900.0):
+            print(
+                "ladder: residency phase stalled; abandoning",
+                file=sys.stderr,
+            )
+            return 2
+
     done = _done_steps(out_path)
     missing = [
         s
-        for s in list(ENV_STEPS) + list(BATCHER_SPEC_STEPS) + list(TIER_STEPS)
+        for s in list(ENV_STEPS)
+        + list(BATCHER_SPEC_STEPS)
+        + list(TIER_STEPS)
+        + list(RES_STEPS)
         if s not in done
     ]
     if missing:
@@ -828,6 +979,8 @@ def main() -> int:
         return _child_batcher_spec(args[i + 1], args[i + 2])
     if "--child-tier" in args:
         return _child_tier(args[args.index("--child-tier") + 1])
+    if "--child-residency" in args:
+        return _child_residency(args[args.index("--child-residency") + 1])
     out = "tpu_results/ladder.jsonl"
     if "--out" in args:
         out = args[args.index("--out") + 1]
