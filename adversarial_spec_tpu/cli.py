@@ -423,6 +423,42 @@ def create_parser() -> argparse.ArgumentParser:
         "one subprocess per replica (worker — the SIGKILL-able "
         "topology tools/chaos_run.py --replica-kill drills)",
     )
+    z.add_argument(
+        "--fleet-autoscale",
+        action=argparse.BooleanOptionalAction,
+        default=None,  # None = inherit ADVSPEC_FLEET_AUTOSCALE (off)
+        help="Elastic fleet: a backlog-driven control loop grows and "
+        "shrinks membership between --fleet-min and --fleet-max — "
+        "warm-before-ring scale-out, lose-nothing drain on scale-in "
+        "(docs/fleet.md; ADVSPEC_FLEET_AUTOSCALE=1 sets the default)",
+    )
+    z.add_argument(
+        "--fleet-min",
+        type=int,
+        default=None,  # None = inherit ADVSPEC_FLEET_MIN (default 1)
+        help="Autoscaler replica floor (ADVSPEC_FLEET_MIN)",
+    )
+    z.add_argument(
+        "--fleet-max",
+        type=int,
+        default=None,  # None = inherit ADVSPEC_FLEET_MAX (default 4)
+        help="Autoscaler replica ceiling (ADVSPEC_FLEET_MAX)",
+    )
+    z.add_argument(
+        "--scale-cooldown-s",
+        type=float,
+        default=None,  # None = inherit ADVSPEC_FLEET_SCALE_COOLDOWN_S
+        help="Minimum seconds between membership changes — the flap "
+        "damper, and the scale-in drain budget "
+        "(ADVSPEC_FLEET_SCALE_COOLDOWN_S, default 5.0)",
+    )
+    z.add_argument(
+        "--scale-interval-s",
+        type=float,
+        default=None,  # None = inherit ADVSPEC_FLEET_SCALE_INTERVAL_S
+        help="Autoscaler decision-tick period "
+        "(ADVSPEC_FLEET_SCALE_INTERVAL_S, default 0.25)",
+    )
 
     v = parser.add_argument_group("serve")
     v.add_argument(
@@ -760,6 +796,31 @@ def _configure_fleet(args: argparse.Namespace):
             args.fleet_transport
             if args.fleet_transport is not None
             else fleet.env_transport()
+        ),
+        autoscale=(
+            args.fleet_autoscale
+            if getattr(args, "fleet_autoscale", None) is not None
+            else fleet.env_autoscale()
+        ),
+        min_replicas=(
+            args.fleet_min
+            if getattr(args, "fleet_min", None) is not None
+            else fleet.env_min_replicas()
+        ),
+        max_replicas=(
+            args.fleet_max
+            if getattr(args, "fleet_max", None) is not None
+            else fleet.env_max_replicas()
+        ),
+        scale_cooldown_s=(
+            args.scale_cooldown_s
+            if getattr(args, "scale_cooldown_s", None) is not None
+            else fleet.env_scale_cooldown_s()
+        ),
+        scale_interval_s=(
+            args.scale_interval_s
+            if getattr(args, "scale_interval_s", None) is not None
+            else fleet.env_scale_interval_s()
         ),
     )
     fleet.reset_stats()

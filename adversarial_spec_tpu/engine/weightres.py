@@ -112,6 +112,7 @@ class WeightStats(procconfig.StatsBase):
     swap_faults: int = 0  # promotions aborted by a fault mid-swap
     coalesced_groups: int = 0  # chat rounds reordered resident-first
     coalesced_units: int = 0  # serve units pulled ahead to dodge a swap
+    preload_hints: int = 0  # warm-replica residency hints (autoscale)
 
     def snapshot(self) -> dict:
         out = self.as_dict()
@@ -160,6 +161,23 @@ def snapshot() -> dict:
 def paging_armed() -> bool:
     """True when evictions demote to host RAM instead of freeing."""
     return _config.enabled and _config.host_mb > 0
+
+
+def preload_hint(models) -> int:
+    """Residency preload hint for a replica being WARMED before ring
+    admission (fleet/autoscale.py): the hottest models from the serve
+    scheduler's model mix, in hotness order. Deliberately advisory —
+    the ledger's one admission surgery still runs on first serve, so
+    conservation invariants are untouched; the hint's value is that
+    the warming replica builds its engines (and, on the TPU engine, its
+    checkpoints materialize) while the replica is NOT routable, moving
+    the cold-load wall off the first routed request. Counted so the
+    elasticity drills can assert warming actually happened. Returns
+    the hint count recorded."""
+    n = len(list(models))
+    if n:
+        stats.preload_hints += n
+    return n
 
 
 def mock_budget_bytes() -> int | None:

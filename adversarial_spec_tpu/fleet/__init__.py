@@ -43,6 +43,25 @@ from adversarial_spec_tpu.engine import procconfig
 DEFAULT_REPLICAS = 2
 TRANSPORTS = ("inproc", "worker")
 
+# Elasticity defaults (fleet/autoscale.py). The fractions are of the
+# PER-REPLICA backlog capacity (serve's max_backlog_tokens): scale-out
+# arms at 0.6 — deliberately BELOW the serve brownout-enter fraction
+# (0.75) so capacity is already being added when brownout would start
+# shedding batch admissions; scale-in arms only when backlog would fit
+# comfortably in one fewer replica. Streaks + cooldown are the
+# hysteresis pair: a decision needs N consecutive ticks AND a quiet
+# period since the last membership change, so an oscillating load
+# trace cannot flap the ring.
+DEFAULT_MIN_REPLICAS = 1
+DEFAULT_MAX_REPLICAS = 4
+DEFAULT_SCALE_OUT_FRACTION = 0.6
+DEFAULT_SCALE_IN_FRACTION = 0.15
+DEFAULT_SCALE_OUT_TICKS = 2
+DEFAULT_SCALE_IN_TICKS = 5
+DEFAULT_SCALE_COOLDOWN_S = 5.0
+DEFAULT_SCALE_INTERVAL_S = 0.25
+DEFAULT_SPAWN_RETRIES = 3
+
 
 def env_enabled() -> bool:
     """The process default for the master switch (``ADVSPEC_FLEET``).
@@ -65,6 +84,51 @@ def env_transport() -> str:
     return t if t in TRANSPORTS else "inproc"
 
 
+def env_autoscale() -> bool:
+    """The process default for elasticity (``ADVSPEC_FLEET_AUTOSCALE``).
+    Default OFF: membership stays fixed until the operator opts in."""
+    return os.environ.get("ADVSPEC_FLEET_AUTOSCALE", "0") == "1"
+
+
+def _env_int(name: str, default: int, floor: int = 0) -> int:
+    try:
+        return max(floor, int(os.environ.get(name, default)))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float, floor: float = 0.0) -> float:
+    try:
+        return max(floor, float(os.environ.get(name, default)))
+    except ValueError:
+        return default
+
+
+def env_min_replicas() -> int:
+    """The elastic floor (``ADVSPEC_FLEET_MIN``)."""
+    return _env_int("ADVSPEC_FLEET_MIN", DEFAULT_MIN_REPLICAS, floor=1)
+
+
+def env_max_replicas() -> int:
+    """The elastic ceiling (``ADVSPEC_FLEET_MAX``)."""
+    return _env_int("ADVSPEC_FLEET_MAX", DEFAULT_MAX_REPLICAS, floor=1)
+
+
+def env_scale_cooldown_s() -> float:
+    """Post-membership-change quiet period
+    (``ADVSPEC_FLEET_SCALE_COOLDOWN_S``)."""
+    return _env_float(
+        "ADVSPEC_FLEET_SCALE_COOLDOWN_S", DEFAULT_SCALE_COOLDOWN_S
+    )
+
+
+def env_scale_interval_s() -> float:
+    """Autoscaler tick period (``ADVSPEC_FLEET_SCALE_INTERVAL_S``)."""
+    return _env_float(
+        "ADVSPEC_FLEET_SCALE_INTERVAL_S", DEFAULT_SCALE_INTERVAL_S
+    )
+
+
 @dataclass
 class FleetConfig:
     """Process-wide knobs, set once per CLI round (or by tests)."""
@@ -78,6 +142,22 @@ class FleetConfig:
     # worker that stays silent this long is treated as dead and its
     # in-flight requests fail over (0 = wait forever).
     request_timeout_s: float = 30.0
+    # Elasticity (fleet/autoscale.py): backlog-driven membership. The
+    # autoscaler only runs when the serve daemon owns a scheduler to
+    # read pressure from; these knobs shape its decisions everywhere
+    # (daemon loop, chaos drills, bench arms).
+    autoscale: bool = False
+    min_replicas: int = DEFAULT_MIN_REPLICAS
+    max_replicas: int = DEFAULT_MAX_REPLICAS
+    scale_out_fraction: float = DEFAULT_SCALE_OUT_FRACTION
+    scale_in_fraction: float = DEFAULT_SCALE_IN_FRACTION
+    scale_out_ticks: int = DEFAULT_SCALE_OUT_TICKS
+    scale_in_ticks: int = DEFAULT_SCALE_IN_TICKS
+    scale_cooldown_s: float = DEFAULT_SCALE_COOLDOWN_S
+    scale_interval_s: float = DEFAULT_SCALE_INTERVAL_S
+    # Bounded spawn retry (fleet/replica.py spawn_replica): attempts
+    # past the first before a typed SpawnFailed aborts the scale-out.
+    spawn_retries: int = DEFAULT_SPAWN_RETRIES
 
 
 def _coerce_transport(value) -> str:
@@ -118,6 +198,16 @@ class FleetStats(procconfig.StatsBase):
     replicas_retired: int = 0
     heartbeats: int = 0
     heartbeat_failures: int = 0
+    # Elasticity (fleet/autoscale.py): membership changes that
+    # completed, spawn attempts that exhausted their bounded retry
+    # (``SpawnFailed`` — each one also enters cooldown, so the counter
+    # bounds how hot a broken spawn path can loop), and decisions the
+    # hysteresis/cooldown pair suppressed (the anti-flap ledger the
+    # oscillating-load test pins).
+    scale_outs: int = 0
+    scale_ins: int = 0
+    spawn_failures: int = 0
+    flaps_suppressed: int = 0
 
     def snapshot(self) -> dict:
         out = self.as_dict()
@@ -134,11 +224,23 @@ _state = procconfig.ProcState(
         enabled=env_enabled(),
         replicas=env_replicas(),
         transport=env_transport(),
+        autoscale=env_autoscale(),
+        min_replicas=env_min_replicas(),
+        max_replicas=env_max_replicas(),
+        scale_cooldown_s=env_scale_cooldown_s(),
+        scale_interval_s=env_scale_interval_s(),
     ),
     FleetStats(),
     coerce={
         "replicas": lambda v: max(1, int(v)),
         "transport": _coerce_transport,
+        "min_replicas": lambda v: max(1, int(v)),
+        "max_replicas": lambda v: max(1, int(v)),
+        "scale_out_ticks": lambda v: max(1, int(v)),
+        "scale_in_ticks": lambda v: max(1, int(v)),
+        "scale_cooldown_s": lambda v: max(0.0, float(v)),
+        "scale_interval_s": lambda v: max(0.0, float(v)),
+        "spawn_retries": lambda v: max(0, int(v)),
     },
 )
 _config = _state.config
@@ -154,12 +256,32 @@ def configure(
     replicas: int | None = None,
     transport: str | None = None,
     request_timeout_s: float | None = None,
+    autoscale: bool | None = None,
+    min_replicas: int | None = None,
+    max_replicas: int | None = None,
+    scale_out_fraction: float | None = None,
+    scale_in_fraction: float | None = None,
+    scale_out_ticks: int | None = None,
+    scale_in_ticks: int | None = None,
+    scale_cooldown_s: float | None = None,
+    scale_interval_s: float | None = None,
+    spawn_retries: int | None = None,
 ) -> FleetConfig:
     return _state.configure(
         enabled=enabled,
         replicas=replicas,
         transport=transport,
         request_timeout_s=request_timeout_s,
+        autoscale=autoscale,
+        min_replicas=min_replicas,
+        max_replicas=max_replicas,
+        scale_out_fraction=scale_out_fraction,
+        scale_in_fraction=scale_in_fraction,
+        scale_out_ticks=scale_out_ticks,
+        scale_in_ticks=scale_in_ticks,
+        scale_cooldown_s=scale_cooldown_s,
+        scale_interval_s=scale_interval_s,
+        spawn_retries=spawn_retries,
     )
 
 
@@ -173,10 +295,16 @@ def snapshot() -> dict:
 
 
 def armed() -> bool:
-    """True when requests should route through the fleet (>= 2 replicas
-    — a 1-replica fleet is just an engine with extra steps, served by
-    the plain dispatch path)."""
-    return _config.enabled and _config.replicas >= 2
+    """True when requests should route through the fleet: >= 2 replicas
+    (a 1-replica fleet is just an engine with extra steps, served by
+    the plain dispatch path) — OR an elastic fleet whose CEILING admits
+    a second replica, because an autoscaled fleet may legitimately
+    start at one replica and grow."""
+    if not _config.enabled:
+        return False
+    if _config.autoscale and _config.max_replicas >= 2:
+        return True
+    return _config.replicas >= 2
 
 
 # -- the process fleet engine ----------------------------------------------
@@ -188,11 +316,21 @@ _engine = None
 _engine_key = None
 
 
+def _topology_key():
+    """(founder count, rebuild key) for the current config. Elastic
+    founders start inside [floor, ceiling] — typically AT the floor,
+    growing on demand (the bench's elastic arm)."""
+    n = _config.replicas
+    if _config.autoscale:
+        n = max(_config.min_replicas, min(n, _config.max_replicas))
+    return n, (n, _config.autoscale, _config.transport, _config.request_timeout_s)
+
+
 def fleet_engine():
     """The process-wide FleetEngine for the current config (lazy; a
     config change retires the old fleet and builds a fresh one)."""
     global _engine, _engine_key
-    key = (_config.replicas, _config.transport, _config.request_timeout_s)
+    n, key = _topology_key()
     if _engine is not None and _engine_key != key:
         _engine.shutdown()
         _engine = None
@@ -200,7 +338,7 @@ def fleet_engine():
         from adversarial_spec_tpu.fleet.router import FleetEngine
 
         _engine = FleetEngine(
-            replicas=_config.replicas,
+            replicas=n,
             transport=_config.transport,
             request_timeout_s=_config.request_timeout_s,
         )
@@ -217,7 +355,7 @@ def install_engine(engine) -> None:
     if _engine is not None and _engine is not engine:
         _engine.shutdown()
     _engine = engine
-    _engine_key = (_config.replicas, _config.transport, _config.request_timeout_s)
+    _engine_key = _topology_key()[1]
 
 
 def shutdown_fleet() -> None:

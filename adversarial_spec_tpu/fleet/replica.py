@@ -60,6 +60,24 @@ class ReplicaDead(RuntimeError):
         self.partial = dict(partial or {})
 
 
+class SpawnFailed(RuntimeError):
+    """Replica provisioning exhausted its bounded retry: every spawn
+    attempt either failed outright or came up unable to answer a ping.
+    Typed so the autoscaler can COUNT it (stats.spawn_failures) and
+    enter cooldown instead of hot-looping on a broken spawn path; the
+    replica never existed as far as the ring is concerned."""
+
+    def __init__(self, replica: str, attempts: int, why: str):
+        super().__init__(
+            f"UNAVAILABLE: replica {replica} failed to spawn after "
+            f"{attempts} attempt(s): {why}"
+        )
+        self.fault_kind = FaultKind.DEVICE_LOST
+        self.seam = "replica"
+        self.replica = replica
+        self.attempts = attempts
+
+
 # -- wire codec (worker protocol; also reused by the worker itself) --------
 
 
@@ -150,6 +168,30 @@ class InProcessReplica:
 
     def ping(self) -> bool:
         return not self.closed
+
+    def warm(self, models: list[str]) -> int:
+        """Pre-build the serving state for ``models`` BEFORE the router
+        admits this replica to the ring (fleet/autoscale.py warm-before-
+        ring contract): engine construction re-attaches the shared
+        DiskStore (so prefix KV written by the rest of the fleet
+        rehydrates instead of re-prefilling) and the weight-residency
+        preload hint (engine/weightres.py) pre-touches the hottest
+        models from the scheduler's model mix so the first routed
+        request pays no cold load. Returns the models warmed."""
+        if self.closed:
+            raise ReplicaDead(self.id, "is closed")
+        from adversarial_spec_tpu.engine import weightres as weightres_mod
+
+        for model in models:
+            eng = self._engine_for(model)
+            ledger = getattr(eng, "ledger", None)
+            if ledger is not None:
+                # Freshen LRU standing for an already-admitted alias;
+                # actual admission happens on first serve (the ledger's
+                # one admission surgery), which the hint accounts for.
+                ledger.touch(model)
+        weightres_mod.preload_hint(models)
+        return len(models)
 
     def chat_batch(
         self, requests, params, consumer=None, on_completion=None
@@ -405,6 +447,16 @@ class WorkerReplica:
             raise
         return [got[j] for j in range(len(requests))]
 
+    def warm(self, models: list[str]) -> int:
+        """Worker-side warm (fleet/worker.py ``warm`` op): the worker
+        builds its engines for ``models`` — shared-store re-attach plus
+        the weight-residency preload hint — before this replica is ever
+        routable. Raises ReplicaDead if the worker dies mid-warm; the
+        autoscaler decommissions it without it ever entering the ring."""
+        self._send({"op": "warm", "models": list(models)})
+        resp = self._read_line(self.request_timeout_s)
+        return int(resp.get("warmed", 0))
+
     def validate(self, model: str) -> str | None:
         self._send({"op": "validate", "model": model})
         resp = self._read_line(self.request_timeout_s)
@@ -447,3 +499,60 @@ class WorkerReplica:
         if self._log is not None:
             self._log.close()
             self._log = None
+
+
+def spawn_replica(
+    replica_id: str,
+    transport: str = "inproc",
+    *,
+    retries: int = 3,
+    backoff_base_s: float = 0.05,
+    sleep=time.sleep,
+    rng=None,
+    engine_factory=None,
+    request_timeout_s: float = 30.0,
+    worker_env: dict | None = None,
+    log_dir: str | None = None,
+):
+    """Provision one replica with BOUNDED retry: each attempt spawns
+    the transport and requires a ping answer; a failed attempt is torn
+    down and retried after a jittered exponential backoff
+    (``backoff_base_s * 2^k * (0.5 + U[0,1))`` — the jitter keeps N
+    autoscalers from stampeding a recovering host). After ``retries``
+    extra attempts the typed :class:`SpawnFailed` propagates — the
+    caller counts it and enters cooldown; this helper NEVER loops
+    unbounded. ``sleep``/``rng`` are injectable for deterministic
+    tests."""
+    if rng is None:
+        import random
+
+        rng = random.random
+    last_why = "never attempted"
+    attempts = max(1, int(retries) + 1)
+    for attempt in range(attempts):
+        if attempt:
+            sleep(backoff_base_s * (2 ** (attempt - 1)) * (0.5 + rng()))
+        rep = None
+        try:
+            if transport == "worker":
+                rep = WorkerReplica(
+                    replica_id,
+                    request_timeout_s=request_timeout_s,
+                    env=worker_env,
+                    log_dir=log_dir,
+                )
+            else:
+                rep = InProcessReplica(
+                    replica_id, engine_factory=engine_factory
+                )
+            if not rep.ping():
+                raise ReplicaDead(replica_id, "never answered its ping")
+            return rep
+        except (ReplicaDead, OSError) as e:
+            last_why = str(e)
+            if rep is not None:
+                try:
+                    rep.close()
+                except Exception:
+                    pass
+    raise SpawnFailed(replica_id, attempts, last_why)

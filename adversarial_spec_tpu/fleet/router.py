@@ -33,6 +33,8 @@ failure) without killing any process.
 
 from __future__ import annotations
 
+import threading
+
 from adversarial_spec_tpu import fleet as fleet_mod
 from adversarial_spec_tpu import obs as obs_mod
 from adversarial_spec_tpu.engine.types import ChatRequest, Completion, SamplingParams
@@ -41,6 +43,7 @@ from adversarial_spec_tpu.fleet.replica import (
     InProcessReplica,
     ReplicaDead,
     WorkerReplica,
+    spawn_replica,
 )
 from adversarial_spec_tpu.resilience import breaker as breaker_mod
 from adversarial_spec_tpu.resilience import faults as faults_mod
@@ -65,6 +68,16 @@ class FleetRouter:
         # Retired replicas and why — the lifecycle surgery's ledger,
         # written ONLY by _retire_replica (GL-LIFECYCLE pins this).
         self._dead: dict[str, str] = {}
+        # Membership lock: the autoscaler mutates ring membership from
+        # its own thread while daemon debate threads walk preference
+        # orders mid-submit — ring reads and membership writes both
+        # take it (RLock: a locked path may re-enter through the
+        # retirement surgery).
+        self._mlock = threading.RLock()
+        # Per-replica in-flight request counts (submit increments
+        # around each dispatch): the scale-in drain watches this reach
+        # zero before retiring the victim.
+        self._inflight: dict[str, int] = {}
         self._affinity = bool(affinity)
         self._rr = 0  # round-robin cursor (affinity=False control arm)
         self._breakers = (
@@ -77,20 +90,93 @@ class FleetRouter:
     # -- membership --------------------------------------------------------
 
     def alive_ids(self) -> list[str]:
-        return sorted(self._ring.nodes)
+        with self._mlock:
+            return sorted(self._ring.nodes)
 
     def replica(self, rid: str):
         return self._replicas.get(rid)
 
+    def retired_reason(self, rid: str) -> str | None:
+        """Why a replica left service (``None`` while alive) — the
+        autoscaler's reconciliation reads this when the router retires
+        a member behind its back."""
+        with self._mlock:
+            return self._dead.get(rid)
+
+    def admit_replica(self, rep) -> bool:
+        """Ring-change hook for scale-OUT (fleet/autoscale.py): admit
+        a replica to the hash ring, making it routable. The caller
+        MUST have spawned, pinged, and WARMED it first — between spawn
+        and this call the replica is invisible to every routing path
+        (the warm-before-ring contract the elasticity tests pin), so
+        no request can ever land on a cold replica. Returns False for
+        a retired or already-ringed id (idempotent)."""
+        rid = rep.id
+        with self._mlock:
+            if rid in self._dead or rid in self._ring.nodes:
+                return False
+            self._replicas[rid] = rep
+            self._ring.add(rid)
+            alive = len(self._ring)
+        if obs_mod.config().enabled:
+            obs_mod.hot.replica_op("ready").inc()
+            obs_mod.hot.fleet_replicas_alive.set(alive)
+        obs_mod.emit(
+            obs_mod.ReplicaEvent(replica=rid, op="ready", alive=alive)
+        )
+        return True
+
+    def drain_replica(self, rid: str) -> bool:
+        """Ring-change hook for scale-IN (fleet/autoscale.py): take a
+        replica OUT of the ring while its transport stays open — new
+        requests route to survivors (and the shared store lets their
+        prefixes rehydrate there), in-flight units keep completing on
+        the victim. NOT a lifecycle exit: the replica is alive until
+        the autoscaler's drain wait finishes and ``_retire_replica``
+        runs; a victim that stalls past the drain deadline is retired
+        mid-batch and the ReplicaDead-remainder machinery re-routes
+        the rest — the planned-handoff half of the drain contract."""
+        with self._mlock:
+            if rid in self._dead or rid not in self._ring.nodes:
+                return False
+            self._ring.remove(rid)
+            alive = len(self._ring)
+        if obs_mod.config().enabled:
+            obs_mod.hot.fleet_replicas_alive.set(alive)
+        return True
+
+    def inflight(self, rid: str) -> int:
+        """Requests currently dispatched to ``rid`` (the scale-in
+        drain's wait condition)."""
+        with self._mlock:
+            return self._inflight.get(rid, 0)
+
+    def affinity_load(self, keys) -> dict[str, int]:
+        """How many of the given affinity keys each ROUTABLE replica
+        primarily owns — the least-affine victim picker's input (the
+        replica owning the fewest active keys loses the least warm
+        prefix KV when it leaves the ring)."""
+        with self._mlock:
+            out: dict[str, int] = {rid: 0 for rid in self._ring.nodes}
+            if not out:
+                return out
+            for key in keys:
+                rid = self._ring.primary(str(key))
+                if rid in out:
+                    out[rid] += 1
+            return out
+
     def _retire_replica(self, rid: str, reason: str) -> None:
         """THE lifecycle surgery: every path that removes a replica
         from service funnels here (transport failure, heartbeat miss,
-        orderly shutdown) — ring membership, the dead-ledger, the
-        transport close, and the telemetry stay in one place."""
-        if rid in self._dead or rid not in self._replicas:
-            return
-        self._dead[rid] = reason
-        self._ring.remove(rid)
+        planned scale-in, orderly shutdown) — ring membership, the
+        dead-ledger, the transport close, and the telemetry stay in
+        one place."""
+        with self._mlock:
+            if rid in self._dead or rid not in self._replicas:
+                return
+            self._dead[rid] = reason
+            self._ring.remove(rid)
         try:
             self._replicas[rid].close()
         except Exception:
@@ -169,7 +255,11 @@ class FleetRouter:
         submit) and open (replica, model) breakers."""
         key = self.affinity_key(req)
         if self._affinity:
-            order = self._ring.preference(key)
+            # Under the membership lock: the autoscaler inserts/removes
+            # vnode points from its own thread, and a preference walk
+            # racing an insort would misread the ring.
+            with self._mlock:
+                order = self._ring.preference(key)
             reason = "affinity"
         else:
             alive = self.alive_ids()
@@ -291,6 +381,10 @@ class FleetRouter:
                     wrapped = (
                         lambda j, text, idxs=idxs: consumer(idxs[j], text)
                     )
+                with self._mlock:
+                    self._inflight[rid] = (
+                        self._inflight.get(rid, 0) + len(idxs)
+                    )
                 try:
                     # The replica chaos seam: an injected fault here is
                     # a replica-level failure the breakers absorb — the
@@ -328,6 +422,11 @@ class FleetRouter:
                         hops[i] += 1
                         pending.append(i)
                     continue
+                finally:
+                    with self._mlock:
+                        self._inflight[rid] = max(
+                            0, self._inflight.get(rid, 0) - len(idxs)
+                        )
                 for j, comp in sorted(got.items()):
                     self._resolve(rid, idxs[j], batch[j], comp, results)
                 for i in idxs:
@@ -380,9 +479,68 @@ class FleetEngine:
             obs_mod.emit(
                 obs_mod.ReplicaEvent(replica=rid, op="spawn", alive=k + 1)
             )
+        # Topology parameters kept for elastic growth: the autoscaler's
+        # spawn_replica() must build replicas indistinguishable from
+        # the founders (same transport, factory, env, timeout).
+        self.transport = transport
+        self.request_timeout_s = request_timeout_s
+        self._engine_factory = engine_factory
+        self._worker_env = worker_env
+        self._log_dir = log_dir
+        self._stats = stats if stats is not None else fleet_mod.stats
+        self._next_rid = n
         self.router = FleetRouter(
             built, breakers=breakers, affinity=affinity, stats=stats
         )
+
+    def reserve_replica_id(self) -> str:
+        """Mint the next replica id WITHOUT spawning — the autoscaler
+        declares the provisioning state (and emits its ScaleEvent)
+        before the first spawn attempt runs."""
+        rid = f"r{self._next_rid}"
+        self._next_rid += 1
+        return rid
+
+    def spawn_replica(
+        self,
+        rid: str | None = None,
+        *,
+        retries: int = 3,
+        backoff_base_s: float = 0.05,
+        sleep=None,
+        rng=None,
+    ):
+        """Provision one NEW replica matching this fleet's topology,
+        through the bounded-retry spawn hardening
+        (:func:`fleet.replica.spawn_replica` — a typed ``SpawnFailed``
+        propagates after the retries exhaust). The returned handle is
+        NOT routable: the caller must warm it and then admit it via
+        ``router.admit_replica`` (the warm-before-ring contract)."""
+        import time as _time
+
+        if rid is None:
+            rid = self.reserve_replica_id()
+        rep = spawn_replica(
+            rid,
+            self.transport,
+            retries=retries,
+            backoff_base_s=backoff_base_s,
+            sleep=sleep if sleep is not None else _time.sleep,
+            rng=rng,
+            engine_factory=self._engine_factory,
+            request_timeout_s=self.request_timeout_s,
+            worker_env=self._worker_env,
+            log_dir=self._log_dir,
+        )
+        self._stats.replicas_spawned += 1
+        if obs_mod.config().enabled:
+            obs_mod.hot.replica_op("spawn").inc()
+        obs_mod.emit(
+            obs_mod.ReplicaEvent(
+                replica=rid, op="spawn", alive=len(self.router.alive_ids())
+            )
+        )
+        return rep
 
     def chat(
         self,

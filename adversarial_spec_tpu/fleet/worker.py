@@ -11,6 +11,10 @@ speaks over stdin/stdout:
   mid-batch loses only the unserved remainder, and the router keeps
   every line that landed.
 - ``{"op": "ping"}`` → ``{"pong": true}`` — the heartbeat probe.
+- ``{"op": "warm", "models": [...]}`` → ``{"warmed": <n>}`` — build
+  the engines for the given models (shared-store re-attach + weight-
+  residency preload hint) BEFORE the autoscaler admits this replica
+  to the ring, so no request ever routes to a cold worker.
 - ``{"op": "check"}`` → allocator + tier ``check_invariants`` on the
   worker's engines (the chaos harness's clean-survivor assertion).
 - ``{"op": "stats"}`` → per-model serve counts plus the worker's
@@ -130,6 +134,17 @@ class _Worker:
                     self._chat(msg)
                 elif op == "ping":
                     self._write({"pong": True, "replica": self.replica_id})
+                elif op == "warm":
+                    from adversarial_spec_tpu.engine import weightres
+
+                    models = [str(m) for m in msg.get("models") or []]
+                    for model in models:
+                        eng = self._engine_for(model)
+                        ledger = getattr(eng, "ledger", None)
+                        if ledger is not None:
+                            ledger.touch(model)
+                    weightres.preload_hint(models)
+                    self._write({"warmed": len(models)})
                 elif op == "validate":
                     model = msg.get("model", "")
                     try:

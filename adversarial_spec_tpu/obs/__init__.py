@@ -47,6 +47,7 @@ from adversarial_spec_tpu.obs.events import (  # noqa: F401 (re-export)
     ReplicaEvent,
     RequestEvent,
     RouteEvent,
+    ScaleEvent,
     ServeEvent,
     SpanEvent,
     SpecEvent,
@@ -173,6 +174,7 @@ class HotMetrics:
         "cancel_tokens_saved",
         "journal_fsync",
         "fleet_replicas_alive",
+        "fleet_replicas_desired",
         "fleet_affinity_ratio",
         "serve_backlog",
         "serve_queue_wait",
@@ -186,6 +188,7 @@ class HotMetrics:
         "_cancel",
         "_route",
         "_replica_op",
+        "_fleet_scale",
         "_serve_op",
         "_serve_shed",
         "_weight_swap",
@@ -280,6 +283,15 @@ class HotMetrics:
             help="requests served by their affinity-primary replica "
             "(this round)",
         )
+        # Elastic fleet (fleet/autoscale.py): the autoscaler's target
+        # population next to the actual ring population
+        # (fleet_replicas_alive above) — a persistent desired > actual
+        # gap is a spawn-failure loop, visible without reading events.
+        self.fleet_replicas_desired = m.gauge(
+            "advspec_fleet_replicas_desired",
+            help="autoscaler target replica count (actual is "
+            "advspec_fleet_replicas_alive)",
+        )
         # Serve daemon (adversarial_spec_tpu/serve): the scheduler's
         # estimated token backlog (the admission-control pressure
         # signal) and per-unit queue wait (admission -> dispatch — the
@@ -307,6 +319,7 @@ class HotMetrics:
         self._cancel: dict = {}
         self._route: dict = {}
         self._replica_op: dict = {}
+        self._fleet_scale: dict = {}
         self._serve_op: dict = {}
         self._serve_shed: dict = {}
         self._weight_swap: dict = {}
@@ -387,6 +400,21 @@ class HotMetrics:
                 "advspec_fleet_replica_events_total",
                 help="fleet replica lifecycle transitions by op",
                 op=op,
+            )
+        return c
+
+    def fleet_scale(self, direction: str, reason: str):
+        """Autoscaler membership changes by direction and trigger
+        (fleet/autoscale.py: out/backlog, out/brownout, in/idle,
+        out/spawn_failed for an aborted scale-out…)."""
+        c = self._fleet_scale.get((direction, reason))
+        if c is None:
+            c = self._fleet_scale[(direction, reason)] = self._m.counter(
+                "advspec_fleet_scale_total",
+                help="autoscaler membership changes by direction and "
+                "trigger",
+                direction=direction,
+                reason=reason,
             )
         return c
 

@@ -59,6 +59,12 @@ Event vocabulary (the schema ``tools/obs_dump.py`` validates):
   entry/exit — with the tenant, tier, and post-op backlog so the
   timeline shows WHO was being served and WHO was shed when pressure
   hit.
+- ``ScaleEvent`` — one autoscaler membership transition
+  (fleet/autoscale.py): a replica provisioned/warming/serving/
+  draining/retired, or a spawn that exhausted its retries — with the
+  desired and alive counts and the backlog that drove the decision,
+  so the timeline shows capacity FOLLOWING pressure, not just
+  pressure building.
 
 Causal tracing (obs/trace.py): EVERY event additionally carries
 ``trace_id`` (the debate round that caused it) and ``span_id`` (the
@@ -359,6 +365,31 @@ class ServeEvent:
     span_id: str = ""
 
 
+@dataclass(slots=True)
+class ScaleEvent:
+    """One elastic-fleet membership transition (fleet/autoscale.py
+    lifecycle machine). ``op`` names the edge the replica crossed
+    (provision → warming → serving on scale-out; draining → retired on
+    scale-in; spawn_failed when the bounded spawn retry gave up).
+    ``direction`` is the scaling decision that caused it ("out"/"in",
+    "" for shutdown teardown); ``reason`` the trigger (backlog,
+    brownout, idle, spawn_failed, shutdown…). ``desired``/``alive``
+    are the autoscaler's target and the routable ring population AFTER
+    the op, and ``backlog_tokens`` the scheduler backlog that drove
+    the decision — the timeline shows capacity following pressure."""
+
+    TYPE = "scale"
+    replica: str = ""
+    op: str = "provision"
+    direction: str = ""  # out | in | "" (teardown / informational)
+    reason: str = ""
+    desired: int = 0
+    alive: int = 0
+    backlog_tokens: int = 0
+    trace_id: str = ""
+    span_id: str = ""
+
+
 EVENT_TYPES = (
     StepEvent,
     RequestEvent,
@@ -376,6 +407,7 @@ EVENT_TYPES = (
     RouteEvent,
     WeightEvent,
     ServeEvent,
+    ScaleEvent,
 )
 
 # ``cancelled`` closes a request envelope mid-decode (streaming early
@@ -437,6 +469,22 @@ SERVE_OPS = (
 )
 
 SERVE_TIERS = ("interactive", "batch")
+
+# The autoscaler's replica lifecycle (fleet/autoscale.py state
+# machine) — graftlint's fifth GL-LIFECYCLE machine enforces the code
+# side of the same contract (every exit through one ``_decommission``
+# surgery). ``spawn_failed`` is the one non-state edge: a scale-out
+# whose bounded spawn retry exhausted before the replica ever existed.
+SCALE_OPS = (
+    "provision",
+    "warming",
+    "serving",
+    "draining",
+    "retired",
+    "spawn_failed",
+)
+
+SCALE_DIRECTIONS = ("out", "in", "")
 
 REQUEST_STATES = (
     "queued",
@@ -523,6 +571,13 @@ def validate_event(obj) -> list[str]:
             errors.append(f"serve: unknown op {obj.get('op')!r}")
         if obj.get("tier") not in SERVE_TIERS:
             errors.append(f"serve: unknown tier {obj.get('tier')!r}")
+    if etype == "scale":
+        if obj.get("op") not in SCALE_OPS:
+            errors.append(f"scale: unknown op {obj.get('op')!r}")
+        if obj.get("direction") not in SCALE_DIRECTIONS:
+            errors.append(
+                f"scale: unknown direction {obj.get('direction')!r}"
+            )
     return errors
 
 

@@ -40,6 +40,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 
+from adversarial_spec_tpu import fleet as fleet_mod
 from adversarial_spec_tpu import obs as obs_mod
 from adversarial_spec_tpu import serve as serve_mod
 from adversarial_spec_tpu.serve import driver, gate, protocol
@@ -99,6 +100,9 @@ class ServeDaemon:
         self._done = asyncio.Event()
         self._t_start = time.monotonic()
         self.drain_report: dict | None = None
+        # Built in run() when the fleet is armed with autoscale on:
+        # the elasticity control loop (fleet/autoscale.py).
+        self.autoscaler = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -109,6 +113,16 @@ class ServeDaemon:
         self._done = asyncio.Event()
         gate.install(self.sched)
         self.pump.start()
+        if fleet_mod.armed() and fleet_mod.config().autoscale:
+            from adversarial_spec_tpu.fleet.autoscale import Autoscaler
+
+            self.autoscaler = Autoscaler(fleet_mod.fleet_engine(), self.sched)
+            # Couple admission capacity to LIVE membership: scale-out
+            # stretches the backlog ceiling and brownout thresholds,
+            # so the fleet grows BEFORE the scheduler sheds (the
+            # brownout→scale-out ordering docs/serving.md documents).
+            self.sched.set_capacity_provider(self.autoscaler.capacity_factor)
+            self.autoscaler.start()
         try:
             self._loop.add_signal_handler(
                 signal.SIGTERM, self.begin_drain, "sigterm"
@@ -136,7 +150,12 @@ class ServeDaemon:
             # instead of blocking forever on a queue nobody serves.
             # Only then wait the executor out, and uninstall the gate
             # LAST — a debate thread must never reach the raw
-            # (single-threaded) engine ungated.
+            # (single-threaded) engine ungated. The autoscaler stops
+            # FIRST: no membership change may race the teardown (its
+            # shutdown only touches mid-transition replicas; serving
+            # founders belong to the fleet engine).
+            if self.autoscaler is not None:
+                self.autoscaler.shutdown()
             self.sched.stop()
             self.pump.join(timeout=5.0)
             self.executor.shutdown(wait=True)
@@ -155,6 +174,8 @@ class ServeDaemon:
             return
         self._draining = True
         self._drain_reason = reason
+        if self.autoscaler is not None:
+            self.autoscaler.begin_drain()
         self.sched.begin_drain()
         for w in list(self._writers):
             self._send(w, {"id": "", "event": "draining", "reason": reason})
@@ -362,7 +383,11 @@ class ServeDaemon:
         accept_t = time.monotonic()
         est = driver.estimate_debate_tokens(obj)
         shed = self.sched.try_admit(
-            obj["tenant"], obj.get("tier", "interactive"), debate_id, est
+            obj["tenant"],
+            obj.get("tier", "interactive"),
+            debate_id,
+            est,
+            models=obj.get("models") or (),
         )
         if shed is not None:
             self._send(

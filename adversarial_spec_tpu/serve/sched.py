@@ -194,8 +194,18 @@ class ServeScheduler:
         # quota remainders (armed when config.tenant_quota_tokens > 0).
         self._reserved: dict[str, int] = {}
         self._debate_tenant: dict[str, str] = {}
+        # Per-active-debate opponent pools (admission metadata): the
+        # autoscaler's model-mix observer — a warming replica preloads
+        # the hottest models counted here.
+        self._debate_models: dict[str, list[str]] = {}
         self._outstanding: dict[str, int] = {}
         self._quota: dict[str, int] = {}
+        # Capacity provider (fleet/autoscale.py): a callable returning
+        # the routable replica count. The admission backlog cap and the
+        # brownout thresholds scale by it — an elastic fleet that just
+        # grew ADMITS more instead of browning out; None (the default,
+        # and every pre-elastic deployment) keeps the static cap.
+        self._capacity_fn = None
         self.brownout = False
         self._prev_gamma: int | None = None
         self.draining = False
@@ -212,6 +222,28 @@ class ServeScheduler:
 
     def _backlog(self) -> int:
         return sum(self._reserved.values())
+
+    def set_capacity_provider(self, fn) -> None:
+        """Install (or clear, ``None``) the fleet-capacity observer:
+        ``fn()`` returns the routable replica count; the effective
+        backlog cap is ``max_backlog_tokens × max(1, fn())``."""
+        with self._cond:
+            self._capacity_fn = fn
+            self._cond.notify_all()
+
+    def _capacity_tokens(self, cfg) -> int:
+        """The EFFECTIVE backlog cap: per-replica cap × routable
+        replicas. Defensive on the provider — a capacity read must
+        never take the admission path down."""
+        base = cfg.max_backlog_tokens
+        fn = self._capacity_fn
+        if fn is None:
+            return base
+        try:
+            factor = max(1, int(fn()))
+        except Exception:
+            factor = 1
+        return base * factor
 
     def _drain_rate(self) -> float:
         elapsed = max(self._clock() - self._started_t, 1e-3)
@@ -262,16 +294,22 @@ class ServeScheduler:
     # -- admission ---------------------------------------------------------
 
     def try_admit(
-        self, tenant: str, tier: str, debate: str, est_tokens: int
+        self, tenant: str, tier: str, debate: str, est_tokens: int,
+        models: list[str] | tuple[str, ...] = (),
     ) -> ShedDecision | None:
         """Admit one debate (reserving its estimate in the backlog
         ledger) or refuse it with a typed shed. Shed order under
         pressure is the contract docs/serving.md documents: drain >
         brownout (batch only) > queue depth > backlog > quota —
         brownout pauses batch ADMISSIONS one step before the hard caps
-        start refusing interactive traffic."""
+        start refusing interactive traffic. The backlog cap scales
+        with fleet capacity (``set_capacity_provider``): with an
+        elastic fleet, scale-out RAISES it before brownout would
+        engage. ``models`` is admission metadata — the debate's
+        opponent pool, feeding the autoscaler's model-mix observer."""
         cfg = serve_mod.config()
         with self._cond:
+            cap_tokens = self._capacity_tokens(cfg)
             retry = est_tokens / self._drain_rate()
             shed: ShedDecision | None = None
             if self.draining:
@@ -296,13 +334,13 @@ class ServeScheduler:
                     f"{self._outstanding.get(tenant, 0)} debates "
                     f"outstanding (cap {cfg.max_queue_depth})",
                 )
-            elif self._backlog() + est_tokens > cfg.max_backlog_tokens:
+            elif self._backlog() + est_tokens > cap_tokens:
                 shed = ShedDecision(
                     "backlog",
-                    (self._backlog() + est_tokens - cfg.max_backlog_tokens)
+                    (self._backlog() + est_tokens - cap_tokens)
                     / self._drain_rate(),
                     f"estimated backlog {self._backlog()} + {est_tokens} "
-                    f"tokens exceeds cap {cfg.max_backlog_tokens}",
+                    f"tokens exceeds cap {cap_tokens}",
                 )
             else:
                 remaining = self._quota_remaining(tenant)
@@ -325,6 +363,8 @@ class ServeScheduler:
             self._outstanding[tenant] = self._outstanding.get(tenant, 0) + 1
             self._reserved[debate] = est_tokens
             self._debate_tenant[debate] = tenant
+            if models:
+                self._debate_models[debate] = [str(m) for m in models]
             serve_mod.stats.accepted_debates += 1
             self._emit(
                 "accepted", tenant=tenant, tier=tier, debate=debate,
@@ -342,6 +382,7 @@ class ServeScheduler:
             if debate not in self._debate_tenant:
                 return  # idempotent: already finished (or never admitted)
             self._reserved.pop(debate, None)
+            self._debate_models.pop(debate, None)
             tenant = self._debate_tenant.pop(debate, "")
             if tenant:
                 self._outstanding[tenant] = max(
@@ -671,12 +712,18 @@ class ServeScheduler:
     def _update_brownout(self) -> None:
         """Hysteresis state machine over the backlog ledger. Entering
         lowers speculation γ (the declared degradation) and pauses
-        batch admissions; exiting restores γ. Caller holds the lock."""
+        batch admissions; exiting restores γ. Caller holds the lock.
+        Thresholds are fractions of the EFFECTIVE capacity
+        (``_capacity_tokens``): a scale-out that lands mid-brownout
+        raises the exit threshold past the backlog and the next
+        admission/finish exits brownout — capacity arriving IS the
+        recovery path, one notch before shedding ever starts."""
         cfg = serve_mod.config()
         backlog = self._backlog()
+        cap_tokens = self._capacity_tokens(cfg)
         if (
             not self.brownout
-            and backlog >= cfg.brownout_enter_fraction * cfg.max_backlog_tokens
+            and backlog >= cfg.brownout_enter_fraction * cap_tokens
         ):
             self.brownout = True
             serve_mod.stats.brownout_entries += 1
@@ -684,7 +731,7 @@ class ServeScheduler:
             self._emit("brownout_enter", tokens=backlog)
         elif (
             self.brownout
-            and backlog <= cfg.brownout_exit_fraction * cfg.max_backlog_tokens
+            and backlog <= cfg.brownout_exit_fraction * cap_tokens
         ):
             self.brownout = False
             serve_mod.stats.brownout_exits += 1
@@ -772,6 +819,32 @@ class ServeScheduler:
             return not self._running and not any(
                 q for qs in self._queues.values() for q in qs.values()
             )
+
+    def pressure_snapshot(self) -> dict:
+        """The autoscaler's observer (fleet/autoscale.py): the backlog
+        ledger, the effective capacity it is measured against, the
+        pressure flags, the ACTIVE affinity keys (admitted debate ids
+        — the least-affine scale-in victim is picked by who primarily
+        owns fewest of these), and the model mix (model → active-
+        debate count, hottest first feeds the warm-replica residency
+        preload). One lock acquire; safe from any thread."""
+        with self._lock:
+            mix: dict[str, int] = {}
+            for models in self._debate_models.values():
+                for m in models:
+                    mix[m] = mix.get(m, 0) + 1
+            return {
+                "backlog_tokens": self._backlog(),
+                "capacity_tokens": self._capacity_tokens(
+                    serve_mod.config()
+                ),
+                "brownout": self.brownout,
+                "draining": self.draining,
+                "active_keys": list(self._reserved),
+                "model_mix": dict(
+                    sorted(mix.items(), key=lambda kv: (-kv[1], kv[0]))
+                ),
+            }
 
     def state_snapshot(self) -> dict:
         """The ``stats`` protocol op's scheduler view."""

@@ -86,6 +86,16 @@ Modes:
                                   # cross-round cache hit-rate, plus
                                   # the replica-kill recovery drill;
                                   # writes BENCH_fleet.json
+  python bench.py --mode elastic  # elastic fleet: accepted-debate
+                                  # throughput + p99 TTFT under a
+                                  # paced load step, autoscaled
+                                  # (floor 1, ceiling 3) vs fixed
+                                  # 3-replica fleet at equal chip
+                                  # ceiling, plus the lose-nothing
+                                  # scale-in drill (byte-identical
+                                  # transcripts, zero duplicated
+                                  # completions); writes
+                                  # BENCH_elastic.json
   --no-interleave                 # escape hatch for any batcher-driven
                                   # mode: run the legacy serialized loop
                                   # (equivalent to ADVSPEC_INTERLEAVE=0)
@@ -1907,6 +1917,286 @@ def _run_fleet(platform: str) -> dict:
     }
 
 
+def _run_elastic(platform: str) -> dict:
+    """Elastic-fleet bench (mock serve daemon — writes
+    BENCH_elastic.json), two drills:
+
+    **Load step** — the same wave-burst open-loop demand step runs
+    against two fleets at the SAME chip ceiling (3 replicas):
+
+    - **fixed** — 3 replicas from the start, no autoscaler: the serve
+      scheduler's admission cap and brownout thresholds are sized for
+      ONE engine (the pre-elastic coupling), so the step sheds at 1x
+      the per-replica backlog cap no matter how many chips idle behind
+      the router;
+    - **elastic** — floor 1, ceiling 3, the autoscaler's capacity
+      provider stretches the admission cap and brownout thresholds
+      with LIVE membership: the fleet grows under the step and admits
+      what the fixed arm refuses.
+
+    Headline: accepted-debate throughput (completed debates per storm
+    second), elastic vs fixed, with interactive p99 TTFT reported for
+    both arms (growing must not trade admission for latency collapse).
+
+    **Scale-in** — the fleet-bench debate workload runs once on a
+    static 2-replica fleet and once with a PLANNED scale-in (drain ->
+    retire through the autoscaler's lifecycle) between rounds:
+    transcripts must be byte-identical and duplicated completions
+    zero — membership change loses nothing.
+
+    Escape hatch: --no-fleet / ADVSPEC_FLEET_AUTOSCALE=0 keeps the
+    static topology.
+    """
+    import asyncio
+    import threading
+
+    from adversarial_spec_tpu import fleet as fleet_mod
+    from adversarial_spec_tpu import serve as serve_mod
+    from adversarial_spec_tpu.engine.types import ChatRequest, SamplingParams
+    from adversarial_spec_tpu.fleet.autoscale import Autoscaler
+    from adversarial_spec_tpu.fleet.router import FleetEngine
+    from adversarial_spec_tpu.serve.client import ServeClient
+    from adversarial_spec_tpu.serve.daemon import ServeDaemon
+
+    n_waves, wave_size = 8, 6
+    spec_doc = (
+        "## Goals\nAbsorb a demand step without shedding accepted work.\n"
+        "## Constraints\n" + "The fleet SHALL grow before it sheds. " * 10
+    )
+    models = ["mock://critic?v=1", "mock://critic?v=2"]
+    old_serve = serve_mod.snapshot()
+    old_fleet = fleet_mod.config()
+
+    def run_step(elastic: bool) -> dict:
+        serve_mod.reset_stats()
+        serve_mod.configure(
+            max_queue_depth=64,
+            max_backlog_tokens=4000,  # per-replica; elastic stretches
+            tenant_quota_tokens=0,
+            drain_deadline_s=3.0,
+        )
+        fleet_mod.shutdown_fleet()
+        fleet_mod.configure(
+            enabled=True,
+            replicas=1 if elastic else 3,  # equal CEILING, not floor
+            transport="inproc",
+            autoscale=elastic,
+            min_replicas=1,
+            max_replicas=3,
+            scale_out_fraction=0.6,
+            scale_in_fraction=0.15,
+            scale_out_ticks=1,
+            # Scale-in hysteresis must exceed the inter-wave gap or the
+            # controller flaps the fleet down between bursts and pays a
+            # re-warm on the next one — the drill pins the knob doing
+            # its job, not a lucky cadence.
+            scale_in_ticks=20,
+            scale_cooldown_s=0.05,
+            scale_interval_s=0.01,
+        )
+        fleet_mod.reset_stats()
+        with tempfile.TemporaryDirectory(prefix="advspec-elastic-") as td:
+            sock = os.path.join(td, "serve.sock")
+            ready = threading.Event()
+            daemon = ServeDaemon(
+                sock, sessions_dir=os.path.join(td, "sessions")
+            )
+            th = threading.Thread(
+                target=lambda: asyncio.run(daemon.run(ready=ready)),
+                daemon=True,
+            )
+            th.start()
+            if not ready.wait(10):
+                raise RuntimeError("bench daemon did not come up")
+            client = ServeClient(sock, timeout_s=60)
+            try:
+                # Warmup: one debate end-to-end so neither arm pays
+                # first-request construction costs inside the
+                # measured window (arm order must not decide the
+                # headline).
+                client.collect(
+                    client.submit_debate(
+                        spec_doc, models, tenant="warm", max_new_tokens=32
+                    ),
+                    timeout_s=60,
+                )
+                # The load step: waves of an UNPACED burst (each wave
+                # alone overruns one replica's admission cap several
+                # times) separated by a gap longer than the control
+                # loop's tick — a demand step the fixed arm must shed
+                # into and the elastic arm gets to grow into.
+                t0 = time.monotonic()
+                submitted = []
+                for wave in range(n_waves):
+                    for k in range(wave_size):
+                        tier = "interactive" if k % 2 else "batch"
+                        submitted.append(
+                            (
+                                client.submit_debate(
+                                    spec_doc,
+                                    models,
+                                    tenant=f"t{k % 2}",
+                                    tier=tier,
+                                    max_new_tokens=1280,
+                                ),
+                                tier,
+                            )
+                        )
+                    time.sleep(0.03)
+                accepted = completed = shed = 0
+                ttfts: list[float] = []
+                for rid, tier in submitted:
+                    evs = client.collect(rid, timeout_s=120)
+                    last = evs[-1]
+                    if evs[0]["event"] == "accepted":
+                        accepted += 1
+                        if last["event"] == "result" and not last.get(
+                            "error"
+                        ):
+                            completed += 1
+                            if tier == "interactive":
+                                ttfts.append(float(last["ttft_s"]))
+                    elif last["event"] == "shed":
+                        shed += 1
+                wall = time.monotonic() - t0
+                client.drain()
+            finally:
+                client.close()
+                th.join(timeout=15)
+        ttfts.sort()
+        p99 = ttfts[max(0, int(len(ttfts) * 0.99) - 1)] if ttfts else 0.0
+        return {
+            "elastic": {"yes": elastic},
+            "accepted": accepted,
+            "completed": completed,
+            "shed": shed,
+            "storm_wall_s": round(wall, 3),
+            "accepted_debates_per_s": round(completed / wall, 3)
+            if wall
+            else 0.0,
+            "ttft_p99_s": round(p99, 4),
+            "scale_outs": fleet_mod.stats.scale_outs,
+            "scale_ins": fleet_mod.stats.scale_ins,
+            "flaps_suppressed": fleet_mod.stats.flaps_suppressed,
+        }
+
+    def run_scale_in(planned: bool) -> tuple[list[str], int]:
+        """The fleet-bench workload with (optionally) a planned
+        scale-in between rounds; returns (transcripts, dup count)."""
+        fleet_mod.reset_stats()
+        n_deb, n_rounds, n_opp = 4, 2, 3
+        params = SamplingParams()
+        engine = FleetEngine(replicas=2, transport="inproc")
+        scaler = Autoscaler(
+            engine,
+            pressure=lambda: {"backlog_tokens": 0, "active_keys": []},
+        )
+        transcripts: list[str] = []
+        try:
+            for r in range(1, n_rounds + 1):
+                for d in range(n_deb):
+                    reqs = [
+                        ChatRequest(
+                            model=f"mock://critic?v={k}",
+                            system="You are an adversarial spec reviewer.",
+                            user=(
+                                f"Debate round {r}\n--- DOCUMENT ---\n"
+                                f"{spec_doc}\n--- END DOCUMENT ---"
+                            ),
+                            affinity_key=f"debate-{d}",
+                        )
+                        for k in range(n_opp)
+                    ]
+                    comps = engine.chat(reqs, params)
+                    if not all(c.ok for c in comps):
+                        raise RuntimeError("mock elastic round failed")
+                    transcripts.extend(c.text for c in comps)
+                if planned and r == 1:
+                    # The planned handoff: drain the least-affine
+                    # replica out of the ring, retire it through the
+                    # lifecycle surgery, keep serving on the survivor.
+                    fleet_mod.configure(min_replicas=1, scale_cooldown_s=0.0)
+                    scaler._scale_in({}, 2, cfg=fleet_mod.config())
+                    if len(engine.router.alive_ids()) != 1:
+                        raise RuntimeError("planned scale-in did not land")
+        finally:
+            scaler.shutdown()
+            dup = fleet_mod.stats.duplicated_completions
+            engine.shutdown()
+        return transcripts, dup
+
+    try:
+        fixed = run_step(elastic=False)
+        elastic = run_step(elastic=True)
+        base_transcripts, base_dup = run_scale_in(planned=False)
+        scaled_transcripts, scaled_dup = run_scale_in(planned=True)
+    finally:
+        fleet_mod.shutdown_fleet()
+        fleet_mod.configure(
+            enabled=old_fleet.enabled,
+            replicas=old_fleet.replicas,
+            transport=old_fleet.transport,
+            autoscale=old_fleet.autoscale,
+            min_replicas=old_fleet.min_replicas,
+            max_replicas=old_fleet.max_replicas,
+            scale_out_fraction=old_fleet.scale_out_fraction,
+            scale_in_fraction=old_fleet.scale_in_fraction,
+            scale_out_ticks=old_fleet.scale_out_ticks,
+            scale_in_ticks=old_fleet.scale_in_ticks,
+            scale_cooldown_s=old_fleet.scale_cooldown_s,
+            scale_interval_s=old_fleet.scale_interval_s,
+        )
+        fleet_mod.reset_stats()
+        serve_mod.configure(
+            max_queue_depth=old_serve["max_queue_depth"],
+            max_backlog_tokens=old_serve["max_backlog_tokens"],
+            tenant_quota_tokens=old_serve["tenant_quota_tokens"],
+            drain_deadline_s=old_serve["drain_deadline_s"],
+        )
+        serve_mod.reset_stats()
+
+    ratio = (
+        elastic["accepted_debates_per_s"] / fixed["accepted_debates_per_s"]
+        if fixed["accepted_debates_per_s"]
+        else 0.0
+    )
+    transcripts_ok = base_transcripts == scaled_transcripts
+    dup_total = base_dup + scaled_dup
+    within = (
+        ratio > 1.0
+        and elastic["scale_outs"] >= 1
+        and transcripts_ok
+        and dup_total == 0
+    )
+    return {
+        "metric": "elastic_accepted_throughput_ratio",
+        "value": round(ratio, 3),
+        "unit": "completed accepted debates/s under a wave-burst load "
+        "step, "
+        "elastic fleet (floor 1, ceiling 3) vs fixed 3-replica fleet "
+        "with single-engine admission caps (equal chip ceiling)",
+        "vs_baseline": None,  # no published elasticity baseline
+        "platform": platform,
+        "within_budget": within,
+        "budget": 1.0,
+        "workload": {
+            "waves": n_waves,
+            "wave_size": wave_size,
+            "wave_gap_ms": 30,
+        },
+        "accepted_throughput_elastic": elastic["accepted_debates_per_s"],
+        "accepted_throughput_fixed": fixed["accepted_debates_per_s"],
+        "ttft_p99_s": {
+            "elastic": elastic["ttft_p99_s"],
+            "fixed": fixed["ttft_p99_s"],
+        },
+        "load_step": {"elastic": elastic, "fixed": fixed},
+        "transcripts_byte_identical": {"scale_in": transcripts_ok},
+        "duplicated_completions": dup_total,
+        "escape_hatch": "--no-fleet (ADVSPEC_FLEET_AUTOSCALE=0)",
+    }
+
+
 def _run_obs_overhead(platform: str) -> dict:
     """Observability overhead bench: what fraction of the mock mixed
     workload's wall the recorder+metrics emit path costs. Budget < 3%
@@ -2189,6 +2479,7 @@ def main() -> int:
     fleet_mode = _mode("fleet")
     serve_mode = _mode("serve")
     residency_mode = _mode("residency")
+    elastic_mode = _mode("elastic")
     if "--no-speculative" in args:
         # Escape hatch mirror of --no-interleave: batcher-driven modes
         # (and any TPU child) decode token-at-a-time.
@@ -2220,6 +2511,8 @@ def main() -> int:
         mode_flag, runner = "--serve", _run_serve
     elif residency_mode:
         mode_flag, runner = "--residency", _run_residency
+    elif elastic_mode:
+        mode_flag, runner = "--elastic", _run_elastic
     else:
         mode_flag, runner = "", _run_bench
 
@@ -2236,7 +2529,7 @@ def main() -> int:
         os.rename(tmp, out_path)
         return 0
 
-    if obs_mode or recover_mode or fleet_mode or serve_mode:
+    if obs_mode or recover_mode or fleet_mode or serve_mode or elastic_mode:
         # Mock-only workloads — no jax, no device, no TPU probe: the
         # obs budget is a CPU host-overhead pin by definition, and the
         # recovery/fleet/serve drills are mock rounds (in-process
@@ -2267,6 +2560,7 @@ def main() -> int:
         or fleet_mode
         or serve_mode
         or residency_mode
+        or elastic_mode
     ):
         # Persist the perf trajectory point alongside the BENCH_r*
         # series the driver records.
@@ -2289,6 +2583,8 @@ def main() -> int:
             if fleet_mode
             else "BENCH_residency.json"
             if residency_mode
+            else "BENCH_elastic.json"
+            if elastic_mode
             else "BENCH_serve.json"
         )
         out = os.path.join(

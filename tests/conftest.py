@@ -123,9 +123,21 @@ def _isolate_state(tmp_path, monkeypatch):
     monkeypatch.delenv("ADVSPEC_FLEET", raising=False)
     monkeypatch.delenv("ADVSPEC_FLEET_REPLICAS", raising=False)
     monkeypatch.delenv("ADVSPEC_FLEET_TRANSPORT", raising=False)
+    monkeypatch.delenv("ADVSPEC_FLEET_AUTOSCALE", raising=False)
+    monkeypatch.delenv("ADVSPEC_FLEET_MIN", raising=False)
+    monkeypatch.delenv("ADVSPEC_FLEET_MAX", raising=False)
+    monkeypatch.delenv("ADVSPEC_FLEET_SCALE_COOLDOWN_S", raising=False)
+    monkeypatch.delenv("ADVSPEC_FLEET_SCALE_INTERVAL_S", raising=False)
     monkeypatch.delenv("ADVSPEC_REPLICA_KILL_AFTER", raising=False)
     fleet.configure(
-        enabled=False, replicas=fleet.DEFAULT_REPLICAS, transport="inproc"
+        enabled=False,
+        replicas=fleet.DEFAULT_REPLICAS,
+        transport="inproc",
+        autoscale=False,
+        min_replicas=fleet.DEFAULT_MIN_REPLICAS,
+        max_replicas=fleet.DEFAULT_MAX_REPLICAS,
+        scale_cooldown_s=fleet.DEFAULT_SCALE_COOLDOWN_S,
+        scale_interval_s=fleet.DEFAULT_SCALE_INTERVAL_S,
     )
     fleet.reset_stats()
     # Streaming config/stats are process-global by design (the CLI arms
@@ -202,7 +214,14 @@ def _isolate_state(tmp_path, monkeypatch):
     serve.reset_stats()
     dispatch.clear_engine_cache()
     fleet.configure(
-        enabled=False, replicas=fleet.DEFAULT_REPLICAS, transport="inproc"
+        enabled=False,
+        replicas=fleet.DEFAULT_REPLICAS,
+        transport="inproc",
+        autoscale=False,
+        min_replicas=fleet.DEFAULT_MIN_REPLICAS,
+        max_replicas=fleet.DEFAULT_MAX_REPLICAS,
+        scale_cooldown_s=fleet.DEFAULT_SCALE_COOLDOWN_S,
+        scale_interval_s=fleet.DEFAULT_SCALE_INTERVAL_S,
     )
     fleet.reset_stats()
     breaker.reset_default_registry()

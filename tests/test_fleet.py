@@ -72,6 +72,36 @@ class TestHashRing:
         ring.add("r1")
         assert {k: ring.primary(k) for k in keys} == before
 
+    def test_add_moves_about_one_over_n_keys_to_the_new_node(self):
+        """The scale-OUT half of the consistent-hashing contract: a
+        node joining an N-1 ring takes ~1/N of the keyspace, every
+        moved key moves TO the newcomer, and nothing else reshuffles."""
+        ring = HashRing(["r0", "r1", "r2"])
+        keys = [f"debate-{i}" for i in range(2000)]
+        before = {k: ring.primary(k) for k in keys}
+        ring.add("r3")
+        moved = [k for k in keys if ring.primary(k) != before[k]]
+        frac = len(moved) / len(keys)
+        assert 0.5 / 4 <= frac <= 2.0 / 4, frac
+        assert all(ring.primary(k) == "r3" for k in moved)
+
+    def test_add_keeps_preference_order_of_existing_nodes(self):
+        """Preference-order stability on add: the newcomer's vnode
+        points interleave into the walk, but the RELATIVE failover
+        order of the pre-existing replicas is untouched for every key
+        (unmoved keys keep their failover order; moved keys keep their
+        old chain right behind the new primary) — a scale-out must not
+        scramble where a later failover would land."""
+        ring = HashRing(["r0", "r1", "r2"])
+        keys = [f"debate-{i}" for i in range(256)]
+        pref_before = {k: ring.preference(k) for k in keys}
+        ring.add("r3")
+        for k in keys:
+            after_without_new = [
+                r for r in ring.preference(k) if r != "r3"
+            ]
+            assert after_without_new == pref_before[k], k
+
     def test_keys_spread_across_replicas(self):
         ring = HashRing(["r0", "r1", "r2"])
         owners = {ring.primary(f"debate-{i}") for i in range(64)}

@@ -1129,6 +1129,7 @@ class TestGraftlint:
             fleet_lifecycle_class="",  # fixture has no fleet machine
             serve_lifecycle_class="",  # fixture has no serve machine
             weightres_lifecycle_class="",  # nor a weight-ledger machine
+            autoscale_lifecycle_class="",  # nor an autoscaler machine
         )
         sources = {
             "pkg/sched.py": (

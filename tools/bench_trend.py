@@ -71,6 +71,20 @@ _RESIDENCY_REQUIRED: dict[str, tuple[type, ...]] = {
     "transcripts_byte_identical": (dict,),
     "unexpected_recompiles": (int,),
 }
+# BENCH_elastic.json additionally pins the elasticity trajectory: the
+# accepted-debate throughput of both load-step arms (the >1x headline
+# must stay decomposable), interactive p99 TTFT per arm (growth must
+# not trade admission for latency collapse), byte-identical transcripts
+# across the planned scale-in, and zero duplicated completions — an
+# elastic bench silently dropping one of these would hide a membership-
+# change regression behind a valid headline ratio.
+_ELASTIC_REQUIRED: dict[str, tuple[type, ...]] = {
+    "accepted_throughput_elastic": (int, float),
+    "accepted_throughput_fixed": (int, float),
+    "ttft_p99_s": (dict,),
+    "transcripts_byte_identical": (dict,),
+    "duplicated_completions": (int,),
+}
 
 
 def _check_fields(
@@ -118,6 +132,21 @@ def validate_bench_file(path: Path) -> tuple[dict | None, list[str]]:
                 problems.append(
                     f"{path.name}: transcripts_byte_identical has a "
                     f"false arm: {ident}"
+                )
+        if mode == "elastic":
+            problems.extend(
+                _check_fields(payload, _ELASTIC_REQUIRED, path.name)
+            )
+            ident = payload.get("transcripts_byte_identical")
+            if isinstance(ident, dict) and not all(ident.values()):
+                problems.append(
+                    f"{path.name}: transcripts_byte_identical has a "
+                    f"false arm: {ident}"
+                )
+            if payload.get("duplicated_completions"):
+                problems.append(
+                    f"{path.name}: duplicated_completions must be 0, "
+                    f"got {payload['duplicated_completions']}"
                 )
         if problems:
             return None, problems

@@ -1088,6 +1088,306 @@ def run_weight_swap(verbose: bool = True) -> tuple[list[str], dict]:
     return failures, payload
 
 
+_SCALE_SPEC = (
+    "## Goals\nAbsorb a demand step without shedding accepted work.\n"
+    "## Constraints\n" + "The fleet SHALL grow before it sheds. " * 10
+)
+_SCALE_MODELS = ["mock://critic?v=1", "mock://critic?v=2"]
+_SCALE_SAMPLE_KEYS = 2000  # affinity keys sampled for ring-movement math
+
+
+def _ring_movement(before: list[str], after: list[str]) -> float:
+    """Fraction of a fixed key sample whose PRIMARY owner changes
+    between two memberships, on real ``HashRing`` instances — the
+    consistent-hashing contract (≈1/N keys move per membership change,
+    not a full reshuffle) measured against the drill's actual replica
+    ids."""
+    from adversarial_spec_tpu.fleet.hashring import HashRing
+
+    ra, rb = HashRing(before), HashRing(after)
+    moved = sum(
+        1
+        for k in range(_SCALE_SAMPLE_KEYS)
+        if ra.primary(f"debate-{k}") != rb.primary(f"debate-{k}")
+    )
+    return moved / _SCALE_SAMPLE_KEYS
+
+
+def run_scale_storm(verbose: bool = True) -> tuple[list[str], dict]:
+    """The elastic-fleet load-step drill (docs/fleet.md "grow before
+    you shed"): an in-process serve daemon with a TIGHT per-replica
+    backlog cap and an elastic fleet (floor 1, ceiling 3) takes an
+    open-loop load step. The contract checked:
+
+    1. scale-out ENGAGES BEFORE any shed (first ScaleEvent precedes
+       the first shed ServeEvent in the flight recorder — capacity
+       grows under pressure before admission refuses);
+    2. zero accepted-request loss across every membership change;
+    3. each membership change moves ≈1/N of the affinity keyspace
+       (consistent hashing, measured on the drill's real rings);
+    4. the backlog's collapse after the step drives scale-IN back to
+       the floor with zero duplicated completions (the lose-nothing
+       drain handoff);
+    5. allocator/tier invariants are clean after the storm (the
+       daemon's ``check`` op).
+
+    Returns (failures, payload); the deterministic mock-clock variant
+    lives in tests/test_autoscale.py under the ``chaos`` marker."""
+    import asyncio
+    import threading
+    import time
+
+    from adversarial_spec_tpu import fleet as fleet_mod
+    from adversarial_spec_tpu import obs as obs_mod
+    from adversarial_spec_tpu import serve as serve_mod
+    from adversarial_spec_tpu.serve.client import ServeClient
+    from adversarial_spec_tpu.serve.daemon import ServeDaemon
+    from adversarial_spec_tpu.serve.protocol import SHED_REASONS
+
+    def say(msg: str) -> None:
+        if verbose:
+            print(f"chaos_run --scale-storm: {msg}", flush=True)
+
+    failures: list[str] = []
+    n_debates = 18
+    old_serve = serve_mod.snapshot()
+    old_fleet = fleet_mod.config()
+    old_obs = obs_mod.config().enabled
+    serve_mod.reset_stats()
+    serve_mod.configure(
+        max_queue_depth=64,
+        max_backlog_tokens=4000,  # PER-REPLICA: elastic cap = N x this
+        tenant_quota_tokens=0,
+        drain_deadline_s=3.0,
+    )
+    fleet_mod.shutdown_fleet()
+    fleet_mod.configure(
+        enabled=True,
+        replicas=1,  # founders start AT the floor
+        transport="inproc",
+        autoscale=True,
+        min_replicas=1,
+        max_replicas=3,
+        scale_out_fraction=0.6,
+        scale_in_fraction=0.15,
+        scale_out_ticks=1,
+        scale_in_ticks=3,
+        scale_cooldown_s=0.1,
+        scale_interval_s=0.01,
+    )
+    fleet_mod.reset_stats()
+    old_ring = obs_mod.config().recorder_size
+    # The ordering + membership assertions replay the WHOLE storm from
+    # the flight recorder; size the ring so step-event volume cannot
+    # age the early scale/shed events out.
+    obs_mod.configure(enabled=True, recorder_size=131072)
+    obs_mod.reset_stats()
+    payload: dict = {}
+    with tempfile.TemporaryDirectory(prefix="advspec-scale-") as td:
+        sock = os.path.join(td, "serve.sock")
+        ready = threading.Event()
+        daemon = ServeDaemon(sock, sessions_dir=os.path.join(td, "sessions"))
+        th = threading.Thread(
+            target=lambda: asyncio.run(daemon.run(ready=ready)), daemon=True
+        )
+        th.start()
+        if not ready.wait(10):
+            return ["daemon did not come up"], {}
+        client = ServeClient(sock, timeout_s=60)
+        try:
+            # The load step: open-loop, but PACED like a demand ramp
+            # (a storm front arrives over tens of milliseconds, not in
+            # one scheduler quantum) — the elasticity claim is "grows
+            # under a step", not "wins a race with a synchronous
+            # burst".
+            t0 = time.monotonic()
+            submitted = []
+            for k in range(n_debates):
+                submitted.append(
+                    client.submit_debate(
+                        _SCALE_SPEC,
+                        _SCALE_MODELS,
+                        tenant=f"t{k % 2}",
+                        tier="batch",
+                        max_new_tokens=160,
+                    )
+                )
+                time.sleep(0.02)
+            say(f"load step submitted: {n_debates} debates, open-loop")
+            accepted = completed = 0
+            shed_reasons: dict[str, int] = {}
+            lost: list[str] = []
+            for rid in submitted:
+                evs = client.collect(rid, timeout_s=120)
+                first, last = evs[0]["event"], evs[-1]
+                if first == "accepted":
+                    accepted += 1
+                    opp_errors = [
+                        r["error"]
+                        for r in last.get("results", [])
+                        if r.get("error")
+                    ]
+                    if (
+                        last["event"] != "result"
+                        or last.get("error")
+                        or opp_errors
+                    ):
+                        lost.append(f"{rid}: {last.get('error') or last['event']}")
+                    else:
+                        completed += 1
+                elif last["event"] == "shed":
+                    reason = last.get("reason", "")
+                    shed_reasons[reason] = shed_reasons.get(reason, 0) + 1
+                    if reason not in SHED_REASONS:
+                        failures.append(f"untyped shed reason {reason!r}")
+                else:
+                    lost.append(f"{rid}: unexpected events {evs}")
+            wall = time.monotonic() - t0
+            # Let the post-step idle drive scale-in BEFORE replaying
+            # the recorder, so the membership history below covers the
+            # whole lifecycle (out AND in).
+            deadline = time.monotonic() + 8.0
+            while (
+                fleet_mod.stats.scale_ins < 1
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.02)
+
+            # 1. the fleet grew, and grew BEFORE any shed.
+            if fleet_mod.stats.scale_outs < 1:
+                failures.append("load step never triggered a scale-out")
+            events = obs_mod.recorder.events()
+            scale_seqs = [
+                e["seq"] for e in events if e["type"] == "scale"
+            ]
+            shed_seqs = [
+                e["seq"]
+                for e in events
+                if e["type"] == "serve" and e["op"] == "shed"
+            ]
+            if shed_seqs and (
+                not scale_seqs or min(scale_seqs) > min(shed_seqs)
+            ):
+                failures.append(
+                    "admission shed before the autoscaler engaged "
+                    f"(first shed seq {min(shed_seqs)}, first scale "
+                    f"seq {min(scale_seqs) if scale_seqs else 'never'})"
+                )
+            # 2. zero accepted-request loss.
+            if lost:
+                failures.append(
+                    f"{len(lost)} accepted request(s) lost: {lost[:3]}"
+                )
+            if accepted + sum(shed_reasons.values()) != n_debates:
+                failures.append("accounting hole in the storm ledger")
+
+            # 3. ≈1/N key movement per membership change, on the real
+            # ring implementation with the drill's replica ids.
+            memberships: list[list[str]] = [["r0"]]
+            for e in events:
+                if e["type"] != "scale":
+                    continue
+                cur = list(memberships[-1])
+                if e["op"] == "serving" and e["replica"] not in cur:
+                    memberships.append(sorted(cur + [e["replica"]]))
+                elif e["op"] == "draining" and e["replica"] in cur:
+                    cur.remove(e["replica"])
+                    memberships.append(cur)
+            movements = []
+            for before, after in zip(memberships, memberships[1:]):
+                frac = _ring_movement(before, after)
+                n_ref = max(len(before), len(after))
+                movements.append(round(frac, 4))
+                if not (0.5 / n_ref) <= frac <= min(1.0, 2.0 / n_ref):
+                    failures.append(
+                        f"membership change {before}->{after} moved "
+                        f"{frac:.0%} of keys (expected ~{1 / n_ref:.0%})"
+                    )
+
+            # 4. the step's collapse drives scale-in back to the
+            # floor, with the lose-nothing drain handoff.
+            if fleet_mod.stats.scale_ins < 1:
+                failures.append("idle fleet never scaled back in")
+            if fleet_mod.stats.duplicated_completions:
+                failures.append(
+                    f"{fleet_mod.stats.duplicated_completions} duplicated "
+                    "completion(s) across membership changes"
+                )
+            # 5. clean invariants after the storm.
+            chk = client.check()
+            if not chk.get("ok"):
+                failures.append(f"invariants violated: {chk.get('problems')}")
+            payload = {
+                "submitted": n_debates,
+                "accepted": accepted,
+                "completed": completed,
+                "shed_reasons": shed_reasons,
+                "scale_outs": fleet_mod.stats.scale_outs,
+                "scale_ins": fleet_mod.stats.scale_ins,
+                "spawn_failures": fleet_mod.stats.spawn_failures,
+                "flaps_suppressed": fleet_mod.stats.flaps_suppressed,
+                "duplicated_completions": (
+                    fleet_mod.stats.duplicated_completions
+                ),
+                "key_movement_per_change": movements,
+                "memberships": [len(m) for m in memberships],
+                "storm_wall_s": round(wall, 3),
+                "invariants_clean": bool(chk.get("ok")),
+                "zero_accepted_lost": not lost,
+            }
+            say(
+                f"{accepted} accepted ({completed} completed), "
+                f"{sum(shed_reasons.values())} shed, "
+                f"{fleet_mod.stats.scale_outs} scale-out(s), "
+                f"{fleet_mod.stats.scale_ins} scale-in(s), "
+                f"key movement {movements}"
+            )
+            client.drain()
+        finally:
+            client.close()
+            th.join(timeout=15)
+            if th.is_alive():
+                failures.append("daemon failed to drain/exit")
+            serve_mod.configure(
+                max_queue_depth=old_serve["max_queue_depth"],
+                max_backlog_tokens=old_serve["max_backlog_tokens"],
+                tenant_quota_tokens=old_serve["tenant_quota_tokens"],
+                drain_deadline_s=old_serve["drain_deadline_s"],
+            )
+            fleet_mod.shutdown_fleet()
+            fleet_mod.configure(
+                enabled=old_fleet.enabled,
+                replicas=old_fleet.replicas,
+                transport=old_fleet.transport,
+                autoscale=old_fleet.autoscale,
+                min_replicas=old_fleet.min_replicas,
+                max_replicas=old_fleet.max_replicas,
+                scale_out_fraction=old_fleet.scale_out_fraction,
+                scale_in_fraction=old_fleet.scale_in_fraction,
+                scale_out_ticks=old_fleet.scale_out_ticks,
+                scale_in_ticks=old_fleet.scale_in_ticks,
+                scale_cooldown_s=old_fleet.scale_cooldown_s,
+                scale_interval_s=old_fleet.scale_interval_s,
+            )
+            fleet_mod.reset_stats()
+            obs_mod.configure(enabled=old_obs, recorder_size=old_ring)
+    return failures, payload
+
+
+def scale_storm_drill(verbose: bool = True) -> int:
+    failures, _ = run_scale_storm(verbose)
+    if failures:
+        print("\n".join(f"FAIL: {f}" for f in failures), file=sys.stderr)
+        return 1
+    if verbose:
+        print(
+            "chaos_run --scale-storm: warm-before-ring growth + "
+            "lose-nothing scale-in + ~1/N ring movement hold",
+            flush=True,
+        )
+    return 0
+
+
 def weight_swap_drill(verbose: bool = True) -> int:
     failures, _ = run_weight_swap(verbose)
     if failures:
@@ -1167,6 +1467,16 @@ def main(argv: list[str] | None = None) -> int:
         "promotes byte-identically",
     )
     ap.add_argument(
+        "--scale-storm",
+        action="store_true",
+        help="elastic-fleet load-step drill: open-loop demand step "
+        "against an autoscaled fleet (floor 1, ceiling 3); assert "
+        "scale-out engages before any shed, zero accepted-request "
+        "loss, ~1/N affinity-key movement per membership change, "
+        "lose-nothing scale-in with zero duplicated completions, and "
+        "clean allocator/tier invariants",
+    )
+    ap.add_argument(
         "--drain",
         action="store_true",
         help="serve SIGTERM drain drill: a real subprocess daemon is "
@@ -1185,6 +1495,8 @@ def main(argv: list[str] | None = None) -> int:
         return replica_kill_drill()
     if args.overload:
         return overload_drill()
+    if args.scale_storm:
+        return scale_storm_drill()
     if args.drain:
         return drain_drill()
     if args.weight_swap:

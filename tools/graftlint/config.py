@@ -337,6 +337,27 @@ class GraftlintConfig:
     weightres_lifecycle_mutators: list[str] = field(
         default_factory=lambda: ["_admit_model"]
     )
+    # The autoscaler's replica-membership state machine
+    # (fleet/autoscale.py), the fifth GL-LIFECYCLE machine: every
+    # terminal transition (aborted warm-up, planned scale-in, orderly
+    # shutdown) must reach the one decommission surgery, and the
+    # member-state ledger is written only by the surgery and the
+    # sanctioned mutators. "" disables (fixture trees).
+    autoscale_lifecycle_class: str = "Autoscaler"
+    autoscale_lifecycle_release: str = "_decommission"
+    autoscale_lifecycle_exits: list[str] = field(
+        default_factory=lambda: [
+            "_abort_warm",
+            "_finish_scale_in",
+            "shutdown",
+        ]
+    )
+    autoscale_lifecycle_owned_attrs: list[str] = field(
+        default_factory=lambda: ["_members"]
+    )
+    autoscale_lifecycle_mutators: list[str] = field(
+        default_factory=lambda: ["_begin_provision", "_advance"]
+    )
 
     def named_lifecycle_machines(
         self,
@@ -386,6 +407,16 @@ class GraftlintConfig:
                     self.weightres_lifecycle_exits,
                     self.weightres_lifecycle_owned_attrs,
                     self.weightres_lifecycle_mutators,
+                ),
+            ),
+            (
+                "autoscale_lifecycle",
+                (
+                    self.autoscale_lifecycle_class,
+                    self.autoscale_lifecycle_release,
+                    self.autoscale_lifecycle_exits,
+                    self.autoscale_lifecycle_owned_attrs,
+                    self.autoscale_lifecycle_mutators,
                 ),
             ),
         ]

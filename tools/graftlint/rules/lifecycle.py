@@ -86,6 +86,7 @@ class LifecycleRule(Rule):
         "fleet_lifecycle_class": "",  # fixture has no fleet machine
         "serve_lifecycle_class": "",  # fixture has no serve machine
         "weightres_lifecycle_class": "",  # nor a weight-ledger machine
+        "autoscale_lifecycle_class": "",  # nor an autoscaler machine
     }
 
     def check(self, ctx: Context) -> None:

@@ -134,6 +134,21 @@ def summarize(events: list[dict]) -> str:
             + (f" ({r['reason']})" if r["reason"] else "")
             + f", {r['alive']} left"
         )
+    scales = [e for e in events if e["type"] == "scale"]
+    if scales:
+        ops: dict[str, int] = {}
+        for s in scales:
+            ops[s["op"]] = ops.get(s["op"], 0) + 1
+        lines.append(
+            "  autoscaler: "
+            + ", ".join(f"{op}={n}" for op, n in sorted(ops.items()))
+        )
+        if ops.get("spawn_failed"):
+            lines.append(
+                f"  WARNING: {ops['spawn_failed']} scale-out(s) aborted "
+                "before ring admission (spawn/warm failure; no request "
+                "ever routed there)"
+            )
     serve = [e for e in events if e["type"] == "serve"]
     if serve:
         sheds: dict[str, int] = {}
@@ -195,6 +210,7 @@ def occupancy_timeline(events: list[dict], width: int = 16) -> str:
             "cancel",
             "route",
             "replica",
+            "scale",
             "serve",
         )
     ]
@@ -205,7 +221,9 @@ def occupancy_timeline(events: list[dict], width: int = 16) -> str:
     )
     scale = max(max_live, 1)
     tiered = any(e["type"] == "swap" for e in steps)
-    fleet = any(e["type"] in ("route", "replica") for e in steps)
+    fleet = any(
+        e["type"] in ("route", "replica", "scale") for e in steps
+    )
     serving = any(e["type"] == "serve" for e in steps)
     rows = []
     host_res = disk_res = 0
@@ -286,6 +304,27 @@ def occupancy_timeline(events: list[dict], width: int = 16) -> str:
                 f"seq {s['seq']:>6} [{'>' * width}] "
                 f"{'route>' + s['replica']:<13} "
                 f"{s['reason']} " + " ".join(notes)
+            )
+            continue
+        if s["type"] == "scale":
+            # Autoscaler lifecycle transitions inline: which replica
+            # moved through which elasticity state, under what backlog,
+            # and the desired-vs-alive membership it left behind.
+            rows.append(
+                f"seq {s['seq']:>6} [{'~' * width}] "
+                f"{'scale:' + s['op']:<13} "
+                + " ".join(
+                    n
+                    for n in (
+                        s["replica"],
+                        s["direction"] and f"dir={s['direction']}",
+                        s["reason"],
+                        f"desired={s['desired']}",
+                        f"alive={s['alive']}",
+                        f"backlog={s['backlog_tokens']}",
+                    )
+                    if n
+                )
             )
             continue
         if s["type"] == "replica":
@@ -370,6 +409,11 @@ def occupancy_timeline(events: list[dict], width: int = 16) -> str:
         + ("; >=span begin <=span end" if spanned else "")
         + ("; x=early cancel" if cancelled else "")
         + ("; rep=last routed replica, !=replica lifecycle" if fleet else "")
+        + (
+            "; ~=autoscaler transition (desired vs alive)"
+            if any(e["type"] == "scale" for e in steps)
+            else ""
+        )
         + (
             "; ten=last running tenant, +=serve admit/finish, "
             "x=shed/preempt/drain, !=brownout"

@@ -207,6 +207,33 @@ def critical_path(requests: dict[str, dict]) -> str:
     return "\n".join(lines) if lines else "(no traced requests)"
 
 
+def membership_changes(events: list[dict]) -> str:
+    """Autoscaler transitions in seq order — fleet membership changing
+    UNDER the waterfall explains a latency cliff (a request queued
+    while the fleet was one replica short) without leaving the view."""
+    scales = [e for e in events if e["type"] == "scale"]
+    if not scales:
+        return ""
+    lines = ["membership changes:"]
+    for s in sorted(scales, key=lambda e: e["seq"]):
+        lines.append(
+            f"  seq {s['seq']:>6} {s['op']:<12} "
+            + " ".join(
+                n
+                for n in (
+                    s["replica"],
+                    s["direction"] and f"dir={s['direction']}",
+                    s["reason"],
+                    f"desired={s['desired']}",
+                    f"alive={s['alive']}",
+                    f"backlog={s['backlog_tokens']}",
+                )
+                if n
+            )
+        )
+    return "\n".join(lines)
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", help="events JSONL file to render")
@@ -250,6 +277,10 @@ def main(argv: list[str] | None = None) -> int:
         print(render_waterfall(requests))
         print()
         print(critical_path(requests))
+        scales = membership_changes(events)
+        if scales:
+            print()
+            print(scales)
     for p in problems:
         print(f"trace_view: DECOMPOSITION VIOLATION: {p}", file=sys.stderr)
     if problems or errors:
