@@ -197,6 +197,7 @@ prefill_chunk = partial(
         "top_k",
         "use_top_p",
         "use_pallas_decode",
+        "use_pallas_matmul",
         "pallas_interpret",
         "mesh",
     ),
@@ -223,6 +224,7 @@ def decode_chunk_steps(
     top_k: int,
     use_top_p: bool = True,
     use_pallas_decode: bool = False,
+    use_pallas_matmul: bool = False,
     pallas_interpret: bool = False,
     mesh=None,
 ) -> tuple[Cache, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
@@ -259,6 +261,7 @@ def decode_chunk_steps(
             cache_index,
             kv_valid,
             use_pallas_decode=use_pallas_decode,
+            use_pallas_matmul=use_pallas_matmul,
             pallas_interpret=pallas_interpret,
             mesh=mesh,
         )
@@ -311,6 +314,7 @@ def generate(
     timeout_s: float = 0.0,
     mesh=None,
     use_pallas_decode: bool | None = None,
+    use_pallas_matmul: bool | None = None,
     share_prefix: bool = True,
     paged: bool = False,
     page_size: int = 128,
@@ -391,6 +395,18 @@ def generate(
             and total_len >= PALLAS_DECODE_MIN_T
         )
     pallas_interpret = jax.default_backend() == "cpu"
+    # Fused dequant-matmul (ops/pallas_quant.py): auto = real TPU. Either
+    # way it only engages when the params actually carry quantized
+    # leaves, and only single-device (models/transformer.py gates on the
+    # mesh) — CPU callers opt in explicitly to run the kernels under
+    # interpret mode (the parity harness).
+    from adversarial_spec_tpu.ops.quant import has_quantized_weights
+
+    if use_pallas_matmul is None:
+        use_pallas_matmul = jax.default_backend() == "tpu"
+    use_pallas_matmul = bool(use_pallas_matmul) and has_quantized_weights(
+        params
+    )
     if use_pallas_decode and mesh is not None and mesh.size > 1:
         from adversarial_spec_tpu.ops.pallas_decode import (
             tp_decode_supported,
@@ -1091,6 +1107,7 @@ def generate(
                 top_k=top_k,
                 use_top_p=use_top_p,
                 use_pallas=use_paged_kernel,
+                use_pallas_matmul=use_pallas_matmul,
                 pallas_interpret=pallas_interpret,
             )
             chunk_args = (
@@ -1182,6 +1199,7 @@ def generate(
                     top_k=top_k,
                     use_top_p=use_top_p,
                     use_pallas_decode=use_pallas_decode,
+                    use_pallas_matmul=use_pallas_matmul,
                     pallas_interpret=pallas_interpret,
                     mesh=mesh
                     if (mesh is not None and mesh.size > 1)

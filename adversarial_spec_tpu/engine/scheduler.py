@@ -96,6 +96,7 @@ from adversarial_spec_tpu.engine.kvcache import (
 )
 from adversarial_spec_tpu.engine.sampling import sample_tokens
 from adversarial_spec_tpu.models.config import ModelConfig
+from adversarial_spec_tpu.ops import quant
 from adversarial_spec_tpu.models.transformer import (
     forward_paged_decode,
     init_cache,
@@ -253,6 +254,7 @@ def _decode_chunk_impl(
     top_k: int,
     use_top_p: bool = True,
     use_pallas: bool = False,
+    use_pallas_matmul: bool = False,
     pallas_interpret: bool = False,
     mesh=None,
 ):
@@ -298,6 +300,7 @@ def _decode_chunk_impl(
             bounds,
             q_pos,
             use_pallas=use_pallas,
+            use_pallas_matmul=use_pallas_matmul,
             pallas_interpret=pallas_interpret,
             mesh=mesh,
         )
@@ -351,6 +354,7 @@ scheduler_decode_chunk = partial(
         "top_k",
         "use_top_p",
         "use_pallas",
+        "use_pallas_matmul",
         "pallas_interpret",
         "mesh",
     ),
@@ -367,6 +371,7 @@ scheduler_decode_chunk = partial(
         "top_k",
         "use_top_p",
         "use_pallas",
+        "use_pallas_matmul",
         "pallas_interpret",
         "mesh",
     ),
@@ -398,6 +403,7 @@ def fused_prefill_decode_chunk(
     top_k: int,
     use_top_p: bool = True,
     use_pallas: bool = False,
+    use_pallas_matmul: bool = False,
     pallas_interpret: bool = False,
     mesh=None,
 ):
@@ -444,6 +450,7 @@ def fused_prefill_decode_chunk(
         top_k=top_k,
         use_top_p=use_top_p,
         use_pallas=use_pallas,
+        use_pallas_matmul=use_pallas_matmul,
         pallas_interpret=pallas_interpret,
         mesh=mesh,
     )
@@ -485,6 +492,7 @@ def _spec_chunk_impl(
     top_k: int,
     use_top_p: bool = True,
     use_pallas: bool = False,
+    use_pallas_matmul: bool = False,
     pallas_interpret: bool = False,
     mesh=None,
 ):
@@ -496,11 +504,14 @@ def _spec_chunk_impl(
     distribution (``accept_spans`` — the dense path's accept math, so
     greedy output stays byte-identical to plain decode).
 
-    The verification forward IS ``forward_paged_decode`` — the γ+1
-    positions flatten into its batch axis (tokens [B·span, 1], each
-    flattened row carrying its own write target and attention bounds),
-    so the verify program shares the decode chunk's traced body the way
-    ``fused_prefill_decode_chunk`` shares the prefill's. In-span
+    The verification forward IS ``forward_paged_decode`` — called
+    span-native (tokens [B, γ+1], each position carrying its own write
+    target and attention bounds), so the verify program shares the
+    decode chunk's traced body the way ``fused_prefill_decode_chunk``
+    shares the prefill's, and the Pallas route rides the multi-position
+    paged kernel (ops/pallas_paged.py:paged_decode_attention_mq — one
+    pass over the row's pages for the whole span, where the pre-PR-17
+    batch-axis flatten re-gathered the pool γ+1 times). In-span
     causality comes from the bounds: position i's window ends at its own
     slot, and every span position's K/V is scattered before attention in
     each layer, so position i sees exactly [pad, cur_len+i).
@@ -559,23 +570,23 @@ def _spec_chunk_impl(
     ).astype(jnp.int32)  # [B, span, 2]
     positions = q_pos - pad_lens[:, None]
 
-    # --- Verify: the single-token paged forward with batch = B·span. ---
+    # --- Verify: the paged forward, span-native ([B, γ+1] positions). ---
     logits, pool = forward_paged_decode(
         params,
         cfg,
-        toks.reshape(B * span, 1),
-        positions.reshape(B * span, 1),
+        toks,
+        positions,
         pool,
-        jnp.repeat(page_table, span, axis=0),
-        write_page.reshape(-1),
-        write_off.reshape(-1),
-        bounds.reshape(B * span, 2),
-        q_pos.reshape(-1),
+        page_table,
+        write_page,
+        write_off,
+        bounds,
+        q_pos,
         use_pallas=use_pallas,
+        use_pallas_matmul=use_pallas_matmul,
         pallas_interpret=pallas_interpret,
         mesh=mesh,
     )
-    logits = logits.reshape(B, span, -1)
 
     # --- Accept by rejection sampling against the true distribution. ---
     filt = filtered_logits(
@@ -669,6 +680,7 @@ scheduler_spec_chunk = partial(
         "top_k",
         "use_top_p",
         "use_pallas",
+        "use_pallas_matmul",
         "pallas_interpret",
         "mesh",
     ),
@@ -685,6 +697,7 @@ scheduler_spec_chunk = partial(
         "top_k",
         "use_top_p",
         "use_pallas",
+        "use_pallas_matmul",
         "pallas_interpret",
         "mesh",
     ),
@@ -720,6 +733,7 @@ def fused_prefill_spec_chunk(
     top_k: int,
     use_top_p: bool = True,
     use_pallas: bool = False,
+    use_pallas_matmul: bool = False,
     pallas_interpret: bool = False,
     mesh=None,
 ):
@@ -770,6 +784,7 @@ def fused_prefill_spec_chunk(
         top_k=top_k,
         use_top_p=use_top_p,
         use_pallas=use_pallas,
+        use_pallas_matmul=use_pallas_matmul,
         pallas_interpret=pallas_interpret,
         mesh=mesh,
     )
@@ -932,6 +947,7 @@ class ContinuousBatcher:
         step_tokens: int = 0,
         speculative: bool | None = None,
         gamma: int | None = None,
+        use_pallas_matmul: bool | None = None,
     ):
         self.params = params
         self.cfg = cfg
@@ -1056,6 +1072,16 @@ class ContinuousBatcher:
         # Fused paged kernel on real TPUs; gather path elsewhere.
         self._use_pallas = jax.default_backend() == "tpu"
         self._pallas_interpret = jax.default_backend() == "cpu"
+        # Fused dequant-matmul (ops/pallas_quant.py) whenever the params
+        # actually carry quantized leaves: on real TPUs by default, or
+        # opted in anywhere via ``use_pallas_matmul`` (CPU runs the same
+        # kernels under interpret mode — the parity harness). A
+        # full-precision checkpoint never routes through the kernels.
+        if use_pallas_matmul is None:
+            use_pallas_matmul = jax.default_backend() == "tpu"
+        self._use_pallas_matmul = bool(use_pallas_matmul) and (
+            quant.has_quantized_weights(params)
+        )
 
         B, cap = self.B, max_new_cap
         self.cap = cap
@@ -2751,6 +2777,7 @@ class ContinuousBatcher:
             top_k=self.top_k,
             use_top_p=self._use_top_p,
             use_pallas=self._use_pallas,
+            use_pallas_matmul=self._use_pallas_matmul,
             pallas_interpret=self._pallas_interpret,
         )
         adm.cache, adm.last_logits = adm_cache, adm_logits
@@ -2796,6 +2823,7 @@ class ContinuousBatcher:
             top_k=self.top_k,
             use_top_p=self._use_top_p,
             use_pallas=self._use_pallas,
+            use_pallas_matmul=self._use_pallas_matmul,
             pallas_interpret=self._pallas_interpret,
         )
         interleave_mod.stats.record_step(fused=False)
@@ -2954,6 +2982,7 @@ class ContinuousBatcher:
                 top_k=self.top_k,
                 use_top_p=self._use_top_p,
                 use_pallas=self._use_pallas,
+                use_pallas_matmul=self._use_pallas_matmul,
                 pallas_interpret=self._pallas_interpret,
             )
             adm.cache, adm.last_logits = adm_cache, adm_logits
@@ -3010,6 +3039,7 @@ class ContinuousBatcher:
                 top_k=self.top_k,
                 use_top_p=self._use_top_p,
                 use_pallas=self._use_pallas,
+                use_pallas_matmul=self._use_pallas_matmul,
                 pallas_interpret=self._pallas_interpret,
             )
             interleave_mod.stats.record_step(fused=False)

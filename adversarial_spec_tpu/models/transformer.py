@@ -36,6 +36,7 @@ star).
 
 from __future__ import annotations
 
+import functools
 import math
 import os
 from typing import Any
@@ -235,11 +236,15 @@ def attention(
     return out.reshape(B, S, Hq, D)
 
 
-def _project_qkv(lp, cfg: ModelConfig, h, B: int, S: int, cos, sin):
-    """Shared QKV projection + bias + head reshape + RoPE (dense & paged)."""
-    q = matmul(h, lp["wq"])
-    k = matmul(h, lp["wk"])
-    v = matmul(h, lp["wv"])
+def _project_qkv(lp, cfg: ModelConfig, h, B: int, S: int, cos, sin, mm=matmul):
+    """Shared QKV projection + bias + head reshape + RoPE (dense & paged).
+
+    ``mm`` is the matmul implementation — the plain dispatch by default,
+    or a partial carrying ``use_pallas``/``interpret`` when the caller
+    enables the fused dequant-matmul kernels (ops/pallas_quant.py)."""
+    q = mm(h, lp["wq"])
+    k = mm(h, lp["wk"])
+    v = mm(h, lp["wv"])
     if cfg.qkv_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -253,7 +258,8 @@ def _project_qkv(lp, cfg: ModelConfig, h, B: int, S: int, cos, sin):
 
 
 def _attn_out_and_ffn(
-    x, attn_out, lp, cfg: ModelConfig, B: int, S: int, psum_axis=None
+    x, attn_out, lp, cfg: ModelConfig, B: int, S: int, psum_axis=None,
+    mm=matmul,
 ):
     """Shared post-attention projection, residuals, and FFN block.
 
@@ -262,8 +268,10 @@ def _attn_out_and_ffn(
     w_down) produce partial sums that must all-reduce over the tp axis —
     BEFORE any post-norm reads them (norms of partial sums are wrong).
     Under GSPMD (jit) leave it None; the compiler inserts the psums.
+
+    ``mm``: matmul implementation (see ``_project_qkv``).
     """
-    out = matmul(
+    out = mm(
         attn_out.reshape(B, S, cfg.n_heads * cfg.head_dim), lp["wo"]
     )
     if psum_axis is not None:
@@ -275,10 +283,10 @@ def _attn_out_and_ffn(
     x = x + out
 
     h = rms_norm(x, lp["ffn_norm"], cfg.rms_eps, cfg.norm_scale_plus_one)
-    ff = _activation(matmul(h, lp["w_gate"]), cfg.activation) * matmul(
+    ff = _activation(mm(h, lp["w_gate"]), cfg.activation) * mm(
         h, lp["w_up"]
     )
-    ff = matmul(ff, lp["w_down"])
+    ff = mm(ff, lp["w_down"])
     if psum_axis is not None:
         ff = jax.lax.psum(ff, psum_axis)
     if cfg.post_norms:
@@ -310,6 +318,7 @@ def forward(
     kv_valid: jnp.ndarray,  # [B, T] bool: slots holding real tokens
     *,
     use_pallas_decode: bool = False,
+    use_pallas_matmul: bool = False,
     pallas_interpret: bool = False,
     lm_head_last_only: bool = False,
     mesh=None,
@@ -328,9 +337,20 @@ def forward(
     flash-decoding kernel (ops/pallas_decode.py). On a multi-device
     ``mesh`` the kernel runs under shard_map — batch over dp, KV heads
     over tp (ops/pallas_decode.py:decode_attention_tp); callers gate on
-    ``tp_decode_supported``.
+    ``tp_decode_supported``. ``use_pallas_matmul`` routes quantized
+    projection/MLP/head weights through the fused dequant-matmul kernels
+    (ops/pallas_quant.py) — single-device only (a pallas_call cannot be
+    GSPMD-partitioned, and the matmul weights shard under jit), so
+    callers gate on ``mesh is None or mesh.size == 1``.
     """
     B, S = tokens.shape
+    mm = (
+        functools.partial(
+            matmul, use_pallas=True, interpret=pallas_interpret
+        )
+        if use_pallas_matmul and (mesh is None or mesh.size == 1)
+        else matmul
+    )
     T = cache["k"].shape[3]  # [L, B, Hkv, T, D]
     pallas_decode = use_pallas_decode and S == 1
     # Short multi-query spans (speculative verification: S = γ+1) run
@@ -435,7 +455,7 @@ def forward(
     def layer_body(x, scanned):
         lp, layer_id, cache_l = scanned
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps, cfg.norm_scale_plus_one)
-        q, k, v = _project_qkv(lp, cfg, h, B, S, cos, sin)
+        q, k, v = _project_qkv(lp, cfg, h, B, S, cos, sin, mm=mm)
         cache_l, k_read, v_read = _write_and_read_kv(cache_l, k, v, x.dtype)
 
         if pallas_decode:
@@ -528,7 +548,7 @@ def forward(
                 attn_softcap=cfg.attn_softcap,
                 scale=cfg.attn_scale,
             )
-        x = _attn_out_and_ffn(x, out, lp, cfg, B, S)
+        x = _attn_out_and_ffn(x, out, lp, cfg, B, S, mm=mm)
         return x, cache_l
 
     # The cache dict scans as a pytree: every leaf carries a leading
@@ -541,12 +561,12 @@ def forward(
         unroll=_DECODE_UNROLL if S <= _DECODE_UNROLL_MAX_SPAN else 1,
     )
 
-    logits = _lm_head_logits(params, cfg, x, lm_head_last_only)
+    logits = _lm_head_logits(params, cfg, x, lm_head_last_only, mm=mm)
     return logits, new_cache
 
 
 def _lm_head_logits(
-    params: Params, cfg: ModelConfig, x, lm_head_last_only: bool
+    params: Params, cfg: ModelConfig, x, lm_head_last_only: bool, mm=matmul
 ):
     x = rms_norm(x, params["final_norm"], cfg.rms_eps, cfg.norm_scale_plus_one)
     if lm_head_last_only:
@@ -558,7 +578,7 @@ def _lm_head_logits(
             # Pre-transposed [D, V] copy (init_params/loader): contracts
             # the major axis at full HBM bandwidth instead of relayouting
             # the embed table every decode step.
-            logits = matmul(
+            logits = mm(
                 x, params["lm_head_t"], preferred_element_type=jnp.float32
             )
         else:
@@ -569,7 +589,7 @@ def _lm_head_logits(
                 preferred_element_type=jnp.float32,
             )
     else:
-        logits = matmul(
+        logits = mm(
             x, params["lm_head"], preferred_element_type=jnp.float32
         )
     if cfg.logit_softcap > 0.0:
@@ -580,34 +600,49 @@ def _lm_head_logits(
 def forward_paged_decode(
     params: Params,
     cfg: ModelConfig,
-    tokens: jnp.ndarray,  # [B, 1] int32 — single decode step
-    positions: jnp.ndarray,  # [B, 1] rope positions
+    tokens: jnp.ndarray,  # [B, S] int32 — decode step (S=1) or a short
+    # multi-position verify span (S=γ+1, speculative decoding)
+    positions: jnp.ndarray,  # [B, S] rope positions
     pool: Cache,  # {"k","v": [L, n_pages, Hkv, page_size, D]} (+"ks"/"vs"
     # [..., 1] f32 scale pages when the pool is int8)
     page_table: jnp.ndarray,  # [B, Pmax] int32; <= 0 = unmapped (0=trash)
-    write_page: jnp.ndarray,  # [B] physical page for this token's KV
-    write_off: jnp.ndarray,  # [B] slot within that page
-    bounds: jnp.ndarray,  # [B, 2] (start, end) valid logical-slot window
-    q_pos: jnp.ndarray,  # scalar or [B]: logical slot of this token
+    write_page: jnp.ndarray,  # [B(, S)] physical page per token's KV
+    write_off: jnp.ndarray,  # [B(, S)] slot within that page
+    bounds: jnp.ndarray,  # [B(, S), 2] (start, end) valid-slot window
+    q_pos: jnp.ndarray,  # scalar, [B], or [B, S]: logical slot per token
     *,
     use_pallas: bool = False,
+    use_pallas_matmul: bool = False,
     pallas_interpret: bool = False,
     mesh=None,
 ) -> tuple[jnp.ndarray, Cache]:
-    """One decode step over the PAGED KV pool.
+    """One decode step (or one multi-position verify span) over the
+    PAGED KV pool.
 
-    Same math as ``forward`` with S=1 (shared helpers), but K/V live in
-    pages shared across rows: the new token's K/V scatters to
-    (write_page[b], write_off[b]) and attention reads through the page
-    table — the fused Pallas kernel on real TPUs, a gather + masked jnp
-    reference path elsewhere (both against the same bounds semantics).
-    Returns (logits [B, 1, vocab], updated pool).
+    Same math as ``forward`` with short S (shared helpers), but K/V live
+    in pages shared across rows: token (b, j)'s K/V scatters to
+    (write_page[b, j], write_off[b, j]) and attention reads through the
+    page table — fused Pallas kernels on real TPUs (S=1:
+    paged_decode_attention; S>1: paged_decode_attention_mq, one pass
+    over the pool for the whole span), a gather + masked jnp reference
+    path elsewhere (same bounds semantics on every path).
+    Returns (logits [B, S, vocab], updated pool).
 
-    On a multi-device ``mesh`` the kernel runs under shard_map with the
-    pool's head axis tp-sharded (ops/pallas_paged.py:
+    In-span causality (S>1, the speculative verify shape) comes from the
+    per-query bounds: position j's window ends at its own slot
+    (``bounds[b, j, 1] = q_pos[b, j] + 1``), and every span position's
+    K/V scatters before attention in each layer, so position j sees
+    exactly [start, q_pos_bj + 1) — byte-compatible with flattening the
+    span into the batch axis, without paying B·span densifications.
+
+    On a multi-device ``mesh`` the S=1 kernel runs under shard_map with
+    the pool's head axis tp-sharded (ops/pallas_paged.py:
     paged_decode_attention_tp); callers gate on tp | n_kv_heads. The
-    non-kernel math (projections, scatter, gather path) partitions
-    under GSPMD as usual.
+    multi-position kernel is single-device (sharded spans take the
+    gather path). The non-kernel math (projections, scatter, gather
+    path) partitions under GSPMD as usual. ``use_pallas_matmul`` routes
+    quantized weights through the fused dequant-matmul kernels
+    (ops/pallas_quant.py) — single-device, like ``forward``.
 
     Composition contract: this function and ``forward`` are pure
     traceable graphs over disjoint state (the paged pool here, a dense
@@ -618,10 +653,25 @@ def forward_paged_decode(
     that would make the fused composition diverge from the standalone
     dispatches.
     """
-    B = tokens.shape[0]
+    B, S = tokens.shape
     page_size = pool["k"].shape[3]
     layer_ids = jnp.arange(cfg.n_layers)
     quant_kv = "ks" in pool  # int8 pages + per-(token, head) scale pages
+    single_device = mesh is None or mesh.size == 1
+    # The S=1 legacy calling convention passes [B]/[B,2]/scalar shapes;
+    # normalize everything to the per-(row, span-position) layout.
+    write_page = write_page.reshape(B, S)
+    write_off = write_off.reshape(B, S)
+    bounds = bounds.reshape(B, S, 2)
+    if jnp.ndim(q_pos) <= 1:
+        q_pos = jnp.broadcast_to(jnp.reshape(q_pos, (-1, 1)), (B, S))
+    mm = (
+        functools.partial(
+            matmul, use_pallas=True, interpret=pallas_interpret
+        )
+        if use_pallas_matmul and single_device
+        else matmul
+    )
     cos, sin = rope_angles(
         positions, cfg.head_dim, cfg.rope_theta, cfg.rope_scaling
     )
@@ -630,46 +680,56 @@ def forward_paged_decode(
     if cfg.scale_embeddings:
         x = (x.astype(jnp.float32) * math.sqrt(cfg.dim)).astype(x.dtype)
 
+    flat_page = write_page.reshape(-1)
+    flat_off = write_off.reshape(-1)
+
     def layer_body(x, scanned):
         lp, layer_id, pool_l = scanned
         k_pages, v_pages = pool_l["k"], pool_l["v"]
         ks_pages = pool_l.get("ks")
         vs_pages = pool_l.get("vs")
         h = rms_norm(x, lp["attn_norm"], cfg.rms_eps, cfg.norm_scale_plus_one)
-        q, k, v = _project_qkv(lp, cfg, h, B, 1, cos, sin)
+        q, k, v = _project_qkv(lp, cfg, h, B, S, cos, sin, mm=mm)
 
         # Pages are heads-major [n_pages, Hkv, page_size, D]; advanced
         # indices (write_page at dim 0, write_off at dim 2) separated by
-        # the head slice put the batch axis first → update [B, Hkv, D].
+        # the head slice put the flattened (row, span) axis first →
+        # update [B·S, Hkv, D]. One scatter per layer regardless of span
+        # width (rejected-draft targets are the trash page, never read).
+        kf = k.reshape(B * S, cfg.n_kv_heads, cfg.head_dim)
+        vf = v.reshape(B * S, cfg.n_kv_heads, cfg.head_dim)
         if quant_kv:
-            kq, ks = _quantize_kv(k[:, 0])  # [B, Hkv, D], [B, Hkv, 1]
-            vq, vs = _quantize_kv(v[:, 0])
-            k_pages = k_pages.at[write_page, :, write_off].set(kq)
-            v_pages = v_pages.at[write_page, :, write_off].set(vq)
-            ks_pages = ks_pages.at[write_page, :, write_off].set(ks)
-            vs_pages = vs_pages.at[write_page, :, write_off].set(vs)
+            kq, ks = _quantize_kv(kf)  # [B·S, Hkv, D], [B·S, Hkv, 1]
+            vq, vs = _quantize_kv(vf)
+            k_pages = k_pages.at[flat_page, :, flat_off].set(kq)
+            v_pages = v_pages.at[flat_page, :, flat_off].set(vq)
+            ks_pages = ks_pages.at[flat_page, :, flat_off].set(ks)
+            vs_pages = vs_pages.at[flat_page, :, flat_off].set(vs)
         else:
-            k_pages = k_pages.at[write_page, :, write_off].set(
-                k[:, 0].astype(k_pages.dtype)
+            k_pages = k_pages.at[flat_page, :, flat_off].set(
+                kf.astype(k_pages.dtype)
             )
-            v_pages = v_pages.at[write_page, :, write_off].set(
-                v[:, 0].astype(v_pages.dtype)
+            v_pages = v_pages.at[flat_page, :, flat_off].set(
+                vf.astype(v_pages.dtype)
             )
 
-        start = _layer_window_start(cfg, layer_id, bounds[:, 0], q_pos)
-        layer_bounds = jnp.stack([start, bounds[:, 1]], axis=1)
+        start = _layer_window_start(
+            cfg, layer_id, bounds[..., 0], q_pos
+        )  # [B, S]
+        end = bounds[..., 1]  # [B, S]
 
-        if use_pallas:
+        if use_pallas and S == 1:
             from adversarial_spec_tpu.ops.pallas_paged import (
                 paged_decode_attention,
                 paged_decode_attention_dp_tp,
                 paged_decode_attention_tp,
             )
 
+            layer_bounds = jnp.stack([start[:, 0], end[:, 0]], axis=1)
             qkw = (
                 dict(k_scale=ks_pages, v_scale=vs_pages) if quant_kv else {}
             )
-            if mesh is not None and mesh.size > 1:
+            if not single_device:
                 from adversarial_spec_tpu.parallel.mesh import DP as _DPAX
 
                 # Mixed dp×tp meshes shard rows + page slabs over dp as
@@ -705,8 +765,33 @@ def forward_paged_decode(
                     interpret=pallas_interpret,
                     **qkw,
                 )[:, None]
+        elif use_pallas and single_device:
+            from adversarial_spec_tpu.ops.pallas_paged import (
+                paged_decode_attention_mq,
+            )
+
+            # Multi-position span: the γ+1 queries of each row fold into
+            # one grid pass over the row's pages, each under its OWN
+            # [start, end) window (in-span causality).
+            out = paged_decode_attention_mq(
+                q,
+                k_pages,
+                v_pages,
+                page_table,
+                start,
+                end,
+                attn_softcap=cfg.attn_softcap,
+                scale=cfg.attn_scale,
+                interpret=pallas_interpret,
+                **(
+                    dict(k_scale=ks_pages, v_scale=vs_pages)
+                    if quant_kv
+                    else {}
+                ),
+            )
         else:
-            # Gather reference path: page table → dense [B, Hkv, T, D].
+            # Gather reference path: page table → dense [B, Hkv, T, D]
+            # (densified ONCE per row — the whole span reads it).
             safe_table = jnp.maximum(page_table, 0)
 
             def to_dense(pages):  # [B, P, Hkv, page, *] → [B, Hkv, T, *]
@@ -737,9 +822,9 @@ def forward_paged_decode(
             )[:, None, :]
             mask = (
                 mapped
-                & (slot >= start[:, None, None])
-                & (slot < layer_bounds[:, 1][:, None, None])
-            )
+                & (slot >= start[..., None])
+                & (slot < end[..., None])
+            )  # [B, S, T]
             out = attention(
                 q,
                 k_dense,
@@ -748,7 +833,7 @@ def forward_paged_decode(
                 attn_softcap=cfg.attn_softcap,
                 scale=cfg.attn_scale,
             )
-        x = _attn_out_and_ffn(x, out, lp, cfg, B, 1)
+        x = _attn_out_and_ffn(x, out, lp, cfg, B, S, mm=mm)
         new_l = {"k": k_pages, "v": v_pages}
         if quant_kv:
             new_l.update(ks=ks_pages, vs=vs_pages)

@@ -7,8 +7,10 @@ fixed-size pages [n_pages, Hkv, page_size, D] shared by all sequences, and
 each row owns an ordered page list (the page table). Debate rounds grow
 sequences at different rates (opponents finish at different lengths), so
 paging keeps HBM occupancy at O(tokens actually written) and makes
-prefix-sharing across opponents possible (same spec prompt → same pages,
-a planned optimization).
+prefix-sharing across opponents real: same spec prompt → same physical
+pages, refcounted by engine/prefix_cache.py (shipped in PR 2 — rows
+whose tables alias a cached prefix read it through this kernel like any
+other page).
 
 Kernel shape: grid (B, n_pages_per_seq); the page table rides in as a
 scalar-prefetch operand so each grid step's BlockSpec ``index_map`` selects
@@ -20,6 +22,13 @@ Hkv× fewer sequential programs and Hkv× larger DMAs than the round-2
 (B, Hkv, P) grid. Online-softmax state (m, l, acc) persists in VMEM
 scratch across the sequential innermost grid dimension: initialized at
 page 0, finalized and written at the last page.
+
+Two entry shapes share that design: ``paged_decode_attention`` (S=1, one
+query token per row — the decode hot loop) and
+``paged_decode_attention_mq`` (a short S=γ+1 query span per row with
+per-position causal bounds — speculative verify reads the pool ONCE for
+the whole span instead of flattening the span into the batch axis and
+re-gathering γ+1 times).
 
 Tested under ``interpret=True`` on CPU against the dense jnp reference
 (tests/test_pallas.py).
@@ -185,6 +194,179 @@ def paged_decode_attention(
     )(bounds, page_table, *operands)
 
     return out[:, :, :g, :].reshape(B, Hq, D)
+
+
+def _paged_mq_attn_kernel(
+    table_ref,  # SMEM [B, P]: physical page id per (row, logical page)
+    bounds_ref,  # VMEM [1, G8, 2]: per query-row [start, end). VMEM, not
+    # SMEM scalar-prefetch: Mosaic only loads SCALARS from SMEM and this
+    # kernel needs the whole per-query bounds vector (the _mq_attn_kernel
+    # pattern from ops/pallas_decode.py).
+    q_ref,  # VMEM [1, Hkv, G8, D] — G8 = pad(S·g) query rows per head
+    k_ref,  # VMEM [1, Hkv, page, D] — page slab selected by index_map
+    v_ref,  # VMEM [1, Hkv, page, D]
+    *rest,  # [ks_ref, vs_ref,] o_ref, m_ref, l_ref, acc_ref
+    scale: float,
+    page_size: int,
+    attn_softcap: float,
+    quantized: bool,
+):
+    # int8 pools mirror _paged_attn_kernel: scale pages stream alongside
+    # the int8 K/V pages, dequant in VMEM.
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        o_ref, m_ref, l_ref, acc_ref = rest
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+    n_pages = pl.num_programs(1)
+    n_kv, G8, D = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full((n_kv, G8, 1), -jnp.inf, jnp.float32)
+        l_ref[:] = jnp.zeros((n_kv, G8, 1), jnp.float32)
+        acc_ref[:] = jnp.zeros((n_kv, G8, D), jnp.float32)
+
+    starts = bounds_ref[0, :, 0]  # [G8]
+    ends = bounds_ref[0, :, 1]
+    page_id = table_ref[b, p]
+    t0 = p * page_size  # logical token offset of this page
+
+    # Unmapped pages (id <= 0: trash page or table padding — the same
+    # sentinel convention as _paged_attn_kernel) and pages wholly outside
+    # EVERY query's window are skipped.
+    @pl.when(
+        (page_id > 0)
+        & (t0 < jnp.max(ends))
+        & (t0 + page_size > jnp.min(starts))
+    )
+    def _accumulate():
+        flash_update_heads(
+            q_ref,
+            k_ref,
+            v_ref,
+            ks_ref if quantized else None,
+            vs_ref if quantized else None,
+            m_ref,
+            l_ref,
+            acc_ref,
+            t0,
+            starts[:, None],  # per-query bounds broadcast inside
+            ends[:, None],
+            scale=scale,
+            attn_softcap=attn_softcap,
+        )
+
+    @pl.when(p == n_pages - 1)
+    def _finalize():
+        o_ref[0] = (
+            acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("attn_softcap", "scale", "interpret")
+)
+def paged_decode_attention_mq(
+    q: jnp.ndarray,  # [B, S, Hq, D] — a SHORT query span (spec verify)
+    k_pages: jnp.ndarray,  # [n_pages, Hkv, page_size, D] heads-major
+    v_pages: jnp.ndarray,  # [n_pages, Hkv, page_size, D]
+    page_table: jnp.ndarray,  # [B, P] int32; <= 0 = unmapped
+    starts: jnp.ndarray,  # [B, S] int32 first valid slot per query
+    ends: jnp.ndarray,  # [B, S] int32 one-past-last valid slot per query
+    attn_softcap: float = 0.0,
+    scale: float | None = None,
+    interpret: bool = False,
+    k_scale: jnp.ndarray | None = None,  # [n_pages, Hkv, page, 1] (int8)
+    v_scale: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Multi-position fused paged attention. Returns [B, S, Hq, D].
+
+    The speculative-verification shape over the PAGED pool: γ+1 query
+    positions per row, each attending through the row's page table under
+    its OWN [start, end) window (end grows by one per position — in-span
+    causality). Same (B, n_pages) grid and scalar-prefetch page gather
+    as ``paged_decode_attention``; the span's queries stack into the
+    sublane dimension (row r = query r//g, group lane r%g), so the whole
+    span costs ONE pass over the row's pages instead of the batch-axis
+    flatten paying the gather γ+1 times. Page-table sentinel convention
+    unchanged: entries <= 0 are unmapped and masked.
+    """
+    B, S, Hq, D = q.shape
+    Hkv, page_size = k_pages.shape[1], k_pages.shape[2]
+    P = page_table.shape[1]
+    g = Hq // Hkv
+    rows = S * g
+    G8 = -(-rows // _SUBLANE) * _SUBLANE
+    T = P * page_size  # logical slot horizon of the table
+    scale = scale if scale is not None else 1.0 / math.sqrt(D)
+    quantized = k_scale is not None
+
+    # [B, Hkv, S·g, D]: row r = query (r // g), group lane (r % g).
+    qg = jnp.transpose(
+        q.reshape(B, S, Hkv, g, D), (0, 2, 1, 3, 4)
+    ).reshape(B, Hkv, rows, D)
+    starts = jnp.broadcast_to(starts, (B, S))
+    ends = jnp.broadcast_to(ends, (B, S))
+    bnd = jnp.stack(
+        [
+            jnp.repeat(starts, g, axis=1),
+            jnp.repeat(ends, g, axis=1),
+        ],
+        axis=2,
+    ).astype(jnp.int32)  # [B, rows, 2]
+    if G8 != rows:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, G8 - rows), (0, 0)))
+        # Pad rows get the empty window [T, 0): a zero start would feed
+        # the min(starts) page-skip guard and disable leading-page
+        # skipping for windowed layers (same trap as decode_attention_mq).
+        bnd = jnp.pad(bnd, ((0, 0), (0, G8 - rows), (0, 0)))
+        bnd = bnd.at[:, rows:, 0].set(T)
+
+    def page_map(b, p, table_ref):
+        return (jnp.maximum(table_ref[b, p], 0), 0, 0, 0)
+
+    page_spec = pl.BlockSpec((1, Hkv, page_size, D), page_map)
+    in_specs = [
+        pl.BlockSpec((1, G8, 2), lambda b, p, *_: (b, 0, 0)),
+        pl.BlockSpec((1, Hkv, G8, D), lambda b, p, *_: (b, 0, 0, 0)),
+        page_spec,
+        page_spec,
+    ]
+    operands = [bnd, qg, k_pages, v_pages]
+    if quantized:
+        scale_spec = pl.BlockSpec((1, Hkv, page_size, 1), page_map)
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
+
+    out = pl.pallas_call(
+        functools.partial(
+            _paged_mq_attn_kernel,
+            scale=scale,
+            page_size=page_size,
+            attn_softcap=attn_softcap,
+            quantized=quantized,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, P),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(
+                (1, Hkv, G8, D), lambda b, p, *_: (b, 0, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((Hkv, G8, 1), jnp.float32),
+                pltpu.VMEM((Hkv, G8, 1), jnp.float32),
+                pltpu.VMEM((Hkv, G8, D), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, G8, D), q.dtype),
+        interpret=interpret,
+    )(page_table, *operands)
+
+    out = out[:, :, :rows, :].reshape(B, Hkv, S, g, D)
+    return jnp.transpose(out, (0, 2, 1, 3, 4)).reshape(B, S, Hq, D)
 
 
 def paged_decode_attention_dp_tp(

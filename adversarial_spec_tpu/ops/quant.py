@@ -30,6 +30,7 @@ bandwidth-trivial and precision-sensitive).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 QUANTIZABLE = frozenset(
@@ -121,9 +122,37 @@ def dequantize(leaf, dtype=jnp.float32, rows: int | None = None) -> jnp.ndarray:
     return jnp.asarray(leaf, dtype)
 
 
-def matmul(x: jnp.ndarray, w, preferred_element_type=None) -> jnp.ndarray:
-    """x @ w for plain, int8-, or int4-quantized weights (dequant fused
-    by XLA into the operand read)."""
+def matmul(
+    x: jnp.ndarray,
+    w,
+    preferred_element_type=None,
+    *,
+    use_pallas: bool = False,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """x @ w for plain, int8-, or int4-quantized weights.
+
+    Default path: XLA's dequant fusion — the unpack/scale multiply is
+    elementwise on the matmul operand, so XLA *usually* folds it into
+    the operand read. ``use_pallas=True`` routes supported quantized
+    shapes through the fused Pallas kernels (ops/pallas_quant.py), which
+    make the stream-packed-once contract explicit instead of relying on
+    the fusion heuristic; unsupported shapes (layer-stacked weights,
+    dims with no unpadded block assignment) silently keep the XLA path —
+    same math either way (docs/kernels.md pins the parity).
+    ``interpret=True`` runs those kernels in Pallas interpret mode (the
+    CPU-parity harness; flag-gated exactly like ``use_pallas_decode``).
+    """
+    if use_pallas and (is_quantized(w) or is_quantized_int4(w)):
+        from adversarial_spec_tpu.ops import pallas_quant
+
+        if pallas_quant.fused_supported(x, w):
+            return pallas_quant.quant_matmul(
+                x,
+                w,
+                preferred_element_type=preferred_element_type,
+                interpret=interpret,
+            )
     if is_quantized_int4(w):
         q = unpack_int4(w["q4"], x.shape[-1])
         y = jnp.matmul(
@@ -146,6 +175,19 @@ def matmul(x: jnp.ndarray, w, preferred_element_type=None) -> jnp.ndarray:
             scale if preferred_element_type is not None else scale.astype(x.dtype)
         )
     return jnp.matmul(x, w, preferred_element_type=preferred_element_type)
+
+
+def has_quantized_weights(params) -> bool:
+    """True iff any leaf of the param pytree is a quantized dict —
+    the auto-enable predicate for the fused Pallas matmul path (a
+    full-precision checkpoint has nothing to dequantize)."""
+    leaves = jax.tree.leaves(
+        params,
+        is_leaf=lambda n: is_quantized(n) or is_quantized_int4(n),
+    )
+    return any(
+        is_quantized(leaf) or is_quantized_int4(leaf) for leaf in leaves
+    )
 
 
 def quantize_params(params: dict, names=QUANTIZABLE, fmt: str = "int8") -> dict:

@@ -86,6 +86,14 @@ Modes:
                                   # cross-round cache hit-rate, plus
                                   # the replica-kill recovery drill;
                                   # writes BENCH_fleet.json
+  python bench.py --mode kernels  # fused serving kernels: interpret-
+                                  # mode parity pins (int8/int4 dequant-
+                                  # matmul vs XLA, multi-position span
+                                  # verify vs dense gather) + real-
+                                  # batcher A/B on int4 weights with
+                                  # byte-identical transcripts and zero
+                                  # unexpected recompiles; writes
+                                  # BENCH_kernels.json
   python bench.py --mode elastic  # elastic fleet: accepted-debate
                                   # throughput + p99 TTFT under a
                                   # paced load step, autoscaled
@@ -1293,6 +1301,226 @@ def _run_residency(platform: str) -> dict:
     }
 
 
+def _run_kernels(platform: str) -> dict:
+    """Fused serving-kernel bench (ops/pallas_quant.py dequant-matmuls +
+    the multi-position verify kernel in ops/pallas_paged.py), two phases:
+
+    1. PARITY (interpret mode): each fused kernel against its XLA
+       reference — int8 dequant-matmul, int4 dequant-matmul (even and
+       odd contraction width: the packed zero-row pad), and the
+       multi-position paged-attention span verify against a dense
+       gather/softmax reference with an unmapped trailing page.
+    2. REAL BATCHER A/B (int4-quantized llama, spec on): one growing-
+       spec workload three ways — XLA verify + XLA matmul, Pallas span
+       verify, Pallas span verify + fused matmul — byte-identical
+       greedy transcripts across arms, per-arm decode tokens/s, and the
+       retrace watch pinning zero unexpected recompiles with both
+       kernels live.
+    """
+    from adversarial_spec_tpu.utils.jaxenv import configure_jax
+
+    configure_jax()
+    import random
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from adversarial_spec_tpu import obs
+    from adversarial_spec_tpu.engine import spec as spec_mod
+    from adversarial_spec_tpu.engine.scheduler import (
+        ContinuousBatcher,
+        SchedRequest,
+    )
+    from adversarial_spec_tpu.models import transformer as T
+    from adversarial_spec_tpu.models.config import get_config
+    from adversarial_spec_tpu.ops import pallas_paged, pallas_quant, quant
+
+    interpret = platform == "cpu"
+    rng = np.random.default_rng(0)
+    parity: dict[str, bool] = {}
+    max_abs_diff: dict[str, float] = {}
+
+    def _pin(name: str, got, ref, tol: float) -> None:
+        d = float(jnp.max(jnp.abs(got.astype(jnp.float32) - ref)))
+        max_abs_diff[name] = d
+        parity[name] = bool(d <= tol)
+
+    # --- 1a. Fused dequant-matmuls vs the XLA dequant-fusion path. ---
+    x = jnp.asarray(rng.standard_normal((24, 256)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((256, 128)), jnp.float32)
+    w8 = quant.quantize_int8(w)
+    _pin(
+        "matmul_int8",
+        pallas_quant.matmul_int8(x, w8["q"], w8["scale"], interpret=True),
+        quant.matmul(x, w8),
+        0.0,  # whole-K accumulation order matches XLA's: bit-exact
+    )
+    w4 = quant.quantize_int4(w)
+    _pin(
+        "matmul_int4",
+        pallas_quant.matmul_int4(x, w4["q4"], w4["scale"], interpret=True),
+        quant.matmul(x, w4),
+        2e-4,  # even/odd K-split reassociates the contraction sum
+    )
+    xo = jnp.asarray(rng.standard_normal((8, 255)), jnp.float32)
+    wo = quant.quantize_int4(
+        jnp.asarray(rng.standard_normal((255, 128)), jnp.float32)
+    )
+    _pin(
+        "matmul_int4_odd_k",
+        pallas_quant.matmul_int4(xo, wo["q4"], wo["scale"], interpret=True),
+        quant.matmul(xo, wo),
+        2e-4,
+    )
+
+    # --- 1b. Multi-position span verify vs a dense gather reference. --
+    B, S, Hq, Hkv, D, page, P = 2, 3, 4, 2, 64, 16, 4
+    g, T_slots = Hq // Hkv, P * page
+    q = jnp.asarray(rng.standard_normal((B, S, Hq, D)), jnp.float32)
+    k_pages = jnp.asarray(
+        rng.standard_normal((B * P + 1, Hkv, page, D)), jnp.float32
+    )
+    v_pages = jnp.asarray(
+        rng.standard_normal((B * P + 1, Hkv, page, D)), jnp.float32
+    )
+    # Three mapped pages per row, trailing page unmapped (sentinel 0).
+    table = np.zeros((B, P), np.int32)
+    for b in range(B):
+        table[b, :3] = 1 + b * P + np.arange(3)
+    base = 2 * page + 5  # the span starts mid-page-3
+    starts = np.zeros((B, S), np.int32)
+    ends = np.asarray(
+        base + 1 + np.arange(S)[None, :] + np.zeros((B, 1), np.int32),
+        np.int32,
+    )
+    scale = float(D) ** -0.5
+    got_mq = pallas_paged.paged_decode_attention_mq(
+        q, k_pages, v_pages, jnp.asarray(table),
+        jnp.asarray(starts), jnp.asarray(ends), interpret=True,
+    )
+    qn, kn, vn = (np.asarray(a, np.float64) for a in (q, k_pages, v_pages))
+    ref_mq = np.zeros((B, S, Hq, D))
+    for b in range(B):
+        ids = np.maximum(table[b], 0)
+        kd = kn[ids].transpose(1, 0, 2, 3).reshape(Hkv, T_slots, D)
+        vd = vn[ids].transpose(1, 0, 2, 3).reshape(Hkv, T_slots, D)
+        mapped = np.repeat(table[b] > 0, page)
+        slot = np.arange(T_slots)
+        for s in range(S):
+            valid = mapped & (slot >= starts[b, s]) & (slot < ends[b, s])
+            for h in range(Hq):
+                logits = kd[h // g] @ qn[b, s, h] * scale
+                logits[~valid] = -np.inf
+                wts = np.exp(logits - logits.max())
+                wts[~valid] = 0.0
+                ref_mq[b, s, h] = (wts @ vd[h // g]) / max(wts.sum(), 1e-30)
+    _pin("paged_mq_verify", got_mq, jnp.asarray(ref_mq, jnp.float32), 1e-4)
+
+    # --- 2. Real batcher: three arms over one growing-spec workload. --
+    size = "1b" if platform != "cpu" else "tiny"
+    cfg = get_config("llama", size)
+    params = quant.quantize_params(
+        T.init_params(
+            jax.random.key(0),
+            cfg,
+            dtype=jnp.bfloat16 if platform != "cpu" else jnp.float32,
+        ),
+        fmt="int4",
+    )
+    gamma = 4
+    n_rounds, n_opp = 2, 2
+    base_len, delta_len, max_new = (
+        (1024, 256, 64) if platform != "cpu" else (192, 32, 16)
+    )
+
+    def arm(use_pallas_verify: bool, use_pallas_matmul: bool):
+        spec_mod.configure(enabled=True, gamma=gamma)
+        spec_mod.reset_stats()
+        obs.configure(enabled=True)
+        obs.reset_stats()
+        obs.retrace.clear()
+        prng = random.Random(1)
+        seg = [prng.randrange(3, cfg.vocab_size) for _ in range(16)]
+        spec = (seg * (base_len // len(seg) + 1))[:base_len]
+        b = ContinuousBatcher(
+            params,
+            cfg,
+            max_batch=n_opp,
+            max_new_cap=max_new,
+            page_size=64,
+            capacity_tokens=1 << 15,
+            greedy=True,
+            prefix_cache=False,
+            use_pallas_matmul=use_pallas_matmul,
+        )
+        b._use_pallas = use_pallas_verify
+        b._pallas_interpret = interpret
+        toks, n_toks = [], 0
+        t0 = time.monotonic()
+        for _ in range(n_rounds):
+            for i in range(n_opp):
+                b.submit(
+                    SchedRequest(
+                        req_id=i,
+                        prompt_ids=list(spec),
+                        max_new_tokens=max_new,
+                    )
+                )
+            results = b.run_all()
+            toks.append([r.tokens.tolist() for r in results])
+            n_toks += sum(len(t) for t in toks[-1])
+            spec = spec + toks[-1][0] + [
+                prng.randrange(3, cfg.vocab_size) for _ in range(delta_len)
+            ]
+        wall = time.monotonic() - t0
+        return toks, n_toks / max(wall, 1e-9), obs.snapshot()["retrace"]
+
+    xla_toks, xla_tps, _ = arm(False, False)
+    pv_toks, pv_tps, _ = arm(True, False)
+    pf_toks, pf_tps, pf_retrace = arm(True, True)
+
+    tokens_per_s = {
+        "xla": round(xla_tps, 2),
+        "pallas_verify": round(pv_tps, 2),
+        "pallas_verify_fused_matmul": round(pf_tps, 2),
+    }
+    transcripts = {
+        "pallas_verify": xla_toks == pv_toks,
+        "pallas_verify_fused_matmul": xla_toks == pf_toks,
+    }
+    recompiles = pf_retrace["unexpected_recompiles"]
+    gates_ok = bool(
+        all(parity.values()) and all(transcripts.values()) and not recompiles
+    )
+
+    return {
+        "metric": "kernels_fused_decode_tok_s",
+        # Decode throughput with BOTH fused kernels live (span verify +
+        # int4 dequant-matmul). On CPU the kernels run in interpret mode
+        # so the number is a functional pin, not a speed claim — the
+        # speedup story is the TPU ladder's phase E sweep; the contract
+        # here is parity + byte-identical transcripts + zero retraces.
+        "value": tokens_per_s["pallas_verify_fused_matmul"],
+        "unit": "decode tok/s, Pallas span verify + fused int4 matmul",
+        "vs_baseline": None,  # no published fused-kernel baseline
+        "platform": platform,
+        "within_budget": gates_ok,
+        "model": f"llama-{size}",
+        "gamma": gamma,
+        "rounds": n_rounds,
+        "opponents": n_opp,
+        "interpret": interpret,
+        "parity": parity,
+        "max_abs_diff": {k: float(v) for k, v in max_abs_diff.items()},
+        "tokens_per_s": tokens_per_s,
+        "transcripts_byte_identical": transcripts,
+        "unexpected_recompiles": recompiles,
+        "escape_hatch": "ContinuousBatcher(use_pallas_matmul=False) / "
+        "generate(use_pallas_matmul=False)",
+    }
+
+
 def _run_cancel(platform: str) -> dict:
     """Streaming early-convergence cancellation bench, two phases:
 
@@ -2480,6 +2708,7 @@ def main() -> int:
     serve_mode = _mode("serve")
     residency_mode = _mode("residency")
     elastic_mode = _mode("elastic")
+    kernels_mode = _mode("kernels")
     if "--no-speculative" in args:
         # Escape hatch mirror of --no-interleave: batcher-driven modes
         # (and any TPU child) decode token-at-a-time.
@@ -2513,6 +2742,8 @@ def main() -> int:
         mode_flag, runner = "--residency", _run_residency
     elif elastic_mode:
         mode_flag, runner = "--elastic", _run_elastic
+    elif kernels_mode:
+        mode_flag, runner = "--kernels", _run_kernels
     else:
         mode_flag, runner = "", _run_bench
 
@@ -2561,6 +2792,7 @@ def main() -> int:
         or serve_mode
         or residency_mode
         or elastic_mode
+        or kernels_mode
     ):
         # Persist the perf trajectory point alongside the BENCH_r*
         # series the driver records.
@@ -2585,6 +2817,8 @@ def main() -> int:
             if residency_mode
             else "BENCH_elastic.json"
             if elastic_mode
+            else "BENCH_kernels.json"
+            if kernels_mode
             else "BENCH_serve.json"
         )
         out = os.path.join(

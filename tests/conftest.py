@@ -45,6 +45,22 @@ def _force_cpu_only_backends() -> None:
     # without this pin that mirror would override the CPU-only test
     # contract mid-suite.
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # Persistent XLA compile cache — the same location configure_jax
+    # points every CLI/ladder child at. The suite and its subprocess
+    # children (ladder children, fleet workers, serve daemons) compile
+    # the same tiny-model programs over and over; warm entries take
+    # whole compiles off the tier-1 wall, and cache keys fingerprint
+    # the computation so a code change can never serve a stale binary.
+    from adversarial_spec_tpu.utils.jaxenv import configure_jax
+
+    configure_jax()
+    # configure_jax's 1.0s floor is tuned for real-model programs; the
+    # suite's tiny-model compiles mostly land under it, so cache them
+    # all — the point here is aggregate wall across hundreds of tests.
+    try:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:
+        pass
 
 
 _force_cpu_only_backends()

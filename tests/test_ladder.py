@@ -148,6 +148,28 @@ def test_residency_child_smoke(tmp_path):
     assert steps["res_pool2b2"]["demotions"] == 0
 
 
+def test_kernels_child_smoke(tmp_path):
+    """Phase E (fused serving kernels): the child must record the
+    dequant-matmul A/B for both quantized formats and the span-verify
+    A/B, each with byte-identical transcripts across arms — the parity
+    half of the harvest a hardware window banks tok/s against."""
+    import tpu_ladder
+
+    out = tmp_path / "smoke.jsonl"
+    proc = _run_child(["--child-kernels", str(out)], out)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    steps = load(str(out), include_smoke=True)
+    for required in tpu_ladder.KERNEL_STEPS:
+        assert required in steps, (required, sorted(steps))
+        row = steps[required]
+        assert row["tokens_identical"] is True, row
+        assert row["speedup"] > 0
+    assert steps["kernels_int8_matmul"]["decode_tok_s_fused"] > 0
+    sv = steps["kernels_span_verify"]
+    assert sv["decode_tok_s_kernel"] > 0
+    assert sv["tokens_per_step"] >= 1.0
+
+
 def test_batcher_spec_child_smoke(tmp_path):
     """Phase B' (batcher γ sweep): the child must drain the bench-shaped
     pool through the ContinuousBatcher under the env γ and record the
@@ -208,6 +230,7 @@ class TestOrchestrator:
                 *tpu_ladder.BATCHER_SPEC_STEPS,
                 *tpu_ladder.TIER_STEPS,
                 *tpu_ladder.RES_STEPS,
+                *tpu_ladder.KERNEL_STEPS,
             ],
         )
         monkeypatch.setattr(bench, "_probe_tpu", lambda **kw: True)
@@ -230,6 +253,7 @@ class TestOrchestrator:
                 + list(tpu_ladder.BATCHER_SPEC_STEPS)
                 + list(tpu_ladder.TIER_STEPS)
                 + list(tpu_ladder.RES_STEPS)
+                + list(tpu_ladder.KERNEL_STEPS)
             )
             if s != "gamma16"
         ]
@@ -247,14 +271,20 @@ class TestOrchestrator:
                     else "--child-tier"
                     if "--child-tier" in cmd
                     else "--child-residency"
+                    if "--child-residency" in cmd
+                    else "--child-kernels"
                 )
                 i = cmd.index(flag)
-                if flag in ("--child-tier", "--child-residency"):
+                if flag in (
+                    "--child-tier", "--child-residency", "--child-kernels"
+                ):
                     # These children record every remaining phase step.
                     phase_steps = (
                         tpu_ladder.TIER_STEPS
                         if flag == "--child-tier"
                         else tpu_ladder.RES_STEPS
+                        if flag == "--child-residency"
+                        else tpu_ladder.KERNEL_STEPS
                     )
                     launched.append(flag.removeprefix("--child-"))
                     with open(cmd[i + 1], "a") as f:

@@ -537,3 +537,362 @@ class TestInt8MqKernel:
         plain = generate(params, cfg, [prompt], speculative=False, **kw)
         spec = generate(params, cfg, [prompt], speculative=True, **kw)
         np.testing.assert_array_equal(plain.tokens, spec.tokens)
+
+
+class TestFusedQuantMatmul:
+    """ops/pallas_quant.py: the in-kernel dequant-matmul over int8 /
+    packed-int4 weights (interpret mode) against the XLA dequant-fusion
+    path in ops/quant.py — the stream-packed-once contract must not
+    change the math."""
+
+    def _xw(self, M=24, K=256, N=128, key=0):
+        ks = jax.random.split(jax.random.key(key), 2)
+        x = jax.random.normal(ks[0], (M, K), jnp.float32)
+        w = jax.random.normal(ks[1], (K, N), jnp.float32)
+        return x, w
+
+    def test_int8_bit_exact_vs_xla(self):
+        from adversarial_spec_tpu.ops import pallas_quant, quant
+
+        x, w = self._xw()
+        w8 = quant.quantize_int8(w)
+        got = pallas_quant.matmul_int8(
+            x, w8["q"], w8["scale"], interpret=True
+        )
+        # Whole-K accumulation matches XLA's order: byte parity.
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(quant.matmul(x, w8))
+        )
+
+    def test_int4_matches_xla_even_and_odd_width(self):
+        from adversarial_spec_tpu.ops import pallas_quant, quant
+
+        for K in (256, 255):  # odd width: the packed zero-row pad
+            x, w = self._xw(M=8, K=K, key=K)
+            w4 = quant.quantize_int4(w)
+            got = pallas_quant.matmul_int4(
+                x, w4["q4"], w4["scale"], interpret=True
+            )
+            # The kernel contracts x_even@lo + x_odd@hi — a reassociated
+            # sum vs XLA's single contraction, so close not bit-equal.
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(quant.matmul(x, w4)),
+                rtol=2e-4, atol=2e-4,
+            )
+
+    def test_stacked_activation_batch(self):
+        from adversarial_spec_tpu.ops import pallas_quant, quant
+
+        x = jax.random.normal(jax.random.key(3), (2, 3, 256), jnp.float32)
+        _, w = self._xw(key=4)
+        w8 = quant.quantize_int8(w)
+        got = pallas_quant.matmul_int8(
+            x, w8["q"], w8["scale"], interpret=True
+        )
+        assert got.shape == (2, 3, 128)
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(quant.matmul(x, w8))
+        )
+
+    def test_dispatch_and_fallback(self):
+        """quant.matmul(use_pallas=True) routes supported shapes to the
+        kernel and silently keeps the XLA path for layer-stacked
+        weights (3-D q: no flat [K, N] operand to stream)."""
+        from adversarial_spec_tpu.ops import pallas_quant, quant
+
+        x, w = self._xw(M=4)
+        w4 = quant.quantize_int4(w)
+        assert pallas_quant.fused_supported(x, w4)
+        got = quant.matmul(x, w4, use_pallas=True, interpret=True)
+        np.testing.assert_array_equal(
+            np.asarray(got),
+            np.asarray(
+                pallas_quant.matmul_int4(
+                    x, w4["q4"], w4["scale"], interpret=True
+                )
+            ),
+        )
+        # Layer-stacked leaves (3-D q) have no flat [K, N] operand to
+        # stream: not fused (the model scans per-layer slices, so the
+        # dispatcher only ever sees 2-D weights — this pins the guard).
+        stacked = {
+            "q4": jnp.stack([w4["q4"]] * 2),
+            "scale": jnp.stack([w4["scale"]] * 2),
+        }
+        assert not pallas_quant.fused_supported(x, stacked)
+        assert not pallas_quant.fused_supported(x, w)  # plain array
+
+    def test_preferred_element_type(self):
+        from adversarial_spec_tpu.ops import pallas_quant, quant
+
+        x, w = self._xw(M=8)
+        w8 = quant.quantize_int8(w)
+        xb = x.astype(jnp.bfloat16)
+        got = pallas_quant.matmul_int8(
+            xb, w8["q"], w8["scale"],
+            preferred_element_type=jnp.float32, interpret=True,
+        )
+        assert got.dtype == jnp.float32
+        default = pallas_quant.matmul_int8(
+            xb, w8["q"], w8["scale"], interpret=True
+        )
+        assert default.dtype == jnp.bfloat16
+
+
+class TestPagedMqKernel:
+    """paged_decode_attention_mq: the γ+1-position verify span over the
+    PAGED pool — per-position causal bounds, one pass over the row's
+    pages, trash/unmapped sentinel discipline unchanged."""
+
+    def _pool(self, B=2, Hkv=2, D=64, page=16, P=6, key=21, poison=False):
+        n_pages = 1 + B * P  # physical page 0 = trash
+        ks = jax.random.split(jax.random.key(key), 2)
+        kp = jax.random.normal(ks[0], (n_pages, Hkv, page, D), jnp.float32)
+        vp = jax.random.normal(ks[1], (n_pages, Hkv, page, D), jnp.float32)
+        if poison:
+            kp = kp.at[0].set(1e9)
+            vp = vp.at[0].set(1e9)
+        return kp, vp
+
+    def _ref(self, q, kp, vp, table, starts, ends):
+        """Dense gather + per-position masked softmax (numpy, f64)."""
+        qn, kn, vn = (np.asarray(a, np.float64) for a in (q, kp, vp))
+        tb, st, en = (np.asarray(a) for a in (table, starts, ends))
+        B, S, Hq, D = qn.shape
+        Hkv, page = kn.shape[1], kn.shape[2]
+        g, T_ = Hq // Hkv, tb.shape[1] * page
+        out = np.zeros((B, S, Hq, D))
+        slot = np.arange(T_)
+        for b in range(B):
+            ids = np.maximum(tb[b], 0)
+            kd = kn[ids].transpose(1, 0, 2, 3).reshape(Hkv, T_, D)
+            vd = vn[ids].transpose(1, 0, 2, 3).reshape(Hkv, T_, D)
+            mapped = np.repeat(tb[b] > 0, page)
+            for s in range(S):
+                ok = mapped & (slot >= st[b, s]) & (slot < en[b, s])
+                for h in range(Hq):
+                    logits = kd[h // g] @ qn[b, s, h] / math.sqrt(D)
+                    logits[~ok] = -np.inf
+                    p = np.exp(logits - logits.max())
+                    p[~ok] = 0.0
+                    out[b, s, h] = (p @ vd[h // g]) / max(p.sum(), 1e-30)
+        return out
+
+    def test_matches_gathered_dense_per_position_bounds(self):
+        from adversarial_spec_tpu.ops.pallas_paged import (
+            paged_decode_attention_mq,
+        )
+
+        B, S, Hq, Hkv, D, page, P = 2, 5, 8, 2, 64, 16, 6
+        q = jax.random.normal(jax.random.key(22), (B, S, Hq, D), jnp.float32)
+        kp, vp = self._pool(B=B, Hkv=Hkv, D=D, page=page, P=P)
+        table = np.full((B, P), -1, np.int32)
+        table[0, :4] = 1 + np.arange(4)
+        table[1, :3] = 1 + P + np.arange(3)
+        base = np.array([[50], [33]])
+        starts = np.zeros((B, S), np.int32)
+        starts[0, :] = 3  # a windowed row
+        ends = (base + 1 + np.arange(S)[None, :]).astype(np.int32)
+
+        out = paged_decode_attention_mq(
+            q, kp, vp, jnp.asarray(table),
+            jnp.asarray(starts), jnp.asarray(ends), interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), self._ref(q, kp, vp, table, starts, ends),
+            rtol=2e-5, atol=2e-5,
+        )
+
+    def test_trash_page_zero_is_masked(self):
+        """Speculative verify parks non-writable span positions on
+        physical page 0; a poisoned trash page must not leak into any
+        span position's output."""
+        from adversarial_spec_tpu.ops.pallas_paged import (
+            paged_decode_attention_mq,
+        )
+
+        B, S, Hq, Hkv, D, page, P = 1, 3, 4, 2, 64, 8, 4
+        q = jax.random.normal(jax.random.key(23), (B, S, Hq, D), jnp.float32)
+        kp, vp = self._pool(B=B, Hkv=Hkv, D=D, page=page, P=P, poison=True)
+        table = np.array([[1, 0, 2, -1]], np.int32)  # a 0 sentinel mid-table
+        starts = np.zeros((B, S), np.int32)
+        ends = np.array([[20, 21, 22]], np.int32)  # spans the unmapped page
+
+        out = paged_decode_attention_mq(
+            q, kp, vp, jnp.asarray(table),
+            jnp.asarray(starts), jnp.asarray(ends), interpret=True,
+        )
+        assert np.all(np.isfinite(np.asarray(out)))
+        np.testing.assert_allclose(
+            np.asarray(out), self._ref(q, kp, vp, table, starts, ends),
+            rtol=2e-5, atol=2e-5,
+        )
+
+    def test_row_count_not_sublane_multiple(self):
+        """S·g = 6 pads to the 8-sublane tile; pad rows get an empty
+        window and must not perturb the real rows."""
+        from adversarial_spec_tpu.ops.pallas_paged import (
+            paged_decode_attention_mq,
+        )
+
+        B, S, Hq, Hkv, D, page, P = 2, 3, 4, 2, 64, 16, 4
+        q = jax.random.normal(jax.random.key(24), (B, S, Hq, D), jnp.float32)
+        kp, vp = self._pool(B=B, Hkv=Hkv, D=D, page=page, P=P)
+        table = 1 + np.arange(B * P, dtype=np.int32).reshape(B, P)
+        starts = np.zeros((B, S), np.int32)
+        ends = np.asarray(
+            40 + np.arange(S)[None, :] + np.zeros((B, 1), np.int32),
+            np.int32,
+        )
+        out = paged_decode_attention_mq(
+            q, kp, vp, jnp.asarray(table),
+            jnp.asarray(starts), jnp.asarray(ends), interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), self._ref(q, kp, vp, table, starts, ends),
+            rtol=2e-5, atol=2e-5,
+        )
+
+    def test_single_position_matches_single_query_kernel(self):
+        """S=1 must agree with paged_decode_attention — the MQ kernel is
+        a strict generalization of the decode kernel's contract."""
+        from adversarial_spec_tpu.ops.pallas_paged import (
+            paged_decode_attention,
+            paged_decode_attention_mq,
+        )
+
+        B, Hq, Hkv, D, page, P = 2, 8, 2, 64, 16, 6
+        q = jax.random.normal(jax.random.key(25), (B, 1, Hq, D), jnp.float32)
+        kp, vp = self._pool(B=B, Hkv=Hkv, D=D, page=page, P=P)
+        table = 1 + np.arange(B * P, dtype=np.int32).reshape(B, P)
+        bounds = jnp.array([[2, 40], [0, 90]], jnp.int32)
+        mq = paged_decode_attention_mq(
+            q, kp, vp, jnp.asarray(table),
+            bounds[:, 0:1], bounds[:, 1:2], interpret=True,
+        )
+        sq = paged_decode_attention(
+            q[:, 0], kp, vp, jnp.asarray(table), bounds, interpret=True
+        )
+        np.testing.assert_allclose(
+            np.asarray(mq[:, 0]), np.asarray(sq), rtol=2e-5, atol=2e-5
+        )
+
+    def test_int8_pool_scales_match_dequant_reference(self):
+        from adversarial_spec_tpu.ops.pallas_paged import (
+            paged_decode_attention_mq,
+        )
+
+        B, S, Hq, Hkv, D, page, P = 2, 3, 4, 2, 64, 16, 4
+        q = jax.random.normal(jax.random.key(26), (B, S, Hq, D), jnp.float32)
+        kf, vf = self._pool(B=B, Hkv=Hkv, D=D, page=page, P=P)
+        amax = jnp.max(jnp.abs(kf), axis=-1, keepdims=True)
+        ksc = jnp.maximum(amax, 1e-8) / 127.0
+        k8 = jnp.clip(jnp.round(kf / ksc), -127, 127).astype(jnp.int8)
+        amax = jnp.max(jnp.abs(vf), axis=-1, keepdims=True)
+        vsc = jnp.maximum(amax, 1e-8) / 127.0
+        v8 = jnp.clip(jnp.round(vf / vsc), -127, 127).astype(jnp.int8)
+        table = 1 + np.arange(B * P, dtype=np.int32).reshape(B, P)
+        starts = np.zeros((B, S), np.int32)
+        ends = np.asarray(
+            30 + np.arange(S)[None, :] + np.zeros((B, 1), np.int32),
+            np.int32,
+        )
+        out = paged_decode_attention_mq(
+            q, k8, v8, jnp.asarray(table),
+            jnp.asarray(starts), jnp.asarray(ends), interpret=True,
+            k_scale=ksc, v_scale=vsc,
+        )
+        ref = paged_decode_attention_mq(
+            q, k8 * ksc, v8 * vsc, jnp.asarray(table),
+            jnp.asarray(starts), jnp.asarray(ends), interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5
+        )
+
+
+class TestFusedMatmulInGenerate:
+    """End-to-end: the fused dequant-matmul routed through the model's
+    projection/MLP/lm-head sites must leave greedy transcripts
+    byte-identical, for both quantized formats, dense and paged."""
+
+    def _quantized(self, fmt):
+        from adversarial_spec_tpu.ops import quant
+
+        cfg = get_config("llama", "tiny")
+        params = T.init_params(jax.random.key(0), cfg, dtype=jnp.float32)
+        return quant.quantize_params(params, fmt=fmt), cfg
+
+    @pytest.mark.parametrize("fmt", ["int8", "int4"])
+    def test_generate_transcript_parity(self, fmt):
+        qp, cfg = self._quantized(fmt)
+        prompts = [[((i * 13) % 500) + 3 for i in range(24)], [5, 9, 7, 5]]
+        kw = dict(
+            max_new_tokens=8, eos_ids=[], greedy=True,
+            speculative=False, share_prefix=False,
+        )
+        off = generate(qp, cfg, prompts, use_pallas_matmul=False, **kw)
+        on = generate(qp, cfg, prompts, use_pallas_matmul=True, **kw)
+        np.testing.assert_array_equal(off.tokens, on.tokens)
+
+    def test_generate_paged_int4_parity(self):
+        qp, cfg = self._quantized("int4")
+        prompts = [[3, 7, 11, 15, 2, 4, 6, 8]]
+        kw = dict(
+            max_new_tokens=8, eos_ids=[], greedy=True,
+            speculative=False, paged=True, page_size=16,
+        )
+        off = generate(qp, cfg, prompts, use_pallas_matmul=False, **kw)
+        on = generate(qp, cfg, prompts, use_pallas_matmul=True, **kw)
+        np.testing.assert_array_equal(off.tokens, on.tokens)
+
+    def test_batcher_both_kernels_zero_recompiles(self):
+        """Two drains through the batcher with the span-verify kernel
+        AND the fused int4 matmul live: greedy parity with the XLA
+        batcher and no seen-key recompile (the promoted-q4 residency
+        contract rides on this same signature stability)."""
+        from adversarial_spec_tpu import obs
+        from adversarial_spec_tpu.engine import spec as spec_mod
+        from adversarial_spec_tpu.engine.scheduler import (
+            ContinuousBatcher,
+            SchedRequest,
+        )
+
+        qp, cfg = self._quantized("int4")
+        prompt = [5 + (i % 7) for i in range(40)]
+        spec_mod.configure(enabled=True, gamma=4)
+        was_enabled = obs.config().enabled
+        obs.configure(enabled=True)
+        obs.retrace.clear()
+
+        def drain(use_pallas, n=6):
+            b = ContinuousBatcher(
+                qp, cfg, max_batch=1, max_new_cap=n,
+                speculative=True, gamma=4,
+                use_pallas_matmul=use_pallas,
+            )
+            b._use_pallas = use_pallas
+            b._pallas_interpret = True
+            out = {}
+            for _ in range(2):  # two drains: reuse, not recompile
+                b.submit(
+                    SchedRequest(
+                        req_id=0, prompt_ids=list(prompt), max_new_tokens=n
+                    )
+                )
+                [r] = b.run_all()
+                out = r.tokens.tolist()
+            return out
+
+        try:
+            ref = drain(False)
+            obs.retrace.clear()
+            fused = drain(True)
+            snap = obs.retrace.snapshot()
+        finally:
+            obs.retrace.clear()
+            obs.configure(enabled=was_enabled)
+            spec_mod.configure(enabled=True, gamma=spec_mod.DEFAULT_GAMMA)
+        assert fused == ref
+        assert snap["programs"], "no program dispatched"
+        assert snap["unexpected_recompiles"] == 0, snap

@@ -401,3 +401,78 @@ class TestQuantizedModel:
             )
         assert out.tokens.shape == (1, 4)
         assert "full-precision KV" not in capsys.readouterr().err
+
+
+class TestFusedMatmulFuzz:
+    """Property fuzz for the fused Pallas dequant-matmul path
+    (ops/pallas_quant.py, interpret mode) against a pure-numpy oracle:
+    odd and even contraction widths, extreme scale magnitudes, stacked
+    activation batches, and the ``dequantize(rows=)`` padded-row edge —
+    the in-kernel unpack must do the same int math the oracle does."""
+
+    def test_fuzz_fused_vs_numpy_oracle(self):
+        from adversarial_spec_tpu.ops import pallas_quant
+
+        rng = np.random.default_rng(11)
+        for case in range(8):
+            K = int(rng.integers(1, 97))
+            N = int(rng.integers(1, 40))
+            M = int(rng.integers(1, 20))
+            xshape = (M, K) if case % 2 else (2, M, K)
+            # Extreme magnitudes (bounded away from f32 overflow in the
+            # K-length accumulation).
+            mag = 10.0 ** float(rng.integers(-12, 12))
+            w = (rng.standard_normal((K, N)) * mag).astype(np.float32)
+            x = rng.standard_normal(xshape).astype(np.float32)
+            xj = jnp.asarray(x)
+
+            w8 = quantize_int8(jnp.asarray(w))
+            ref8 = x.astype(np.float64) @ (
+                np.asarray(w8["q"], np.float64)
+                * np.asarray(w8["scale"], np.float64)
+            )
+            got8 = np.asarray(
+                pallas_quant.matmul_int8(
+                    xj, w8["q"], w8["scale"], interpret=True
+                )
+            )
+            tol = 2e-4 * (np.max(np.abs(ref8)) + 1e-30)
+            assert np.max(np.abs(got8 - ref8)) <= tol, (case, K, N, mag)
+
+            w4 = quantize_int4(jnp.asarray(w))
+            # Oracle via the independent numpy unpack — also the
+            # dequantize(rows=) edge: odd K packed one zero row.
+            deq = _np_unpack_int4(np.asarray(w4["q4"]), K).astype(
+                np.float64
+            ) * np.asarray(w4["scale"], np.float64)
+            np.testing.assert_array_equal(
+                np.asarray(dequantize(w4, rows=K)),
+                deq.astype(np.float32),
+            )
+            ref4 = x.astype(np.float64) @ deq
+            got4 = np.asarray(
+                pallas_quant.matmul_int4(
+                    xj, w4["q4"], w4["scale"], interpret=True
+                )
+            )
+            tol = 2e-4 * (np.max(np.abs(ref4)) + 1e-30)
+            assert np.max(np.abs(got4 - ref4)) <= tol, (case, K, N, mag)
+
+    def test_fused_dispatch_matches_kernel_exactly(self):
+        """quant.matmul(use_pallas=True) must BE the kernel result (no
+        silent fallback for a supported shape)."""
+        from adversarial_spec_tpu.ops import pallas_quant
+
+        x = jax.random.normal(jax.random.key(5), (6, 33), jnp.float32)
+        w4 = quantize_int4(
+            jax.random.normal(jax.random.key(6), (33, 24), jnp.float32)
+        )
+        assert pallas_quant.fused_supported(x, w4)
+        np.testing.assert_array_equal(
+            np.asarray(matmul(x, w4, use_pallas=True, interpret=True)),
+            np.asarray(
+                pallas_quant.matmul_int4(
+                    x, w4["q4"], w4["scale"], interpret=True
+                )
+            ),
+        )

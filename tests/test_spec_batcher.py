@@ -827,3 +827,77 @@ class TestMockAcceptanceModel:
         spec_mod.reset_stats()
         self._chat("Words repeat here. " * 20)
         assert spec_mod.stats.spec_steps == 0
+
+
+class TestBatcherSpecPallasVerify:
+    """The γ-span verify routed through the multi-position paged Pallas
+    kernel (``paged_decode_attention_mq``, interpret on CPU) must not
+    change a single greedy token vs the XLA gather verify or plain
+    dense decode — three arms, every draft width."""
+
+    def _drain_kernel(self, params, cfg, prompts, budgets, *, eos=(), **kw):
+        b = ContinuousBatcher(
+            params,
+            cfg,
+            max_batch=kw.pop("max_batch", 2),
+            max_new_cap=max(budgets),
+            eos_ids=list(eos),
+            **kw,
+        )
+        # Route attention through the Pallas kernels in interpret mode
+        # (the batcher auto-enables them on TPU only).
+        b._use_pallas = True
+        b._pallas_interpret = True
+        for i, (p, n) in enumerate(zip(prompts, budgets)):
+            b.submit(
+                SchedRequest(req_id=i, prompt_ids=list(p), max_new_tokens=n)
+            )
+        results = b.run_all()
+        return {r.req_id: r.tokens.tolist() for r in results}
+
+    # Interpret-mode drains are wall-heavy, so the budgets stay small —
+    # 8 tokens still crosses several verify spans at every γ here.
+    @pytest.mark.parametrize("gamma", [2, 4, 8])
+    def test_three_arm_parity(self, tiny_model, gamma):
+        params, cfg = tiny_model
+        prompts = [_repetitive_prompt(44), _repetitive_prompt(52, period=5)]
+        budgets = [8, 8]
+        _, xla, _ = _drain(
+            params, cfg, prompts, budgets, speculative=True, gamma=gamma
+        )
+        kern = self._drain_kernel(
+            params, cfg, prompts, budgets, speculative=True, gamma=gamma
+        )
+        assert xla == kern, f"gamma={gamma}: kernel verify changed tokens"
+        for i, p in enumerate(prompts):
+            ref = generate(
+                params, cfg, [p], max_new_tokens=budgets[i], eos_ids=[],
+                greedy=True, speculative=False,
+            )
+            np.testing.assert_array_equal(
+                kern[i], ref.tokens[0, : ref.n_generated[0]],
+                err_msg=f"gamma={gamma} req {i} vs dense reference",
+            )
+
+    def test_eos_inside_span_kernel_verify(self, tiny_model):
+        """An EOS accepted mid-span through the kernel verify must stop
+        the row exactly where the XLA verify (and plain decode) stops."""
+        params, cfg = tiny_model
+        prompts = [_repetitive_prompt(40)]
+        _, probe, _ = _drain(
+            params, cfg, prompts, [16], max_batch=1, speculative=False,
+        )
+        out = probe[0]
+        if len(out) < 4:
+            pytest.skip("probe output too short to pick a mid-run EOS")
+        eos = out[len(out) // 2]
+        _, off, _ = _drain(
+            params, cfg, prompts, [16], max_batch=1, eos=[eos],
+            speculative=False,
+        )
+        kern = self._drain_kernel(
+            params, cfg, prompts, [16], max_batch=1, eos=[eos],
+            speculative=True, gamma=4,
+        )
+        assert kern == off
+        assert kern[0][-1] == eos  # EOS kept, nothing after

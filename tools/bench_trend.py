@@ -85,6 +85,19 @@ _ELASTIC_REQUIRED: dict[str, tuple[type, ...]] = {
     "transcripts_byte_identical": (dict,),
     "duplicated_completions": (int,),
 }
+# BENCH_kernels.json additionally pins the fused-kernel contract: the
+# numeric parity of each fused kernel against its XLA reference, the
+# per-arm decode throughput the headline ratio decomposes into,
+# byte-identical transcripts fused-on vs fused-off, and zero unexpected
+# recompiles through the batcher with both kernels live — a kernels
+# bench silently dropping one of these would hide a numerics or
+# retrace regression behind a valid speedup headline.
+_KERNELS_REQUIRED: dict[str, tuple[type, ...]] = {
+    "parity": (dict,),
+    "tokens_per_s": (dict,),
+    "transcripts_byte_identical": (dict,),
+    "unexpected_recompiles": (int,),
+}
 
 
 def _check_fields(
@@ -147,6 +160,21 @@ def validate_bench_file(path: Path) -> tuple[dict | None, list[str]]:
                 problems.append(
                     f"{path.name}: duplicated_completions must be 0, "
                     f"got {payload['duplicated_completions']}"
+                )
+        if mode == "kernels":
+            problems.extend(
+                _check_fields(payload, _KERNELS_REQUIRED, path.name)
+            )
+            for gate in ("parity", "transcripts_byte_identical"):
+                vals = payload.get(gate)
+                if isinstance(vals, dict) and not all(vals.values()):
+                    problems.append(
+                        f"{path.name}: {gate} has a false arm: {vals}"
+                    )
+            if payload.get("unexpected_recompiles"):
+                problems.append(
+                    f"{path.name}: unexpected_recompiles must be 0, "
+                    f"got {payload['unexpected_recompiles']}"
                 )
         if problems:
             return None, problems

@@ -133,16 +133,32 @@ class GraftlintConfig:
         ]
     )
     # Extra dotted function names (module.func) to treat as trace roots
-    # beyond what jit/pallas_call discovery finds.
-    trace_extra_roots: list[str] = field(default_factory=list)
+    # beyond what jit/pallas_call discovery finds. The fused serving
+    # kernels are pinned so a refactor that indirects the pallas_call
+    # kernel reference cannot silently drop their GL-TRACE coverage;
+    # quant.matmul/unpack_int4 likewise, now that the forwards reach
+    # them through an ``mm=`` parameter the callee resolver can't
+    # follow.
+    trace_extra_roots: list[str] = field(
+        default_factory=lambda: [
+            "adversarial_spec_tpu.ops.pallas_quant._qmm_int8_kernel",
+            "adversarial_spec_tpu.ops.pallas_quant._qmm_int4_kernel",
+            "adversarial_spec_tpu.ops.pallas_paged._paged_mq_attn_kernel",
+            "adversarial_spec_tpu.ops.quant.matmul",
+            "adversarial_spec_tpu.ops.quant.unpack_int4",
+        ]
+    )
     # --- GL-RETRACE --------------------------------------------------
     # Functions that bound a Python scalar to a small fixed set of
     # values (pow2 buckets): their results may feed static args.
+    # _plan_blocks buckets fused quant-matmul block shapes to a fixed
+    # candidate table (ops/pallas_quant.py).
     retrace_bucketers: list[str] = field(
         default_factory=lambda: [
             "bucket_length",
             "_next_chunk_len",
             "_fused_chunk_len",
+            "_plan_blocks",
         ]
     )
     # --- GL-REFCOUNT -------------------------------------------------

@@ -52,6 +52,14 @@ Phase C (tiered KV — engine/kvtier.py, one child for all steps):
   round's admissions promote; the crossover_report row that judges
   how much host RAM buys at each pool size.
 
+Phase E (fused serving kernels — ops/pallas_quant.py + the span verify
+in ops/pallas_paged.py, one child for all steps):
+  kernels_{int8,int4}_matmul: in-kernel dequant-matmul vs the XLA
+  dequant-fusion path, warm decode tok/s both ways, byte-identical
+  greedy transcripts.
+  kernels_span_verify: the γ+1-position paged verify kernel vs the XLA
+  gather verify through the ContinuousBatcher at γ=8.
+
 ADVSPEC_LADDER_SMOKE=1 dry-runs the whole ladder code path on CPU with
 tiny shapes (tests/test_ladder.py); smoke rows are stamped
 ``"smoke": true`` and excluded from resumability and from every tuning
@@ -63,6 +71,7 @@ Usage:
   python tpu_ladder.py --child-env OUT STEP                # internal
   python tpu_ladder.py --child-batcher-spec OUT STEP       # internal
   python tpu_ladder.py --child-tier OUT                    # internal
+  python tpu_ladder.py --child-kernels OUT                 # internal
 """
 
 from __future__ import annotations
@@ -817,6 +826,154 @@ def _child_residency(out_path: str) -> int:
     return 0
 
 
+# ------------------------------------------------------------- phase E
+
+KERNEL_STEPS = (
+    "kernels_int8_matmul",
+    "kernels_int4_matmul",
+    "kernels_span_verify",
+)
+
+
+def _child_kernels(out_path: str) -> int:
+    """Phase E: fused serving kernels (ops/pallas_quant.py dequant-
+    matmuls + the multi-position span verify in ops/pallas_paged.py)
+    vs their XLA paths on real hardware — decode tok/s both ways with
+    byte-identical greedy transcripts (a speedup with different tokens
+    is a bug, not a win). Each arm runs twice; the second (warm) run is
+    the measurement. Smoke mode drives the same code on CPU with the
+    kernels in interpret mode."""
+    from adversarial_spec_tpu.utils.jaxenv import configure_jax
+
+    configure_jax()
+    import jax
+    import jax.numpy as jnp
+
+    from adversarial_spec_tpu.engine import spec as spec_mod
+    from adversarial_spec_tpu.engine.generate import generate
+    from adversarial_spec_tpu.engine.scheduler import (
+        ContinuousBatcher,
+        SchedRequest,
+    )
+    from adversarial_spec_tpu.models import transformer as T
+    from adversarial_spec_tpu.models.config import get_config
+    from adversarial_spec_tpu.ops import quant
+
+    smoke = _smoke()
+    platform = jax.devices()[0].platform
+    if platform == "cpu" and not smoke:
+        _append(out_path, {"step": "kernels_abort_cpu"})
+        return 1
+    size = "tiny" if smoke else "1b"
+    cfg = get_config("llama", size)
+    base = T.init_params(
+        jax.random.key(0), cfg,
+        dtype=jnp.float32 if smoke else jnp.bfloat16,
+    )
+    # Smoke halves the decode budget: interpret-mode kernels pay real
+    # wall per token, and 8 tokens already cross several verify spans.
+    n_prompt, n_decode = (
+        (SMOKE_PROMPT, SMOKE_DECODE // 2)
+        if smoke
+        else (BENCH_PROMPT, BENCH_DECODE)
+    )
+    prompts = [
+        [3 + ((i * 7 + r) % (cfg.vocab_size - 3)) for i in range(n_prompt)]
+        for r in range(2)
+    ]
+    done = _done_steps(out_path)
+
+    def mm_arm(params, fused: bool):
+        t0 = time.monotonic()
+        res = generate(
+            params, cfg, prompts,
+            max_new_tokens=n_decode, eos_ids=[], greedy=True,
+            speculative=False, share_prefix=False,
+            use_pallas_matmul=fused,
+        )
+        wall = time.monotonic() - t0
+        toks = int(res.n_generated.sum())
+        return res.tokens.tolist(), toks / max(wall, 1e-9)
+
+    for fmt in ("int8", "int4"):
+        step = f"kernels_{fmt}_matmul"
+        if step in done:
+            continue
+        qp = quant.quantize_params(base, fmt=fmt)
+        if not smoke:  # warm both programs: measure steady state, not
+            mm_arm(qp, True)  # the cold compile (pointless under
+            mm_arm(qp, False)  # interpret mode, where time is fake)
+        t_on, tps_on = mm_arm(qp, True)
+        t_off, tps_off = mm_arm(qp, False)
+        del qp
+        _append(
+            out_path,
+            {
+                "step": step,
+                "platform": platform,
+                "model": f"llama-{size}",
+                "decode_tok_s_fused": round(tps_on, 1),
+                "decode_tok_s_xla": round(tps_off, 1),
+                "speedup": round(tps_on / max(tps_off, 1e-9), 3),
+                "tokens_identical": t_on == t_off,
+            },
+        )
+
+    step = "kernels_span_verify"
+    if step not in done:
+        qp = quant.quantize_params(base, fmt="int4")
+        gamma = 8
+        prompt = [5 + (i % 7) for i in range(n_prompt)]
+
+        def verify_arm(use_pallas: bool):
+            spec_mod.configure(enabled=True, gamma=gamma)
+            spec_mod.reset_stats()
+            b = ContinuousBatcher(
+                qp, cfg, max_batch=2, max_new_cap=n_decode,
+                page_size=64, greedy=True, prefix_cache=False,
+                speculative=True, gamma=gamma,
+                use_pallas_matmul=False,  # isolate the verify kernel
+            )
+            b._use_pallas = use_pallas
+            if smoke:
+                b._pallas_interpret = True
+            t0 = time.monotonic()
+            for i in range(2):
+                b.submit(
+                    SchedRequest(
+                        req_id=i, prompt_ids=list(prompt),
+                        max_new_tokens=n_decode,
+                    )
+                )
+            results = b.run_all()
+            wall = time.monotonic() - t0
+            toks = {r.req_id: r.tokens.tolist() for r in results}
+            n = sum(len(t) for t in toks.values())
+            return toks, n / max(wall, 1e-9), spec_mod.stats.snapshot()
+
+        if not smoke:  # warm (skipped under interpret — time is fake)
+            verify_arm(True)
+            verify_arm(False)
+        t_on, tps_on, snap = verify_arm(True)
+        t_off, tps_off, _ = verify_arm(False)
+        _append(
+            out_path,
+            {
+                "step": step,
+                "platform": platform,
+                "model": f"llama-{size}",
+                "gamma": gamma,
+                "decode_tok_s_kernel": round(tps_on, 1),
+                "decode_tok_s_xla": round(tps_off, 1),
+                "speedup": round(tps_on / max(tps_off, 1e-9), 3),
+                "tokens_identical": t_on == t_off,
+                "acceptance_rate": snap["acceptance_rate"],
+                "tokens_per_step": snap["tokens_per_step"],
+            },
+        )
+    return 0
+
+
 def _clean_env(knobs: dict[str, str] | None = None) -> dict[str, str]:
     """Child env for a measurement: ambient ADVSPEC_* tuning knobs are
     stripped so the harvest records CANONICAL defaults (an operator's
@@ -948,6 +1105,28 @@ def orchestrate(out_path: str) -> int:
             )
             return 2
 
+    # Phase E (fused kernels): fused-vs-XLA A/B of the dequant-matmul
+    # and span-verify kernels, one warm child.
+    if any(s not in _done_steps(out_path) for s in KERNEL_STEPS):
+        if not _probe_tpu(timeout_s=60.0):
+            print(
+                "ladder: tunnel gone before kernels phase",
+                file=sys.stderr,
+            )
+            return 2
+        child = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--child-kernels", out_path],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True, env=_clean_env(), cwd=REPO,
+        )
+        if not _wait_progress(out_path, child, stall_s=900.0):
+            print(
+                "ladder: kernels phase stalled; abandoning",
+                file=sys.stderr,
+            )
+            return 2
+
     done = _done_steps(out_path)
     missing = [
         s
@@ -955,6 +1134,7 @@ def orchestrate(out_path: str) -> int:
         + list(BATCHER_SPEC_STEPS)
         + list(TIER_STEPS)
         + list(RES_STEPS)
+        + list(KERNEL_STEPS)
         if s not in done
     ]
     if missing:
@@ -981,6 +1161,8 @@ def main() -> int:
         return _child_tier(args[args.index("--child-tier") + 1])
     if "--child-residency" in args:
         return _child_residency(args[args.index("--child-residency") + 1])
+    if "--child-kernels" in args:
+        return _child_kernels(args[args.index("--child-kernels") + 1])
     out = "tpu_results/ladder.jsonl"
     if "--out" in args:
         out = args[args.index("--out") + 1]
