@@ -288,6 +288,16 @@ def create_parser() -> argparse.ArgumentParser:
         "ADVSPEC_KV_STORE_DIR sets the process default)",
     )
     d.add_argument(
+        "--kv-flush-blocks",
+        type=int,
+        default=None,  # None = inherit ADVSPEC_KV_FLUSH_BLOCKS (default 0)
+        help="Write-through flush threshold for the disk KV store: "
+        "flush pending demoted blocks every N enqueued blocks instead "
+        "of only at settle, bounding the publish window a crash can "
+        "lose (0 = settle-only, the default; "
+        "ADVSPEC_KV_FLUSH_BLOCKS sets the process default)",
+    )
+    d.add_argument(
         "--weight-res",
         action=argparse.BooleanOptionalAction,
         default=None,  # None = inherit ADVSPEC_WEIGHT_RES (default on)
@@ -443,6 +453,16 @@ def create_parser() -> argparse.ArgumentParser:
         type=int,
         default=None,  # None = inherit ADVSPEC_FLEET_MAX (default 4)
         help="Autoscaler replica ceiling (ADVSPEC_FLEET_MAX)",
+    )
+    z.add_argument(
+        "--fleet-prefill-replicas",
+        type=int,
+        default=None,  # None = inherit ADVSPEC_FLEET_PREFILL_REPLICAS
+        help="Disaggregated serving: founders carrying the prefill "
+        "role — large admissions prefill there and ship their KV "
+        "blocks to a decode replica through the shared store "
+        "(docs/fleet.md; 0 = symmetric fleet, the default; "
+        "ADVSPEC_FLEET_PREFILL_REPLICAS sets the process default)",
     )
     z.add_argument(
         "--scale-cooldown-s",
@@ -743,6 +763,11 @@ def _configure_kv_tier(args: argparse.Namespace):
             if args.kv_store_dir is not None
             else kvtier.env_store_dir()
         ),
+        flush_blocks=(
+            args.kv_flush_blocks
+            if args.kv_flush_blocks is not None
+            else kvtier.env_flush_blocks()
+        ),
     )
     kvtier.reset_stats()
     return kvtier
@@ -822,6 +847,12 @@ def _configure_fleet(args: argparse.Namespace):
             if getattr(args, "scale_interval_s", None) is not None
             else fleet.env_scale_interval_s()
         ),
+        prefill_replicas=(
+            args.fleet_prefill_replicas
+            if getattr(args, "fleet_prefill_replicas", None) is not None
+            else fleet.env_prefill_replicas()
+        ),
+        handoff_threshold_tokens=fleet.env_handoff_threshold_tokens(),
     )
     fleet.reset_stats()
     return fleet

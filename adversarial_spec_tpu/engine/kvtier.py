@@ -33,9 +33,10 @@ drives the same machine deterministically on CPU with ``payload=None``
 
 Process-wide config + stats follow the ``procconfig`` pattern shared
 with ``interleave``/``spec``/``prefix_cache``: the CLI arms per round
-(``--kv-host-mb``, ``--kv-store-dir``, ``--no-kv-tier``; env
-``ADVSPEC_KV_HOST_MB`` / ``ADVSPEC_KV_STORE_DIR`` / ``ADVSPEC_KV_TIER``)
-and snapshots into ``perf.kv_tier``. Deliberately imports no jax.
+(``--kv-host-mb``, ``--kv-store-dir``, ``--kv-flush-blocks``,
+``--no-kv-tier``; env ``ADVSPEC_KV_HOST_MB`` / ``ADVSPEC_KV_STORE_DIR``
+/ ``ADVSPEC_KV_FLUSH_BLOCKS`` / ``ADVSPEC_KV_TIER``) and snapshots into
+``perf.kv_tier``. Deliberately imports no jax.
 """
 
 from __future__ import annotations
@@ -67,6 +68,14 @@ class TierConfig:
     host_mb: int = DEFAULT_HOST_MB
     # Disk-store root directory ("" disables tier 2).
     store_dir: str = ""
+    # Write-through flush threshold: flush the pending disk queue every
+    # N queued blocks (0 = only at drain-end settle()). Mid-drain
+    # flushes write ONLY already-resolved payloads — an unresolved lazy
+    # materializer stays queued, so the no-sync-on-hot-path discipline
+    # holds. Armed for cross-replica handoff (fleet disaggregation):
+    # a decode replica can only adopt blocks that reached the shared
+    # store before the drain ended.
+    flush_blocks: int = 0
 
 
 def env_enabled() -> bool:
@@ -85,6 +94,14 @@ def env_host_mb() -> int:
 def env_store_dir() -> str:
     """The process default store root (``ADVSPEC_KV_STORE_DIR``)."""
     return os.environ.get("ADVSPEC_KV_STORE_DIR", "") or ""
+
+
+def env_flush_blocks() -> int:
+    """The process default flush threshold (``ADVSPEC_KV_FLUSH_BLOCKS``)."""
+    try:
+        return max(0, int(os.environ.get("ADVSPEC_KV_FLUSH_BLOCKS", "0")))
+    except ValueError:
+        return 0
 
 
 @dataclass
@@ -154,9 +171,13 @@ _state = procconfig.ProcState(
         enabled=env_enabled(),
         host_mb=env_host_mb(),
         store_dir=env_store_dir(),
+        flush_blocks=env_flush_blocks(),
     ),
     TierStats(),
-    coerce={"host_mb": lambda v: max(0, int(v))},
+    coerce={
+        "host_mb": lambda v: max(0, int(v)),
+        "flush_blocks": lambda v: max(0, int(v)),
+    },
 )
 _config = _state.config
 stats = _state.stats
@@ -170,9 +191,13 @@ def configure(
     enabled: bool | None = None,
     host_mb: int | None = None,
     store_dir: str | None = None,
+    flush_blocks: int | None = None,
 ) -> TierConfig:
     return _state.configure(
-        enabled=enabled, host_mb=host_mb, store_dir=store_dir
+        enabled=enabled,
+        host_mb=host_mb,
+        store_dir=store_dir,
+        flush_blocks=flush_blocks,
     )
 
 
@@ -767,8 +792,11 @@ class TieredStore:
     def enqueue_store(self, chain: str, tokens, payload) -> None:
         """Queue one block for disk write-through (content-addressed:
         already-stored and already-queued chains are no-ops). Flushed by
-        ``settle()`` at drain end — file I/O never rides the serving
-        path."""
+        ``settle()`` at drain end — and, when ``flush_blocks`` arms the
+        write-through threshold, every N queued blocks mid-drain (the
+        fleet-handoff publication window). Threshold flushes write only
+        ALREADY-RESOLVED payloads, so file I/O rides the serving path
+        but a device sync never does."""
         if (
             self.disk is None
             or chain in self._pending
@@ -779,19 +807,30 @@ class TieredStore:
         self._pending[chain] = entry
         if callable(payload):
             self._note_lazy(entry)
+        threshold = _config.flush_blocks
+        if threshold > 0 and len(self._pending) >= threshold:
+            self._flush_pending(force=False)
 
-    def settle(self) -> int:
-        """Flush pending disk writes + resolve lazy host payloads (the
-        sanctioned drain-end point: every async device→host copy
-        started this drain has long resolved). Returns entries
-        written."""
+    def _flush_pending(self, force: bool = True) -> int:
+        """Write queued blocks through to the disk store. ``force``
+        (settle / handoff publication — the sanctioned sync points)
+        resolves lazy payloads; a threshold flush (``force=False``)
+        writes only blocks whose payload is already a plain value and
+        leaves unresolved lazies queued — the serving path never pays a
+        device sync for write-through. Returns entries written."""
         wrote = 0
         wrote_tokens = 0
         t0 = time.monotonic()
-        pending = list(self._pending.values())
-        self._pending.clear()
-        self._lazy.clear()
-        for p in pending:
+        pending = self._pending
+        self._pending = {}
+        if force:
+            self._lazy.clear()
+        for chain, p in pending.items():
+            if not force and callable(p.payload):
+                # Unresolved lazy: stays queued (and stays in _lazy —
+                # the bounded resolve keeps draining it off-threshold).
+                self._pending[chain] = p
+                continue
             payload = p.payload() if callable(p.payload) else p.payload
             if self.disk is not None and self.disk.put(
                 p.chain, p.tokens, payload
@@ -802,10 +841,50 @@ class TieredStore:
             self.stats.store_writes += wrote
             self.stats.swap_out_s += time.monotonic() - t0
             self._emit("store", "disk", wrote, wrote_tokens)
+        return wrote
+
+    def settle(self) -> int:
+        """Flush pending disk writes + resolve lazy host payloads (the
+        sanctioned drain-end point: every async device→host copy
+        started this drain has long resolved). Returns entries
+        written."""
+        wrote = self._flush_pending(force=True)
         if self.host is not None:
             for b in list(self.host._blocks.values()):
                 HostTier.materialize(b)
         return wrote
+
+    def publish_chains(self, chains, slot: int = -1) -> list[str]:
+        """Prefill-side handoff publication: force-flush the pending
+        queue (a sanctioned sync point, like ``settle`` — the prefill
+        drain just ended) so the given chains are durable in the SHARED
+        store, and return the sublist that actually is. Emits one
+        ``ship`` SwapEvent for the durable blocks — the cross-replica
+        half of the tier state machine's telemetry."""
+        if self.disk is None:
+            return []
+        self._flush_pending(force=True)
+        durable = [c for c in chains if self.disk.has(c)]
+        if durable:
+            self._emit("ship", "disk", len(durable), 0, slot)
+        return durable
+
+    def prefetch_chains(self, chains) -> int:
+        """Decode-side prefetch hint: probe the store for chains a
+        remote prefill shipped ahead of the admission that will adopt
+        them (existence only — promotion into fresh pages happens in
+        that admission's tiered lookup, overlapped with whatever the
+        decode replica is doing now). Emits one ``prefetch`` SwapEvent;
+        returns the probe's hit count."""
+        if self.disk is None:
+            return 0
+        n = sum(
+            1
+            for c in chains
+            if c in self._pending or self.disk.has(c)
+        )
+        self._emit("prefetch", "disk", n, 0)
+        return n
 
     def check_invariants(self) -> None:
         if self.host is not None:

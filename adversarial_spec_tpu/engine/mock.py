@@ -428,6 +428,35 @@ class MockEngine:
         obs_mod.slo_check("ttft", span_id, prefill_s)
         obs_mod.slo_check("round", span_id, prefill_s + decode_s)
 
+    def _ensure_prefix(self) -> None:
+        """Build the allocator + prefix cache (and attach the KV tiers
+        when armed) on first use — also reachable through ``prefetch``,
+        so a COLD decode replica can probe the shared store before its
+        first request ever admits."""
+        from adversarial_spec_tpu.engine import prefix_cache as prefix_mod
+
+        if self._prefix is not None:
+            return
+        from adversarial_spec_tpu.engine import kvtier as kvtier_mod
+        from adversarial_spec_tpu.engine.kvcache import PageAllocator
+
+        self._allocator = PageAllocator(_POOL_PAGES, _PAGE_TOKENS)
+        self._prefix = prefix_mod.PrefixCache(
+            self._allocator,
+            max_pages=prefix_mod.config().max_pages,
+        )
+        if kvtier_mod.armed():
+            # Same tier state machine as the scheduler, accounting
+            # only: nominal block bytes (no KV exists here) and a
+            # mock-namespace store fingerprint, so a real engine
+            # can never rehydrate accounting-only entries.
+            tiers = kvtier_mod.build_for(
+                _PAGE_TOKENS * 64,
+                ("mock", _TOKEN_CHARS, _PAGE_TOKENS),
+            )
+            if tiers is not None:
+                self._prefix.attach_tiers(tiers)
+
     def _account_prefix(
         self,
         req: ChatRequest,
@@ -449,26 +478,7 @@ class MockEngine:
             prefix_mod.stats.record_prefill(len(tokens), 0)
             self._account_interleave(len(tokens), overlapped, req_index)
             return 0
-        if self._prefix is None:
-            from adversarial_spec_tpu.engine import kvtier as kvtier_mod
-            from adversarial_spec_tpu.engine.kvcache import PageAllocator
-
-            self._allocator = PageAllocator(_POOL_PAGES, _PAGE_TOKENS)
-            self._prefix = prefix_mod.PrefixCache(
-                self._allocator,
-                max_pages=prefix_mod.config().max_pages,
-            )
-            if kvtier_mod.armed():
-                # Same tier state machine as the scheduler, accounting
-                # only: nominal block bytes (no KV exists here) and a
-                # mock-namespace store fingerprint, so a real engine
-                # can never rehydrate accounting-only entries.
-                tiers = kvtier_mod.build_for(
-                    _PAGE_TOKENS * 64,
-                    ("mock", _TOKEN_CHARS, _PAGE_TOKENS),
-                )
-                if tiers is not None:
-                    self._prefix.attach_tiers(tiers)
+        self._ensure_prefix()
         # The cap is per-round CLI config; follow it on a live cache.
         self._prefix.max_pages = prefix_mod.config().max_pages
         alloc, cache = self._allocator, self._prefix
@@ -527,6 +537,81 @@ class MockEngine:
         prefix_mod.stats.record_prefill(len(tokens) - cached, cached)
         self._account_interleave(len(tokens) - cached, overlapped, req_index)
         return cached
+
+    @staticmethod
+    def _chain_walk(req: ChatRequest) -> list[str]:
+        """The request's full-page chain hashes, computed from the
+        prompt text alone — exactly the chains ``lookup_tiered`` walks
+        on the decode side, so they are the handoff hint's currency."""
+        from adversarial_spec_tpu.engine import kvtier as kvtier_mod
+
+        text = req.system + "\x1f" + req.user
+        tokens = [
+            text[i : i + _TOKEN_CHARS]
+            for i in range(0, len(text), _TOKEN_CHARS)
+        ]
+        chains: list[str] = []
+        chain = ""
+        for b in range(len(tokens) // _PAGE_TOKENS):
+            key = tuple(tokens[b * _PAGE_TOKENS : (b + 1) * _PAGE_TOKENS])
+            chain = kvtier_mod.chain_hash(chain, key)
+            chains.append(chain)
+        return chains
+
+    def prefill(
+        self, requests: list[ChatRequest], params: SamplingParams
+    ) -> list[dict]:
+        """Disaggregated prefill — the handoff's shipping half: run
+        admission + prefix/tier accounting ONLY (no reply decodes),
+        settle the produced blocks write-through to the shared disk
+        store, and return each request's durable chain hashes. The
+        decode-side replica prefetches those chains and its first step
+        starts from a tier hit; a request whose blocks did not all
+        land reports only the durable prefix, so the router's
+        adopt-vs-degrade decision is store-accurate."""
+        out: list[dict] = []
+        for i, req in enumerate(requests):
+            with obs_mod.trace_scope(req.trace_id, req.span_id):
+                cached = self._account_prefix(
+                    req, overlapped=i > 0, req_index=i
+                )
+                chains = self._chain_walk(req)
+                tiers = (
+                    self._prefix.tiers if self._prefix is not None else None
+                )
+                durable = (
+                    tiers.publish_chains(chains, slot=i)
+                    if tiers is not None
+                    else []
+                )
+                in_tokens = _estimate_tokens(req.system) + _estimate_tokens(
+                    req.user
+                )
+                out.append(
+                    {
+                        "chains": list(durable),
+                        "blocks": len(durable),
+                        "tokens": in_tokens,
+                        "cached": cached,
+                        "new_tokens": max(in_tokens - cached, 0),
+                    }
+                )
+        return out
+
+    def prefetch(self, chains) -> int:
+        """Decode-side handoff hint: how many of the shipped chains
+        this engine's tier store can already serve (the promotion
+        itself happens on the adopting request's own tiered lookup —
+        this is the ahead-of-admission probe)."""
+        from adversarial_spec_tpu.engine import prefix_cache as prefix_mod
+
+        if not prefix_mod.config().enabled:
+            return 0
+        self._ensure_prefix()
+        tiers = self._prefix.tiers
+        if tiers is None:
+            return 0
+        return tiers.prefetch_chains(chains)
 
     def chat(
         self,

@@ -62,6 +62,19 @@ DEFAULT_SCALE_COOLDOWN_S = 5.0
 DEFAULT_SCALE_INTERVAL_S = 0.25
 DEFAULT_SPAWN_RETRIES = 3
 
+# Disaggregation defaults (fleet/handoff.py + role-aware routing).
+# ``prefill_replicas`` > 0 splits the fleet: that many founders carry
+# the "prefill" role, the rest "decode", and admissions whose estimated
+# prefill exceeds ``handoff_threshold_tokens`` run admission+prefill on
+# a prefill replica, publish the produced blocks to the shared store,
+# and decode on a decode replica that adopts them from a tier hit.
+# 0 keeps the symmetric (un-roled) fleet. The per-role min/max bound
+# the role-aware autoscaler.
+DEFAULT_PREFILL_REPLICAS = 0
+DEFAULT_HANDOFF_THRESHOLD_TOKENS = 256
+DEFAULT_MIN_PREFILL_REPLICAS = 1
+DEFAULT_MAX_PREFILL_REPLICAS = 2
+
 
 def env_enabled() -> bool:
     """The process default for the master switch (``ADVSPEC_FLEET``).
@@ -129,6 +142,23 @@ def env_scale_interval_s() -> float:
     )
 
 
+def env_prefill_replicas() -> int:
+    """Prefill-role founder count (``ADVSPEC_FLEET_PREFILL_REPLICAS``;
+    0 = symmetric fleet, no disaggregation)."""
+    return _env_int(
+        "ADVSPEC_FLEET_PREFILL_REPLICAS", DEFAULT_PREFILL_REPLICAS
+    )
+
+
+def env_handoff_threshold_tokens() -> int:
+    """Estimated-prefill-token threshold above which an admission
+    routes prefill-first (``ADVSPEC_FLEET_HANDOFF_THRESHOLD``)."""
+    return _env_int(
+        "ADVSPEC_FLEET_HANDOFF_THRESHOLD",
+        DEFAULT_HANDOFF_THRESHOLD_TOKENS,
+    )
+
+
 @dataclass
 class FleetConfig:
     """Process-wide knobs, set once per CLI round (or by tests)."""
@@ -158,6 +188,14 @@ class FleetConfig:
     # Bounded spawn retry (fleet/replica.py spawn_replica): attempts
     # past the first before a typed SpawnFailed aborts the scale-out.
     spawn_retries: int = DEFAULT_SPAWN_RETRIES
+    # Disaggregation (fleet/handoff.py): founders carrying the
+    # "prefill" role (0 = symmetric fleet), the estimated-prefill
+    # threshold that routes an admission prefill-first, and the
+    # per-role membership bounds the role-aware autoscaler honors.
+    prefill_replicas: int = DEFAULT_PREFILL_REPLICAS
+    handoff_threshold_tokens: int = DEFAULT_HANDOFF_THRESHOLD_TOKENS
+    min_prefill_replicas: int = DEFAULT_MIN_PREFILL_REPLICAS
+    max_prefill_replicas: int = DEFAULT_MAX_PREFILL_REPLICAS
 
 
 def _coerce_transport(value) -> str:
@@ -208,12 +246,30 @@ class FleetStats(procconfig.StatsBase):
     scale_ins: int = 0
     spawn_failures: int = 0
     flaps_suppressed: int = 0
+    # Disaggregation (fleet/handoff.py): cross-replica KV handoffs by
+    # terminal outcome. ``handoff_adopted`` = the decode replica's
+    # first step started from a tier hit on the shipped blocks;
+    # ``handoff_degraded`` = the lost-race fallback (store miss,
+    # quarantine, partial publish) re-prefilled locally —
+    # byte-identical transcript, just slower; ``handoff_abandoned`` =
+    # the prefill side died before publication. ``handoff_shipped_
+    # blocks`` counts blocks made durable for a handoff.
+    handoff_attempts: int = 0
+    handoff_adopted: int = 0
+    handoff_degraded: int = 0
+    handoff_abandoned: int = 0
+    handoff_shipped_blocks: int = 0
 
     def snapshot(self) -> dict:
         out = self.as_dict()
         out["affinity_hit_rate"] = (
             round(self.affinity_hits / self.routed_requests, 4)
             if self.routed_requests
+            else 0.0
+        )
+        out["handoff_hit_rate"] = (
+            round(self.handoff_adopted / self.handoff_attempts, 4)
+            if self.handoff_attempts
             else 0.0
         )
         return out
@@ -229,6 +285,8 @@ _state = procconfig.ProcState(
         max_replicas=env_max_replicas(),
         scale_cooldown_s=env_scale_cooldown_s(),
         scale_interval_s=env_scale_interval_s(),
+        prefill_replicas=env_prefill_replicas(),
+        handoff_threshold_tokens=env_handoff_threshold_tokens(),
     ),
     FleetStats(),
     coerce={
@@ -241,6 +299,10 @@ _state = procconfig.ProcState(
         "scale_cooldown_s": lambda v: max(0.0, float(v)),
         "scale_interval_s": lambda v: max(0.0, float(v)),
         "spawn_retries": lambda v: max(0, int(v)),
+        "prefill_replicas": lambda v: max(0, int(v)),
+        "handoff_threshold_tokens": lambda v: max(0, int(v)),
+        "min_prefill_replicas": lambda v: max(1, int(v)),
+        "max_prefill_replicas": lambda v: max(1, int(v)),
     },
 )
 _config = _state.config
@@ -266,6 +328,10 @@ def configure(
     scale_cooldown_s: float | None = None,
     scale_interval_s: float | None = None,
     spawn_retries: int | None = None,
+    prefill_replicas: int | None = None,
+    handoff_threshold_tokens: int | None = None,
+    min_prefill_replicas: int | None = None,
+    max_prefill_replicas: int | None = None,
 ) -> FleetConfig:
     return _state.configure(
         enabled=enabled,
@@ -282,6 +348,10 @@ def configure(
         scale_cooldown_s=scale_cooldown_s,
         scale_interval_s=scale_interval_s,
         spawn_retries=spawn_retries,
+        prefill_replicas=prefill_replicas,
+        handoff_threshold_tokens=handoff_threshold_tokens,
+        min_prefill_replicas=min_prefill_replicas,
+        max_prefill_replicas=max_prefill_replicas,
     )
 
 
@@ -307,6 +377,17 @@ def armed() -> bool:
     return _config.replicas >= 2
 
 
+def disagg_armed() -> bool:
+    """True when the fleet is split into prefill/decode roles: a
+    routable fleet with at least one prefill-role founder AND at least
+    one decode replica left over."""
+    return (
+        armed()
+        and _config.prefill_replicas > 0
+        and _config.replicas > _config.prefill_replicas
+    )
+
+
 # -- the process fleet engine ----------------------------------------------
 # Built lazily on first armed dispatch, rebuilt when the knobs that
 # shape the topology change (the TpuEngine batcher_key precedent), and
@@ -319,11 +400,19 @@ _engine_key = None
 def _topology_key():
     """(founder count, rebuild key) for the current config. Elastic
     founders start inside [floor, ceiling] — typically AT the floor,
-    growing on demand (the bench's elastic arm)."""
+    growing on demand (the bench's elastic arm). The prefill-role
+    founder count shapes the topology too: flipping disaggregation on
+    or off rebuilds the fleet with the roles re-tagged."""
     n = _config.replicas
     if _config.autoscale:
         n = max(_config.min_replicas, min(n, _config.max_replicas))
-    return n, (n, _config.autoscale, _config.transport, _config.request_timeout_s)
+    return n, (
+        n,
+        _config.autoscale,
+        _config.transport,
+        _config.request_timeout_s,
+        _config.prefill_replicas,
+    )
 
 
 def fleet_engine():
@@ -341,6 +430,7 @@ def fleet_engine():
             replicas=n,
             transport=_config.transport,
             request_timeout_s=_config.request_timeout_s,
+            prefill_replicas=_config.prefill_replicas,
         )
         _engine_key = key
     return _engine

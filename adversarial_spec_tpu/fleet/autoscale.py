@@ -43,6 +43,15 @@ suppressed inside ``scale_cooldown_s`` of the previous membership
 change (counted in ``stats.flaps_suppressed``). Membership is clamped
 to ``[min_replicas, max_replicas]`` — the floor and ceiling are hard.
 
+Role-aware elasticity (disaggregated fleets): when the managed engine
+is role-split (``prefill_replicas > 0``), the tick reads the
+scheduler's per-role backlog split instead — prefill-token backlog
+sizes the PREFILL pool, the decode remainder sizes the DECODE pool —
+each under its own min/max (``min/max_prefill_replicas`` vs the
+symmetric ``min/max_replicas``) and its own hysteresis streak. The
+decisions run through the same spawn/warm/ring and drain/retire paths;
+the per-replica lifecycle machine is reused unchanged.
+
 The loop thread calls exactly :meth:`tick`; the deterministic drills
 (``tests/test_autoscale.py``, ``tools/chaos_run.py --scale-storm``)
 inject ``clock``/``sleep``/``rng`` and call ``tick()`` directly.
@@ -117,6 +126,12 @@ class Autoscaler:
         self._pending: dict[str, object] = {}
         self._out_streak = 0
         self._in_streak = 0
+        # Per-role streaks (disaggregated fleets): each pool carries
+        # its own hysteresis so prefill pressure cannot spend decode's
+        # streak or vice versa. The shared cooldown still serializes
+        # membership changes across pools.
+        self._out_streaks: dict[str, int] = {}
+        self._in_streaks: dict[str, int] = {}
         self._last_change_t: float | None = None
         self._last_backlog = 0
         self._desired = max(1, len(self._members))
@@ -263,6 +278,8 @@ class Autoscaler:
         with self._lock:
             self._last_backlog = backlog
             self._reconcile()
+            if self._disagg():
+                return self._tick_disagg(cfg, snap, brownout, draining)
             serving = self._serving_ids()
             n = len(serving)
             per = serve_mod.config().max_backlog_tokens
@@ -308,6 +325,86 @@ class Autoscaler:
                 return self._scale_out(snap, n, reason=reason, cfg=cfg)
             return self._scale_in(snap, n, cfg=cfg)
 
+    def _disagg(self) -> bool:
+        """Whether the managed fleet is role-split (prefill/decode
+        disaggregation) — flips the tick to per-role decisions."""
+        return getattr(self._engine, "prefill_replicas", 0) > 0
+
+    def _tick_disagg(self, cfg, snap, brownout: bool, draining: bool) -> bool:
+        """Role-aware decision (caller holds the lock): each pool
+        reads ITS half of the scheduler's backlog split — prefill-
+        token backlog sizes the prefill pool, the decode remainder
+        sizes the decode pool — under its own min/max and its own
+        hysteresis streak. The winning decision runs through the SAME
+        spawn/warm/ring and drain/retire paths as a symmetric fleet;
+        the lifecycle machine never learns about roles."""
+        per = serve_mod.config().max_backlog_tokens
+        pools = (
+            (
+                "prefill",
+                int(snap.get("prefill_backlog_tokens", 0)),
+                cfg.min_prefill_replicas,
+                cfg.max_prefill_replicas,
+            ),
+            (
+                "decode",
+                int(snap.get("decode_backlog_tokens", 0)),
+                cfg.min_replicas,
+                cfg.max_replicas,
+            ),
+        )
+        n_total = len(self._serving_ids())
+        decision = None
+        for role, backlog, lo, hi in pools:
+            n = len(self._serving_ids(role))
+            want_out = (
+                not draining
+                and n < hi
+                and (
+                    brownout
+                    or backlog >= cfg.scale_out_fraction * per * max(n, 1)
+                )
+            )
+            want_in = (
+                not draining
+                and not brownout
+                and n > lo
+                and backlog
+                <= cfg.scale_in_fraction * per * max(n - 1, 1)
+            )
+            self._out_streaks[role] = (
+                self._out_streaks.get(role, 0) + 1 if want_out else 0
+            )
+            self._in_streaks[role] = (
+                self._in_streaks.get(role, 0) + 1 if want_in else 0
+            )
+            if decision is not None:
+                continue  # streaks still advance for the other pool
+            if self._out_streaks[role] >= cfg.scale_out_ticks and want_out:
+                decision = (
+                    role, "out", "brownout" if brownout else "backlog"
+                )
+            elif self._in_streaks[role] >= cfg.scale_in_ticks and want_in:
+                decision = (role, "in", "idle")
+        if decision is None:
+            self._set_desired(
+                max(n_total, cfg.min_replicas + cfg.min_prefill_replicas)
+            )
+            return False
+        now = self._clock()
+        if (
+            self._last_change_t is not None
+            and now - self._last_change_t < cfg.scale_cooldown_s
+        ):
+            self.stats.flaps_suppressed += 1
+            return False
+        role, direction, reason = decision
+        if direction == "out":
+            return self._scale_out(
+                snap, n_total, reason=reason, cfg=cfg, role=role
+            )
+        return self._scale_in(snap, n_total, cfg=cfg, role=role)
+
     def _reconcile(self) -> None:
         """Members the ROUTER retired behind our back (transport
         fault, heartbeat miss) funnel through the surgery too, so the
@@ -319,17 +416,24 @@ class Autoscaler:
                     rid, self._router.retired_reason(rid) or "dead"
                 )
 
-    def _serving_ids(self) -> list[str]:
+    def _serving_ids(self, role: str | None = None) -> list[str]:
         ring = set(self._router.alive_ids())
-        return [
-            rid
-            for rid, st in self._members.items()
-            if st == SERVING and rid in ring
-        ]
+        out = []
+        for rid, st in self._members.items():
+            if st != SERVING or rid not in ring:
+                continue
+            if role is not None:
+                rep = self._router.replica(rid)
+                if getattr(rep, "role", "") != role:
+                    continue
+            out.append(rid)
+        return out
 
     # -- scale-out: spawn -> warm -> ping -> ring --------------------------
 
-    def _scale_out(self, snap: dict, n: int, *, reason: str, cfg) -> bool:
+    def _scale_out(
+        self, snap: dict, n: int, *, reason: str, cfg, role: str = ""
+    ) -> bool:
         rid = self._engine.reserve_replica_id()
         self._set_desired(n + 1)
         self._begin_provision(rid)
@@ -337,13 +441,14 @@ class Autoscaler:
         try:
             rep = self._engine.spawn_replica(
                 rid,
+                role=role,
                 retries=cfg.spawn_retries,
                 sleep=self._sleep,
                 rng=self._rng,
             )
         except SpawnFailed:
             self.stats.spawn_failures += 1
-            self._out_streak = 0
+            self._reset_streak("out", role)
             self._last_change_t = self._clock()  # never loop hot
             self._set_desired(n)
             self._abort_warm(rid, "spawn_failed")
@@ -357,7 +462,7 @@ class Autoscaler:
                 raise RuntimeError(f"{rid} failed post-warm ping")
         except Exception:
             # Died WHILE warming: never entered the ring, never will.
-            self._out_streak = 0
+            self._reset_streak("out", role)
             self._last_change_t = self._clock()
             self._set_desired(n)
             self._abort_warm(rid, "warm_failed")
@@ -370,8 +475,18 @@ class Autoscaler:
             obs_mod.hot.fleet_scale("out", reason).inc()
         self._emit("serving", replica=rid, direction="out", reason=reason)
         self._last_change_t = self._clock()
-        self._out_streak = 0
+        self._reset_streak("out", role)
         return True
+
+    def _reset_streak(self, direction: str, role: str = "") -> None:
+        if direction == "out":
+            self._out_streak = 0
+            if role:
+                self._out_streaks[role] = 0
+        else:
+            self._in_streak = 0
+            if role:
+                self._in_streaks[role] = 0
 
     def _hot_models(self, snap: dict) -> list[str]:
         """Hottest models in the scheduler's active mix (already
@@ -381,9 +496,13 @@ class Autoscaler:
 
     # -- scale-in: un-ring -> drain -> retire ------------------------------
 
-    def _scale_in(self, snap: dict, n: int, *, cfg) -> bool:
-        serving = self._serving_ids()
-        if len(serving) <= cfg.min_replicas:
+    def _scale_in(self, snap: dict, n: int, *, cfg, role: str = "") -> bool:
+        serving = self._serving_ids(role or None)
+        floor = (
+            cfg.min_prefill_replicas if role == "prefill"
+            else cfg.min_replicas
+        )
+        if len(serving) <= floor:
             return False
         load = self._router.affinity_load(snap.get("active_keys") or [])
         # Least-affine loses; ties break toward the NEWEST replica
@@ -407,7 +526,7 @@ class Autoscaler:
             self._sleep(_DRAIN_POLL_S)
         self._finish_scale_in(victim)
         self._last_change_t = self._clock()
-        self._in_streak = 0
+        self._reset_streak("in", role)
         return True
 
     @staticmethod

@@ -145,8 +145,12 @@ def check_engine_invariants(engine) -> None:
 class InProcessReplica:
     """A fresh engine instance (per provider) behind a replica id."""
 
-    def __init__(self, replica_id: str, engine_factory=None):
+    def __init__(self, replica_id: str, engine_factory=None, role: str = ""):
         self.id = replica_id
+        # Disaggregation role ("prefill" / "decode"; "" = any) — the
+        # hash ring tags this node with it so role-filtered preference
+        # walks keep ordinary traffic off prefill replicas.
+        self.role = role
         # The lifecycle seam: fresh engines, NOT dispatch's process-wide
         # cache — each replica must own its allocator/prefix cache.
         if engine_factory is None:
@@ -243,6 +247,42 @@ class InProcessReplica:
                     on_completion(j, comp)
         return results  # type: ignore[return-value]
 
+    def prefill(self, requests, params) -> list[dict]:
+        """Disaggregated prefill: run admission + prefill ONLY for the
+        group (no decode), publish the produced KV blocks to the
+        shared store, and return each request's chain hashes — the
+        handoff hint the decode-side replica prefetches against. Per
+        provider group, mirroring ``chat_batch``."""
+        if self.closed:
+            raise ReplicaDead(self.id, "is closed")
+        results: list[dict | None] = [None] * len(requests)
+        by_provider: dict[str, list[int]] = {}
+        for j, req in enumerate(requests):
+            by_provider.setdefault(
+                req.model.partition("://")[0], []
+            ).append(j)
+        for idxs in by_provider.values():
+            engine = self._engine_for(requests[idxs[0]].model)
+            outs = engine.prefill([requests[j] for j in idxs], params)
+            for row, j in enumerate(idxs):
+                out = outs[row]
+                results[j] = out
+                # Prefill seconds on the same synthetic tokens/1024
+                # clock chat_batch uses (no decode half).
+                self.busy_s += max(int(out.get("new_tokens", 0)), 0) / 1024.0
+        return results  # type: ignore[return-value]
+
+    def prefetch(self, model: str, chains) -> int:
+        """Decode-side handoff hint: probe the shared store for the
+        shipped chains (promoting what it can ahead of the adopting
+        request). Returns how many of ``chains`` are available."""
+        if self.closed:
+            raise ReplicaDead(self.id, "is closed")
+        engine = self._engine_for(model)
+        if hasattr(engine, "prefetch"):
+            return int(engine.prefetch(chains))
+        return 0
+
     def validate(self, model: str) -> str | None:
         try:
             return self._engine_for(model).validate(model)
@@ -258,6 +298,7 @@ class InProcessReplica:
     def stats(self) -> dict:
         return {
             "replica": self.id,
+            "role": self.role,
             "served": dict(self.served),
             "busy_s": round(self.busy_s, 6),
         }
@@ -276,8 +317,10 @@ class WorkerReplica:
         request_timeout_s: float = 30.0,
         env: dict | None = None,
         log_dir: str | None = None,
+        role: str = "",
     ):
         self.id = replica_id
+        self.role = role
         self.request_timeout_s = float(request_timeout_s)
         self._env = dict(env) if env is not None else None
         self._log_dir = log_dir
@@ -320,14 +363,17 @@ class WorkerReplica:
             stderr = self._log
         # Binary, unbuffered pipes: the reader below selects on the raw
         # fd and must never race a Python-level buffer (see _rbuf).
+        argv = [
+            sys.executable,
+            "-m",
+            "adversarial_spec_tpu.fleet.worker",
+            "--replica-id",
+            self.id,
+        ]
+        if self.role:
+            argv += ["--role", self.role]
         self._proc = subprocess.Popen(
-            [
-                sys.executable,
-                "-m",
-                "adversarial_spec_tpu.fleet.worker",
-                "--replica-id",
-                self.id,
-            ],
+            argv,
             stdin=subprocess.PIPE,
             stdout=subprocess.PIPE,
             stderr=stderr,
@@ -447,6 +493,59 @@ class WorkerReplica:
             raise
         return [got[j] for j in range(len(requests))]
 
+    def prefill(self, requests, params) -> list[dict]:
+        """Disaggregated prefill through the worker (``prefill`` op).
+        The worker settles each request's blocks to the shared store
+        BEFORE flushing its result line, so every result that arrives
+        here is durable — a SIGKILL mid-publish loses only the
+        unflushed remainder, which the ReplicaDead ``partial`` carries
+        back for the partial-publish degradation decision."""
+        self._send(
+            {
+                "op": "prefill",
+                "requests": [request_to_wire(r) for r in requests],
+                "params": params_to_wire(params),
+            }
+        )
+        got: dict[int, dict] = {}
+        try:
+            while len(got) < len(requests):
+                obj = self._read_line(self.request_timeout_s)
+                if obj.get("done"):
+                    break
+                j = int(obj.get("i", -1))
+                if not 0 <= j < len(requests) or j in got:
+                    raise ReplicaDead(
+                        self.id, f"answered out of protocol (i={j})", got
+                    )
+                got[j] = dict(obj.get("result") or {})
+            if len(got) == len(requests):
+                obj = self._read_line(self.request_timeout_s)
+                if not obj.get("done"):
+                    raise ReplicaDead(
+                        self.id, "missed its done marker", got
+                    )
+            else:
+                raise ReplicaDead(
+                    self.id,
+                    f"finished early ({len(got)}/{len(requests)})",
+                    got,
+                )
+        except ReplicaDead as e:
+            if not e.partial:
+                e.partial = dict(got)
+            raise
+        return [got[j] for j in range(len(requests))]
+
+    def prefetch(self, model: str, chains) -> int:
+        """Decode-side handoff hint through the worker (``prefetch``
+        op): how many shipped chains its shared store can serve."""
+        self._send(
+            {"op": "prefetch", "model": model, "chains": list(chains)}
+        )
+        resp = self._read_line(self.request_timeout_s)
+        return int(resp.get("found", 0))
+
     def warm(self, models: list[str]) -> int:
         """Worker-side warm (fleet/worker.py ``warm`` op): the worker
         builds its engines for ``models`` — shared-store re-attach plus
@@ -513,6 +612,7 @@ def spawn_replica(
     request_timeout_s: float = 30.0,
     worker_env: dict | None = None,
     log_dir: str | None = None,
+    role: str = "",
 ):
     """Provision one replica with BOUNDED retry: each attempt spawns
     the transport and requires a ping answer; a failed attempt is torn
@@ -540,10 +640,11 @@ def spawn_replica(
                     request_timeout_s=request_timeout_s,
                     env=worker_env,
                     log_dir=log_dir,
+                    role=role,
                 )
             else:
                 rep = InProcessReplica(
-                    replica_id, engine_factory=engine_factory
+                    replica_id, engine_factory=engine_factory, role=role
                 )
             if not rep.ping():
                 raise ReplicaDead(replica_id, "never answered its ping")

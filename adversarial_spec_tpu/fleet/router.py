@@ -29,6 +29,20 @@ The chaos injector's ``replica`` seam fires before every group
 dispatch: an injected fault there exercises the breaker-skip path
 (the replica stays alive; its (replica, model) pairs absorb the
 failure) without killing any process.
+
+**Disaggregation** (``prefill_replicas > 0``): the fleet splits into a
+PREFILL pool and a DECODE pool (role tags on the hash ring). Ordinary
+chat traffic routes decode-side only; an admission whose estimated
+prefill tokens clear ``handoff_threshold_tokens`` first runs
+admission + prefill on a prefill-role replica, which publishes the
+produced KV blocks to the shared disk store and returns the chain
+hashes. The decode replica — chosen at the SAME time — receives a
+prefetch hint (the chain list) so engine/kvtier.py promotes the
+shipped blocks overlapped with the tail of the remote prefill; its
+first step starts from a tier hit. A handoff that loses the race
+(store miss, partial publish, prefill-replica death) degrades to a
+local prefill with byte-identical transcripts — the lifecycle ledger
+(fleet/handoff.py) pins every path to exactly one outcome.
 """
 
 from __future__ import annotations
@@ -38,6 +52,7 @@ import threading
 from adversarial_spec_tpu import fleet as fleet_mod
 from adversarial_spec_tpu import obs as obs_mod
 from adversarial_spec_tpu.engine.types import ChatRequest, Completion, SamplingParams
+from adversarial_spec_tpu.fleet.handoff import HandoffLedger
 from adversarial_spec_tpu.fleet.hashring import HashRing
 from adversarial_spec_tpu.fleet.replica import (
     InProcessReplica,
@@ -64,7 +79,13 @@ class FleetRouter:
         stats=None,
     ):
         self._replicas = {r.id: r for r in replicas}
-        self._ring = HashRing(self._replicas)
+        self._ring = HashRing()
+        for r in replicas:
+            self._ring.add(r.id, getattr(r, "role", ""))
+        # Role every ORDINARY chat routes under (None = any replica;
+        # "decode" when the fleet is disaggregated — prefill replicas
+        # then only ever see the explicit handoff hop).
+        self.route_role: str | None = None
         # Retired replicas and why — the lifecycle surgery's ledger,
         # written ONLY by _retire_replica (GL-LIFECYCLE pins this).
         self._dead: dict[str, str] = {}
@@ -89,9 +110,14 @@ class FleetRouter:
 
     # -- membership --------------------------------------------------------
 
-    def alive_ids(self) -> list[str]:
+    def alive_ids(self, role: str | None = None) -> list[str]:
         with self._mlock:
-            return sorted(self._ring.nodes)
+            nodes = (
+                self._ring.nodes
+                if role is None
+                else self._ring.role_nodes(role)
+            )
+            return sorted(nodes)
 
     def replica(self, rid: str):
         return self._replicas.get(rid)
@@ -116,7 +142,7 @@ class FleetRouter:
             if rid in self._dead or rid in self._ring.nodes:
                 return False
             self._replicas[rid] = rep
-            self._ring.add(rid)
+            self._ring.add(rid, getattr(rep, "role", ""))
             alive = len(self._ring)
         if obs_mod.config().enabled:
             obs_mod.hot.replica_op("ready").inc()
@@ -259,10 +285,15 @@ class FleetRouter:
             # vnode points from its own thread, and a preference walk
             # racing an insort would misread the ring.
             with self._mlock:
-                order = self._ring.preference(key)
+                order = self._ring.preference(key, role=self.route_role)
+                if not order and self.route_role is not None:
+                    # The routed role's pool emptied (every decode
+                    # replica died): spill to the other pool rather
+                    # than fail — availability beats specialization.
+                    order = self._ring.preference(key)
             reason = "affinity"
         else:
-            alive = self.alive_ids()
+            alive = self.alive_ids(role=self.route_role) or self.alive_ids()
             self._rr += 1
             cut = self._rr % len(alive) if alive else 0
             order = alive[cut:] + alive[:cut]
@@ -280,6 +311,16 @@ class FleetRouter:
                 continue
             return rid, reason, rid == primary and self._affinity
         return None, reason, False
+
+    def handoff_pair(self, key: str) -> tuple[str | None, str | None]:
+        """The (prefill, decode) replica pair ``key`` hashes to — both
+        chosen at the SAME time, from the same ring walk, so the
+        prefetch hint can race ahead of the remote prefill. ``None``
+        entries mean that role's pool is empty."""
+        with self._mlock:
+            pre = self._ring.preference(key, limit=1, role="prefill")
+            dec = self._ring.preference(key, limit=1, role="decode")
+        return (pre[0] if pre else None, dec[0] if dec else None)
 
     def _record_route(
         self, i: int, req: ChatRequest, rid: str, hop: int, reason: str,
@@ -458,20 +499,31 @@ class FleetEngine:
         worker_env: dict | None = None,
         log_dir: str | None = None,
         stats=None,
+        prefill_replicas: int = 0,
+        handoff_threshold_tokens: int | None = None,
     ):
         n = max(1, int(replicas))
+        # Disaggregation: the first P founders take the prefill role,
+        # the rest decode; at least one decode replica always remains
+        # (P is clamped), and P=0 keeps every node untagged — the
+        # symmetric fleet, byte-identical to the pre-disagg topology.
+        p = max(0, min(int(prefill_replicas), n - 1))
         built = []
         for k in range(n):
             rid = f"r{k}"
+            role = ("prefill" if k < p else "decode") if p else ""
             if transport == "worker":
                 rep = WorkerReplica(
                     rid,
                     request_timeout_s=request_timeout_s,
                     env=worker_env,
                     log_dir=log_dir,
+                    role=role,
                 )
             else:
-                rep = InProcessReplica(rid, engine_factory=engine_factory)
+                rep = InProcessReplica(
+                    rid, engine_factory=engine_factory, role=role
+                )
             built.append(rep)
             (stats if stats is not None else fleet_mod.stats).replicas_spawned += 1
             if obs_mod.config().enabled:
@@ -489,9 +541,19 @@ class FleetEngine:
         self._log_dir = log_dir
         self._stats = stats if stats is not None else fleet_mod.stats
         self._next_rid = n
+        self.prefill_replicas = p
+        self.handoff_threshold_tokens = (
+            fleet_mod.config().handoff_threshold_tokens
+            if handoff_threshold_tokens is None
+            else max(0, int(handoff_threshold_tokens))
+        )
+        self.handoff = HandoffLedger(stats=stats)
         self.router = FleetRouter(
             built, breakers=breakers, affinity=affinity, stats=stats
         )
+        if p:
+            # Ordinary chat traffic never lands on a prefill replica.
+            self.router.route_role = "decode"
 
     def reserve_replica_id(self) -> str:
         """Mint the next replica id WITHOUT spawning — the autoscaler
@@ -505,6 +567,7 @@ class FleetEngine:
         self,
         rid: str | None = None,
         *,
+        role: str = "",
         retries: int = 3,
         backoff_base_s: float = 0.05,
         sleep=None,
@@ -531,6 +594,7 @@ class FleetEngine:
             request_timeout_s=self.request_timeout_s,
             worker_env=self._worker_env,
             log_dir=self._log_dir,
+            role=role,
         )
         self._stats.replicas_spawned += 1
         if obs_mod.config().enabled:
@@ -542,6 +606,119 @@ class FleetEngine:
         )
         return rep
 
+    # -- disaggregated prefill/decode handoff ------------------------------
+
+    @staticmethod
+    def estimate_prefill_tokens(req: ChatRequest) -> int:
+        """Estimated prefill tokens for one request — the admission
+        threshold's input, on the mock tokenizer's 4-chars-per-token
+        scale (system + separator + user)."""
+        return (len(req.system) + 1 + len(req.user)) // 4
+
+    def disagg_armed(self) -> bool:
+        """Whether a handoff can run right now: the fleet was built
+        disaggregated AND both role pools still have routable
+        members (a dead prefill pool silently disarms — every
+        admission just prefills locally, the degradation contract)."""
+        return bool(
+            self.prefill_replicas
+            and self.router.alive_ids("prefill")
+            and self.router.alive_ids("decode")
+        )
+
+    def _run_handoff(self, key, batch, req_ids, params, pre_rid, dec_rid):
+        """Drive ONE handoff through its lifecycle: remote prefill on
+        ``pre_rid`` → publish to the shared store → prefetch hint to
+        ``dec_rid``. Every path lands in exactly one ledger exit; a
+        lost race degrades (the decode side prefills locally with
+        byte-identical output) rather than erroring."""
+        self.handoff.begin(key, pre_rid, dec_rid)
+        for i, req in zip(req_ids, batch):
+            if obs_mod.config().enabled:
+                obs_mod.hot.route("prefill").inc()
+            obs_mod.emit(
+                obs_mod.RouteEvent(
+                    replica=pre_rid,
+                    req_id=i,
+                    key=key,
+                    model=req.model,
+                    hop=0,
+                    reason="prefill",
+                    trace_id=req.trace_id,
+                    span_id=req.span_id,
+                )
+            )
+        self.handoff.note_prefilling(key)
+        rep = self.router.replica(pre_rid)
+        try:
+            outs = rep.prefill(batch, params)
+        except ReplicaDead as e:
+            # The prefill replica died mid-publish. Results that hit
+            # the wire before death are DURABLE (the worker settles
+            # the store before flushing each line): a complete partial
+            # set still ships; anything less degrades to local prefill.
+            self.router._on_replica_fault(pre_rid, e)
+            outs = [e.partial.get(j) for j in range(len(batch))]
+            if any(o is None for o in outs):
+                self.handoff._degrade(key, "partial_publish")
+                return
+        except Exception:
+            self.handoff._degrade(key, "prefill_error")
+            return
+        chains: list[str] = []
+        seen: set[str] = set()
+        blocks = 0
+        for o in outs:
+            for c in o.get("chains", ()):
+                if c not in seen:
+                    seen.add(c)
+                    chains.append(c)
+            blocks += int(o.get("blocks", 0))
+        if not chains:
+            # Nothing page-aligned to ship (prompt below one KV page).
+            self.handoff._abandon(key, "no_blocks")
+            return
+        self.handoff.note_published(key, chains, blocks)
+        dec = self.router.replica(dec_rid)
+        try:
+            found = dec.prefetch(batch[0].model, chains)
+        except ReplicaDead as e:
+            self.router._on_replica_fault(dec_rid, e)
+            self.handoff._degrade(key, "decode_dead")
+            return
+        except Exception:
+            found = 0
+        if found >= len(chains):
+            self.handoff._finish_adopt(key)
+        else:
+            self.handoff._degrade(key, "store_miss")
+
+    def _maybe_handoff(self, requests, params) -> None:
+        """The routing split: admissions whose estimated prefill
+        clears the threshold run their prefill on a prefill-role
+        replica first. Grouped per affinity key — one handoff per
+        debate; later rounds ride the shipped prefix through the
+        ordinary tier path and never re-handoff."""
+        threshold = self.handoff_threshold_tokens
+        groups: dict[str, list[int]] = {}
+        for i, req in enumerate(requests):
+            groups.setdefault(self.router.affinity_key(req), []).append(i)
+        for key, idxs in groups.items():
+            if self.handoff.seen(key):
+                continue
+            est = max(
+                self.estimate_prefill_tokens(requests[i]) for i in idxs
+            )
+            if est < threshold:
+                continue
+            pre_rid, dec_rid = self.router.handoff_pair(key)
+            if pre_rid is None or dec_rid is None or pre_rid == dec_rid:
+                continue
+            self._run_handoff(
+                key, [requests[i] for i in idxs], idxs, params,
+                pre_rid, dec_rid,
+            )
+
     def chat(
         self,
         requests: list[ChatRequest],
@@ -549,6 +726,8 @@ class FleetEngine:
         consumer=None,
     ) -> list[Completion]:
         self.router.health_check()
+        if self.disagg_armed():
+            self._maybe_handoff(requests, params)
         return self.router.submit(requests, params, consumer=consumer)
 
     def validate(self, model: str) -> str | None:

@@ -27,11 +27,29 @@ fields), so every event this process emits resolves back to the round
 and opponent that caused it — the replica hop is invisible to causal
 tracing.
 
+Disaggregation ops (``--role prefill`` workers are the shipping end
+of a cross-replica KV handoff):
+
+- ``{"op": "prefill", "requests": [...], "params": {...}}`` — run
+  admission + prefill ONLY, settle the produced blocks to the shared
+  store, then write ``{"i": <n>, "result": {"chains": [...],
+  "blocks": <b>, ...}}`` per request and a done marker. Each result
+  line flushes only AFTER its blocks are durable, so a SIGKILL
+  mid-publish loses exactly the unflushed remainder — the
+  partial-publish degradation the router handles.
+- ``{"op": "prefetch", "model": ..., "chains": [...]}`` →
+  ``{"found": <n>}`` — the decode-side hint probe.
+- ``{"op": "role"}`` → ``{"role": ...}``.
+
 ``ADVSPEC_REPLICA_KILL_AFTER`` is the chaos trigger (mirroring the
 journal's ``ADVSPEC_JOURNAL_KILL_AFTER``): ``N`` or
 ``<replica-id>:N`` SIGKILLs THIS process the instant its N-th
 completion line is flushed — a real kill at a deterministic
 mid-round point (``tools/chaos_run.py --replica-kill``).
+``ADVSPEC_PREFILL_KILL_AFTER`` is the same trigger counted on PREFILL
+result lines instead (``tools/chaos_run.py --handoff-kill``: the
+prefill replica dies after its blocks are durable but before the
+decode side promotes them).
 """
 
 from __future__ import annotations
@@ -52,10 +70,10 @@ from adversarial_spec_tpu.fleet.replica import (
 )
 
 
-def _kill_after(replica_id: str) -> int:
-    """Parse ``ADVSPEC_REPLICA_KILL_AFTER`` (``N`` arms every worker,
-    ``<id>:N`` arms only the named replica). 0 = disarmed."""
-    raw = os.environ.get("ADVSPEC_REPLICA_KILL_AFTER", "")
+def _kill_after(replica_id: str, var: str = "ADVSPEC_REPLICA_KILL_AFTER") -> int:
+    """Parse a kill trigger (``N`` arms every worker, ``<id>:N`` arms
+    only the named replica). 0 = disarmed."""
+    raw = os.environ.get(var, "")
     if not raw:
         return 0
     target, sep, n = raw.rpartition(":")
@@ -68,13 +86,18 @@ def _kill_after(replica_id: str) -> int:
 
 
 class _Worker:
-    def __init__(self, replica_id: str, out) -> None:
+    def __init__(self, replica_id: str, out, role: str = "") -> None:
         self.replica_id = replica_id
+        self.role = role
         self.out = out
         self._engines: dict[str, object] = {}
         self.served: dict[str, int] = {}
         self._n_served = 0
+        self._n_prefilled = 0
         self._kill_after = _kill_after(replica_id)
+        self._prefill_kill_after = _kill_after(
+            replica_id, "ADVSPEC_PREFILL_KILL_AFTER"
+        )
 
     def _engine_for(self, model: str):
         key = model.partition("://")[0]
@@ -111,11 +134,37 @@ class _Worker:
                 os.kill(os.getpid(), signal.SIGKILL)
         self._write({"done": True, "served": self._n_served})
 
+    def _prefill(self, msg: dict) -> None:
+        """The handoff's shipping end: prefill each request, settle
+        its blocks to the shared store, and only THEN flush the result
+        line — every line the other end reads is durable, so the kill
+        trigger below produces exactly the durable-then-dead window
+        the ``--handoff-kill`` drill needs."""
+        requests = [request_from_wire(r) for r in msg.get("requests", [])]
+        params = params_from_wire(msg.get("params") or {})
+        for j, req in enumerate(requests):
+            try:
+                out = self._engine_for(req.model).prefill([req], params)[0]
+            except Exception as e:  # a request must not kill the worker
+                out = {"error": f"{type(e).__name__}: {e}", "chains": []}
+            self._write({"i": j, "result": out})
+            self._n_prefilled += 1
+            if (
+                self._prefill_kill_after
+                and self._n_prefilled >= self._prefill_kill_after
+            ):
+                # The handoff chaos trigger: die HARD with this
+                # request's blocks durable in the shared store and its
+                # result line flushed, before any decode-side adoption.
+                os.kill(os.getpid(), signal.SIGKILL)
+        self._write({"done": True, "prefilled": self._n_prefilled})
+
     def _stats(self) -> dict:
         from adversarial_spec_tpu.engine import kvtier, prefix_cache
 
         return {
             "replica": self.replica_id,
+            "role": self.role,
             "pid": os.getpid(),
             "served": dict(self.served),
             "prefix_cache": prefix_cache.snapshot(),
@@ -132,6 +181,20 @@ class _Worker:
                 op = msg.get("op")
                 if op == "chat":
                     self._chat(msg)
+                elif op == "prefill":
+                    self._prefill(msg)
+                elif op == "prefetch":
+                    model = msg.get("model", "")
+                    chains = [str(c) for c in msg.get("chains") or []]
+                    eng = self._engine_for(model)
+                    found = (
+                        int(eng.prefetch(chains))
+                        if hasattr(eng, "prefetch")
+                        else 0
+                    )
+                    self._write({"found": found})
+                elif op == "role":
+                    self._write({"role": self.role})
                 elif op == "ping":
                     self._write({"pong": True, "replica": self.replica_id})
                 elif op == "warm":
@@ -181,8 +244,14 @@ class _Worker:
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--replica-id", default="r0")
+    ap.add_argument(
+        "--role",
+        default="",
+        choices=("", "prefill", "decode"),
+        help="disaggregation role this replica serves",
+    )
     args = ap.parse_args(argv)
-    worker = _Worker(args.replica_id, sys.stdout)
+    worker = _Worker(args.replica_id, sys.stdout, role=args.role)
     return worker.serve(sys.stdin)
 
 

@@ -179,6 +179,7 @@ class HotMetrics:
         "serve_backlog",
         "serve_queue_wait",
         "weight_resident",
+        "handoff_latency",
         "_m",
         "_sync",
         "_fault",
@@ -192,6 +193,7 @@ class HotMetrics:
         "_serve_op",
         "_serve_shed",
         "_weight_swap",
+        "_handoff",
     )
 
     def __init__(self, m: MetricsRegistry) -> None:
@@ -311,6 +313,14 @@ class HotMetrics:
             "advspec_weight_resident_models",
             help="opponent models resident in device HBM",
         )
+        # Cross-replica KV handoff (fleet/handoff.py): prefill-publish
+        # through decode-adoption wall — the disaggregation tax a
+        # handoff pays instead of a local re-prefill.
+        self.handoff_latency = m.histogram(
+            "advspec_kv_handoff_seconds",
+            help="cross-replica KV handoff wall (prefill publish "
+            "through decode adoption)",
+        )
         self._sync: dict = {}
         self._fault: dict = {}
         self._breaker: dict = {}
@@ -323,6 +333,7 @@ class HotMetrics:
         self._serve_op: dict = {}
         self._serve_shed: dict = {}
         self._weight_swap: dict = {}
+        self._handoff: dict = {}
 
     def sync(self, reason: str):
         c = self._sync.get(reason)
@@ -457,6 +468,21 @@ class HotMetrics:
                 direction=direction,
             )
         return h
+
+    def handoff(self, outcome: str):
+        """Cross-replica KV handoffs by terminal outcome
+        (fleet/handoff.py state machine: adopted = the decode replica's
+        first step started from a tier hit; degraded = the lost-race
+        fallback re-prefilled locally; abandoned = the handoff died
+        before publication)."""
+        c = self._handoff.get(outcome)
+        if c is None:
+            c = self._handoff[outcome] = self._m.counter(
+                "advspec_kv_handoff_total",
+                help="cross-replica KV handoffs by outcome",
+                outcome=outcome,
+            )
+        return c
 
     def swap_latency(self, direction: str):
         """KV swap wall histogram by direction (in: promote/rehydrate

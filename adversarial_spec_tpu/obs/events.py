@@ -182,9 +182,11 @@ class SwapEvent:
     the edge of the tier state machine (demote: device→host; promote:
     host→device; rehydrate: disk→device; spill: host LRU→disk; store:
     insert write-through→disk; free: host LRU drop; quarantine: corrupt
-    disk entry moved aside). ``host_resident``/``disk_resident`` are
-    the per-tier block counts AFTER the op — tools/obs_dump.py's
-    occupancy timeline reads tier residency off these."""
+    disk entry moved aside; ship: prefill-side handoff publication to
+    the shared store; prefetch: decode-side hint probe ahead of
+    adoption). ``host_resident``/``disk_resident`` are the per-tier
+    block counts AFTER the op — tools/obs_dump.py's occupancy timeline
+    reads tier residency off these."""
 
     TYPE = "swap"
     op: str = "demote"
@@ -423,6 +425,8 @@ SWAP_OPS = (
     "store",
     "free",
     "quarantine",
+    "ship",
+    "prefetch",
 )
 
 # The weight-residency state machine's edges (engine/weightres.py) —
@@ -449,6 +453,10 @@ ROUTE_REASONS = (
     "breaker_open",
     "failover",
     "random",
+    # Disaggregated fleet (fleet/handoff.py): the prefill-role hop of
+    # a cross-replica KV handoff — the decode hop that follows it
+    # routes with its own reason (affinity within the decode pool).
+    "prefill",
 )
 
 # The serve-daemon request lifecycle (docs/serving.md state machine)

@@ -388,6 +388,7 @@ class ServeDaemon:
             debate_id,
             est,
             models=obj.get("models") or (),
+            prefill_tokens=driver.estimate_debate_prefill_tokens(obj),
         )
         if shed is not None:
             self._send(

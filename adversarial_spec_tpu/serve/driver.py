@@ -50,6 +50,16 @@ def estimate_debate_tokens(payload: dict) -> int:
     return per_opp * max(1, len(models))
 
 
+def estimate_debate_prefill_tokens(payload: dict) -> int:
+    """The PREFILL share of the debate estimate (prompt tokens only,
+    no decode budget) — the scheduler's per-role backlog split and the
+    disaggregated router's handoff threshold both read this scale."""
+    spec = payload.get("spec", "")
+    models = payload.get("models", [])
+    per_opp = max(1, len(spec) // 4) + 256
+    return per_opp * max(1, len(models))
+
+
 def _params_from_payload(payload: dict) -> SamplingParams:
     return SamplingParams(
         max_new_tokens=int(payload.get("max_new_tokens") or 1024),

@@ -94,6 +94,13 @@ def estimate_tokens(request: ChatRequest, params: SamplingParams) -> int:
     return max(1, prompt) + max(1, int(params.max_new_tokens))
 
 
+def estimate_prefill_tokens(request: ChatRequest) -> int:
+    """The PREFILL share of the admission estimate (prompt tokens
+    only, same 4-chars-per-token rule) — the disaggregated fleet's
+    routing threshold input and the prefill-pool backlog signal."""
+    return max(1, (len(request.system) + len(request.user)) // 4)
+
+
 @dataclass(frozen=True)
 class ShedDecision:
     """A typed admission refusal: the reason names WHY (a
@@ -193,6 +200,11 @@ class ServeScheduler:
         # backlog), per-tenant outstanding debate counts, per-tenant
         # quota remainders (armed when config.tenant_quota_tokens > 0).
         self._reserved: dict[str, int] = {}
+        # The PREFILL share of each reservation (role-aware elasticity:
+        # the autoscaler scales the prefill pool on this sub-ledger,
+        # the decode pool on the remainder). Kept beside _reserved,
+        # released with it.
+        self._reserved_prefill: dict[str, int] = {}
         self._debate_tenant: dict[str, str] = {}
         # Per-active-debate opponent pools (admission metadata): the
         # autoscaler's model-mix observer — a warming replica preloads
@@ -296,6 +308,7 @@ class ServeScheduler:
     def try_admit(
         self, tenant: str, tier: str, debate: str, est_tokens: int,
         models: list[str] | tuple[str, ...] = (),
+        prefill_tokens: int = 0,
     ) -> ShedDecision | None:
         """Admit one debate (reserving its estimate in the backlog
         ledger) or refuse it with a typed shed. Shed order under
@@ -362,6 +375,10 @@ class ServeScheduler:
                 return shed
             self._outstanding[tenant] = self._outstanding.get(tenant, 0) + 1
             self._reserved[debate] = est_tokens
+            if prefill_tokens > 0:
+                self._reserved_prefill[debate] = min(
+                    int(prefill_tokens), est_tokens
+                )
             self._debate_tenant[debate] = tenant
             if models:
                 self._debate_models[debate] = [str(m) for m in models]
@@ -382,6 +399,7 @@ class ServeScheduler:
             if debate not in self._debate_tenant:
                 return  # idempotent: already finished (or never admitted)
             self._reserved.pop(debate, None)
+            self._reserved_prefill.pop(debate, None)
             self._debate_models.pop(debate, None)
             tenant = self._debate_tenant.pop(debate, "")
             if tenant:
@@ -833,8 +851,17 @@ class ServeScheduler:
             for models in self._debate_models.values():
                 for m in models:
                     mix[m] = mix.get(m, 0) + 1
+            prefill_backlog = sum(self._reserved_prefill.values())
             return {
                 "backlog_tokens": self._backlog(),
+                # The per-role split (fleet disaggregation): prefill is
+                # the sub-ledger of prompt-token reservations, decode
+                # the remainder — the autoscaler sizes each pool off
+                # its own half.
+                "prefill_backlog_tokens": prefill_backlog,
+                "decode_backlog_tokens": max(
+                    0, self._backlog() - prefill_backlog
+                ),
                 "capacity_tokens": self._capacity_tokens(
                     serve_mod.config()
                 ),

@@ -104,6 +104,17 @@ Modes:
                                   # transcripts, zero duplicated
                                   # completions); writes
                                   # BENCH_elastic.json
+  python bench.py --mode disagg   # prefill/decode disaggregation:
+                                  # decode-side p99 TTFT + accepted-
+                                  # debate throughput, role-split fleet
+                                  # (2 prefill + 2 decode) vs symmetric
+                                  # 4-replica fleet at equal replica
+                                  # count on a prefill-heavy workload,
+                                  # plus the cross-replica KV handoff
+                                  # hit fraction (byte-identical
+                                  # transcripts, zero duplicated
+                                  # completions); writes
+                                  # BENCH_disagg.json
   --no-interleave                 # escape hatch for any batcher-driven
                                   # mode: run the legacy serialized loop
                                   # (equivalent to ADVSPEC_INTERLEAVE=0)
@@ -2425,6 +2436,222 @@ def _run_elastic(platform: str) -> dict:
     }
 
 
+def _run_disagg(platform: str) -> dict:
+    """Prefill/decode disaggregation bench (deterministic CPU mock —
+    writes BENCH_disagg.json).
+
+    A prefill-heavy debate workload (8 debates sharing one large
+    document, 2 rounds, 2 opponents, short decode budgets) runs
+    through two fleets at EQUAL replica count (4):
+
+    - **symmetric** — 4 undifferentiated replicas, prefix-affinity
+      routing: every replica pays the shared document's full prefill
+      the first time a debate lands on it, stalling that debate's
+      first decode step behind ~P tokens of prefill;
+    - **disagg** — 2 prefill + 2 decode replicas: round-1 admissions
+      over the handoff threshold prefill on the prefill pool, publish
+      their paged-KV blocks to the shared content-addressed store, and
+      the decode replica promotes the shipped chains before its first
+      step — decode-side prefill shrinks to the residual (unpaged
+      tail) tokens.
+
+    Both clocks are the mock's deterministic tokens/1024 busy model
+    (prefill actually computed + decode produced), so the bench is
+    exact on CPU: **decode-side TTFT** per request is (input -
+    cached)/1024 synthetic seconds — the prefill stall the serving
+    replica pays before its first decode step — and accepted-debate
+    throughput divides completed debates by the BUSIEST replica's
+    clock (replicas serve concurrently; the slowest pool gates).
+    Headline: round-1 decode-side p99 TTFT, disagg vs symmetric, with
+    the handoff hit fraction (adopted/attempts), byte-identical
+    transcripts across arms, zero duplicated completions, and zero
+    decode-side unexpected recompiles required. Escape hatch:
+    ADVSPEC_FLEET_PREFILL_REPLICAS=0 keeps the symmetric topology.
+    """
+    from adversarial_spec_tpu import fleet as fleet_mod
+    from adversarial_spec_tpu import obs as obs_mod
+    from adversarial_spec_tpu.engine import kvtier
+    from adversarial_spec_tpu.engine import prefix_cache as prefix_mod
+    from adversarial_spec_tpu.engine.types import ChatRequest, SamplingParams
+    from adversarial_spec_tpu.fleet.router import FleetEngine
+
+    n_debates, n_rounds, n_opp, n_replicas = 8, 2, 2, 4
+    # One large shared document (the prefill-heavy part), with every
+    # per-debate / per-round variation APPENDED so the shared prefix
+    # stays block-aligned across debates, rounds, and opponents.
+    shared_doc = (
+        "## Goals\nServe first tokens before the prefill pool pays "
+        "for them twice.\n## Constraints\n"
+        + "The decode replica SHALL NOT re-prefill shipped blocks. " * 120
+    )
+    params = SamplingParams(max_new_tokens=64, greedy=True)
+
+    def make_reqs(d: int, r: int) -> list:
+        return [
+            ChatRequest(
+                model=f"mock://critic?v={k}",
+                system="You are an adversarial spec reviewer.",
+                user=(
+                    f"--- DOCUMENT ---\n{shared_doc}\n--- END DOCUMENT "
+                    f"---\nDebate {d} round {r}: focus on section {d}."
+                ),
+                affinity_key=f"debate-{d}",
+            )
+            for k in range(n_opp)
+        ]
+
+    def run_arm(prefill_replicas: int) -> dict:
+        prefix_mod.configure(enabled=True, max_pages=0)
+        prefix_mod.reset_stats()
+        fleet_mod.reset_stats()
+        obs_mod.reset_stats()
+        obs_mod.retrace.clear()
+        with tempfile.TemporaryDirectory(prefix="advspec-disagg-") as td:
+            # The shared content-addressed store: the handoff's wire.
+            # Both arms run the same tier config (only the topology
+            # differs); write-through flush keeps the publish window
+            # tight so a handoff's blocks are durable at publish time.
+            kvtier.configure(
+                enabled=True,
+                host_mb=64,
+                store_dir=os.path.join(td, "kvstore"),
+                flush_blocks=8,
+            )
+            kvtier.reset_stats()
+            engine = FleetEngine(
+                replicas=n_replicas,
+                transport="inproc",
+                affinity=True,
+                prefill_replicas=prefill_replicas,
+            )
+            transcripts: list[str] = []
+            ttfts_r1: list[float] = []
+            completed = 0
+            try:
+                for r in range(1, n_rounds + 1):
+                    for d in range(n_debates):
+                        comps = engine.chat(make_reqs(d, r), params)
+                        if not all(c.ok for c in comps):
+                            raise RuntimeError("mock disagg round failed")
+                        completed += 1
+                        transcripts.extend(c.text for c in comps)
+                        if r == 1:
+                            ttfts_r1.extend(
+                                max(
+                                    c.usage.input_tokens
+                                    - c.usage.cached_tokens,
+                                    0,
+                                )
+                                / 1024.0
+                                for c in comps
+                            )
+                busys = sorted(
+                    (
+                        (s.get("role", ""), s["busy_s"])
+                        for s in engine.router.replica_stats()
+                    ),
+                    key=lambda t: t[1],
+                    reverse=True,
+                )
+                fleet_snap = fleet_mod.snapshot()
+            finally:
+                dup = fleet_mod.stats.duplicated_completions
+                engine.shutdown()
+            kvtier.configure(enabled=False, store_dir="", flush_blocks=0)
+        ttfts_r1.sort()
+        p99 = (
+            ttfts_r1[max(0, int(len(ttfts_r1) * 0.99) - 1)]
+            if ttfts_r1
+            else 0.0
+        )
+        busiest = busys[0][1] if busys else 0.0
+        return {
+            "prefill_replicas": prefill_replicas,
+            "decode_replicas": n_replicas - prefill_replicas,
+            "transcripts": transcripts,
+            "ttft_p99_s": round(p99, 6),
+            "busy_s_by_replica": [
+                {"role": role or "any", "busy_s": round(b, 6)}
+                for role, b in busys
+            ],
+            "accepted_debates_per_s": round(completed / busiest, 3)
+            if busiest
+            else 0.0,
+            "completed": completed,
+            "handoff": {
+                "attempts": fleet_snap["handoff_attempts"],
+                "adopted": fleet_snap["handoff_adopted"],
+                "degraded": fleet_snap["handoff_degraded"],
+                "abandoned": fleet_snap["handoff_abandoned"],
+                "shipped_blocks": fleet_snap["handoff_shipped_blocks"],
+                "hit_fraction": fleet_snap["handoff_hit_rate"],
+            },
+            "duplicated_completions": dup,
+            "unexpected_recompiles": obs_mod.snapshot()["retrace"][
+                "unexpected_recompiles"
+            ],
+        }
+
+    symmetric = run_arm(prefill_replicas=0)
+    disagg = run_arm(prefill_replicas=2)
+
+    transcripts_ok = symmetric["transcripts"] == disagg["transcripts"]
+    for arm in (symmetric, disagg):
+        arm.pop("transcripts")
+    dup_total = (
+        symmetric["duplicated_completions"] + disagg["duplicated_completions"]
+    )
+    recompiles = disagg["unexpected_recompiles"]
+    hit_fraction = disagg["handoff"]["hit_fraction"]
+    # Guard the ratio: a fully-adopted handoff can drive the disagg
+    # residual prefill to zero tokens.
+    ratio = symmetric["ttft_p99_s"] / max(disagg["ttft_p99_s"], 1 / 1024.0)
+    within = (
+        disagg["ttft_p99_s"] < symmetric["ttft_p99_s"]
+        and disagg["handoff"]["attempts"] >= n_debates
+        and hit_fraction > 0.0
+        and transcripts_ok
+        and dup_total == 0
+        and recompiles == 0
+    )
+    return {
+        "metric": "disagg_decode_ttft_p99_speedup",
+        "value": round(ratio, 3),
+        "unit": "round-1 decode-side p99 TTFT (synthetic tokens/1024 "
+        "prefill stall before the first decode step), symmetric "
+        "4-replica fleet vs 2 prefill + 2 decode at equal replica "
+        "count, prefill-heavy shared-document workload",
+        "vs_baseline": None,  # no published disaggregation baseline
+        "platform": platform,
+        "within_budget": within,
+        "budget": 1.0,
+        "workload": {
+            "debates": n_debates,
+            "rounds": n_rounds,
+            "opponents": n_opp,
+            "replicas": n_replicas,
+            "shared_doc_chars": len(shared_doc),
+            "max_new_tokens": params.max_new_tokens,
+        },
+        "ttft_p99_s": {
+            "disagg": disagg["ttft_p99_s"],
+            "symmetric": symmetric["ttft_p99_s"],
+        },
+        "accepted_debates_per_s": {
+            "disagg": disagg["accepted_debates_per_s"],
+            "symmetric": symmetric["accepted_debates_per_s"],
+        },
+        "handoff": disagg["handoff"],
+        "handoff_hit_fraction": hit_fraction,
+        "transcripts_byte_identical": {"disagg": transcripts_ok},
+        "duplicated_completions": dup_total,
+        "unexpected_recompiles": recompiles,
+        "arms": {"disagg": disagg, "symmetric": symmetric},
+        "escape_hatch": "ADVSPEC_FLEET_PREFILL_REPLICAS=0 "
+        "(symmetric topology)",
+    }
+
+
 def _run_obs_overhead(platform: str) -> dict:
     """Observability overhead bench: what fraction of the mock mixed
     workload's wall the recorder+metrics emit path costs. Budget < 3%
@@ -2708,6 +2935,7 @@ def main() -> int:
     serve_mode = _mode("serve")
     residency_mode = _mode("residency")
     elastic_mode = _mode("elastic")
+    disagg_mode = _mode("disagg")
     kernels_mode = _mode("kernels")
     if "--no-speculative" in args:
         # Escape hatch mirror of --no-interleave: batcher-driven modes
@@ -2742,6 +2970,8 @@ def main() -> int:
         mode_flag, runner = "--residency", _run_residency
     elif elastic_mode:
         mode_flag, runner = "--elastic", _run_elastic
+    elif disagg_mode:
+        mode_flag, runner = "--disagg", _run_disagg
     elif kernels_mode:
         mode_flag, runner = "--kernels", _run_kernels
     else:
@@ -2760,7 +2990,14 @@ def main() -> int:
         os.rename(tmp, out_path)
         return 0
 
-    if obs_mode or recover_mode or fleet_mode or serve_mode or elastic_mode:
+    if (
+        obs_mode
+        or recover_mode
+        or fleet_mode
+        or serve_mode
+        or elastic_mode
+        or disagg_mode
+    ):
         # Mock-only workloads — no jax, no device, no TPU probe: the
         # obs budget is a CPU host-overhead pin by definition, and the
         # recovery/fleet/serve drills are mock rounds (in-process
@@ -2792,6 +3029,7 @@ def main() -> int:
         or serve_mode
         or residency_mode
         or elastic_mode
+        or disagg_mode
         or kernels_mode
     ):
         # Persist the perf trajectory point alongside the BENCH_r*
@@ -2817,6 +3055,8 @@ def main() -> int:
             if residency_mode
             else "BENCH_elastic.json"
             if elastic_mode
+            else "BENCH_disagg.json"
+            if disagg_mode
             else "BENCH_kernels.json"
             if kernels_mode
             else "BENCH_serve.json"

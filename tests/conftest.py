@@ -111,8 +111,12 @@ def _isolate_state(tmp_path, monkeypatch):
     monkeypatch.setenv("ADVSPEC_KV_TIER", "0")
     monkeypatch.delenv("ADVSPEC_KV_HOST_MB", raising=False)
     monkeypatch.delenv("ADVSPEC_KV_STORE_DIR", raising=False)
+    monkeypatch.delenv("ADVSPEC_KV_FLUSH_BLOCKS", raising=False)
     kvtier.configure(
-        enabled=False, host_mb=kvtier.DEFAULT_HOST_MB, store_dir=""
+        enabled=False,
+        host_mb=kvtier.DEFAULT_HOST_MB,
+        store_dir="",
+        flush_blocks=0,
     )
     kvtier.reset_stats()
     # Weight-residency config/stats are process-global by design (the
@@ -145,6 +149,9 @@ def _isolate_state(tmp_path, monkeypatch):
     monkeypatch.delenv("ADVSPEC_FLEET_SCALE_COOLDOWN_S", raising=False)
     monkeypatch.delenv("ADVSPEC_FLEET_SCALE_INTERVAL_S", raising=False)
     monkeypatch.delenv("ADVSPEC_REPLICA_KILL_AFTER", raising=False)
+    monkeypatch.delenv("ADVSPEC_PREFILL_KILL_AFTER", raising=False)
+    monkeypatch.delenv("ADVSPEC_FLEET_PREFILL_REPLICAS", raising=False)
+    monkeypatch.delenv("ADVSPEC_FLEET_HANDOFF_THRESHOLD", raising=False)
     fleet.configure(
         enabled=False,
         replicas=fleet.DEFAULT_REPLICAS,
@@ -154,6 +161,10 @@ def _isolate_state(tmp_path, monkeypatch):
         max_replicas=fleet.DEFAULT_MAX_REPLICAS,
         scale_cooldown_s=fleet.DEFAULT_SCALE_COOLDOWN_S,
         scale_interval_s=fleet.DEFAULT_SCALE_INTERVAL_S,
+        prefill_replicas=fleet.DEFAULT_PREFILL_REPLICAS,
+        handoff_threshold_tokens=fleet.DEFAULT_HANDOFF_THRESHOLD_TOKENS,
+        min_prefill_replicas=fleet.DEFAULT_MIN_PREFILL_REPLICAS,
+        max_prefill_replicas=fleet.DEFAULT_MAX_PREFILL_REPLICAS,
     )
     fleet.reset_stats()
     # Streaming config/stats are process-global by design (the CLI arms
@@ -238,13 +249,20 @@ def _isolate_state(tmp_path, monkeypatch):
         max_replicas=fleet.DEFAULT_MAX_REPLICAS,
         scale_cooldown_s=fleet.DEFAULT_SCALE_COOLDOWN_S,
         scale_interval_s=fleet.DEFAULT_SCALE_INTERVAL_S,
+        prefill_replicas=fleet.DEFAULT_PREFILL_REPLICAS,
+        handoff_threshold_tokens=fleet.DEFAULT_HANDOFF_THRESHOLD_TOKENS,
+        min_prefill_replicas=fleet.DEFAULT_MIN_PREFILL_REPLICAS,
+        max_prefill_replicas=fleet.DEFAULT_MAX_PREFILL_REPLICAS,
     )
     fleet.reset_stats()
     breaker.reset_default_registry()
     prefix_cache.configure(enabled=True, max_pages=0)
     prefix_cache.reset_stats()
     kvtier.configure(
-        enabled=False, host_mb=kvtier.DEFAULT_HOST_MB, store_dir=""
+        enabled=False,
+        host_mb=kvtier.DEFAULT_HOST_MB,
+        store_dir="",
+        flush_blocks=0,
     )
     kvtier.reset_stats()
     weightres.configure(enabled=True, host_mb=weightres.DEFAULT_HOST_MB)

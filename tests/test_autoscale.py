@@ -206,8 +206,9 @@ class TestSpawnHardening:
         count. Injected sleep/rng make the jitter exact."""
 
         class _NeverUp:
-            def __init__(self, rid, engine_factory=None):
+            def __init__(self, rid, engine_factory=None, role=""):
                 self.id = rid
+                self.role = role
                 self.closed = False
 
             def ping(self):
@@ -807,6 +808,79 @@ class TestMockClockScaleStorm:
                 )
             # Survivor invariants clean (the drill's `check` op).
             eng.router.check_invariants()
+        finally:
+            scaler.shutdown()
+            eng.shutdown()
+
+
+class TestRoleAwareScaling:
+    """Disaggregated fleets scale per role: prefill-token backlog sizes
+    the prefill pool, the decode remainder sizes the decode pool, each
+    under its own floor/ceiling (docs/fleet.md "Disaggregation")."""
+
+    def _role_pressure(self, prefill=0, decode=0, brownout=False):
+        snap = {
+            "backlog_tokens": prefill + decode,
+            "prefill_backlog_tokens": prefill,
+            "decode_backlog_tokens": decode,
+            "brownout": brownout,
+            "draining": False,
+            "active_keys": [],
+            "model_mix": {},
+        }
+        return lambda: dict(snap)
+
+    def test_prefill_backlog_grows_only_the_prefill_pool(self):
+        _elastic_cfg(
+            replicas=2,
+            max_replicas=4,
+            min_prefill_replicas=1,
+            max_prefill_replicas=2,
+        )
+        obs_mod.reset_stats()
+        eng = FleetEngine(replicas=2, prefill_replicas=1)
+        scaler = Autoscaler(
+            eng,
+            pressure=self._role_pressure(prefill=10**6, decode=0),
+            clock=FakeClock(),
+            sleep=lambda s: None,
+        )
+        try:
+            assert scaler.tick() is True
+            assert sorted(eng.router.alive_ids("prefill")) == ["r0", "r2"]
+            assert eng.router.alive_ids("decode") == ["r1"]  # untouched
+            # Ceiling is per-pool: the prefill pool is now full, so the
+            # same pressure cannot grow it past max_prefill_replicas.
+            assert scaler.tick() is False
+        finally:
+            scaler.shutdown()
+            eng.shutdown()
+
+    def test_idle_decode_pool_shrinks_to_its_own_floor(self):
+        _elastic_cfg(
+            replicas=3,
+            min_replicas=1,
+            max_replicas=4,
+            min_prefill_replicas=1,
+            max_prefill_replicas=2,
+        )
+        obs_mod.reset_stats()
+        eng = FleetEngine(replicas=3, prefill_replicas=1)
+        scaler = Autoscaler(
+            eng,
+            pressure=self._role_pressure(prefill=0, decode=0),
+            clock=FakeClock(),
+            sleep=lambda s: None,
+        )
+        try:
+            # Decode pool (r1, r2) is idle above its floor: one leaves.
+            assert scaler.tick() is True
+            assert len(eng.router.alive_ids("decode")) == 1
+            # Both pools now sit AT their floors: idleness changes
+            # nothing — disaggregation never scales a pool to zero.
+            assert scaler.tick() is False
+            assert eng.router.alive_ids("prefill") == ["r0"]
+            assert len(eng.router.alive_ids("decode")) == 1
         finally:
             scaler.shutdown()
             eng.shutdown()

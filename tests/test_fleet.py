@@ -642,6 +642,43 @@ class TestFleetLifecycleLint:
         )
 
 
+class TestHandoffLifecycleLint:
+    def test_exit_skipping_the_publication_surgery_fires(self):
+        """GL-LIFECYCLE's handoff machine is LIVE on the real source: a
+        hand-rolled degrade that skips _publish_blocks (writing the
+        terminal-outcome ledger directly) is permanently caught."""
+        from pathlib import Path
+
+        from tools.graftlint.config import GraftlintConfig
+        from tools.graftlint.core import lint_sources
+
+        src = Path("adversarial_spec_tpu/fleet/handoff.py").read_text(
+            encoding="utf-8"
+        )
+        broken = src.replace(
+            "        return self._publish_blocks(key, DEGRADED, reason)\n",
+            "        self._outcomes[key] = DEGRADED\n"
+            "        return None\n",
+        )
+        assert broken != src, "_degrade surgery call not found to strip"
+        cfg = GraftlintConfig(package="pkg")
+        findings = lint_sources(
+            {"pkg/handoff.py": broken}, rules=["GL-LIFECYCLE"], cfg=cfg
+        )
+        msgs = [f.message for f in findings]
+        assert any(
+            "HandoffLedger._degrade never reaches" in m for m in msgs
+        ), msgs
+        assert any("self._outcomes" in m and "_degrade" in m for m in msgs)
+        # The committed source is clean under the same config.
+        assert (
+            lint_sources(
+                {"pkg/handoff.py": src}, rules=["GL-LIFECYCLE"], cfg=cfg
+            )
+            == []
+        )
+
+
 class TestCliFleet:
     def _run(self, argv, monkeypatch, capsys, stdin="# spec\nBody.\n"):
         import io
@@ -735,3 +772,320 @@ class TestReplicaKillChaos:
         assert payload["reissued_requests"] == 2
         assert payload["survivor_rehydrated_blocks"] > 0
         assert payload["recovered_fraction"] == 0.5
+
+
+class TestHashRingRoles:
+    """Role-tagged ring pins (fleet disaggregation, docs/fleet.md)."""
+
+    def test_role_filter_skips_foreign_roles(self):
+        ring = HashRing()
+        ring.add("p0", role="prefill")
+        ring.add("d0", role="decode")
+        ring.add("d1", role="decode")
+        for key in (f"debate-{i}" for i in range(32)):
+            assert ring.preference(key, role="prefill") == ["p0"]
+            dec = ring.preference(key, role="decode")
+            assert sorted(dec) == ["d0", "d1"]
+            assert ring.primary(key, role="decode") == dec[0]
+        assert ring.role_of("p0") == "prefill"
+        assert ring.role_nodes("decode") == {"d0", "d1"}
+
+    def test_untagged_nodes_serve_every_role(self):
+        ring = HashRing(["r0", "r1"])  # symmetric fleet: no tags
+        assert ring.role_nodes("prefill") == {"r0", "r1"}
+        assert ring.role_nodes("decode") == {"r0", "r1"}
+        for key in ("a", "b", "c"):
+            assert ring.primary(key, role="decode") == ring.primary(key)
+
+    def test_empty_role_pool_routes_nowhere(self):
+        ring = HashRing()
+        ring.add("d0", role="decode")
+        assert ring.preference("k", role="prefill") == []
+        assert ring.primary("k", role="prefill") is None
+
+    def test_role_pool_membership_change_scoped_to_the_pool(self):
+        """The per-pool consistent-hashing contract: a node joining
+        the decode pool moves ~1/N of DECODE keys (all to the
+        newcomer) and zero prefill keys; the foreign pool never even
+        observes the change."""
+        ring = HashRing()
+        ring.add("p0", role="prefill")
+        ring.add("p1", role="prefill")
+        for k in range(3):
+            ring.add(f"d{k}", role="decode")
+        keys = [f"debate-{i}" for i in range(2000)]
+        dec_before = {k: ring.primary(k, role="decode") for k in keys}
+        pre_before = {k: ring.primary(k, role="prefill") for k in keys}
+        ring.add("d3", role="decode")
+        moved = [
+            k for k in keys if ring.primary(k, role="decode") != dec_before[k]
+        ]
+        frac = len(moved) / len(keys)
+        assert 0.5 / 4 <= frac <= 2.0 / 4, frac
+        assert all(
+            ring.primary(k, role="decode") == "d3" for k in moved
+        )
+        assert all(
+            ring.primary(k, role="prefill") == pre_before[k] for k in keys
+        )
+
+
+class TestHandoffLedger:
+    """The handoff lifecycle machine in isolation (fleet/handoff.py)."""
+
+    def _ledger(self):
+        from adversarial_spec_tpu.fleet.handoff import HandoffLedger
+
+        fleet_mod.reset_stats()
+        return HandoffLedger(stats=fleet_mod.stats)
+
+    def test_adopt_walks_the_full_lifecycle(self):
+        from adversarial_spec_tpu.fleet import handoff as h
+
+        led = self._ledger()
+        rec = led.begin("debate-A", "r0", "r1")
+        assert rec.state == h.PLANNED
+        assert led.seen("debate-A") and not led.seen("debate-B")
+        led.note_prefilling("debate-A")
+        assert led.active("debate-A").state == h.PREFILLING
+        led.note_published("debate-A", ["c1", "c2"], blocks=2)
+        assert led.active("debate-A").state == h.PUBLISHED
+        out = led._finish_adopt("debate-A")
+        assert out is not None and out.state == h.ADOPTED
+        assert led.active("debate-A") is None
+        assert led.outcome("debate-A") == h.ADOPTED
+        assert led.seen("debate-A")  # decided keys never re-handoff
+        assert fleet_mod.stats.handoff_attempts == 1
+        assert fleet_mod.stats.handoff_adopted == 1
+        assert fleet_mod.stats.handoff_shipped_blocks == 2
+
+    def test_surgery_is_idempotent_first_decision_stands(self):
+        led = self._ledger()
+        led.begin("k", "r0", "r1")
+        assert led._degrade("k", "store_miss") is not None
+        # A second exit for the same key is a no-op: no double count.
+        assert led._finish_adopt("k") is None
+        assert led._degrade("k", "again") is None
+        assert led.outcome("k") == "degraded"
+        assert fleet_mod.stats.handoff_degraded == 1
+        assert fleet_mod.stats.handoff_adopted == 0
+
+    def test_abandon_counts_separately(self):
+        led = self._ledger()
+        led.begin("k", "r0", "r1")
+        led._abandon("k", "no_blocks")
+        assert led.outcome("k") == "abandoned"
+        assert fleet_mod.stats.handoff_abandoned == 1
+        assert led.snapshot() == {
+            "active": 0, "adopted": 0, "degraded": 0, "abandoned": 1,
+        }
+
+
+class TestDisaggRouting:
+    """Prefill/decode disaggregation on in-process replicas: the
+    adopted fast path, every degradation, and the byte-identity
+    contract against a symmetric fleet."""
+
+    DOC = (
+        "## Goals\nShip the spec.\n## Constraints\n"
+        + "The decode replica SHALL NOT re-prefill shipped blocks. " * 40
+    )
+
+    def _arm_tier(self, tmp_path):
+        from adversarial_spec_tpu.engine import kvtier
+
+        kvtier.configure(
+            enabled=True, host_mb=64, store_dir=str(tmp_path / "store")
+        )
+
+    def _reqs(self, n=2, key="debate-dis", doc=None):
+        doc = self.DOC if doc is None else doc
+        return [
+            _req(
+                model=f"mock://critic?v={k}",
+                key=key,
+                user=doc + f"\nOpponent {k}.",
+            )
+            for k in range(n)
+        ]
+
+    def _texts(self, engine, reqs):
+        params = SamplingParams(max_new_tokens=32, greedy=True)
+        outs = engine.chat(reqs, params)
+        assert all(o.ok for o in outs), [o.error for o in outs]
+        return [o.text for o in outs]
+
+    def test_adopted_handoff_is_byte_identical(self, tmp_path):
+        self._arm_tier(tmp_path)
+        fleet_mod.reset_stats()
+        sym = FleetEngine(replicas=2, transport="inproc")
+        ref = self._texts(sym, self._reqs())
+        sym.shutdown()
+        fleet_mod.reset_stats()
+        eng = FleetEngine(replicas=2, transport="inproc", prefill_replicas=1)
+        try:
+            assert eng.disagg_armed()
+            assert eng.router.alive_ids("prefill") == ["r0"]
+            assert eng.router.alive_ids("decode") == ["r1"]
+            got = self._texts(eng, self._reqs())
+            assert got == ref  # byte-identical across topologies
+            assert fleet_mod.stats.handoff_attempts == 1
+            assert fleet_mod.stats.handoff_adopted == 1
+            assert fleet_mod.stats.handoff_shipped_blocks > 0
+            assert eng.handoff.outcome("debate-dis") == "adopted"
+        finally:
+            eng.shutdown()
+
+    def test_small_admissions_never_handoff(self, tmp_path):
+        self._arm_tier(tmp_path)
+        fleet_mod.reset_stats()
+        eng = FleetEngine(replicas=2, transport="inproc", prefill_replicas=1)
+        try:
+            self._texts(eng, self._reqs(doc="Tiny spec."))
+            assert fleet_mod.stats.handoff_attempts == 0
+        finally:
+            eng.shutdown()
+
+    def test_later_rounds_ride_the_first_handoff(self, tmp_path):
+        self._arm_tier(tmp_path)
+        fleet_mod.reset_stats()
+        eng = FleetEngine(replicas=2, transport="inproc", prefill_replicas=1)
+        try:
+            self._texts(eng, self._reqs())
+            self._texts(eng, self._reqs())  # round 2, same debate key
+            assert fleet_mod.stats.handoff_attempts == 1  # no re-handoff
+        finally:
+            eng.shutdown()
+
+    def test_prefill_error_degrades_byte_identical(
+        self, tmp_path, monkeypatch
+    ):
+        self._arm_tier(tmp_path)
+        fleet_mod.reset_stats()
+        sym = FleetEngine(replicas=2, transport="inproc")
+        ref = self._texts(sym, self._reqs())
+        sym.shutdown()
+        fleet_mod.reset_stats()
+        eng = FleetEngine(replicas=2, transport="inproc", prefill_replicas=1)
+        try:
+            def boom(requests, params):
+                raise RuntimeError("prefill replica exploded")
+
+            monkeypatch.setattr(eng.router.replica("r0"), "prefill", boom)
+            got = self._texts(eng, self._reqs())
+            assert got == ref  # local prefill on the decode side
+            assert fleet_mod.stats.handoff_degraded == 1
+            assert fleet_mod.stats.handoff_adopted == 0
+        finally:
+            eng.shutdown()
+
+    def test_no_store_abandons_but_still_serves(self, tmp_path):
+        from adversarial_spec_tpu.engine import kvtier
+
+        # Tier 2 unset: the prefill side has nowhere durable to ship.
+        kvtier.configure(enabled=True, host_mb=64, store_dir="")
+        fleet_mod.reset_stats()
+        eng = FleetEngine(replicas=2, transport="inproc", prefill_replicas=1)
+        try:
+            self._texts(eng, self._reqs())
+            assert fleet_mod.stats.handoff_attempts == 1
+            assert fleet_mod.stats.handoff_abandoned == 1
+        finally:
+            eng.shutdown()
+
+    def test_symmetric_fleet_never_plans_handoffs(self, tmp_path):
+        self._arm_tier(tmp_path)
+        fleet_mod.reset_stats()
+        eng = FleetEngine(replicas=2, transport="inproc")
+        try:
+            assert not eng.disagg_armed()
+            self._texts(eng, self._reqs())
+            assert fleet_mod.stats.handoff_attempts == 0
+        finally:
+            eng.shutdown()
+
+
+@pytest.mark.chaos
+class TestHandoffKillChaos:
+    """The tier-1 disagg chaos smoke: the FULL drill from
+    tools/chaos_run.py --handoff-kill — a 1 prefill + 1 decode worker
+    fleet, the prefill replica SIGKILLed (a) after its publications
+    are durable (handoff must still adopt) and (b) mid-publication
+    (handoff must degrade to local prefill), byte-identical
+    transcripts and zero duplicated completions throughout."""
+
+    def test_handoff_kill_contract(self):
+        from tools.chaos_run import run_handoff_kill
+
+        failures, payload = run_handoff_kill(verbose=False)
+        assert failures == []
+        assert payload["adopted_after_kill"] is True
+        assert payload["degraded_on_partial"] is True
+        assert payload["transcripts_byte_identical"] is True
+        assert payload["duplicated_completions"] == 0
+        assert payload["decode_rehydrated_blocks"] > 0
+        assert payload["invariants_clean"] is True
+
+
+@pytest.mark.chaos
+class TestWorkerDisaggProtocol:
+    """Worker-transport round-trip of the disagg ops: role rides the
+    spawn, prefill publishes durable chains to the shared store, and a
+    second worker's prefetch finds every one of them."""
+
+    def test_prefill_publishes_and_peer_prefetch_finds(self, tmp_path):
+        import os
+
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            ADVSPEC_KV_TIER="1",
+            ADVSPEC_KV_HOST_MB="64",
+            ADVSPEC_KV_STORE_DIR=str(tmp_path / "store"),
+        )
+        doc = "The prefill worker SHALL publish durable blocks. " * 40
+        pre = WorkerReplica(
+            "wp0", request_timeout_s=60.0, env=env,
+            log_dir=str(tmp_path), role="prefill",
+        )
+        dec = WorkerReplica(
+            "wd0", request_timeout_s=60.0, env=env,
+            log_dir=str(tmp_path), role="decode",
+        )
+        try:
+            assert pre.role == "prefill" and dec.role == "decode"
+            outs = pre.prefill(
+                [_req(user=doc), _req(model="mock://agree", user=doc)],
+                PARAMS,
+            )
+            assert len(outs) == 2
+            chains = sorted(
+                {c for o in outs for c in o.get("chains", ())}
+            )
+            assert chains, outs  # something page-aligned shipped
+            assert all(o.get("blocks", 0) > 0 for o in outs)
+            # The peer worker sees every published chain in the store.
+            assert dec.prefetch("mock://critic", chains) == len(chains)
+            assert dec.prefetch("mock://critic", ["bogus-chain"]) == 0
+        finally:
+            pre.close()
+            dec.close()
+
+
+class TestDisaggBenchPin:
+    def test_bench_trend_picks_up_the_disagg_bench(self):
+        from pathlib import Path
+
+        from tools.bench_trend import validate_bench_file
+
+        bench = Path(__file__).resolve().parent.parent / "BENCH_disagg.json"
+        assert bench.is_file(), "BENCH_disagg.json must be committed"
+        row, problems = validate_bench_file(bench)
+        assert problems == []
+        assert row["mode"] == "disagg"
+        assert row["metric"] == "disagg_decode_ttft_p99_speedup"
+        payload = json.loads(bench.read_text(encoding="utf-8"))
+        assert payload["transcripts_byte_identical"]["disagg"] is True
+        assert payload["duplicated_completions"] == 0
+        assert payload["unexpected_recompiles"] == 0
+        assert payload["handoff_hit_fraction"] > 0

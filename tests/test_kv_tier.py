@@ -891,3 +891,70 @@ class TestObsDumpTimeline:
         timeline = occupancy_timeline(events)
         assert "host=" in timeline and "disk=" in timeline
         assert "demote" in timeline
+
+
+class TestFlushThreshold:
+    """``--kv-flush-blocks``: write-through flush every N enqueued
+    blocks instead of only at settle — the disagg publication window
+    bound (docs/kv_tiering.md)."""
+
+    def _tiers(self, tmp_path):
+        kvtier.reset_stats()
+        return kvtier.TieredStore(
+            None, kvtier.DiskStore(str(tmp_path / "store"), "fp-a")
+        )
+
+    def _payload(self):
+        return {"k": np.zeros(2, dtype=np.float32)}
+
+    def test_env_default(self, monkeypatch):
+        monkeypatch.delenv("ADVSPEC_KV_FLUSH_BLOCKS", raising=False)
+        assert kvtier.env_flush_blocks() == 0  # settle-only
+        monkeypatch.setenv("ADVSPEC_KV_FLUSH_BLOCKS", "8")
+        assert kvtier.env_flush_blocks() == 8
+        monkeypatch.setenv("ADVSPEC_KV_FLUSH_BLOCKS", "junk")
+        assert kvtier.env_flush_blocks() == 0
+
+    def test_settle_only_by_default(self, tmp_path):
+        tiers = self._tiers(tmp_path)
+        for i in range(6):
+            tiers.enqueue_store(
+                kvtier.chain_hash("", (i,)), (i,), self._payload()
+            )
+        assert kvtier.stats.store_writes == 0  # nothing mid-drain
+        assert tiers.settle() == 6
+        assert kvtier.stats.store_writes == 6
+
+    def test_threshold_flushes_mid_drain(self, tmp_path):
+        kvtier.configure(flush_blocks=3)
+        tiers = self._tiers(tmp_path)
+        for i in range(5):
+            tiers.enqueue_store(
+                kvtier.chain_hash("", (i,)), (i,), self._payload()
+            )
+        # The 3rd enqueue crossed the threshold: one flush of 3.
+        assert kvtier.stats.store_writes == 3
+        assert tiers.settle() == 2  # the tail still settles
+        assert kvtier.stats.store_writes == 5
+
+    def test_threshold_flush_never_resolves_lazies(self, tmp_path):
+        """A threshold flush must not sync the device mid-drain: lazy
+        payloads stay queued for settle (the sanctioned point)."""
+        kvtier.configure(flush_blocks=2)
+        tiers = self._tiers(tmp_path)
+        calls = []
+
+        def lazy():
+            calls.append(1)
+            return self._payload()
+
+        tiers.enqueue_store(kvtier.chain_hash("", (1,)), (1,), lazy)
+        tiers.enqueue_store(
+            kvtier.chain_hash("", (2,)), (2,), self._payload()
+        )
+        # Threshold crossed: the plain payload flushed, the lazy held.
+        assert kvtier.stats.store_writes == 1
+        assert calls == []
+        assert tiers.settle() == 1  # lazy resolves only at settle
+        assert calls == [1]
+        assert kvtier.stats.store_writes == 2

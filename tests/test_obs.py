@@ -536,3 +536,40 @@ class TestBreakerEvents:
         snap = obs.metrics.snapshot()
         assert snap['advspec_breaker_transitions_total{to="open"}'] == 1
         assert snap['advspec_breaker_transitions_total{to="closed"}'] == 1
+
+
+class TestHandoffTelemetry:
+    """Disaggregation telemetry (fleet/handoff.py): ship/prefetch
+    SwapEvents validate against the schema and the handoff ledger's
+    surgery updates the counter + latency histogram exactly once."""
+
+    def test_ship_and_prefetch_swap_events_validate(self):
+        from adversarial_spec_tpu.obs.events import SwapEvent
+
+        r = FlightRecorder(size=8)
+        r.append(SwapEvent(op="ship", tier="disk", blocks=4, slot=0))
+        r.append(SwapEvent(op="prefetch", tier="disk", blocks=4))
+        for line in r.to_jsonl().splitlines():
+            assert validate_event(json.loads(line)) == []
+        bad = json.loads(r.to_jsonl().splitlines()[0])
+        bad["op"] = "teleport"
+        assert validate_event(bad)  # unknown swap op rejects
+
+    def test_surgery_updates_counter_and_histogram_once(self):
+        from adversarial_spec_tpu import fleet as fleet_mod
+        from adversarial_spec_tpu import obs as obs_mod
+        from adversarial_spec_tpu.fleet.handoff import HandoffLedger
+
+        obs_mod.configure(enabled=True)
+        fleet_mod.reset_stats()
+        led = HandoffLedger(stats=fleet_mod.stats)
+        led.begin("k", "r0", "r1")
+        led.note_published("k", ["c1"], blocks=1)
+        led._finish_adopt("k")
+        led._finish_adopt("k")  # idempotent: no double count
+        led.begin("k2", "r0", "r1")
+        led._degrade("k2", "store_miss")
+        snap = obs_mod.metrics.snapshot()
+        assert snap['advspec_kv_handoff_total{outcome="adopted"}'] == 1
+        assert snap['advspec_kv_handoff_total{outcome="degraded"}'] == 1
+        assert snap["advspec_kv_handoff_seconds"]["count"] == 2

@@ -1130,6 +1130,7 @@ class TestGraftlint:
             serve_lifecycle_class="",  # fixture has no serve machine
             weightres_lifecycle_class="",  # nor a weight-ledger machine
             autoscale_lifecycle_class="",  # nor an autoscaler machine
+            handoff_lifecycle_class="",  # nor a handoff ledger
         )
         sources = {
             "pkg/sched.py": (

@@ -85,6 +85,22 @@ _ELASTIC_REQUIRED: dict[str, tuple[type, ...]] = {
     "transcripts_byte_identical": (dict,),
     "duplicated_completions": (int,),
 }
+# BENCH_disagg.json additionally pins the disaggregation trajectory:
+# decode-side p99 TTFT per arm (the headline speedup must stay
+# decomposable), accepted-debate throughput per arm, the cross-replica
+# KV handoff hit fraction (a disagg bench whose handoffs silently all
+# degraded to local prefill would report a meaningless TTFT win),
+# byte-identical transcripts disagg-vs-symmetric, zero duplicated
+# completions, and zero decode-side unexpected recompiles.
+_DISAGG_REQUIRED: dict[str, tuple[type, ...]] = {
+    "ttft_p99_s": (dict,),
+    "accepted_debates_per_s": (dict,),
+    "handoff_hit_fraction": (int, float),
+    "handoff": (dict,),
+    "transcripts_byte_identical": (dict,),
+    "duplicated_completions": (int,),
+    "unexpected_recompiles": (int,),
+}
 # BENCH_kernels.json additionally pins the fused-kernel contract: the
 # numeric parity of each fused kernel against its XLA reference, the
 # per-arm decode throughput the headline ratio decomposes into,
@@ -161,6 +177,22 @@ def validate_bench_file(path: Path) -> tuple[dict | None, list[str]]:
                     f"{path.name}: duplicated_completions must be 0, "
                     f"got {payload['duplicated_completions']}"
                 )
+        if mode == "disagg":
+            problems.extend(
+                _check_fields(payload, _DISAGG_REQUIRED, path.name)
+            )
+            ident = payload.get("transcripts_byte_identical")
+            if isinstance(ident, dict) and not all(ident.values()):
+                problems.append(
+                    f"{path.name}: transcripts_byte_identical has a "
+                    f"false arm: {ident}"
+                )
+            for gate in ("duplicated_completions", "unexpected_recompiles"):
+                if payload.get(gate):
+                    problems.append(
+                        f"{path.name}: {gate} must be 0, "
+                        f"got {payload[gate]}"
+                    )
         if mode == "kernels":
             problems.extend(
                 _check_fields(payload, _KERNELS_REQUIRED, path.name)

@@ -29,6 +29,19 @@ the round journal's one-record-per-index replay), the survivor
 rehydrating the shared document prefix from the disk store instead of
 re-prefilling, and allocator + tier invariants clean on the survivor.
 
+``--handoff-kill`` is the DISAGGREGATION variant (docs/fleet.md
+"Disaggregation"): a 1 prefill + 1 decode worker fleet runs a debate
+whose admission crosses the handoff threshold, and the prefill replica
+is SIGKILLed at the worst moment — published KV blocks durable in the
+shared store, decode replica not yet promoted
+(``ADVSPEC_PREFILL_KILL_AFTER``). The drill asserts the decode replica
+adopts the dead publisher's blocks (store rehydration, not a
+re-prefill), a mid-publish kill degrades to local prefill instead of
+erroring, transcripts stay byte-identical to an uninterrupted disagg
+run in both variants, zero duplicated completions, the dead replica is
+retired through the fleet lifecycle, and survivor invariants are
+clean.
+
 ``--overload`` is the SERVE storm drill (docs/serving.md): an
 in-process ``advspec serve`` daemon with tight admission caps takes an
 open-loop burst several times its backlog cap and must shed, not
@@ -59,6 +72,7 @@ Usage:
     python tools/chaos_run.py --sweep 5      # + 5 extra fuzz seeds
     python tools/chaos_run.py --crash        # SIGKILL + resume drill
     python tools/chaos_run.py --replica-kill # fleet replica-loss drill
+    python tools/chaos_run.py --handoff-kill # prefill-loss handoff drill
     python tools/chaos_run.py --overload     # serve storm drill
     python tools/chaos_run.py --drain        # serve SIGTERM drain drill
     python tools/chaos_run.py --weight-swap  # weight-swap fault drill
@@ -421,6 +435,238 @@ def run_replica_kill(verbose: bool = True) -> tuple[list[str], dict]:
         )
         fleet_mod.reset_stats()
     return failures, payload
+
+
+_HANDOFF_MODELS = [f"mock://critic?v={k}" for k in range(1, 5)]
+_HANDOFF_DEBATE_ID = "handoff-drill"
+
+
+def run_handoff_kill(verbose: bool = True) -> tuple[list[str], dict]:
+    """The prefill/decode handoff-loss drill (docs/fleet.md
+    "Disaggregation"): a 1 prefill + 1 decode worker fleet shares one
+    content-addressed KV store, and the PREFILL replica is SIGKILLed at
+    the exact worst moment — its published blocks are durable on disk
+    but the decode replica has not yet promoted them
+    (``ADVSPEC_PREFILL_KILL_AFTER``). The contract checked:
+
+    1. the handoff still ADOPTS: the decode replica rehydrates the
+       dead replica's shipped blocks from the store instead of
+       re-prefilling (a durable publication survives its publisher);
+    2. a PARTIAL publication (killed mid-publish) degrades cleanly:
+       the router falls back to local prefill on the decode side, no
+       error surfaces to the caller;
+    3. transcripts are byte-identical to an uninterrupted disagg run
+       in BOTH kill variants, with zero duplicated completions;
+    4. the dead prefill replica is retired through the fleet
+       lifecycle and allocator/tier invariants are clean on the
+       survivor.
+
+    Returns (failures, payload); the deterministic in-process variant
+    lives in tests/test_fleet.py under the ``chaos`` marker."""
+    from adversarial_spec_tpu import fleet as fleet_mod
+    from adversarial_spec_tpu.engine.types import ChatRequest, SamplingParams
+    from adversarial_spec_tpu.fleet.router import FleetEngine
+
+    def say(msg: str) -> None:
+        if verbose:
+            print(f"chaos_run --handoff-kill: {msg}", flush=True)
+
+    failures: list[str] = []
+    spec = _CRASH_SPEC * 12  # long enough to cross the handoff threshold
+    reqs = [
+        ChatRequest(
+            model=m,
+            system="You are an adversarial spec reviewer.",
+            user=f"Debate round 1\n--- DOCUMENT ---\n{spec}\n--- END ---",
+            affinity_key=_HANDOFF_DEBATE_ID,
+        )
+        for m in _HANDOFF_MODELS
+    ]
+    params = SamplingParams()
+    payload: dict = {
+        "opponents": len(_HANDOFF_MODELS),
+        "prefill_replica": "r0",
+        "decode_replica": "r1",
+    }
+
+    def disagg_round(td: str, name: str, kill_after: int | None):
+        """One disagg fleet round over worker replicas; r0 is the
+        prefill founder, r1 the decode founder. ``kill_after`` N means
+        r0 SIGKILLs itself the instant its Nth prefill result line is
+        durable on the pipe (blocks already flushed to the store)."""
+        worker_env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "ADVSPEC_KV_TIER": "1",
+            "ADVSPEC_KV_HOST_MB": "64",
+            "ADVSPEC_KV_STORE_DIR": os.path.join(td, f"store-{name}"),
+        }
+        if kill_after is not None:
+            worker_env["ADVSPEC_PREFILL_KILL_AFTER"] = f"r0:{kill_after}"
+        fleet_mod.reset_stats()
+        engine = FleetEngine(
+            replicas=2,
+            transport="worker",
+            prefill_replicas=1,
+            request_timeout_s=60.0,
+            worker_env=worker_env,
+            log_dir=os.path.join(td, f"logs-{name}"),
+        )
+        try:
+            comps = engine.chat(reqs, params)
+            snap = fleet_mod.snapshot()
+            stats = fleet_mod.stats
+            alive = engine.router.alive_ids()
+            dec_stats = (
+                engine.router.replica("r1").stats() if "r1" in alive else {}
+            )
+            problems: list[str] = []
+            try:
+                engine.router.check_invariants()
+            except Exception as e:
+                problems.append(str(e))
+            return {
+                "texts": [c.text for c in comps],
+                "ok": all(c.ok for c in comps),
+                "errors": [c.error for c in comps if not c.ok],
+                "snap": snap,
+                "duplicated": stats.duplicated_completions,
+                "retired": stats.replicas_retired,
+                "alive": alive,
+                "rehydrated": int(
+                    dec_stats.get("kv_tier", {}).get("rehydrated_blocks", 0)
+                ),
+                "invariant_problems": problems,
+            }
+        finally:
+            engine.shutdown()
+
+    with tempfile.TemporaryDirectory(prefix="advspec-handoff-") as td:
+        # Phase A — reference: the same disagg round, uninterrupted.
+        ref = disagg_round(td, "ref", kill_after=None)
+        if not ref["ok"]:
+            return [f"reference disagg round failed: {ref['errors']}"], payload
+        if ref["snap"]["handoff_adopted"] != 1:
+            failures.append(
+                "reference round did not adopt its handoff: "
+                f"{ref['snap']}"
+            )
+        say(
+            f"reference round complete (handoff adopted, "
+            f"{ref['snap']['handoff_shipped_blocks']} blocks shipped)"
+        )
+
+        # Phase B — durable-then-dead: r0 dies after ALL prefill
+        # results (and their blocks) are durable, before r1 promotes.
+        got = disagg_round(td, "kill", kill_after=len(_HANDOFF_MODELS))
+        if not got["ok"]:
+            failures.append(
+                f"round lost work across the prefill kill: {got['errors']}"
+            )
+        if got["texts"] != ref["texts"]:
+            failures.append(
+                "transcripts diverged from the uninterrupted disagg run"
+            )
+        if got["snap"]["handoff_adopted"] != 1:
+            failures.append(
+                "durable publication was not adopted after the publisher "
+                f"died: {got['snap']}"
+            )
+        if not got["rehydrated"]:
+            failures.append(
+                "decode replica rehydrated nothing from the dead "
+                "replica's store writes"
+            )
+        if got["retired"] != 1:
+            failures.append(
+                f"expected 1 retired replica, got {got['retired']}"
+            )
+        if got["alive"] != ["r1"]:
+            failures.append(f"expected survivor ['r1'], alive: {got['alive']}")
+        if got["duplicated"]:
+            failures.append(
+                f"{got['duplicated']} duplicated completion(s)"
+            )
+        if got["invariant_problems"]:
+            failures.append(
+                f"survivor invariants violated: {got['invariant_problems']}"
+            )
+        say(
+            "r0 SIGKILLed post-publication; decode adopted "
+            f"{got['snap']['handoff_shipped_blocks']} durable blocks, "
+            f"rehydrated {got['rehydrated']}, transcripts "
+            + ("byte-identical" if got["texts"] == ref["texts"] else "DIVERGED")
+        )
+
+        # Phase C — mid-publish: r0 dies after HALF the prefill
+        # results; the incomplete publication must degrade to local
+        # prefill on the decode side, not error and not adopt.
+        part = disagg_round(td, "partial", kill_after=2)
+        if not part["ok"]:
+            failures.append(
+                f"partial-publish round lost work: {part['errors']}"
+            )
+        if part["texts"] != ref["texts"]:
+            failures.append(
+                "partial-publish transcripts diverged from the reference"
+            )
+        if part["snap"]["handoff_degraded"] != 1:
+            failures.append(
+                "partial publication did not degrade: "
+                f"{part['snap']}"
+            )
+        if part["duplicated"]:
+            failures.append(
+                f"{part['duplicated']} duplicated completion(s) "
+                "in the partial-publish variant"
+            )
+        if part["invariant_problems"]:
+            failures.append(
+                "partial-publish survivor invariants violated: "
+                f"{part['invariant_problems']}"
+            )
+        say(
+            "r0 SIGKILLed mid-publication; handoff degraded to local "
+            "prefill, transcripts "
+            + (
+                "byte-identical"
+                if part["texts"] == ref["texts"]
+                else "DIVERGED"
+            )
+        )
+        payload.update(
+            {
+                "shipped_blocks": got["snap"]["handoff_shipped_blocks"],
+                "decode_rehydrated_blocks": got["rehydrated"],
+                "adopted_after_kill": got["snap"]["handoff_adopted"] == 1,
+                "degraded_on_partial": part["snap"]["handoff_degraded"] == 1,
+                "transcripts_byte_identical": (
+                    got["texts"] == ref["texts"]
+                    and part["texts"] == ref["texts"]
+                ),
+                "duplicated_completions": got["duplicated"]
+                + part["duplicated"],
+                "invariants_clean": not (
+                    got["invariant_problems"] or part["invariant_problems"]
+                ),
+            }
+        )
+    fleet_mod.reset_stats()
+    return failures, payload
+
+
+def handoff_kill_drill(verbose: bool = True) -> int:
+    failures, _ = run_handoff_kill(verbose)
+    if failures:
+        print("\n".join(f"FAIL: {f}" for f in failures), file=sys.stderr)
+        return 1
+    if verbose:
+        print(
+            "chaos_run --handoff-kill: durable-publication adoption + "
+            "partial-publish degradation hold",
+            flush=True,
+        )
+    return 0
 
 
 _OVERLOAD_SPEC = (
@@ -1449,6 +1695,16 @@ def main(argv: list[str] | None = None) -> int:
         "shared-store rehydration, and clean survivor invariants",
     )
     ap.add_argument(
+        "--handoff-kill",
+        action="store_true",
+        help="prefill-loss handoff drill: SIGKILL the prefill replica of "
+        "a 1+1 disagg worker fleet after its published KV blocks are "
+        "durable but before the decode replica promotes them; assert "
+        "store-rehydrated adoption, clean degradation on a partial "
+        "publication, byte-identical transcripts, zero duplicated "
+        "completions, and clean survivor invariants",
+    )
+    ap.add_argument(
         "--overload",
         action="store_true",
         help="serve overload storm drill: open-loop burst at several "
@@ -1493,6 +1749,8 @@ def main(argv: list[str] | None = None) -> int:
         return crash_drill()
     if args.replica_kill:
         return replica_kill_drill()
+    if args.handoff_kill:
+        return handoff_kill_drill()
     if args.overload:
         return overload_drill()
     if args.scale_storm:

@@ -374,6 +374,27 @@ class GraftlintConfig:
     autoscale_lifecycle_mutators: list[str] = field(
         default_factory=lambda: ["_begin_provision", "_advance"]
     )
+    # The cross-replica KV handoff ledger (fleet/handoff.py), the sixth
+    # GL-LIFECYCLE machine: every terminal transition (adopt, degrade,
+    # abandon) must reach the one publication surgery, and the
+    # terminal-outcome ledger is written nowhere else — so a handoff
+    # can neither be double-counted nor vanish between states. The
+    # non-terminal ``note_*`` helpers mutate the in-flight record, not
+    # the owned ledger, so they need no mutator entry. "" disables
+    # (fixture trees).
+    handoff_lifecycle_class: str = "HandoffLedger"
+    handoff_lifecycle_release: str = "_publish_blocks"
+    handoff_lifecycle_exits: list[str] = field(
+        default_factory=lambda: [
+            "_finish_adopt",
+            "_degrade",
+            "_abandon",
+        ]
+    )
+    handoff_lifecycle_owned_attrs: list[str] = field(
+        default_factory=lambda: ["_outcomes"]
+    )
+    handoff_lifecycle_mutators: list[str] = field(default_factory=list)
 
     def named_lifecycle_machines(
         self,
@@ -433,6 +454,16 @@ class GraftlintConfig:
                     self.autoscale_lifecycle_exits,
                     self.autoscale_lifecycle_owned_attrs,
                     self.autoscale_lifecycle_mutators,
+                ),
+            ),
+            (
+                "handoff_lifecycle",
+                (
+                    self.handoff_lifecycle_class,
+                    self.handoff_lifecycle_release,
+                    self.handoff_lifecycle_exits,
+                    self.handoff_lifecycle_owned_attrs,
+                    self.handoff_lifecycle_mutators,
                 ),
             ),
         ]

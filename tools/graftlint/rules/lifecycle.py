@@ -87,6 +87,7 @@ class LifecycleRule(Rule):
         "serve_lifecycle_class": "",  # fixture has no serve machine
         "weightres_lifecycle_class": "",  # nor a weight-ledger machine
         "autoscale_lifecycle_class": "",  # nor an autoscaler machine
+        "handoff_lifecycle_class": "",  # nor a handoff ledger
     }
 
     def check(self, ctx: Context) -> None:

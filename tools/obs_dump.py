@@ -91,6 +91,24 @@ def summarize(events: list[dict]) -> str:
             f"  WARNING: {len(quarantined)} corrupt KV store entr"
             f"{'y' if len(quarantined) == 1 else 'ies'} quarantined"
         )
+    ships = [
+        e for e in events if e["type"] == "swap" and e["op"] == "ship"
+    ]
+    prefetches = [
+        e for e in events if e["type"] == "swap" and e["op"] == "prefetch"
+    ]
+    handoff_routes = [
+        e for e in events if e["type"] == "route" and e["reason"] == "prefill"
+    ]
+    if ships or prefetches or handoff_routes:
+        lines.append(
+            "  kv handoff: "
+            f"{len(handoff_routes)} prefill-routed request(s), "
+            f"{sum(s['blocks'] for s in ships)} block(s) shipped "
+            f"({len(ships)} publication(s)), "
+            f"{sum(p['blocks'] for p in prefetches)} block(s) found at "
+            f"prefetch"
+        )
     weights = [e for e in events if e["type"] == "weight"]
     if weights:
         ops: dict[str, int] = {}

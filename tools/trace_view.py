@@ -10,6 +10,12 @@ service wall within tolerance. A waterfall that doesn't add up is a
 telemetry bug, and this tool treats it as one (exit 1), so the
 decomposition stays checked, not decorative.
 
+A disaggregated fleet dump (reason="prefill" RouteEvents + "ship"
+SwapEvents, fleet/router.py + fleet/handoff.py) additionally annotates
+each handed-off request's waterfall head with the handoff path —
+``prefill@r0 -> decode@r2 (N blocks shipped)`` — so the cross-replica
+KV handoff is readable straight off the view.
+
 Usage:
     python tools/trace_view.py events.jsonl               # waterfalls + check
     python tools/trace_view.py events.jsonl --trace ID    # one round only
@@ -54,7 +60,17 @@ def collect_requests(events: list[dict]) -> dict[str, dict]:
     in the waterfall head instead of only in the raw dump."""
     out: dict[str, dict] = {}
     routes: dict[str, list[dict]] = {}
+    ships: dict[str, int] = {}
     for e in events:
+        if (
+            e["type"] == "swap"
+            and e["op"] == "ship"
+            and e.get("span_id")
+        ):
+            # Handoff publications stamped with the request's span: the
+            # block count feeds the waterfall's handoff annotation.
+            ships[e["span_id"]] = ships.get(e["span_id"], 0) + e["blocks"]
+            continue
         if e["type"] == "route" and e.get("span_id"):
             routes.setdefault(e["span_id"], []).append(
                 {
@@ -96,6 +112,9 @@ def collect_requests(events: list[dict]) -> dict[str, dict]:
     for span_id, hops in routes.items():
         if span_id in out:
             out[span_id]["route"] = sorted(hops, key=lambda h: h["seq"])
+    for span_id, blocks in ships.items():
+        if span_id in out:
+            out[span_id]["shipped_blocks"] = blocks
     return out
 
 
@@ -149,6 +168,13 @@ def render_waterfall(
             else ", open)"
         )
         hops = rec.get("route") or []
+        # A disagg handoff stamps an extra reason="prefill" route at
+        # the prefill replica before the ordinary decode-side route:
+        # render it as its own annotation ("prefill@r0 -> decode@r2
+        # (N blocks shipped)") and keep the via-chain to the replicas
+        # that actually served the request.
+        pre_hops = [h for h in hops if h["reason"] == "prefill"]
+        hops = [h for h in hops if h["reason"] != "prefill"]
         if hops:
             # The replica path: "via r0" normally; a failover shows the
             # whole chain ("via r0 -> r1 (failover)") so a replica loss
@@ -157,6 +183,14 @@ def render_waterfall(
             head += f"  via {path}"
             if hops[-1]["hop"] > 0:
                 head += f" ({hops[-1]['reason']})"
+        if pre_hops:
+            dec = hops[0]["replica"] if hops else "?"
+            head += (
+                f"  handoff prefill@{pre_hops[0]['replica']} -> "
+                f"decode@{dec}"
+            )
+            if rec.get("shipped_blocks"):
+                head += f" ({rec['shipped_blocks']} blocks shipped)"
         rows.append(head)
         offset = 0.0
         for name in STAGES:
