@@ -8,15 +8,14 @@ where ``tpu://`` slots in. Engines are cached: all ``tpu://`` models share one
 
 from __future__ import annotations
 
-import threading
-
 from adversarial_spec_tpu.engine.types import Engine
+from adversarial_spec_tpu.resilience import lockdep as lockdep_mod
 
 _ENGINE_CACHE: dict[str, Engine] = {}
 # The serve daemon resolves engines from concurrent debate threads;
 # double-building a provider's engine (two allocators, two weight
 # sets) must not be a race outcome.
-_CACHE_LOCK = threading.Lock()
+_CACHE_LOCK = lockdep_mod.make_lock("dispatch._CACHE_LOCK")
 
 
 def _provider_key(model: str) -> str:

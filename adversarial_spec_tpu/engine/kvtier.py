@@ -53,6 +53,7 @@ import numpy as np
 
 from adversarial_spec_tpu import obs as obs_mod
 from adversarial_spec_tpu.engine import procconfig
+from adversarial_spec_tpu.resilience import lockdep as lockdep_mod
 
 DEFAULT_HOST_MB = 256
 
@@ -418,7 +419,7 @@ class DiskStore:
         # writes a unique temp name and the replaces are atomic, so
         # the last identical copy wins and every instance's resident
         # count stays consistent with its own scan.
-        self._put_lock = threading.Lock()
+        self._put_lock = lockdep_mod.make_lock("DiskStore._put_lock")
         self._tmp_seq = itertools.count()
         self._resident = self._scan()
 
@@ -516,16 +517,19 @@ class DiskStore:
             os.replace(
                 path, os.path.join(self.quarantine_dir, f"{chain}.kvb")
             )
-            self._resident = max(0, self._resident - 1)
+            with self._put_lock:
+                self._resident = max(0, self._resident - 1)
         except OSError:
             pass
         stats.store_corrupt += 1
+        with self._put_lock:
+            resident = self._resident
         obs_mod.emit(
             obs_mod.SwapEvent(
                 op="quarantine",
                 tier="disk",
                 blocks=1,
-                disk_resident=self._resident,
+                disk_resident=resident,
             )
         )
 

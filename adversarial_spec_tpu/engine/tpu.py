@@ -67,6 +67,7 @@ from adversarial_spec_tpu.parallel.mesh import (
 )
 from adversarial_spec_tpu.parallel.sharding import make_device_put
 from adversarial_spec_tpu.resilience import faults, injector
+from adversarial_spec_tpu.resilience import lockdep as lockdep_mod
 
 _GIB = 1 << 30
 
@@ -184,7 +185,7 @@ class TpuEngine:
 
     def __init__(self) -> None:
         self._models: dict[str, LoadedModel] = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep_mod.make_lock("TpuEngine._lock")
         self._inflight: dict[str, Future] = {}
         # Estimated bytes of loads currently MATERIALIZING (foreground
         # or prefetch): counted alongside _models in every budget sum so

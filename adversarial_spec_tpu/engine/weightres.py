@@ -54,6 +54,7 @@ from dataclasses import dataclass
 
 from adversarial_spec_tpu import obs as obs_mod
 from adversarial_spec_tpu.engine import procconfig
+from adversarial_spec_tpu.resilience import lockdep as lockdep_mod
 
 DEFAULT_HOST_MB = 2048
 
@@ -239,7 +240,7 @@ class WeightLedger:
         # that is about to serve); merged into the entry at admission.
         self._pre_pins: dict[str, int] = {}
         self._clock = 0
-        self._lock = threading.Lock()
+        self._lock = lockdep_mod.make_lock("WeightLedger._lock")
         self.stats = stats_obj if stats_obj is not None else stats
         # Conservation counters (lifetime).
         self.admitted = 0  # loads + promotions into the device tier
@@ -251,8 +252,9 @@ class WeightLedger:
     # -- queries ------------------------------------------------------
 
     def state(self, alias: str) -> str | None:
-        e = self._entries.get(alias)
-        return e.state if e is not None else None
+        with self._lock:
+            e = self._entries.get(alias)
+            return e.state if e is not None else None
 
     def is_resident(self, alias: str) -> bool:
         return self.state(alias) == RESIDENT
@@ -265,25 +267,39 @@ class WeightLedger:
         place — the transition commits via :meth:`promote_model` only
         after the device transfer is dispatched, so an aborted swap
         leaves the tier intact)."""
-        e = self._entries.get(alias)
-        return e if e is not None and e.state == HOST else None
+        with self._lock:
+            e = self._entries.get(alias)
+            return e if e is not None and e.state == HOST else None
 
     def resident_aliases(self) -> list[str]:
-        return [a for a, e in self._entries.items() if e.state == RESIDENT]
+        with self._lock:
+            return [
+                a for a, e in self._entries.items() if e.state == RESIDENT
+            ]
 
     def host_aliases(self) -> list[str]:
-        return [a for a, e in self._entries.items() if e.state == HOST]
+        with self._lock:
+            return [a for a, e in self._entries.items() if e.state == HOST]
 
     @property
     def resident_models(self) -> int:
-        return sum(1 for e in self._entries.values() if e.state == RESIDENT)
+        with self._lock:
+            return sum(
+                1 for e in self._entries.values() if e.state == RESIDENT
+            )
 
     @property
     def host_models(self) -> int:
-        return sum(1 for e in self._entries.values() if e.state == HOST)
+        with self._lock:
+            return sum(1 for e in self._entries.values() if e.state == HOST)
 
     @property
     def host_bytes(self) -> int:
+        with self._lock:
+            return self._host_bytes_locked()
+
+    def _host_bytes_locked(self) -> int:
+        """Caller must hold ``_lock`` (plain Lock — not re-entrant)."""
         return sum(
             e.bytes_host for e in self._entries.values() if e.state == HOST
         )
@@ -291,14 +307,15 @@ class WeightLedger:
     def lru_resident_alias(self) -> str | None:
         """The least-recently-used unpinned resident model (the next
         eviction victim), or None when everything resident is pinned."""
-        cands = [
-            e
-            for e in self._entries.values()
-            if e.state == RESIDENT and e.pins == 0
-        ]
-        if not cands:
-            return None
-        return min(cands, key=lambda e: e.last_used).alias
+        with self._lock:
+            cands = [
+                e
+                for e in self._entries.values()
+                if e.state == RESIDENT and e.pins == 0
+            ]
+            if not cands:
+                return None
+            return min(cands, key=lambda e: e.last_used).alias
 
     def resident_first(self, aliases: list[str]) -> list[str]:
         """Stable resident-first order for one round's model groups —
@@ -350,10 +367,11 @@ class WeightLedger:
                     del self._pre_pins[alias]
 
     def pinned(self, alias: str) -> bool:
-        e = self._entries.get(alias)
-        if e is not None and e.pins > 0:
-            return True
-        return bool(self._pre_pins.get(alias))
+        with self._lock:
+            e = self._entries.get(alias)
+            if e is not None and e.pins > 0:
+                return True
+            return bool(self._pre_pins.get(alias))
 
     # -- transitions --------------------------------------------------
 
@@ -466,7 +484,7 @@ class WeightLedger:
                 if host_budget_bytes is not None
                 else _config.host_mb << 20
             )
-            while self.host_bytes > budget:
+            while self._host_bytes_locked() > budget:
                 victims = [
                     e
                     for e in self._entries.values()

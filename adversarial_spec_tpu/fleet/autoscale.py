@@ -66,6 +66,7 @@ from adversarial_spec_tpu import fleet as fleet_mod
 from adversarial_spec_tpu import obs as obs_mod
 from adversarial_spec_tpu import serve as serve_mod
 from adversarial_spec_tpu.fleet.replica import SpawnFailed
+from adversarial_spec_tpu.resilience import lockdep as lockdep_mod
 
 # Lifecycle states (one machine per managed replica).
 PROVISIONING = "provisioning"
@@ -135,7 +136,7 @@ class Autoscaler:
         self._last_change_t: float | None = None
         self._last_backlog = 0
         self._desired = max(1, len(self._members))
-        self._lock = threading.RLock()
+        self._lock = lockdep_mod.make_rlock("Autoscaler._lock")
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -523,7 +524,11 @@ class Autoscaler:
         while (
             self._router.inflight(victim) > 0 and self._clock() < deadline
         ):
-            self._sleep(_DRAIN_POLL_S)
+            # Deliberate sleep under the membership lock: membership
+            # changes are serialized by design, and nothing on the
+            # serving path blocks on this lock (capacity and pressure
+            # reads go through lock-free snapshots).
+            self._sleep(_DRAIN_POLL_S)  # graftlint: disable=GL-LOCK-BLOCKING -- drain poll; membership changes are intentionally serialized under this lock
         self._finish_scale_in(victim)
         self._last_change_t = self._clock()
         self._reset_streak("in", role)

@@ -62,6 +62,7 @@ from adversarial_spec_tpu.fleet.replica import (
 )
 from adversarial_spec_tpu.resilience import breaker as breaker_mod
 from adversarial_spec_tpu.resilience import faults as faults_mod
+from adversarial_spec_tpu.resilience import lockdep as lockdep_mod
 from adversarial_spec_tpu.resilience import injector
 
 
@@ -94,7 +95,7 @@ class FleetRouter:
         # orders mid-submit — ring reads and membership writes both
         # take it (RLock: a locked path may re-enter through the
         # retirement surgery).
-        self._mlock = threading.RLock()
+        self._mlock = lockdep_mod.make_rlock("FleetRouter._mlock")
         # Per-replica in-flight request counts (submit increments
         # around each dispatch): the scale-in drain watches this reach
         # zero before retiring the victim.
@@ -203,6 +204,7 @@ class FleetRouter:
                 return
             self._dead[rid] = reason
             self._ring.remove(rid)
+            alive = len(self._ring)
         try:
             self._replicas[rid].close()
         except Exception:
@@ -210,10 +212,10 @@ class FleetRouter:
         self.stats.replicas_retired += 1
         if obs_mod.config().enabled:
             obs_mod.hot.replica_op("retire").inc()
-            obs_mod.hot.fleet_replicas_alive.set(len(self._ring))
+            obs_mod.hot.fleet_replicas_alive.set(alive)
         obs_mod.emit(
             obs_mod.ReplicaEvent(
-                replica=rid, op="retire", reason=reason, alive=len(self._ring)
+                replica=rid, op="retire", reason=reason, alive=alive
             )
         )
 
@@ -252,7 +254,7 @@ class FleetRouter:
                     obs_mod.ReplicaEvent(
                         replica=rid,
                         op="heartbeat_miss",
-                        alive=len(self._ring),
+                        alive=len(self.alive_ids()),
                     )
                 )
                 self._heartbeat_failure(rid)
@@ -294,8 +296,9 @@ class FleetRouter:
             reason = "affinity"
         else:
             alive = self.alive_ids(role=self.route_role) or self.alive_ids()
-            self._rr += 1
-            cut = self._rr % len(alive) if alive else 0
+            with self._mlock:
+                self._rr += 1
+                cut = self._rr % len(alive) if alive else 0
             order = alive[cut:] + alive[:cut]
             reason = "random"
         primary = order[0] if order else None
@@ -400,7 +403,7 @@ class FleetRouter:
                         error=(
                             "UNAVAILABLE: fleet has no routable replica "
                             f"for {requests[i].model} "
-                            f"({len(self._dead)} retired, "
+                            f"({len(self._dead)} retired, "  # graftlint: disable=GL-LOCK-GUARD -- diagnostic count in an error string; a stale read is harmless
                             f"{self.stats.breaker_skips} breaker skip(s))"
                         ),
                         transient=False,

@@ -47,6 +47,7 @@ from adversarial_spec_tpu.obs.events import (  # noqa: F401 (re-export)
     ReplicaEvent,
     RequestEvent,
     RouteEvent,
+    LockEvent,
     ScaleEvent,
     ServeEvent,
     SpanEvent,
@@ -194,6 +195,8 @@ class HotMetrics:
         "_serve_shed",
         "_weight_swap",
         "_handoff",
+        "_lock_hold",
+        "_lock_wait",
     )
 
     def __init__(self, m: MetricsRegistry) -> None:
@@ -334,6 +337,8 @@ class HotMetrics:
         self._serve_shed: dict = {}
         self._weight_swap: dict = {}
         self._handoff: dict = {}
+        self._lock_hold: dict = {}
+        self._lock_wait: dict = {}
 
     def sync(self, reason: str):
         c = self._sync.get(reason)
@@ -454,6 +459,34 @@ class HotMetrics:
                 reason=reason,
             )
         return c
+
+    def lock_hold(self, lock: str):
+        """Per-lock hold-wall histogram (resilience/lockdep.py
+        TrackedLock release path) — a critical section that grew past
+        its budget shows up as a fat column here before it shows up as
+        contention anywhere else."""
+        h = self._lock_hold.get(lock)
+        if h is None:
+            h = self._lock_hold[lock] = self._m.histogram(
+                "advspec_lock_hold_seconds",
+                help="tracked-lock hold wall by lock (lockdep)",
+                lock=lock,
+            )
+        return h
+
+    def lock_wait(self, lock: str):
+        """Per-lock acquisition-wait histogram (TrackedLock acquire
+        path): the contention ledger — waits fatten here long before a
+        stall is user-visible, and the deadlock-hammer drill pins the
+        families exist."""
+        h = self._lock_wait.get(lock)
+        if h is None:
+            h = self._lock_wait[lock] = self._m.histogram(
+                "advspec_lock_wait_seconds",
+                help="tracked-lock acquisition wait wall by lock (lockdep)",
+                lock=lock,
+            )
+        return h
 
     def weight_swap_latency(self, direction: str):
         """Weight-residency swap wall histogram by direction (load:

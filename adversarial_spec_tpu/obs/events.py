@@ -392,6 +392,25 @@ class ScaleEvent:
     span_id: str = ""
 
 
+@dataclass(slots=True)
+class LockEvent:
+    """One lockdep sanitizer detection (resilience/lockdep.py). ``op``
+    is the violation kind; ``lock`` the lock whose acquisition closed
+    the cycle, ``held`` the lock held at that moment, and ``edge`` the
+    offending acquisition-order edge (``"held->lock"``). The full
+    stacks live in the LockOrderViolation the sanitizer records (and
+    in the auto-dumped ring's surrounding events) — an event field is
+    not the place for a multi-KB traceback."""
+
+    TYPE = "lock"
+    op: str = "violation"
+    lock: str = ""
+    held: str = ""
+    edge: str = ""
+    trace_id: str = ""
+    span_id: str = ""
+
+
 EVENT_TYPES = (
     StepEvent,
     RequestEvent,
@@ -410,6 +429,7 @@ EVENT_TYPES = (
     WeightEvent,
     ServeEvent,
     ScaleEvent,
+    LockEvent,
 )
 
 # ``cancelled`` closes a request envelope mid-decode (streaming early
@@ -493,6 +513,12 @@ SCALE_OPS = (
 )
 
 SCALE_DIRECTIONS = ("out", "in", "")
+
+# The lockdep sanitizer's detections (resilience/lockdep.py): today
+# only order inversions — the op whitelist exists so a future
+# hold-too-long / wait-too-long detector extends the vocabulary here
+# instead of minting untyped strings.
+LOCK_OPS = ("violation",)
 
 REQUEST_STATES = (
     "queued",
@@ -586,7 +612,22 @@ def validate_event(obj) -> list[str]:
             errors.append(
                 f"scale: unknown direction {obj.get('direction')!r}"
             )
+    if etype == "lock" and obj.get("op") not in LOCK_OPS:
+        errors.append(f"lock: unknown op {obj.get('op')!r}")
     return errors
+
+
+def _recorder_lock():
+    """The recorder's mutation lock through the lockdep seam
+    (resilience/lockdep.py), ``metrics=False``: a histogram observe
+    takes the metrics-registry lock, so obs-internal locks must never
+    observe themselves. Lazy import — obs loads before resilience in
+    some import orders, and the recorder must construct either way."""
+    try:
+        from adversarial_spec_tpu.resilience import lockdep
+    except ImportError:  # pragma: no cover - partial-init fallback
+        return threading.Lock()
+    return lockdep.make_lock("FlightRecorder._lock", metrics=False)
 
 
 @dataclass
@@ -601,7 +642,7 @@ class FlightRecorder:
     # Serializes seq/dropped/_buf mutation: the serve daemon's debate
     # threads emit concurrently (buffered + dropped == seq must hold
     # exactly — the chaos fuzz pins it).
-    _lock: threading.Lock = field(default_factory=threading.Lock)
+    _lock: object = field(default_factory=_recorder_lock)
 
     def __post_init__(self) -> None:
         self._buf = deque(self._buf, maxlen=self.size)

@@ -132,6 +132,18 @@ class Histogram:
         self.count = 0
 
 
+def _registry_lock():
+    """The registry lock through the lockdep seam, ``metrics=False``:
+    every histogram observe takes THIS lock, so instrumenting it would
+    recurse. Lazy import — obs loads before resilience in some import
+    orders, and the registry must construct either way."""
+    try:
+        from adversarial_spec_tpu.resilience import lockdep
+    except ImportError:  # pragma: no cover - partial-init fallback
+        return threading.Lock()
+    return lockdep.make_lock("MetricsRegistry._lock", metrics=False)
+
+
 class MetricsRegistry:
     """Named metrics with optional labels; one instance per process.
 
@@ -143,7 +155,7 @@ class MetricsRegistry:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = _registry_lock()
         # name -> (kind, help, {labels_tuple: metric})
         self._families: dict[str, tuple[str, str, dict]] = {}
 

@@ -76,7 +76,21 @@ ambient = _Ambient()
 _trace_counter = 0
 # Per-scope counters for daemon minting (scope = one debate/session id).
 _scope_counters: dict[str, int] = {}
-_mint_lock = threading.Lock()
+
+
+def _make_mint_lock():
+    """Minting lock through the lockdep seam, ``metrics=False`` (a
+    histogram observe would re-enter obs). Lazy import — obs loads
+    before resilience in some import orders, and minting must work
+    either way."""
+    try:
+        from adversarial_spec_tpu.resilience import lockdep
+    except ImportError:  # pragma: no cover - partial-init fallback
+        return threading.Lock()
+    return lockdep.make_lock("trace._mint_lock", metrics=False)
+
+
+_mint_lock = _make_mint_lock()
 
 
 def _scope_suffix(scope: str) -> str:

@@ -30,6 +30,7 @@ import threading
 import time
 
 from adversarial_spec_tpu import obs as obs_mod
+from adversarial_spec_tpu.resilience import lockdep as lockdep_mod
 from adversarial_spec_tpu.resilience.faults import FaultKind
 
 CLOSED = "closed"
@@ -179,7 +180,7 @@ class BreakerRegistry:
         self.cooldown_s = cooldown_s
         self.enabled = enabled
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockdep_mod.make_lock("BreakerRegistry._lock")
         self._breakers: dict[str, CircuitBreaker] = {}
 
     def configure(
@@ -318,7 +319,7 @@ class BreakerRegistry:
 # -- default process registry ---------------------------------------------
 
 _default: BreakerRegistry | None = None
-_default_lock = threading.Lock()
+_default_lock = lockdep_mod.make_lock("breaker._default_lock")
 
 
 def default_registry() -> BreakerRegistry:

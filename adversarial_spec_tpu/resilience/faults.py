@@ -38,6 +38,7 @@ import threading
 from enum import Enum
 
 from adversarial_spec_tpu import obs as obs_mod
+from adversarial_spec_tpu.resilience import lockdep as lockdep_mod
 
 
 class FaultKind(str, Enum):
@@ -123,7 +124,7 @@ def is_transient(exc: BaseException) -> bool:
 # engine/scheduler sit several calls below the CLI's tracer, and faults
 # are rare enough that a lock per event is free.
 
-_lock = threading.Lock()
+_lock = lockdep_mod.make_lock("faults._lock")
 _counts: dict[str, int] = {}
 
 

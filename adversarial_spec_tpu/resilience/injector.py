@@ -63,6 +63,7 @@ import random
 import threading
 from dataclasses import dataclass, field
 
+from adversarial_spec_tpu.resilience import lockdep as lockdep_mod
 from adversarial_spec_tpu.resilience.faults import FaultKind
 
 SEAMS = (
@@ -173,7 +174,7 @@ class FaultInjector:
     def __init__(self, rules=(), seed: int | None = None):
         self.rules: list[FaultRule] = list(rules)
         self._rng = random.Random(seed)
-        self._lock = threading.Lock()
+        self._lock = lockdep_mod.make_lock("FaultInjector._lock")
         self.fired: dict[str, int] = {}  # "<seam>.<kind>" -> fire count
         self.seam_hits: dict[str, int] = {}  # seam -> hook invocations
 
@@ -206,7 +207,7 @@ class FaultInjector:
 # -- active injector -------------------------------------------------------
 
 _active: FaultInjector | None = None
-_active_lock = threading.Lock()
+_active_lock = lockdep_mod.make_lock("injector._active_lock")
 
 
 def active() -> FaultInjector:

@@ -76,6 +76,7 @@ from adversarial_spec_tpu import obs as obs_mod
 from adversarial_spec_tpu import serve as serve_mod
 from adversarial_spec_tpu.engine import weightres as weightres_mod
 from adversarial_spec_tpu.engine.types import ChatRequest, Completion, SamplingParams
+from adversarial_spec_tpu.resilience import lockdep as lockdep_mod
 from adversarial_spec_tpu.serve.protocol import SHED_REASONS, TIERS
 
 # Floor for the retry-after estimate's drain rate (tokens/s): before
@@ -183,7 +184,7 @@ class ServeScheduler:
 
     def __init__(self, clock=time.monotonic) -> None:
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockdep_mod.make_lock("ServeScheduler._lock")
         self._cond = threading.Condition(self._lock)
         # tier -> tenant -> FIFO of queued units.
         self._queues: dict[str, dict[str, deque[Unit]]] = {

@@ -18,6 +18,10 @@ _SKIP_PATH_FRAGMENTS = (
     # mutant that ACCIDENTALLY fixes one breaks the self-test for the
     # wrong reason. tools/lint_all.py asserts this entry stays.
     "/tools/graftlint/",
+    # The lockdep sanitizer's violation formatting (stack capture,
+    # message assembly) is diagnostics for humans: mutants there either
+    # trip its own self-test trivially or change only report prose.
+    "/resilience/lockdep.py",
 )
 
 _SKIP_LINE_MARKERS = (

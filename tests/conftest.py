@@ -10,6 +10,12 @@ jax initializes, hence at conftest import time.
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The whole suite runs with the lockdep sanitizer armed: every
+# declared lock becomes a TrackedLock, the acquisition-order graph is
+# live, and any inversion fails the test that caused it (the fixture
+# below asserts zero violations at teardown). Must be set before the
+# package imports — make_lock() reads it at lock construction.
+os.environ.setdefault("ADVSPEC_LOCKDEP", "1")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -227,7 +233,19 @@ def _isolate_state(tmp_path, monkeypatch):
     # Full retrace clear (reset() deliberately keeps compile baselines
     # for warm per-round accounting; tests want cold-start isolation).
     obs.retrace.clear()
+    # Lockdep state is process-global by design (the order graph spans
+    # every lock in the process); tests must not leak edges — or,
+    # worse, a recorded violation — into each other.
+    from adversarial_spec_tpu.resilience import lockdep
+
+    lockdep.reset()
     yield
+    leaked = lockdep.violations()
+    assert not leaked, (
+        "lock-order violation(s) recorded during this test:\n"
+        + "\n\n".join(str(v) for v in leaked)
+    )
+    lockdep.reset()
     serve_gate.uninstall()
     serve.configure(
         max_queue_depth=serve.DEFAULT_QUEUE_DEPTH,

@@ -198,6 +198,9 @@ class TestGraftlint:
         "GL-ATOMIC",
         "GL-LIFECYCLE",
         "GL-CONFIG",
+        "GL-LOCK-GUARD",
+        "GL-LOCK-ORDER",
+        "GL-LOCK-BLOCKING",
     }
 
     def test_repo_is_clean(self):
@@ -675,9 +678,13 @@ class TestGraftlint:
             "files",
             "checked_calls",
             "rule_seconds",
+            "artifacts",
         }
         assert payload["version"] == 1
         assert payload["rules"] == ["GL-IMPORT"]
+        # Rule-emitted artifacts (GL-LOCK-ORDER's lock_order/lock_edges)
+        # only appear when their rule is selected.
+        assert payload["artifacts"] == {}
         assert set(payload["counts"]) == {
             "total",
             "suppressed",
@@ -1131,6 +1138,8 @@ class TestGraftlint:
             weightres_lifecycle_class="",  # nor a weight-ledger machine
             autoscale_lifecycle_class="",  # nor an autoscaler machine
             handoff_lifecycle_class="",  # nor a handoff ledger
+            lock_guards=[],  # nor any declared locks
+            lock_thread_entries=[],
         )
         sources = {
             "pkg/sched.py": (

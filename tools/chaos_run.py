@@ -1648,6 +1648,241 @@ def weight_swap_drill(verbose: bool = True) -> int:
     return 0
 
 
+def run_deadlock_hammer(verbose: bool = True) -> tuple[list[str], dict]:
+    """The lock-discipline drill (docs/locking.md), two phases:
+
+    1. CLEAN STORM: a real ServeScheduler and a real Autoscaler over a
+       real in-proc fleet, all locks tracked by the lockdep sanitizer
+       (resilience/lockdep.py). Worker threads hammer exactly the
+       cross-component paths that hold one lock while taking another:
+       autoscaler ticks (Autoscaler._lock -> ServeScheduler._lock via
+       the pressure observer, -> FleetRouter._mlock via membership
+       surgery), admissions whose capacity provider reads the ring
+       under the scheduler lock (ServeScheduler._lock ->
+       FleetRouter._mlock), and router health rounds. Contract: the
+       sanitizer records cross-lock edges (the storm really exercised
+       nesting) and ZERO violations — the shipped hierarchy is acyclic
+       under real concurrency, not just under GL-LOCK-ORDER's static
+       graph.
+
+    2. SEEDED INVERSION: two fresh tracked locks driven through a
+       deterministic two-thread A->B / B->A inversion — the threads
+       run SEQUENTIALLY (start+join each), so the opposite-direction
+       edge is already in the graph when the second thread inverts it
+       and no real deadlock is ever risked. Contract: exactly the
+       seeded violation is detected, naming both stacks. Proves the
+       drill would catch a phase-1 regression rather than silently
+       passing with a dead sanitizer.
+
+    Returns (failures, payload); the deterministic mock-clock variant
+    lives in tests/test_lockdep.py under the ``chaos`` marker."""
+    import threading
+    import time
+
+    from adversarial_spec_tpu import fleet as fleet_mod
+    from adversarial_spec_tpu import serve as serve_mod
+    from adversarial_spec_tpu.fleet.autoscale import Autoscaler
+    from adversarial_spec_tpu.fleet.router import FleetEngine
+    from adversarial_spec_tpu.resilience import lockdep
+    from adversarial_spec_tpu.serve.sched import ServeScheduler
+
+    def say(msg: str) -> None:
+        if verbose:
+            print(f"chaos_run --deadlock-hammer: {msg}", flush=True)
+
+    failures: list[str] = []
+    payload: dict = {}
+    old_serve = serve_mod.snapshot()
+    old_fleet = fleet_mod.config()
+    serve_mod.reset_stats()
+    serve_mod.configure(
+        max_queue_depth=256,
+        max_backlog_tokens=100_000,
+        tenant_quota_tokens=0,
+        drain_deadline_s=3.0,
+    )
+    fleet_mod.shutdown_fleet()
+    fleet_mod.configure(
+        enabled=True,
+        replicas=2,
+        transport="inproc",
+        autoscale=True,
+        min_replicas=1,
+        max_replicas=3,
+        scale_out_fraction=0.5,
+        scale_in_fraction=0.1,
+        scale_out_ticks=1,
+        scale_in_ticks=2,
+        scale_cooldown_s=0.0,
+        scale_interval_s=0.01,
+    )
+    lockdep.configure(enabled=True, raise_on_violation=False)
+    lockdep.reset()
+    try:
+        # -- phase 1: clean ordered storm over the real stack ---------
+        say("phase 1: concurrent admission/tick/health storm")
+        eng = FleetEngine(replicas=2)
+        sched = ServeScheduler()
+        sched.set_capacity_provider(
+            lambda: len(eng.router.alive_ids())
+        )
+        scaler = Autoscaler(
+            eng,
+            pressure=sched.pressure_snapshot,
+            sleep=lambda s: None,
+        )
+        stop_t = time.monotonic() + 2.0
+        errors: list[str] = []
+
+        def admit_loop() -> None:
+            i = 0
+            try:
+                while time.monotonic() < stop_t:
+                    i += 1
+                    deb = f"hammer-{threading.get_ident()}-{i}"
+                    shed = sched.try_admit(
+                        "tenant-a",
+                        "interactive",
+                        deb,
+                        est_tokens=200,
+                        models=["mock://critic", "mock://agree"],
+                    )
+                    if shed is None:
+                        sched.pressure_snapshot()
+                        sched.finish_debate(deb)
+            except Exception as exc:  # noqa: BLE001 - drill boundary
+                errors.append(f"admit_loop: {exc!r}")
+
+        def tick_loop() -> None:
+            try:
+                while time.monotonic() < stop_t:
+                    scaler.tick()
+            except Exception as exc:  # noqa: BLE001 - drill boundary
+                errors.append(f"tick_loop: {exc!r}")
+
+        def health_loop() -> None:
+            try:
+                while time.monotonic() < stop_t:
+                    eng.router.health_check()
+                    eng.router.check_invariants()
+            except Exception as exc:  # noqa: BLE001 - drill boundary
+                errors.append(f"health_loop: {exc!r}")
+
+        threads = [
+            threading.Thread(target=admit_loop, name="hammer-admit-1"),
+            threading.Thread(target=admit_loop, name="hammer-admit-2"),
+            threading.Thread(target=tick_loop, name="hammer-tick"),
+            threading.Thread(target=health_loop, name="hammer-health"),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30.0)
+        if any(t.is_alive() for t in threads):
+            failures.append("storm thread wedged (possible deadlock)")
+        failures.extend(errors)
+        edges = lockdep.order_edges()
+        cross = {
+            (a, b) for a, bs in edges.items() for b in bs if a != b
+        }
+        payload["edges"] = sorted(f"{a}->{b}" for a, b in cross)
+        say(f"storm recorded {len(cross)} cross-lock edge(s)")
+        if not cross:
+            failures.append(
+                "storm recorded no cross-lock edges — the drill did "
+                "not exercise nested acquisition (dead hammer)"
+            )
+        storm_violations = lockdep.violations()
+        if storm_violations:
+            failures.append(
+                "lock-order violation(s) in the real stack:\n"
+                + "\n\n".join(str(v) for v in storm_violations)
+            )
+        eng.shutdown()
+        sched.stop()
+
+        # -- phase 2: seeded deterministic inversion ------------------
+        say("phase 2: seeded two-thread A->B / B->A inversion")
+        lockdep.reset()
+        a = lockdep.TrackedLock("hammer.A", metrics=False)
+        b = lockdep.TrackedLock("hammer.B", metrics=False)
+
+        def forward() -> None:
+            with a:
+                with b:
+                    pass
+
+        def backward() -> None:
+            with b:
+                with a:
+                    pass
+
+        for fn in (forward, backward):  # sequential: no real deadlock
+            t = threading.Thread(target=fn, name=f"hammer-{fn.__name__}")
+            t.start()
+            t.join(timeout=10.0)
+        seeded = lockdep.violations()
+        payload["seeded_violations"] = len(seeded)
+        if len(seeded) != 1:
+            failures.append(
+                f"seeded inversion produced {len(seeded)} violation(s), "
+                "expected exactly 1"
+            )
+        else:
+            v = seeded[0]
+            if v.edge != ("hammer.B", "hammer.A"):
+                failures.append(f"seeded violation edge {v.edge}")
+            msg = str(v)
+            if "this acquisition" not in msg or "opposite edge" not in msg:
+                failures.append(
+                    f"seeded violation does not name both stacks: "
+                    f"{msg[:200]!r}"
+                )
+    finally:
+        lockdep.reset()
+        lockdep.configure(
+            enabled=lockdep.env_enabled(), raise_on_violation=False
+        )
+        serve_mod.configure(
+            max_queue_depth=old_serve["max_queue_depth"],
+            max_backlog_tokens=old_serve["max_backlog_tokens"],
+            tenant_quota_tokens=old_serve["tenant_quota_tokens"],
+            drain_deadline_s=old_serve["drain_deadline_s"],
+        )
+        serve_mod.reset_stats()
+        fleet_mod.shutdown_fleet()
+        fleet_mod.configure(
+            enabled=old_fleet.enabled,
+            replicas=old_fleet.replicas,
+            transport=old_fleet.transport,
+            autoscale=old_fleet.autoscale,
+            min_replicas=old_fleet.min_replicas,
+            max_replicas=old_fleet.max_replicas,
+            scale_out_fraction=old_fleet.scale_out_fraction,
+            scale_in_fraction=old_fleet.scale_in_fraction,
+            scale_out_ticks=old_fleet.scale_out_ticks,
+            scale_in_ticks=old_fleet.scale_in_ticks,
+            scale_cooldown_s=old_fleet.scale_cooldown_s,
+            scale_interval_s=old_fleet.scale_interval_s,
+        )
+        fleet_mod.reset_stats()
+    return failures, payload
+
+
+def deadlock_hammer_drill(verbose: bool = True) -> int:
+    failures, _ = run_deadlock_hammer(verbose)
+    if failures:
+        print("\n".join(f"FAIL: {f}" for f in failures), file=sys.stderr)
+        return 1
+    if verbose:
+        print(
+            "chaos_run --deadlock-hammer: acyclic order under real "
+            "concurrency + seeded inversion detected with both stacks",
+            flush=True,
+        )
+    return 0
+
+
 def _pytest(extra: list[str], env_overrides: dict[str, str]) -> int:
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
@@ -1733,6 +1968,15 @@ def main(argv: list[str] | None = None) -> int:
         "clean allocator/tier invariants",
     )
     ap.add_argument(
+        "--deadlock-hammer",
+        action="store_true",
+        help="lock-discipline drill: concurrent admission/autoscale/"
+        "health storm over the real scheduler+fleet with the lockdep "
+        "sanitizer armed (assert cross-lock edges recorded and zero "
+        "order violations), then a seeded sequential two-thread "
+        "inversion (assert exactly one violation naming both stacks)",
+    )
+    ap.add_argument(
         "--drain",
         action="store_true",
         help="serve SIGTERM drain drill: a real subprocess daemon is "
@@ -1755,6 +1999,8 @@ def main(argv: list[str] | None = None) -> int:
         return overload_drill()
     if args.scale_storm:
         return scale_storm_drill()
+    if args.deadlock_hammer:
+        return deadlock_hammer_drill()
     if args.drain:
         return drain_drill()
     if args.weight_swap:

@@ -19,6 +19,29 @@ from dataclasses import dataclass, field
 from pathlib import Path
 
 
+@dataclass(frozen=True)
+class LockGuard:
+    """One decoded ``lock_guards`` entry: a declared lock, the
+    attribute aliases that count as holding it, and the state it
+    guards. ``classname`` is "" for module-level locks; ``guarded``
+    names instance attributes (class locks) or module globals."""
+
+    module: str
+    classname: str
+    lock_attr: str
+    aliases: tuple[str, ...]  # includes lock_attr itself
+    guarded: tuple[str, ...]
+
+    @property
+    def name(self) -> str:
+        """Canonical lock name — matches the runtime lockdep wrapper
+        name so the static order graph and the sanitizer's violation
+        reports speak one vocabulary."""
+        if self.classname:
+            return f"{self.classname}.{self.lock_attr}"
+        return f"{self.module.rsplit('.', 1)[-1]}.{self.lock_attr}"
+
+
 @dataclass
 class GraftlintConfig:
     # Root package the domain rules reason about.
@@ -395,6 +418,146 @@ class GraftlintConfig:
         default_factory=lambda: ["_outcomes"]
     )
     handoff_lifecycle_mutators: list[str] = field(default_factory=list)
+    # -- GL-LOCK (rules/locking.py) ------------------------------------
+    # The lock-discipline map: one entry per declared lock, both the
+    # guards table (GL-LOCK-GUARD) and the lock *inventory* GL-CONFIG
+    # checks declarations against. Entry grammar (TOML-subset has no
+    # tables, so each entry is one string):
+    #   "<module>:<Class>.<lockattr>[|<alias>...]=<attr>,<attr>"
+    #   "<module>:<globalname>[|<alias>...]=<global>,<global>"
+    # Aliases name other attributes holding the SAME lock (a Condition
+    # constructed over it: ``with self._cond`` == holding ``_lock``).
+    # An empty right-hand side declares a pure ordering lock guarding
+    # no named state.
+    lock_guards: list[str] = field(
+        default_factory=lambda: [
+            "adversarial_spec_tpu.serve.sched:ServeScheduler._lock|_cond="
+            "_queues,_passes,_running,_reserved,_reserved_prefill,"
+            "_debate_tenant,_debate_models,_outstanding,_quota,"
+            "_capacity_fn,brownout,_prev_gamma,draining,_drain_forced,"
+            "_stopped,_charged_tokens",
+            "adversarial_spec_tpu.fleet.autoscale:Autoscaler._lock="
+            "_members,_pending,_out_streak,_in_streak,_out_streaks,"
+            "_in_streaks,_last_change_t,_last_backlog,_desired",
+            "adversarial_spec_tpu.fleet.router:FleetRouter._mlock="
+            "_ring,_dead,_inflight,_rr",
+            "adversarial_spec_tpu.engine.weightres:WeightLedger._lock="
+            "_entries,_pre_pins,_clock",
+            "adversarial_spec_tpu.engine.tpu:TpuEngine._lock="
+            "_models,_inflight,_loading,_demoting",
+            "adversarial_spec_tpu.engine.kvtier:DiskStore._put_lock="
+            "_resident",
+            "adversarial_spec_tpu.engine.dispatch:_CACHE_LOCK="
+            "_ENGINE_CACHE",
+            "adversarial_spec_tpu.obs.metrics:MetricsRegistry._lock="
+            "_families",
+            "adversarial_spec_tpu.obs.trace:_mint_lock="
+            "_trace_counter,_scope_counters",
+            "adversarial_spec_tpu.obs.events:FlightRecorder._lock=_buf",
+            "adversarial_spec_tpu.resilience.faults:_lock=_counts",
+            "adversarial_spec_tpu.resilience.injector:FaultInjector._lock="
+            "fired,seam_hits",
+            "adversarial_spec_tpu.resilience.injector:_active_lock=_active",
+            "adversarial_spec_tpu.resilience.breaker:BreakerRegistry._lock="
+            "_breakers",
+            "adversarial_spec_tpu.resilience.breaker:_default_lock=_default",
+        ]
+    )
+    # Thread entry points for GL-LOCK-GUARD reachability BEYOND the
+    # auto-discovered ones (threading.Thread targets and Thread
+    # subclass ``run``): "<module>:<func>" / "<module>:<Class>.<method>".
+    # The daemon runs debates on executor threads (run_in_executor is
+    # not statically resolvable) and drills drive the autoscaler's
+    # ``tick`` directly.
+    lock_thread_entries: list[str] = field(
+        default_factory=lambda: [
+            "adversarial_spec_tpu.serve.driver:run_debate",
+            "adversarial_spec_tpu.fleet.autoscale:Autoscaler.tick",
+        ]
+    )
+    # Call patterns GL-LOCK-BLOCKING refuses while any tracked lock is
+    # held: a dotted pattern matches the dotted call name (suffix), a
+    # bare name matches the final attribute/function segment. ``wait``
+    # on an alias of a held lock's own Condition is exempt (the wait
+    # RELEASES that lock); waiting on anything else while holding a
+    # lock is the finding.
+    lock_blocking_calls: list[str] = field(
+        default_factory=lambda: [
+            "time.sleep",
+            "_sleep",
+            "os.fsync",
+            "fsync",
+            "subprocess.run",
+            "subprocess.check_output",
+            "subprocess.Popen",
+            "block_until_ready",
+            "device_get",
+            "chat",
+            "wait",
+            "join",
+        ]
+    )
+
+    def parsed_lock_guards(self) -> list["LockGuard"]:
+        """``lock_guards`` decoded into :class:`LockGuard` records.
+        Raises ValueError on malformed entries (GL-CONFIG surfaces the
+        same failure as a finding on full runs)."""
+        out: list[LockGuard] = []
+        for entry in self.lock_guards:
+            head, sep, attrs = entry.partition("=")
+            if not sep:
+                raise ValueError(
+                    f"lock_guards entry {entry!r}: missing '=' "
+                    "(use '<module>:<lock>=<attr>,...')"
+                )
+            module, msep, lockpart = head.partition(":")
+            module = module.strip()
+            if not msep or not module or not lockpart.strip():
+                raise ValueError(
+                    f"lock_guards entry {entry!r}: head must be "
+                    "'<module>:<lock>'"
+                )
+            names = [n.strip() for n in lockpart.split("|") if n.strip()]
+            first = names[0]
+            if "." in first:
+                classname, lock_attr = first.split(".", 1)
+            else:
+                classname, lock_attr = "", first
+            aliases = [lock_attr]
+            for n in names[1:]:
+                aliases.append(n.split(".", 1)[1] if "." in n else n)
+            guarded = tuple(
+                a.strip() for a in attrs.split(",") if a.strip()
+            )
+            out.append(
+                LockGuard(
+                    module=module,
+                    classname=classname,
+                    lock_attr=lock_attr,
+                    aliases=tuple(aliases),
+                    guarded=guarded,
+                )
+            )
+        return out
+
+    def parsed_thread_entries(self) -> list[tuple[str, str, str]]:
+        """``lock_thread_entries`` decoded as (module, classname, func);
+        classname is "" for module-level functions."""
+        out: list[tuple[str, str, str]] = []
+        for entry in self.lock_thread_entries:
+            module, sep, func = entry.partition(":")
+            if not sep or not module.strip() or not func.strip():
+                raise ValueError(
+                    f"lock_thread_entries entry {entry!r}: use "
+                    "'<module>:<func>' or '<module>:<Class>.<method>'"
+                )
+            func = func.strip()
+            if "." in func:
+                classname, func = func.split(".", 1)
+            else:
+                classname = ""
+            out.append((module.strip(), classname, func))
+        return out
 
     def named_lifecycle_machines(
         self,

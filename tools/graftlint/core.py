@@ -84,8 +84,8 @@ _REGISTRY: dict[str, Rule] = {}
 
 
 def register(cls: type[Rule]) -> type[Rule]:
-    if not cls.id or not re.fullmatch(r"GL-[A-Z]+", cls.id):
-        raise ValueError(f"rule id {cls.id!r} must match GL-[A-Z]+")
+    if not cls.id or not re.fullmatch(r"GL-[A-Z]+(-[A-Z]+)*", cls.id):
+        raise ValueError(f"rule id {cls.id!r} must match GL-[A-Z]+(-[A-Z]+)*")
     if cls.id in _REGISTRY:
         raise ValueError(f"duplicate rule id {cls.id}")
     _REGISTRY[cls.id] = cls()
@@ -120,6 +120,10 @@ class Context:
         self.full_run = full_run
         self.findings: list[Finding] = []
         self.n_checked_calls = 0  # GL-ARITY call sites verified
+        # Rule-published structured output surfaced in --json (e.g.
+        # GL-LOCK-ORDER's discovered lock hierarchy). Keyed by a short
+        # artifact name; values must be JSON-serializable.
+        self.artifacts: dict[str, object] = {}
 
     def report(
         self, rule_id: str, path: Path, lineno: int, message: str
@@ -244,6 +248,9 @@ class LintResult:
     # Per-rule wall seconds: slow passes must be visible as the rule
     # set grows (interprocedural passes are not free).
     rule_seconds: dict[str, float] = field(default_factory=dict)
+    # Structured rule output (Context.artifacts) — e.g. the canonical
+    # lock hierarchy GL-LOCK-ORDER discovered.
+    artifacts: dict[str, object] = field(default_factory=dict)
 
     @property
     def exit_code(self) -> int:
@@ -269,6 +276,7 @@ class LintResult:
                 r: round(s, 4)
                 for r, s in sorted(self.rule_seconds.items())
             },
+            "artifacts": dict(sorted(self.artifacts.items())),
         }
 
 
@@ -419,6 +427,7 @@ def run(
         n_checked_calls=ctx.n_checked_calls,
         rules_run=tuple(selected),
         rule_seconds=rule_seconds,
+        artifacts=dict(ctx.artifacts),
     )
 
 

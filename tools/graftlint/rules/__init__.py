@@ -12,6 +12,7 @@ from tools.graftlint.rules import (  # noqa: E402,F401
     configcheck,
     donate,
     lifecycle,
+    locking,
     refcount,
     retrace,
     sync,

@@ -82,6 +82,9 @@ class ConfigRule(Rule):
         "serve_lifecycle_class": "",  # fixture has no serve machine
         "weightres_lifecycle_class": "",  # nor a weight-ledger machine
         "autoscale_lifecycle_class": "",  # nor an autoscaler machine
+        "lock_guards": [],  # fixture declares no locks
+        "lock_thread_entries": [],
+        "lock_blocking_calls": [],
     }
 
     def check(self, ctx: Context) -> None:
@@ -199,6 +202,9 @@ class ConfigRule(Rule):
             for a in owned:
                 need(a in lc_attrs, f"{prefix}_owned_attrs", a)
 
+        # -- lock-guard table (GL-LOCK's configuration) ----------------
+        self._check_lock_table(ctx, class_defs, need)
+
         for knob, entry in stale:
             ctx.report(
                 "GL-CONFIG",
@@ -209,3 +215,181 @@ class ConfigRule(Rule):
                 "was renamed; update or delete the entry (stale "
                 "allowlists silently disarm their rule)",
             )
+
+    # -- GL-LOCK config ---------------------------------------------------
+
+    _LOCK_CTORS = frozenset(
+        {"Lock", "RLock", "Condition", "make_lock", "make_rlock"}
+    )
+
+    def _check_lock_table(self, ctx: Context, class_defs, need) -> None:
+        """The ``lock_guards`` table is GL-LOCK's ground truth, so it
+        rots two ways: an entry can name code that moved (stale — same
+        failure mode as every allowlist), and code can grow a lock the
+        table never heard of (a silently unguarded lock, which is
+        worse). Both directions are findings."""
+        cfg = ctx.cfg
+        try:
+            guards = cfg.parsed_lock_guards()
+        except ValueError as exc:
+            ctx.report(
+                "GL-CONFIG",
+                ctx.repo / "pyproject.toml",
+                _pyproject_line(ctx.repo, "lock_guards"),
+                f"[tool.graftlint] {exc}",
+            )
+            guards = []
+        try:
+            entries = cfg.parsed_thread_entries()
+        except ValueError as exc:
+            ctx.report(
+                "GL-CONFIG",
+                ctx.repo / "pyproject.toml",
+                _pyproject_line(ctx.repo, "lock_thread_entries"),
+                f"[tool.graftlint] {exc}",
+            )
+            entries = []
+
+        def class_decls(modname: str, cname: str) -> tuple[set[str], set]:
+            """(attr names assigned/used in the class, incl. dataclass
+            field AnnAssign targets in the class body)."""
+            info = ctx.index.get(modname)
+            attrs: set[str] = set()
+            if info is None:
+                return attrs, set()
+            for node in ast.walk(info.tree):
+                if isinstance(node, ast.ClassDef) and node.name == cname:
+                    for sub in node.body:
+                        if isinstance(sub, ast.AnnAssign) and isinstance(
+                            sub.target, ast.Name
+                        ):
+                            attrs.add(sub.target.id)
+                        elif isinstance(sub, ast.Assign):
+                            for t in sub.targets:
+                                if isinstance(t, ast.Name):
+                                    attrs.add(t.id)
+                    for sub in ast.walk(node):
+                        if isinstance(sub, ast.Attribute):
+                            attrs.add(sub.attr)
+            return attrs, set()
+
+        def module_names(modname: str) -> set[str]:
+            info = ctx.index.get(modname)
+            if info is None:
+                return set()
+            return {
+                n.id for n in ast.walk(info.tree) if isinstance(n, ast.Name)
+            }
+
+        for g in guards:
+            label = f"{g.module}:{g.name}"
+            if g.module not in ctx.index:
+                need(False, "lock_guards", label)
+                continue
+            if g.classname:
+                info = ctx.index[g.module]
+                if g.classname not in info.classes:
+                    need(False, "lock_guards", label)
+                    continue
+                attrs, _ = class_decls(g.module, g.classname)
+                for alias in g.aliases:
+                    need(alias in attrs, "lock_guards", f"{label}|{alias}")
+                for a in g.guarded:
+                    need(a in attrs, "lock_guards", f"{label}={a}")
+            else:
+                names = module_names(g.module)
+                for alias in g.aliases:
+                    need(alias in names, "lock_guards", f"{label}|{alias}")
+                for a in g.guarded:
+                    need(a in names, "lock_guards", f"{label}={a}")
+
+        for module, classname, func in entries:
+            label = f"{module}:{classname + '.' if classname else ''}{func}"
+            info = ctx.index.get(module)
+            if info is None:
+                need(False, "lock_thread_entries", label)
+                continue
+            if classname:
+                ci = info.classes.get(classname)
+                need(
+                    ci is not None and func in ci.method_nodes,
+                    "lock_thread_entries",
+                    label,
+                )
+            else:
+                need(func in info.func_nodes, "lock_thread_entries", label)
+
+        # -- unlisted locks: every Lock/RLock/Condition constructed in
+        # the package must appear in the guards table (possibly with an
+        # empty guarded set — "no guarded state" is a reviewed claim,
+        # absence is not).
+        listed: dict[tuple[str, str], set[str]] = {}
+        for g in guards:
+            listed.setdefault((g.module, g.classname), set()).update(
+                g.aliases
+            )
+
+        def is_lock_ctor(value: ast.expr) -> bool:
+            if not isinstance(value, ast.Call):
+                return False
+            f = value.func
+            name = (
+                f.attr
+                if isinstance(f, ast.Attribute)
+                else f.id if isinstance(f, ast.Name) else ""
+            )
+            return name in self._LOCK_CTORS
+
+        pkg = cfg.package
+        for modname, info in ctx.index.items():
+            if modname != pkg and not modname.startswith(pkg + "."):
+                continue
+            if modname.rsplit(".", 1)[-1] == "lockdep":
+                continue  # the sanitizer's own internals
+            # Module-level lock bindings.
+            for node in info.tree.body:
+                if isinstance(node, ast.Assign) and is_lock_ctor(node.value):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and t.id not in (
+                            listed.get((modname, ""), set())
+                        ):
+                            ctx.report(
+                                "GL-CONFIG",
+                                info.path,
+                                node.lineno,
+                                f"lock {t.id!r} in {modname} is not "
+                                "listed in [tool.graftlint] lock_guards "
+                                "— every lock must declare its guarded "
+                                "state (an empty guarded set is a "
+                                "reviewed claim; absence is an "
+                                "unreviewed lock)",
+                            )
+            # self.<attr> lock bindings inside class methods.
+            for cname, ci in info.classes.items():
+                allowed = listed.get((modname, cname), set())
+                for mnode in ci.method_nodes.values():
+                    for sub in ast.walk(mnode):
+                        if not (
+                            isinstance(sub, ast.Assign)
+                            and is_lock_ctor(sub.value)
+                        ):
+                            continue
+                        for t in sub.targets:
+                            if (
+                                isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"
+                                and t.attr not in allowed
+                            ):
+                                ctx.report(
+                                    "GL-CONFIG",
+                                    info.path,
+                                    sub.lineno,
+                                    f"lock {cname}.{t.attr} in "
+                                    f"{modname} is not listed in "
+                                    "[tool.graftlint] lock_guards — "
+                                    "every lock must declare its "
+                                    "guarded state (an empty guarded "
+                                    "set is a reviewed claim; absence "
+                                    "is an unreviewed lock)",
+                                )

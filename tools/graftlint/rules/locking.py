@@ -1,0 +1,728 @@
+"""GL-LOCK — static lock discipline for the threaded serving stack.
+
+Since PR 13 the daemon worker threads, the EnginePump, the autoscaler
+tick thread, weight-residency prefetch threads, and fleet heartbeats
+share ~15 locks with no machine-checked statement of which lock guards
+which state or which acquisition orders are legal. Three rules pin it:
+
+- **GL-LOCK-GUARD** — the ``[tool.graftlint] lock_guards`` table maps
+  each declared lock to the attributes it guards; any read/write of a
+  guarded attribute reachable from a thread entry point (discovered
+  ``threading.Thread`` targets and ``Thread``-subclass ``run`` methods
+  plus the configured ``lock_thread_entries``) that is not dominated
+  by a ``with <lock>`` on the owning lock is a finding. Deliberate
+  lock-free fast paths carry the same reasoned inline disables GL-SYNC
+  uses.
+- **GL-LOCK-ORDER** — the static acquisition-order graph: a nested
+  ``with`` adds an edge, and a call made while holding L1 that can
+  reach an acquire of L2 adds L1→L2 through the call graph. Any cycle
+  is a finding; the discovered order is emitted into ``--json``
+  (``artifacts.lock_order``) so the runtime lockdep sanitizer
+  (adversarial_spec_tpu/resilience/lockdep.py) and docs/locking.md
+  share one canonical hierarchy.
+- **GL-LOCK-BLOCKING** — calls that can block indefinitely or for
+  device-scale time (``lock_blocking_calls``: sleeps, fsync,
+  subprocess, device syncs, engine ``chat`` dispatch, ``wait`` on a
+  *different* lock's condition) while any tracked lock is held. This
+  pins the PR 15 review fix — the GB-scale demotion gather moved
+  outside the engine lock — as a checked rule instead of folklore.
+
+The analysis is deliberately conservative: ``with`` scopes are lexical,
+held-on-entry sets for caller-holds helpers come from a fixed point
+over *resolvable* call sites (``self.method``/name/module-attr calls;
+cross-object attribute calls fall back to name matching for
+reachability), and callbacks stored in attributes are invisible — the
+runtime lockdep sanitizer is the dynamic complement that catches those.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from tools.graftlint.core import Context, Rule, register
+from tools.graftlint.dataflow import function_table, resolve_call
+from tools.graftlint.index import dotted_name
+
+# Constructor-family methods own their instance exclusively: guarded
+# attribute writes there are initialization, not racing access.
+_CTOR_NAMES = ("__init__", "__post_init__", "__new__")
+
+# Names the call fallback must never match: they collide with builtin
+# container/primitive methods (``self._roles.get(...)`` is a dict get,
+# not DiskStore.get), so a name match is overwhelmingly a false edge.
+_FALLBACK_STOPLIST = frozenset(
+    {
+        "get", "set", "add", "pop", "put", "items", "keys", "values",
+        "update", "clear", "reset", "copy", "count", "index", "insert",
+        "remove", "discard", "extend", "append", "appendleft", "popleft",
+        "setdefault", "sort", "reverse", "join", "split", "strip",
+        "startswith", "endswith", "encode", "decode", "format", "replace",
+        "read", "write", "flush", "close", "open", "seek", "submit",
+        "result", "cancel", "wait", "notify", "notify_all", "acquire",
+        "release", "locked", "is_set", "start", "run", "group", "match",
+        "search", "send", "recv", "empty", "full", "qsize", "lower",
+        "upper", "total_seconds", "exists", "mkdir", "unlink",
+    }
+)
+
+
+@dataclass
+class _Acquire:
+    guard: str  # canonical lock name acquired
+    lineno: int
+    held: frozenset  # lexically held just before this acquire
+
+
+@dataclass
+class _Access:
+    guard: str  # lock that must be held
+    attr: str
+    lineno: int
+    held: frozenset  # lexically held at the access
+
+
+@dataclass
+class _CallSite:
+    dotted: str  # dotted text of the call target ("self._sleep")
+    lineno: int
+    held: frozenset  # lexically held at the call
+    # Strict candidates (resolved, or a UNIQUE non-stoplisted name
+    # match): feed the entry-held fixed point, the acquire closure,
+    # and GL-LOCK-ORDER edges — a spurious edge there manufactures
+    # cycles or dissolves a caller-holds helper's held set.
+    callees: tuple = ()
+    # Broad candidates (every non-stoplisted name match): feed only
+    # GL-LOCK-GUARD's reachability BFS, where over-approximation just
+    # means more functions get their (real) accesses checked.
+    reach: tuple = ()
+    resolved: bool = False  # True when callees came from resolve_call
+    receiver_lock: str | None = None  # x.wait(): lock x aliases, if any
+    thread_target: tuple | None = None  # threading.Thread(target=...)
+
+
+@dataclass
+class _FuncFacts:
+    acquires: list = field(default_factory=list)
+    accesses: list = field(default_factory=list)
+    calls: list = field(default_factory=list)
+
+
+class LockAnalysis:
+    """Shared per-run substrate for the three GL-LOCK rules: lock/guard
+    lookup tables, per-function with-scope facts, resolvable call
+    edges, the entry-held fixed point, and thread-entry discovery."""
+
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+        self.error: str | None = None
+        try:
+            self.guards = ctx.cfg.parsed_lock_guards()
+            self.entries_cfg = ctx.cfg.parsed_thread_entries()
+        except ValueError as e:
+            self.error = str(e)
+            self.guards = []
+            self.entries_cfg = []
+            self.facts = {}
+            return
+        pkg = ctx.cfg.package
+        self.universe = frozenset(g.name for g in self.guards)
+        # Lock-expression lookup: (module, class, attr) and (module,
+        # global) exact matches, plus package-unique alias attributes
+        # for cross-object expressions (``self._router._mlock``).
+        self.class_alias: dict[tuple, object] = {}
+        self.mod_alias: dict[tuple, object] = {}
+        self.guarded_class: dict[tuple, object] = {}
+        self.guarded_mod: dict[tuple, object] = {}
+        alias_count: dict[str, list] = {}
+        for g in self.guards:
+            for a in g.aliases:
+                alias_count.setdefault(a, []).append(g)
+                if g.classname:
+                    self.class_alias[(g.module, g.classname, a)] = g
+                else:
+                    self.mod_alias[(g.module, a)] = g
+            for attr in g.guarded:
+                if g.classname:
+                    self.guarded_class[(g.module, g.classname, attr)] = g
+                else:
+                    self.guarded_mod[(g.module, attr)] = g
+        self.attr_unique = {
+            a: gs[0] for a, gs in alias_count.items() if len(gs) == 1
+        }
+
+        table = function_table(ctx.index)
+        # The lockdep sanitizer itself manipulates raw primitives on
+        # behalf of every tracked lock — analyzing it would attribute
+        # every lock's behavior to its internals (self-observation).
+        self.table = {
+            k: fe
+            for k, fe in table.items()
+            if (fe.modname == pkg or fe.modname.startswith(pkg + "."))
+            and fe.modname.rsplit(".", 1)[-1] != "lockdep"
+        }
+        # Name-based call fallback: cross-object attribute calls
+        # (``sched.submit_units(...)``) are not statically resolvable;
+        # matching the attribute name against package definitions keeps
+        # the reachability closure honest at the cost of noise.
+        self.by_name: dict[str, list] = {}
+        self.by_name_reach: dict[str, list] = {}
+        for k, fe in self.table.items():
+            if fe.name.startswith("__"):
+                continue
+            self.by_name_reach.setdefault(fe.name, []).append(k)
+            if fe.name not in _FALLBACK_STOPLIST:
+                self.by_name.setdefault(fe.name, []).append(k)
+
+        self.facts: dict[tuple, _FuncFacts] = {}
+        for k, fe in self.table.items():
+            self.facts[k] = self._scan_function(fe)
+        self._resolve_callees()
+        self.thread_roots = self._discover_roots()
+        self.entry_held = self._entry_held_fixpoint()
+        self.acq_closure = self._acquire_closure()
+
+    # -- per-function with-scope scan ---------------------------------
+
+    def _scan_function(self, fe) -> _FuncFacts:
+        info = self.ctx.index[fe.modname]
+        facts = _FuncFacts()
+
+        def lock_of(expr) -> str | None:
+            if isinstance(expr, ast.Attribute):
+                if (
+                    isinstance(expr.value, ast.Name)
+                    and expr.value.id == "self"
+                    and fe.classname
+                ):
+                    g = self.class_alias.get(
+                        (fe.modname, fe.classname, expr.attr)
+                    )
+                    if g is not None:
+                        return g.name
+                g = self.attr_unique.get(expr.attr)
+                return g.name if g is not None else None
+            if isinstance(expr, ast.Name):
+                g = self.mod_alias.get((fe.modname, expr.id))
+                return g.name if g is not None else None
+            return None
+
+        def access_of(node) -> str | None:
+            if (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and fe.classname
+            ):
+                g = self.guarded_class.get(
+                    (fe.modname, fe.classname, node.attr)
+                )
+                return g.name if g is not None else None
+            if isinstance(node, ast.Name):
+                g = self.guarded_mod.get((fe.modname, node.id))
+                return g.name if g is not None else None
+            return None
+
+        def walk(node, held: frozenset) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # A nested def's body runs later (often on another
+                # thread): locks held here are NOT held there.
+                for d in node.decorator_list:
+                    walk(d, held)
+                for stmt in node.body:
+                    walk(stmt, frozenset())
+                return
+            if isinstance(node, ast.Lambda):
+                # Lambdas overwhelmingly run inline (sort/min keys,
+                # callbacks invoked before the with exits) — keep the
+                # held set. Deferred lambdas are a known blind spot the
+                # runtime sanitizer covers.
+                walk(node.body, held)
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                new = set(held)
+                for item in node.items:
+                    g = lock_of(item.context_expr)
+                    if g is not None:
+                        facts.acquires.append(
+                            _Acquire(g, item.context_expr.lineno,
+                                     frozenset(new))
+                        )
+                        new.add(g)
+                    else:
+                        walk(item.context_expr, frozenset(new))
+                for stmt in node.body:
+                    walk(stmt, frozenset(new))
+                return
+            if isinstance(node, ast.Call):
+                cs = _CallSite(
+                    dotted=dotted_name(node.func),
+                    lineno=node.lineno,
+                    held=held,
+                )
+                if isinstance(node.func, ast.Attribute):
+                    cs.receiver_lock = lock_of(node.func.value)
+                key = resolve_call(
+                    info, node, classname=fe.classname,
+                    index=self.ctx.index,
+                )
+                if key is not None and key in self.table:
+                    cs.callees = (key,)
+                    cs.reach = (key,)
+                    cs.resolved = True
+                elif isinstance(node.func, ast.Attribute):
+                    cs.reach = tuple(
+                        self.by_name_reach.get(node.func.attr, ())
+                    )
+                    cands = tuple(
+                        self.by_name.get(node.func.attr, ())
+                    )
+                    if len(cands) == 1:
+                        cs.callees = cands
+                if cs.dotted in ("threading.Thread", "Thread"):
+                    cs.thread_target = self._thread_target(
+                        info, fe, node
+                    )
+                facts.calls.append(cs)
+                for child in ast.iter_child_nodes(node):
+                    walk(child, held)
+                return
+            if isinstance(node, (ast.Attribute, ast.Name)):
+                g = access_of(node)
+                if g is not None:
+                    name = (
+                        node.attr
+                        if isinstance(node, ast.Attribute)
+                        else node.id
+                    )
+                    facts.accesses.append(
+                        _Access(g, name, node.lineno, held)
+                    )
+                if isinstance(node, ast.Attribute):
+                    walk(node.value, held)
+                return
+            for child in ast.iter_child_nodes(node):
+                walk(child, held)
+
+        for stmt in fe.node.body:
+            walk(stmt, frozenset())
+        return facts
+
+    def _thread_target(self, info, fe, call: ast.Call):
+        """Resolve ``threading.Thread(target=X)``: a (modname, funckey)
+        when X names a function/method, else ("", "") meaning
+        "unresolvable — treat the enclosing function as the entry"
+        (nested-def targets are lexically inside it anyway)."""
+        for kw in call.keywords:
+            if kw.arg != "target":
+                continue
+            t = kw.value
+            if isinstance(t, ast.Name):
+                key = (info.modname, t.id)
+                if key in self.table:
+                    return key
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+                and fe.classname
+            ):
+                key = (info.modname, f"{fe.classname}.{t.attr}")
+                if key in self.table:
+                    return key
+            return ("", "")
+        return ("", "")
+
+    # -- call graph ----------------------------------------------------
+
+    def _resolve_callees(self) -> None:
+        # Incoming resolvable edges per callee; fallback edges are kept
+        # separate and only used when a function has NO resolved
+        # callers (a spurious name-match with an unlocked caller must
+        # not dissolve a caller-holds helper's held set).
+        self.incoming: dict[tuple, list] = {}
+        self.incoming_fb: dict[tuple, list] = {}
+        for key, facts in self.facts.items():
+            for cs in facts.calls:
+                sink = self.incoming if cs.resolved else self.incoming_fb
+                for c in cs.callees:
+                    sink.setdefault(c, []).append((key, cs.held))
+
+    def _discover_roots(self) -> dict[tuple, str]:
+        """Thread entry points → human-readable provenance."""
+        roots: dict[tuple, str] = {}
+        for mod, cls, funcname in self.entries_cfg:
+            funckey = f"{cls}.{funcname}" if cls else funcname
+            key = (mod, funckey)
+            if key in self.facts:
+                roots[key] = "configured thread entry"
+        for modname, info in self.ctx.index.items():
+            for cname, ci in info.classes.items():
+                if any(
+                    b == "Thread" or b.endswith(".Thread")
+                    for b in ci.bases
+                ) and "run" in ci.method_nodes:
+                    key = (modname, f"{cname}.run")
+                    if key in self.facts:
+                        roots[key] = "threading.Thread subclass run()"
+        for key, facts in self.facts.items():
+            for cs in facts.calls:
+                if cs.thread_target is None:
+                    continue
+                if cs.thread_target in self.facts:
+                    roots.setdefault(
+                        cs.thread_target, "threading.Thread target"
+                    )
+                else:
+                    # Unresolvable (nested def / local): the closure
+                    # body is lexically inside the spawning function.
+                    roots.setdefault(
+                        key, "spawns thread with local target"
+                    )
+        return roots
+
+    def _entry_held_fixpoint(self) -> dict[tuple, frozenset]:
+        """Held-on-entry per function: the intersection of (caller's
+        entry-held ∪ lexical held at call site) over known call sites.
+        Thread entries and functions with no known callers start
+        empty. Monotone decreasing from the full lock universe."""
+        eh: dict[tuple, frozenset] = {}
+        sources: dict[tuple, list] = {}
+        for key in self.facts:
+            callers = self.incoming.get(key) or self.incoming_fb.get(key)
+            if key in self.thread_roots or not callers:
+                eh[key] = frozenset()
+            else:
+                sources[key] = callers
+                eh[key] = self.universe
+        changed = True
+        while changed:
+            changed = False
+            for key, callers in sources.items():
+                new = None
+                for caller, held in callers:
+                    tot = eh.get(caller, frozenset()) | held
+                    new = tot if new is None else (new & tot)
+                if new is not None and new != eh[key]:
+                    eh[key] = new
+                    changed = True
+        return eh
+
+    def _acquire_closure(self) -> dict[tuple, frozenset]:
+        """Locks a function may acquire, transitively (lexical acquires
+        plus every callee candidate's closure). Iterative fixed point —
+        the call graph has cycles."""
+        ac = {
+            key: frozenset(a.guard for a in facts.acquires)
+            for key, facts in self.facts.items()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for key, facts in self.facts.items():
+                cur = ac[key]
+                for cs in facts.calls:
+                    for c in cs.callees:
+                        cur = cur | ac.get(c, frozenset())
+                if cur != ac[key]:
+                    ac[key] = cur
+                    changed = True
+        return ac
+
+    def total_held(self, key: tuple, lexical: frozenset) -> frozenset:
+        return self.entry_held.get(key, frozenset()) | lexical
+
+    def path_of(self, key: tuple):
+        return self.ctx.index[key[0]].path
+
+
+def _analysis(ctx: Context) -> LockAnalysis:
+    a = getattr(ctx, "_gl_lock_analysis", None)
+    if a is None or a.ctx is not ctx:
+        a = LockAnalysis(ctx)
+        ctx._gl_lock_analysis = a
+    return a
+
+
+@register
+class LockGuardRule(Rule):
+    id = "GL-LOCK-GUARD"
+    title = "guarded state must be accessed under its declared lock"
+    rationale = (
+        "The serving stack's scheduler/autoscaler/residency triangle "
+        "shares dicts across daemon worker threads, the engine pump, "
+        "and the tick thread. A guarded-attribute access outside its "
+        "``with <lock>`` is a torn read or lost update waiting for "
+        "load; the guards table makes 'which lock protects this' a "
+        "checked declaration instead of tribal knowledge."
+    )
+    fixtures = {
+        "pkg/mod.py": (
+            "import threading\n"
+            "\n"
+            "class Worker(threading.Thread):\n"
+            "    def __init__(self):\n"
+            "        super().__init__()\n"
+            "        self._lock = threading.Lock()\n"
+            "        self._state = {}\n"
+            "    def run(self):\n"
+            "        with self._lock:\n"
+            "            self._state['a'] = 1\n"
+            "        self._state['b'] = 2\n"
+        ),
+    }
+    fixture_config = {
+        "package": "pkg",
+        "lock_guards": ["pkg.mod:Worker._lock=_state"],
+        "lock_thread_entries": [],
+        "lock_blocking_calls": [],
+    }
+
+    def check(self, ctx: Context) -> None:
+        an = _analysis(ctx)
+        if an.error is not None:
+            return  # GL-CONFIG reports the malformed table
+        reachable: dict[tuple, str] = {}
+        queue = list(an.thread_roots.items())
+        while queue:
+            key, provenance = queue.pop()
+            if key in reachable:
+                continue
+            reachable[key] = provenance
+            entry_name = an.table[key].qualname
+            for cs in an.facts[key].calls:
+                for c in cs.reach:
+                    if c not in reachable:
+                        queue.append((c, f"via {entry_name}"))
+        for key, provenance in reachable.items():
+            fe = an.table[key]
+            if fe.name in _CTOR_NAMES:
+                continue
+            facts = an.facts[key]
+            seen: set[tuple] = set()
+            for acc in facts.accesses:
+                held = an.total_held(key, acc.held)
+                if acc.guard in held:
+                    continue
+                dedup = (acc.lineno, acc.attr)
+                if dedup in seen:
+                    continue
+                seen.add(dedup)
+                ctx.report(
+                    self.id,
+                    an.path_of(key),
+                    acc.lineno,
+                    f"{fe.qualname} accesses {acc.attr!r} without "
+                    f"holding {acc.guard} (thread-reachable: "
+                    f"{provenance}); wrap in 'with' or add a reasoned "
+                    "disable for a deliberate lock-free path",
+                )
+
+
+@register
+class LockOrderRule(Rule):
+    id = "GL-LOCK-ORDER"
+    title = "the static lock acquisition-order graph must be acyclic"
+    rationale = (
+        "Two threads taking the same pair of locks in opposite orders "
+        "is the canonical deadlock, and nothing about either call site "
+        "looks wrong in isolation. The static order graph (nested "
+        "withs propagated through the call graph) proves a global "
+        "hierarchy exists; the discovered order lands in --json as the "
+        "one canonical hierarchy the runtime lockdep sanitizer and "
+        "docs/locking.md share."
+    )
+    fixtures = {
+        "pkg/mod.py": (
+            "import threading\n"
+            "\n"
+            "A = threading.Lock()\n"
+            "B = threading.Lock()\n"
+            "\n"
+            "def forward():\n"
+            "    with A:\n"
+            "        with B:\n"
+            "            pass\n"
+            "\n"
+            "def backward():\n"
+            "    with B:\n"
+            "        with A:\n"
+            "            pass\n"
+        ),
+    }
+    fixture_config = {
+        "package": "pkg",
+        "lock_guards": ["pkg.mod:A=", "pkg.mod:B="],
+        "lock_thread_entries": [],
+        "lock_blocking_calls": [],
+    }
+
+    def check(self, ctx: Context) -> None:
+        an = _analysis(ctx)
+        if an.error is not None:
+            return
+        # (held → acquired) edges with one example site each.
+        edges: dict[tuple[str, str], tuple[str, int]] = {}
+
+        def add_edge(h: str, a: str, key: tuple, lineno: int) -> None:
+            if h == a:  # reentrant re-acquire (RLock) is not an order
+                return
+            if (h, a) not in edges:
+                rel = an.path_of(key).relative_to(ctx.repo).as_posix()
+                edges[(h, a)] = (rel, lineno)
+
+        for key, facts in an.facts.items():
+            base = an.entry_held.get(key, frozenset())
+            for acq in facts.acquires:
+                for h in base | acq.held:
+                    add_edge(h, acq.guard, key, acq.lineno)
+            for cs in facts.calls:
+                held = base | cs.held
+                if not held:
+                    continue
+                for c in cs.callees:
+                    for a in an.acq_closure.get(c, ()):
+                        for h in held:
+                            add_edge(h, a, key, cs.lineno)
+
+        adj: dict[str, set[str]] = {}
+        for (h, a) in edges:
+            adj.setdefault(h, set()).add(a)
+
+        # Cycle detection + topological order (DFS, deterministic).
+        order: list[str] = []
+        state: dict[str, int] = {}  # 1 = on stack, 2 = done
+        cycles: list[list[str]] = []
+        stack: list[str] = []
+
+        def visit(n: str) -> None:
+            state[n] = 1
+            stack.append(n)
+            for m in sorted(adj.get(n, ())):
+                if state.get(m) == 1:
+                    cycles.append(stack[stack.index(m):] + [m])
+                elif m not in state:
+                    visit(m)
+            stack.pop()
+            state[n] = 2
+            order.append(n)
+
+        nodes = sorted(
+            set(an.universe)
+            | {n for e in edges for n in e}
+        )
+        for n in nodes:
+            if n not in state:
+                visit(n)
+        order.reverse()
+
+        for cyc in cycles:
+            sites = [
+                f"{a}->{b} at {edges[(a, b)][0]}:{edges[(a, b)][1]}"
+                for a, b in zip(cyc, cyc[1:])
+                if (a, b) in edges
+            ]
+            first = edges.get((cyc[0], cyc[1]))
+            path = first[0] if first else "pyproject.toml"
+            line = first[1] if first else 1
+            ctx.report(
+                self.id,
+                ctx.repo / path,
+                line,
+                "lock acquisition-order cycle "
+                + " -> ".join(cyc)
+                + " ("
+                + "; ".join(sites)
+                + ") — pick one global order and restructure the "
+                "odd acquisition out",
+            )
+        if ctx.full_run:
+            ctx.artifacts["lock_order"] = order
+            ctx.artifacts["lock_edges"] = {
+                f"{h}->{a}": f"{site}:{line}"
+                for (h, a), (site, line) in sorted(edges.items())
+            }
+
+
+@register
+class LockBlockingRule(Rule):
+    id = "GL-LOCK-BLOCKING"
+    title = "no indefinite/device-scale blocking under a tracked lock"
+    rationale = (
+        "A sleep, fsync, subprocess read, device sync, or engine chat "
+        "dispatch made while holding a hot-path lock turns every other "
+        "thread's microsecond acquire into a device-scale stall — the "
+        "exact bug PR 15's review fixed by hand when the GB-scale "
+        "demotion gather ran under the engine lock. Waiting on a "
+        "DIFFERENT lock's condition while holding one is the same "
+        "hazard with deadlock on top."
+    )
+    fixtures = {
+        "pkg/mod.py": (
+            "import threading\n"
+            "import time\n"
+            "\n"
+            "L = threading.Lock()\n"
+            "\n"
+            "def slow_path():\n"
+            "    with L:\n"
+            "        time.sleep(1.0)\n"
+        ),
+    }
+    fixture_config = {
+        "package": "pkg",
+        "lock_guards": ["pkg.mod:L="],
+        "lock_thread_entries": [],
+        "lock_blocking_calls": ["time.sleep"],
+    }
+
+    def check(self, ctx: Context) -> None:
+        an = _analysis(ctx)
+        if an.error is not None:
+            return
+        patterns = ctx.cfg.lock_blocking_calls
+        for key, facts in an.facts.items():
+            fe = an.table[key]
+            for cs in facts.calls:
+                held = an.total_held(key, cs.held)
+                if not held:
+                    continue
+                last = cs.dotted.rsplit(".", 1)[-1]
+                hit = None
+                for p in patterns:
+                    if "." in p:
+                        if cs.dotted == p or cs.dotted.endswith("." + p):
+                            hit = p
+                            break
+                    elif last == p:
+                        hit = p
+                        break
+                if hit is None:
+                    continue
+                if last == "wait" and cs.receiver_lock is not None:
+                    # Condition.wait on the held lock's OWN condition
+                    # releases it while waiting — that is the sanctioned
+                    # pattern. Still holding anything else is the bug.
+                    rest = held - {cs.receiver_lock}
+                    if not rest:
+                        continue
+                    ctx.report(
+                        self.id,
+                        an.path_of(key),
+                        cs.lineno,
+                        f"{fe.qualname} waits on {cs.receiver_lock}'s "
+                        f"condition while still holding "
+                        f"{', '.join(sorted(rest))} — the wait only "
+                        "releases its own lock; this blocks every "
+                        "acquirer of the others",
+                    )
+                    continue
+                ctx.report(
+                    self.id,
+                    an.path_of(key),
+                    cs.lineno,
+                    f"{fe.qualname} calls {cs.dotted}() while holding "
+                    f"{', '.join(sorted(held))} (blocking pattern "
+                    f"{hit!r}) — move the blocking work outside the "
+                    "lock or add a reasoned disable",
+                )

@@ -143,6 +143,24 @@ def _stage_graftlint_config() -> bool:
     return ok
 
 
+def _stage_lockdep_selftest() -> bool:
+    """Prove the runtime lockdep sanitizer is live, mirroring graftlint
+    ``--self-test``: a synthetic two-lock inversion must be detected
+    and must name both stacks. A sanitizer that silently stopped
+    detecting would make every 'zero violations' green a lie."""
+    from adversarial_spec_tpu.resilience import lockdep
+
+    problems = lockdep.self_test()
+    for p in problems:
+        print(f"lint_all: lockdep-selftest: {p}", file=sys.stderr)
+    ok = not problems
+    print(
+        f"lint_all: lockdep-selftest {'OK' if ok else 'FAILED'}",
+        file=sys.stderr,
+    )
+    return ok
+
+
 def _stage_mutmut_sanity() -> bool:
     ok = True
 
@@ -284,6 +302,7 @@ def main(argv: list[str] | None = None) -> int:
             )
     ok = _stage_graftlint(paths)
     ok = _stage_graftlint_config() and ok
+    ok = _stage_lockdep_selftest() and ok
     ok = _stage_mutmut_sanity() and ok
     ok = _stage_journal_schema() and ok
     if args.full:
