@@ -408,6 +408,12 @@ class MockEngine:
                     slot=req_index,
                     tokens=tokens,
                     cached_tokens=cached,
+                    # Only the queue transition carries the arrival
+                    # stamp (0.0 unless ADVSPEC_OBS_ARRIVALS armed —
+                    # the byte-determinism pins see all zeros).
+                    arrival_s=(
+                        obs_mod.arrival_now() if state == "queued" else 0.0
+                    ),
                 )
             )
         for name, phase, wall in spans:

@@ -31,6 +31,7 @@ is off the serving path pays a single attribute load per site.
 from __future__ import annotations
 
 import os
+import time
 from dataclasses import dataclass
 
 from adversarial_spec_tpu.obs import trace  # noqa: F401 (re-export)
@@ -88,6 +89,12 @@ class ObsConfig:
     # ``slo_round_s`` its full service wall (prefill + decode).
     slo_ttft_ms: float = 0.0
     slo_round_s: float = 0.0
+    # Arrival capture (``ADVSPEC_OBS_ARRIVALS``): stamp admission-edge
+    # events (RequestEvent/ServeEvent ``arrival_s``) with a monotonic
+    # offset from the obs epoch so tools/load_replay.py can reconstruct
+    # arrival processes. DEFAULT OFF: real walls on mock events would
+    # break the byte-determinism pins every mock dump carries.
+    arrivals: bool = False
 
 
 def env_enabled() -> bool:
@@ -127,13 +134,24 @@ def env_slo_round_s() -> float:
     return _env_float("ADVSPEC_SLO_ROUND_S")
 
 
+def env_arrivals() -> bool:
+    """Process default for arrival capture (``ADVSPEC_OBS_ARRIVALS``;
+    default OFF — the mock byte-determinism pins depend on it)."""
+    return os.environ.get("ADVSPEC_OBS_ARRIVALS", "0") == "1"
+
+
 _config = ObsConfig(
     enabled=env_enabled(),
     recorder_size=env_recorder_size(),
     events_out=os.environ.get("ADVSPEC_EVENTS_OUT") or None,
     slo_ttft_ms=env_slo_ttft_ms(),
     slo_round_s=env_slo_round_s(),
+    arrivals=env_arrivals(),
 )
+# The arrival epoch: ``arrival_s`` offsets are monotonic seconds since
+# this point, re-based by reset_stats() so one CLI invocation (or one
+# replay run) starts its arrival clock at ~0.
+_arrival_t0 = time.monotonic()
 # (kind, span_id) pairs that already fired their SLO capture — the
 # exactly-once-per-breaching-request guard; cleared by reset_stats().
 _slo_fired: set[tuple[str, str]] = set()
@@ -544,6 +562,7 @@ def configure(
     dump_on_fault: bool | None = None,
     slo_ttft_ms: float | None = None,
     slo_round_s: float | None = None,
+    arrivals: bool | None = None,
 ) -> ObsConfig:
     if enabled is not None:
         _config.enabled = bool(enabled)
@@ -559,6 +578,8 @@ def configure(
         _config.slo_ttft_ms = max(0.0, float(slo_ttft_ms))
     if slo_round_s is not None:
         _config.slo_round_s = max(0.0, float(slo_round_s))
+    if arrivals is not None:
+        _config.arrivals = bool(arrivals)
     return _config
 
 
@@ -566,12 +587,26 @@ def reset_stats() -> None:
     """Per-invocation reset (one CLI invocation = one round): metrics
     zero in place, the ring clears, the retrace watch starts fresh, and
     the trace-id counter + ambient context + fired-SLO set clear (trace
-    state must never leak across CLI invocations)."""
+    state must never leak across CLI invocations). The arrival epoch
+    re-bases so a replay run's ``arrival_s`` offsets start at ~0."""
+    global _arrival_t0
     metrics.reset()
     recorder.clear()
     retrace.reset()
     trace.reset()
     _slo_fired.clear()
+    _arrival_t0 = time.monotonic()
+
+
+def arrival_now() -> float:
+    """The monotonic arrival offset to stamp on an admission-edge event
+    RIGHT NOW: seconds since the obs epoch (last reset_stats()), or 0.0
+    when arrival capture is unarmed — the default, which keeps mock
+    event dumps byte-deterministic. Emit sites call this once at
+    admission and thread the value into the event they emit."""
+    if _config.enabled and _config.arrivals:
+        return time.monotonic() - _arrival_t0
+    return 0.0
 
 
 def emit(ev) -> None:

@@ -105,6 +105,11 @@ class RequestEvent:
     slot: int = -1
     tokens: int = 0  # tokens relevant to this transition
     cached_tokens: int = 0
+    # Monotonic arrival offset (seconds since obs reset) recorded at
+    # admission when ADVSPEC_OBS_ARRIVALS is armed (obs.arrival_now());
+    # 0.0 otherwise — the default keeps mock dumps byte-deterministic,
+    # armed dumps feed tools/load_replay.py's trace reconstruction.
+    arrival_s: float = 0.0
     trace_id: str = ""
     span_id: str = ""
 
@@ -363,6 +368,12 @@ class ServeEvent:
     reason: str = ""
     tokens: int = 0
     backlog_tokens: int = 0
+    # Monotonic arrival offset (seconds since obs reset) stamped on the
+    # admission-edge ops (accepted/shed) when ADVSPEC_OBS_ARRIVALS is
+    # armed; 0.0 otherwise (the byte-determinism default). The replay
+    # harness (tools/load_replay.py) reconstructs per-tenant arrival
+    # processes from these offsets.
+    arrival_s: float = 0.0
     trace_id: str = ""
     span_id: str = ""
 

@@ -21,6 +21,7 @@ output is byte-deterministic given deterministic observations.
 
 from __future__ import annotations
 
+import math
 import threading
 from dataclasses import dataclass, field
 
@@ -48,6 +49,27 @@ LATENCY_BUCKETS_S = (
 
 # Ratio-shaped histograms (utilization, hit rates) bucket on [0, 1].
 RATIO_BUCKETS = (0.1, 0.25, 0.5, 0.75, 0.9, 0.95, 0.99, 1.0)
+
+# The quantiles every histogram exposes in snapshot()/render_prometheus()
+# — THE shared percentile vocabulary (load_replay, the SLO gates, and
+# bench.py report the same three, so a latency tail reads the same
+# everywhere).
+QUANTILES = (0.5, 0.95, 0.99)
+
+
+def percentile(samples, q: float) -> float:
+    """Exact nearest-rank percentile of a sample list (sorted copy made
+    here) — THE one sample-percentile implementation: the overload
+    drill's SLO gate (tools/chaos_run.py), bench.py's per-arm TTFT
+    tails, and tools/load_replay.py all report through this instead of
+    each hand-rolling an off-by-one index. Returns 0.0 on an empty
+    sample set (a quantile of nothing is not a latency)."""
+    xs = sorted(float(v) for v in samples)
+    if not xs:
+        return 0.0
+    q = min(max(float(q), 0.0), 1.0)
+    rank = max(1, math.ceil(q * len(xs)))
+    return xs[rank - 1]
 
 
 def _fmt(v: float) -> str:
@@ -126,6 +148,27 @@ class Histogram:
             out.append(running)
         return out
 
+    def quantile(self, q: float) -> float:
+        """Bucket-interpolated quantile estimate (Prometheus
+        ``histogram_quantile`` semantics): find the bucket holding the
+        q·count-th observation and interpolate linearly between its
+        bounds. Observations past the last bound clamp to the last
+        bound — a fixed-bucket histogram cannot see further. 0.0 when
+        empty."""
+        if self.count <= 0:
+            return 0.0
+        q = min(max(float(q), 0.0), 1.0)
+        rank = q * self.count
+        running = 0
+        for i, bound in enumerate(self.buckets):
+            prev = running
+            running += self.counts[i]
+            if running >= rank and self.counts[i] > 0:
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                frac = (rank - prev) / self.counts[i]
+                return lo + (bound - lo) * min(max(frac, 0.0), 1.0)
+        return float(self.buckets[-1]) if self.buckets else 0.0
+
     def reset(self) -> None:
         self.counts = [0] * len(self.buckets)
         self.sum = 0.0
@@ -201,7 +244,9 @@ class MetricsRegistry:
 
     def snapshot(self) -> dict:
         """Stable dict of every series: ``name{labels}`` → scalar for
-        counters/gauges, ``{count, sum}`` for histograms. Sorted keys."""
+        counters/gauges, ``{count, sum, p50, p95, p99}`` for histograms
+        (bucket-estimated quantiles — see ``Histogram.quantile``).
+        Sorted keys."""
         out: dict = {}
         with self._lock:
             for name in sorted(self._families):
@@ -213,6 +258,9 @@ class MetricsRegistry:
                         out[k] = {
                             "count": metric.count,
                             "sum": round(metric.sum, 6),
+                            "p50": round(metric.quantile(0.5), 6),
+                            "p95": round(metric.quantile(0.95), 6),
+                            "p99": round(metric.quantile(0.99), 6),
                         }
                     else:
                         out[k] = (
@@ -253,6 +301,13 @@ class MetricsRegistry:
                         lines.append(
                             f"{name}_count{_label_str(key)} {total}"
                         )
+                        for q in QUANTILES:
+                            suffix = f"p{int(q * 100)}"
+                            val = round(metric.quantile(q), 6)
+                            lines.append(
+                                f"{name}_{suffix}{_label_str(key)} "
+                                f"{_fmt(val)}"
+                            )
                     else:
                         lines.append(
                             f"{name}{_label_str(key)} {_fmt(metric.value)}"

@@ -327,6 +327,12 @@ class ServeDaemon:
                     "event": "stats",
                     "serve": serve_mod.snapshot(),
                     "scheduler": self.sched.state_snapshot(),
+                    # The admission ledger's live view — backlog tokens,
+                    # brownout, capacity — previously in-process-only
+                    # (the autoscaler's feed); exposed here so external
+                    # scrapers and tools/load_replay.py see the same
+                    # pressure the scheduler sheds on.
+                    "pressure": self.sched.pressure_snapshot(),
                     "uptime_s": round(time.monotonic() - self._t_start, 3),
                 },
             )
@@ -389,6 +395,7 @@ class ServeDaemon:
             est,
             models=obj.get("models") or (),
             prefill_tokens=driver.estimate_debate_prefill_tokens(obj),
+            arrival_s=obs_mod.arrival_now(),
         )
         if shed is not None:
             self._send(

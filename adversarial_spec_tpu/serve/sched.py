@@ -264,7 +264,8 @@ class ServeScheduler:
 
     def _emit(self, op: str, *, tenant: str = "", tier: str = "interactive",
               debate: str = "", index: int = -1, reason: str = "",
-              tokens: int = 0, trace_id: str = "", span_id: str = "") -> None:
+              tokens: int = 0, trace_id: str = "", span_id: str = "",
+              arrival_s: float = 0.0) -> None:
         if obs_mod.config().enabled:
             obs_mod.hot.serve_op(op).inc()
             obs_mod.hot.serve_backlog.set(float(self._backlog()))
@@ -278,6 +279,7 @@ class ServeScheduler:
                     reason=reason,
                     tokens=tokens,
                     backlog_tokens=self._backlog(),
+                    arrival_s=arrival_s,
                     trace_id=trace_id,
                     span_id=span_id,
                 )
@@ -310,6 +312,7 @@ class ServeScheduler:
         self, tenant: str, tier: str, debate: str, est_tokens: int,
         models: list[str] | tuple[str, ...] = (),
         prefill_tokens: int = 0,
+        arrival_s: float = 0.0,
     ) -> ShedDecision | None:
         """Admit one debate (reserving its estimate in the backlog
         ledger) or refuse it with a typed shed. Shed order under
@@ -372,6 +375,7 @@ class ServeScheduler:
                 self._emit(
                     "shed", tenant=tenant, tier=tier, debate=debate,
                     reason=shed.reason, tokens=est_tokens,
+                    arrival_s=arrival_s,
                 )
                 return shed
             self._outstanding[tenant] = self._outstanding.get(tenant, 0) + 1
@@ -386,7 +390,7 @@ class ServeScheduler:
             serve_mod.stats.accepted_debates += 1
             self._emit(
                 "accepted", tenant=tenant, tier=tier, debate=debate,
-                tokens=est_tokens,
+                tokens=est_tokens, arrival_s=arrival_s,
             )
             self._update_brownout()
             return None

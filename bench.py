@@ -115,6 +115,11 @@ Modes:
                                   # transcripts, zero duplicated
                                   # completions); writes
                                   # BENCH_disagg.json
+  python bench.py --mode capacity # capacity frontier: seeded open-loop
+                                  # trace replay (tools/load_replay.py)
+                                  # binary-searched to the SLO breach
+                                  # per knob arm (replicas 1 vs 3);
+                                  # writes BENCH_capacity.json
   --no-interleave                 # escape hatch for any batcher-driven
                                   # mode: run the legacy serialized loop
                                   # (equivalent to ADVSPEC_INTERLEAVE=0)
@@ -1997,6 +2002,49 @@ def _run_serve(platform: str) -> dict:
     }
 
 
+def _run_capacity(platform: str) -> dict:
+    """Capacity-frontier bench (deterministic seeded replay on the CPU
+    mock — writes BENCH_capacity.json): delegates to
+    ``tools/load_replay.py`` — a seeded heavy-tailed synthetic trace is
+    replayed open-loop against an in-process serve daemon, binary-
+    searching the rate multiplier until the SLO breaches, per knob arm
+    (replica count 1 vs 3 through the scheduler's capacity provider).
+
+    Headline: accepted debates/s at the SLO frontier on the baseline
+    arm. ``vs_baseline`` compares against the committed
+    BENCH_capacity.json, and tools/bench_trend.py fails the gate when
+    the frontier drops >10% — capacity regressions, not just single-
+    stream wall, now fail loudly. Escape hatch: the harness only runs
+    when asked to; deleting BENCH_capacity.json drops the gate."""
+    import tools.load_replay as load_replay
+
+    slo = load_replay.SLOSpec()
+    reqs = load_replay.synthesize(load_replay.SynthSpec(seed=0, requests=64))
+    frontier = load_replay.frontier_sweep(
+        reqs,
+        [
+            load_replay.ServeKnobs(replicas=1),
+            load_replay.ServeKnobs(replicas=3),
+        ],
+        slo,
+        max_doublings=4,
+        bisect_iters=2,
+    )
+    baseline = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_capacity.json"
+    )
+    from pathlib import Path
+
+    payload = load_replay.bench_payload(
+        frontier,
+        slo,
+        "synthetic seed=0 requests=64",
+        platform=platform,
+        baseline_path=Path(baseline),
+    )
+    return payload
+
+
 def _run_fleet(platform: str) -> dict:
     """Fleet bench (deterministic CPU mock — writes BENCH_fleet.json):
 
@@ -2302,8 +2350,9 @@ def _run_elastic(platform: str) -> dict:
             finally:
                 client.close()
                 th.join(timeout=15)
-        ttfts.sort()
-        p99 = ttfts[max(0, int(len(ttfts) * 0.99) - 1)] if ttfts else 0.0
+        from adversarial_spec_tpu.obs.metrics import percentile
+
+        p99 = percentile(ttfts, 0.99)
         return {
             "elastic": {"yes": elastic},
             "accepted": accepted,
@@ -2558,12 +2607,9 @@ def _run_disagg(platform: str) -> dict:
                 dup = fleet_mod.stats.duplicated_completions
                 engine.shutdown()
             kvtier.configure(enabled=False, store_dir="", flush_blocks=0)
-        ttfts_r1.sort()
-        p99 = (
-            ttfts_r1[max(0, int(len(ttfts_r1) * 0.99) - 1)]
-            if ttfts_r1
-            else 0.0
-        )
+        from adversarial_spec_tpu.obs.metrics import percentile
+
+        p99 = percentile(ttfts_r1, 0.99)
         busiest = busys[0][1] if busys else 0.0
         return {
             "prefill_replicas": prefill_replicas,
@@ -2687,7 +2733,10 @@ def _run_obs_overhead(platform: str) -> dict:
     n_repeats = int(os.environ.get("BENCH_OBS_REPEATS", "7"))
 
     def drain(enabled: bool) -> float:
-        obs.configure(enabled=enabled)
+        # Arrivals armed whenever obs is: the < 3% budget covers the
+        # worst case (the per-queued-event monotonic arrival stamp
+        # included), not just the byte-deterministic default.
+        obs.configure(enabled=enabled, arrivals=enabled)
         obs.reset_stats()
         prefix_mod.reset_stats()
         interleave_mod.reset_stats()
@@ -2738,6 +2787,11 @@ def _run_obs_overhead(platform: str) -> dict:
                     obs.RequestEvent(
                         req_id=i, state=st, slot=1, tokens=99,
                         cached_tokens=288,
+                        # queue-edge arrival stamp, as engine/mock.py
+                        # pays it when ADVSPEC_OBS_ARRIVALS is armed
+                        arrival_s=(
+                            obs.arrival_now() if st == "queued" else 0.0
+                        ),
                     )
                 )
             for name, phase, wall in (
@@ -2772,7 +2826,7 @@ def _run_obs_overhead(platform: str) -> dict:
     # Emit-cost floor: K blocks of N requests; each block is long
     # enough (tens of ms) that intra-block noise averages, and the min
     # across blocks floors inter-block noise.
-    obs.configure(enabled=True)
+    obs.configure(enabled=True, arrivals=True)
     n_block = int(os.environ.get("BENCH_OBS_EMIT_BLOCK", "50000"))
     per_request = []
     for _ in range(5):
@@ -2789,7 +2843,8 @@ def _run_obs_overhead(platform: str) -> dict:
         order = (False, True) if rep % 2 == 0 else (True, False)
         for enabled in order:
             walls[enabled].append(round(drain(enabled), 4))
-    obs.configure(enabled=True)  # leave the process default armed
+    # leave the process default armed, arrivals back to the env default
+    obs.configure(enabled=True, arrivals=obs.env_arrivals())
     off_wall, on_wall = min(walls[False]), min(walls[True])
     overhead = (
         per_request_emit_s * requests_per_run / off_wall if off_wall else 0.0
@@ -2937,6 +2992,7 @@ def main() -> int:
     elastic_mode = _mode("elastic")
     disagg_mode = _mode("disagg")
     kernels_mode = _mode("kernels")
+    capacity_mode = _mode("capacity")
     if "--no-speculative" in args:
         # Escape hatch mirror of --no-interleave: batcher-driven modes
         # (and any TPU child) decode token-at-a-time.
@@ -2974,6 +3030,8 @@ def main() -> int:
         mode_flag, runner = "--disagg", _run_disagg
     elif kernels_mode:
         mode_flag, runner = "--kernels", _run_kernels
+    elif capacity_mode:
+        mode_flag, runner = "--capacity", _run_capacity
     else:
         mode_flag, runner = "", _run_bench
 
@@ -2997,6 +3055,7 @@ def main() -> int:
         or serve_mode
         or elastic_mode
         or disagg_mode
+        or capacity_mode
     ):
         # Mock-only workloads — no jax, no device, no TPU probe: the
         # obs budget is a CPU host-overhead pin by definition, and the
@@ -3031,6 +3090,7 @@ def main() -> int:
         or elastic_mode
         or disagg_mode
         or kernels_mode
+        or capacity_mode
     ):
         # Persist the perf trajectory point alongside the BENCH_r*
         # series the driver records.
@@ -3059,6 +3119,8 @@ def main() -> int:
             if disagg_mode
             else "BENCH_kernels.json"
             if kernels_mode
+            else "BENCH_capacity.json"
+            if capacity_mode
             else "BENCH_serve.json"
         )
         out = os.path.join(

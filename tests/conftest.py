@@ -223,11 +223,13 @@ def _isolate_state(tmp_path, monkeypatch):
     monkeypatch.delenv("ADVSPEC_OBS", raising=False)
     monkeypatch.delenv("ADVSPEC_EVENTS_OUT", raising=False)
     monkeypatch.delenv("ADVSPEC_FLIGHT_RECORDER_SIZE", raising=False)
+    monkeypatch.delenv("ADVSPEC_OBS_ARRIVALS", raising=False)
     obs.configure(
         enabled=True,
         recorder_size=obs.DEFAULT_RECORDER_SIZE,
         events_out="",
         dump_on_fault=True,
+        arrivals=False,
     )
     obs.reset_stats()
     # Full retrace clear (reset() deliberately keeps compile baselines
@@ -292,6 +294,7 @@ def _isolate_state(tmp_path, monkeypatch):
         recorder_size=obs.DEFAULT_RECORDER_SIZE,
         events_out="",
         dump_on_fault=True,
+        arrivals=False,
     )
     obs.reset_stats()
     obs.retrace.clear()

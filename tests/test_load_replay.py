@@ -1,0 +1,405 @@
+"""Trace-replay load harness tests (tools/load_replay.py).
+
+Covers the four contracts the harness stands on: the canonical shape
+encoding is invertible (record → reconstruct → identical requests),
+the synthetic generator is seed-deterministic, the recording reader is
+torn/foreign-line tolerant (journal discipline), and the arrival
+process is OPEN-LOOP — a slow server must never slow the schedule.
+The chaos-marked smoke drives the real daemon end to end: a small
+sweep with zero accepted-request loss, plus the 1×-rate round-trip
+that replays a recorded flight-recorder dump to byte-identical
+transcripts.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from adversarial_spec_tpu.serve import driver  # noqa: E402
+from tools import load_replay  # noqa: E402
+from tools.load_replay import (  # noqa: E402
+    ReplayRequest,
+    ServeKnobs,
+    SLOSpec,
+    SynthSpec,
+    canonical_spec,
+    est_tokens_for,
+    read_recording,
+    replay_once,
+    slo_breaches,
+    spec_chars_from_est,
+    synthesize,
+    tenant_rates,
+)
+
+
+class TestCanonicalShapeEncoding:
+    def test_spec_is_exact_length_and_deterministic(self):
+        for n in (128, 256, 513, 2048, 4096):
+            s = canonical_spec(n)
+            assert len(s) == n - (n % 4)
+            assert s == canonical_spec(n)
+
+    def test_est_inverts_for_every_canonical_shape(self):
+        """The round-trip pin at the unit level: estimate → shape →
+        estimate is the identity for every canonical (chars, tier)."""
+        for tier in ("interactive", "batch"):
+            for chars in (128, 400, 512, 1000, 4096):
+                chars = len(canonical_spec(chars))
+                est = est_tokens_for(chars, tier)
+                assert spec_chars_from_est(est, tier) == chars
+                # And the daemon-side estimator agrees byte for byte.
+                assert est == driver.estimate_debate_tokens(
+                    {
+                        "spec": canonical_spec(chars),
+                        "models": list(load_replay.MODELS),
+                        "max_new_tokens": load_replay.TIER_MAX_NEW[tier],
+                    }
+                )
+
+    def test_foreign_estimates_rejected(self):
+        assert spec_chars_from_est(3, "interactive") is None  # odd
+        assert spec_chars_from_est(10, "interactive") is None  # tiny
+        assert spec_chars_from_est(10**6, "batch") is None  # huge
+        assert spec_chars_from_est(900, "premium") is None  # bad tier
+
+
+class TestSynthesis:
+    def test_seed_determinism(self):
+        a = synthesize(SynthSpec(seed=7, requests=40))
+        b = synthesize(SynthSpec(seed=7, requests=40))
+        assert a == b
+        c = synthesize(SynthSpec(seed=8, requests=40))
+        assert a != c
+
+    def test_trace_shape(self):
+        reqs = synthesize(SynthSpec(seed=0, requests=80, tenants=3))
+        assert len(reqs) == 80
+        # Arrivals are monotone non-decreasing offsets from 0.
+        offsets = [r.arrival_s for r in reqs]
+        assert offsets == sorted(offsets) and offsets[0] > 0
+        # Zipf skew: the hot tenant dominates.
+        rates = tenant_rates(reqs)
+        assert set(rates) <= {"t0", "t1", "t2"}
+        assert rates["t0"] == max(rates.values())
+        # Mixed tiers, canonical shapes throughout.
+        assert {r.tier for r in reqs} == {"interactive", "batch"}
+        for r in reqs:
+            assert r.spec_chars == len(canonical_spec(r.spec_chars))
+
+
+class TestTolerantReader:
+    def _line(self, seq, op="accepted", arrival=1.0, tokens=None,
+              tier="interactive", tenant="t0"):
+        if tokens is None:
+            tokens = est_tokens_for(512, tier)
+        return json.dumps(
+            {
+                "seq": seq,
+                "type": "serve",
+                "op": op,
+                "tenant": tenant,
+                "tier": tier,
+                "debate": f"d{seq:05d}",
+                "index": -1,
+                "reason": "",
+                "tokens": tokens,
+                "backlog_tokens": 0,
+                "arrival_s": arrival,
+                "trace_id": "",
+                "span_id": "",
+            }
+        )
+
+    def test_reconstructs_arrivals_rebased(self, tmp_path):
+        p = tmp_path / "events.jsonl"
+        p.write_text(
+            self._line(1, arrival=5.0)
+            + "\n"
+            + self._line(2, arrival=5.25, tier="batch", tenant="t1")
+            + "\n"
+        )
+        reqs, report = read_recording(p)
+        assert report == {"requests": 2, "skipped": 0, "torn_tail": 0}
+        assert [r.arrival_s for r in reqs] == [0.0, 0.25]  # re-based
+        assert [r.tenant for r in reqs] == ["t0", "t1"]
+        assert [r.tier for r in reqs] == ["interactive", "batch"]
+        assert all(r.spec_chars == 512 for r in reqs)
+
+    def test_torn_tail_discarded_foreign_lines_skipped_alone(
+        self, tmp_path
+    ):
+        """Journal tolerant-reader discipline: one bad byte never
+        poisons the recording — garbage, foreign event types, foreign
+        versions (unknown shape / wrong field types), and a torn final
+        line each drop ALONE."""
+        p = tmp_path / "events.jsonl"
+        lines = [
+            self._line(1, arrival=1.0),
+            "{not json at all",
+            json.dumps({"seq": 2, "type": "futuristic", "op": "warp"}),
+            json.dumps({"seq": 3, "type": "step", "kind": "decode"}),
+            # serve event from a FOREIGN workload: non-canonical est.
+            self._line(4, arrival=1.5, tokens=7),
+            # serve event with a wrong-typed tokens field.
+            self._line(5, arrival=1.6).replace(
+                f'"tokens": {est_tokens_for(512, "interactive")}',
+                '"tokens": "many"',
+            ),
+            # unarmed event (arrival 0): recorded pre-arming, not ours.
+            self._line(6, arrival=0.0),
+            self._line(7, arrival=2.0),
+        ]
+        # Torn tail: the final line has no newline terminator.
+        p.write_text("\n".join(lines) + "\n" + self._line(8)[:20])
+        reqs, report = read_recording(p)
+        assert len(reqs) == 2  # seq 1 and 7 only
+        assert report["torn_tail"] == 1
+        assert report["skipped"] == 3  # garbage + bad est + bad type
+        assert [r.arrival_s for r in reqs] == [0.0, 1.0]
+
+    def test_empty_and_unarmed_recordings(self, tmp_path):
+        p = tmp_path / "empty.jsonl"
+        p.write_text("")
+        reqs, report = read_recording(p)
+        assert reqs == [] and report["requests"] == 0
+
+
+@pytest.mark.chaos
+class TestReplayAgainstDaemon:
+    """End-to-end against the real socket daemon on the mock engine."""
+
+    def test_open_loop_schedule_fidelity_on_slow_server(
+        self, monkeypatch
+    ):
+        """A server that takes ~100ms per debate must NOT slow the
+        arrival schedule (open loop): with 8 arrivals 25ms apart, a
+        closed-loop harness would stretch the schedule 4x+; the open-
+        loop generator's p99 submit lateness stays under 50ms."""
+        real_run = driver.run_debate
+
+        def slow_run(payload, sched, **kw):
+            time.sleep(0.1)
+            return real_run(payload, sched, **kw)
+
+        monkeypatch.setattr(driver, "run_debate", slow_run)
+        reqs = [
+            ReplayRequest(
+                arrival_s=0.025 * i,
+                tenant="t0",
+                tier="interactive",
+                spec_chars=256,
+            )
+            for i in range(8)
+        ]
+        res = replay_once(
+            reqs,
+            1.0,
+            knobs=ServeKnobs(max_backlog_tokens=10**6, max_queue_depth=64),
+            poll_pressure=False,
+        )
+        m = res.metrics
+        assert m["lost"] == 0 and m["shed"] == 0
+        assert m["completed"] == 8
+        # The schedule span is 0.175s; the run itself takes longer
+        # (slow server), but the GENERATOR stayed on time.
+        assert m["schedule_lateness_p99_s"] < 0.05
+
+    def test_smoke_sweep_zero_accepted_loss(self):
+        """The tier-1 replay smoke: a small two-arm sweep completes
+        with zero accepted-request loss and a bench_trend-valid
+        payload (the lint_all replay-smoke stage's contract)."""
+        from tools.bench_trend import validate_bench_file
+
+        reqs = synthesize(SynthSpec(seed=0, requests=12))
+        slo = SLOSpec()
+        frontier = load_replay.frontier_sweep(
+            reqs,
+            [ServeKnobs(replicas=1), ServeKnobs(replicas=3)],
+            slo,
+            max_doublings=1,
+            bisect_iters=0,
+        )
+        assert set(frontier) == {"replicas=1", "replicas=3"}
+        for arm in frontier.values():
+            assert arm["at_frontier"]["lost"] == 0
+            assert arm["debates_per_s"] >= 0
+        payload = load_replay.bench_payload(
+            frontier, slo, "test", platform="cpu"
+        )
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as td:
+            out = Path(td) / "BENCH_capacity.json"
+            out.write_text(json.dumps(payload), encoding="utf-8")
+            row, problems = validate_bench_file(out)
+        assert problems == [] and row is not None
+
+    def test_recorded_roundtrip_byte_identical_at_1x(self, tmp_path):
+        """The acceptance pin: replay a synthetic trace with arrivals
+        armed, dump the flight recorder, RECONSTRUCT the trace from
+        the dump, replay at 1× — byte-identical transcripts, because
+        the canonical shape encoding makes the recorded admission
+        estimates invertible."""
+        events = str(tmp_path / "events.jsonl")
+        reqs = synthesize(SynthSpec(seed=3, requests=10))
+        knobs = ServeKnobs(max_backlog_tokens=10**6, max_queue_depth=64)
+        first = replay_once(
+            reqs,
+            1.0,
+            knobs=knobs,
+            collect_transcripts=True,
+            events_out=events,
+            poll_pressure=False,
+        )
+        assert first.metrics["shed"] == 0 and first.metrics["lost"] == 0
+        rebuilt, report = read_recording(events)
+        assert report["requests"] == len(reqs)
+        assert report["skipped"] == 0
+        # The reconstruction IS the original workload: same shapes,
+        # tenants, tiers (arrivals re-based to the first admission).
+        assert [
+            (r.tenant, r.tier, r.spec_chars) for r in rebuilt
+        ] == [(r.tenant, r.tier, r.spec_chars) for r in reqs]
+        second = replay_once(
+            rebuilt,
+            1.0,
+            knobs=knobs,
+            collect_transcripts=True,
+            poll_pressure=False,
+        )
+        assert second.metrics["shed"] == 0 and second.metrics["lost"] == 0
+        assert first.transcripts == second.transcripts
+        assert all(t is not None for t in first.transcripts)
+
+    def test_slo_breach_detection(self):
+        m = {"lost": 0, "ttft_p95_s": 0.1, "shed_fraction": 0.0}
+        assert slo_breaches(m, SLOSpec()) == []
+        assert slo_breaches({**m, "lost": 1}, SLOSpec())
+        assert slo_breaches({**m, "ttft_p95_s": 9.0}, SLOSpec())
+        assert slo_breaches({**m, "shed_fraction": 0.5}, SLOSpec())
+
+
+class TestArrivalRendering:
+    """The obs_dump/trace_view satellite: armed recordings render the
+    arrival offsets (@t) and the per-tenant rate summary."""
+
+    def _serve_event(self, seq, arrival, tenant="t0"):
+        return {
+            "seq": seq,
+            "type": "serve",
+            "op": "accepted",
+            "tenant": tenant,
+            "tier": "interactive",
+            "debate": f"d{seq:05d}",
+            "index": -1,
+            "reason": "",
+            "tokens": 1000,
+            "backlog_tokens": 1000,
+            "arrival_s": arrival,
+            "trace_id": "",
+            "span_id": "",
+        }
+
+    def _request_event(self, seq, req_id, state, arrival=0.0):
+        return {
+            "seq": seq,
+            "type": "request",
+            "req_id": req_id,
+            "state": state,
+            "slot": req_id,
+            "tokens": 10,
+            "cached_tokens": 0,
+            "arrival_s": arrival,
+            "trace_id": "",
+            "span_id": "",
+        }
+
+    def test_obs_dump_summary_has_tenant_rate_line(self):
+        from tools.obs_dump import summarize
+
+        events = [
+            self._serve_event(1, 1.0, "t0"),
+            self._serve_event(2, 1.5, "t0"),
+            self._serve_event(3, 3.0, "t1"),
+        ]
+        text = summarize(events)
+        assert "arrivals: 3 over 2.000s" in text
+        assert "t0=1.0/s" in text and "t1=0.5/s" in text
+        # Unarmed dumps (arrival 0) keep the old summary byte for byte.
+        unarmed = [
+            {**e, "arrival_s": 0.0} for e in events
+        ]
+        assert "arrivals:" not in summarize(unarmed)
+
+    def test_obs_dump_request_log_leads_with_arrival_column(self):
+        from tools.obs_dump import request_log
+
+        events = [
+            self._request_event(1, 0, "queued", arrival=1.25),
+            self._request_event(2, 0, "finished"),
+        ]
+        text = request_log(events)
+        assert "@   1.250s " in text.splitlines()[0]
+        # Non-edge rows keep alignment without inventing an offset.
+        assert text.splitlines()[1].startswith(" " * 11 + "seq")
+        unarmed = request_log(
+            [self._request_event(1, 0, "queued", arrival=0.0)]
+        )
+        assert "@" not in unarmed
+
+    def test_obs_dump_timeline_serve_rows_show_offset(self):
+        from tools.obs_dump import occupancy_timeline
+
+        events = [
+            {
+                "seq": 1,
+                "type": "step",
+                "kind": "decode",
+                "n_live": 1,
+                "admission_slot": -1,
+                "prefill_tokens": 0,
+                "pipeline_depth": 0,
+                "sync_reason": "",
+                "trace_id": "",
+                "span_id": "",
+            },
+            self._serve_event(2, 0.125),
+        ]
+        text = occupancy_timeline(events)
+        assert "@0.125s" in text
+
+    def test_trace_view_waterfall_head_shows_arrival(self):
+        from tools.trace_view import collect_requests, render_waterfall
+
+        def span(seq, name, phase, wall):
+            return {
+                "seq": seq,
+                "type": "span",
+                "name": name,
+                "phase": phase,
+                "req_id": 0,
+                "slot": 0,
+                "wall_s": wall,
+                "trace_id": "tr0",
+                "span_id": "sp0",
+            }
+
+        events = [
+            self._request_event(1, 0, "queued", arrival=2.5),
+            span(2, "request", "begin", 0.0),
+            span(3, "prefill", "end", 0.01),
+            span(4, "decode", "end", 0.02),
+            span(5, "request", "end", 0.03),
+        ]
+        recs = collect_requests(events)
+        assert recs["sp0"]["arrival_s"] == 2.5
+        assert "@2.500s" in render_waterfall(recs)

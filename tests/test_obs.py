@@ -79,7 +79,17 @@ class TestMetricsRegistry:
         assert snap['advspec_x_total{seam="a"}'] == 3
         assert snap['advspec_x_total{seam="b"}'] == 1
         assert snap["advspec_util"] == 0.5
-        assert snap["advspec_lat_seconds"] == {"count": 3, "sum": 99.55}
+        assert snap["advspec_lat_seconds"] == {
+            "count": 3,
+            "sum": 99.55,
+            # Bucket-estimated quantiles: p50 interpolates inside the
+            # (0.1, 1.0] bucket; the tail quantiles clamp to the last
+            # bound (the overflow observation is past what fixed
+            # buckets can resolve).
+            "p50": 0.55,
+            "p95": 1.0,
+            "p99": 1.0,
+        }
 
     def test_handles_are_stable_and_reset_in_place(self):
         """The resilience/interleave reset contract: an engine holding a
@@ -137,8 +147,61 @@ class TestMetricsRegistry:
         assert 'advspec_lat_seconds_bucket{le="+Inf"} 1\n' in text
         assert "advspec_lat_seconds_sum 0.7\n" in text
         assert "advspec_lat_seconds_count 1\n" in text
+        # Quantile estimate lines ride along after _count — ONE
+        # implementation (Histogram.quantile) feeds snapshot(),
+        # render_prometheus(), and every harness percentile.
+        assert "advspec_lat_seconds_p50 0.75\n" in text
+        assert "advspec_lat_seconds_p95 0.975\n" in text
+        assert "advspec_lat_seconds_p99 0.995\n" in text
         # Deterministic: same registry renders the same bytes.
         assert text == reg.render_prometheus()
+
+    def test_percentile_exact_nearest_rank(self):
+        """The shared sample-percentile (obs.metrics.percentile): exact
+        nearest-rank pins on a known sample — the SLO gate, bench.py,
+        and load_replay all report through this one implementation."""
+        from adversarial_spec_tpu.obs.metrics import percentile
+
+        xs = list(range(1, 101))  # 1..100
+        assert percentile(xs, 0.50) == 50
+        assert percentile(xs, 0.95) == 95
+        assert percentile(xs, 0.99) == 99
+        assert percentile(xs, 1.0) == 100
+        assert percentile(xs, 0.0) == 1
+        assert percentile([7.5], 0.99) == 7.5
+        assert percentile([], 0.99) == 0.0
+        # Unsorted input: percentile sorts a copy, never mutates.
+        ys = [3.0, 1.0, 2.0]
+        assert percentile(ys, 0.5) == 2.0
+        assert ys == [3.0, 1.0, 2.0]
+
+    def test_histogram_quantile_vs_exact_percentiles(self):
+        """Unit pin: bucket-estimated quantiles track exact percentiles
+        on a known sample to within one bucket width (the resolution a
+        fixed-bucket histogram can promise) and clamp to the last bound
+        beyond it."""
+        from adversarial_spec_tpu.obs.metrics import (
+            Histogram,
+            percentile,
+        )
+
+        buckets = (0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
+        h = Histogram(buckets=buckets)
+        samples = [0.001 * i for i in range(1, 200)]  # 1ms..199ms
+        for v in samples:
+            h.observe(v)
+        for q in (0.5, 0.95, 0.99):
+            exact = percentile(samples, q)
+            est = h.quantile(q)
+            # The estimate lands in the same bucket as the exact value.
+            width = max(
+                b - a for a, b in zip((0.0,) + buckets, buckets)
+            )
+            assert abs(est - exact) <= width
+        assert Histogram(buckets=buckets).quantile(0.99) == 0.0
+        h2 = Histogram(buckets=(1.0, 2.0))
+        h2.observe(50.0)  # beyond the last bound: clamps, never lies up
+        assert h2.quantile(0.99) == 2.0
 
 
 class TestFlightRecorder:
@@ -180,12 +243,17 @@ class TestFlightRecorder:
             "slot": -1,
             "tokens": 0,
             "cached_tokens": 0,
+            "arrival_s": 0.0,
             "trace_id": "",
             "span_id": "",
         }
         assert validate_event(good) == []
         assert validate_event({**good, "state": "exploded"})  # bad state
         assert validate_event({**good, "extra": 1})  # unknown field
+        # arrival_s is a schema field like any other: int is an
+        # acceptable float, a string is not.
+        assert validate_event({**good, "arrival_s": 2}) == []
+        assert validate_event({**good, "arrival_s": "soon"})
         # Trace ids are schema fields like any other: wrong type and
         # missing both reject.
         assert validate_event({**good, "trace_id": 7})

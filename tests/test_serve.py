@@ -863,6 +863,45 @@ class TestDaemonSocket:
             assert not th.is_alive()
         assert daemon.drain_report["clean_exit"] is True
 
+    def test_stats_op_exposes_admission_pressure(self, tmp_path):
+        """The stats op carries the admission ledger's live pressure
+        view over the wire — the ``pressure_snapshot`` the autoscaler
+        reads in-process (backlog tokens, brownout, capacity) plus the
+        per-tier queue depths already in ``scheduler`` — so the replay
+        harness and external scrapers see the same numbers the
+        scheduler sheds on."""
+        serve_mod.configure(max_queue_depth=8, max_backlog_tokens=10**6)
+        daemon, th, sock = self._start(tmp_path)
+        client = ServeClient(sock)
+        try:
+            rid = client.submit_debate(
+                SPEC, ["mock://critic?v=1", "mock://agree"]
+            )
+            client.collect(rid, timeout_s=20)
+            stats = client.stats()
+            pressure = stats["pressure"]
+            for key in (
+                "backlog_tokens",
+                "prefill_backlog_tokens",
+                "decode_backlog_tokens",
+                "capacity_tokens",
+                "brownout",
+                "draining",
+            ):
+                assert key in pressure, key
+            assert pressure["capacity_tokens"] == 10**6
+            assert pressure["brownout"] is False
+            assert pressure["draining"] is False
+            # Per-tier queue depths ride in the scheduler snapshot.
+            assert set(stats["scheduler"]["queued_units"]) >= {
+                "interactive",
+                "batch",
+            }
+        finally:
+            client.drain()
+            client.close()
+            th.join(timeout=15)
+
     def test_overload_storm_sheds_typed_zero_loss(self, tmp_path):
         """The tier-1 slice of chaos_run --overload: open-loop burst
         past the caps → typed sheds, zero accepted loss, brownout,

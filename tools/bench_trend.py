@@ -114,6 +114,16 @@ _KERNELS_REQUIRED: dict[str, tuple[type, ...]] = {
     "transcripts_byte_identical": (dict,),
     "unexpected_recompiles": (int,),
 }
+# BENCH_capacity.json additionally pins the capacity frontier
+# (tools/load_replay.py): the per-arm frontier dict (>=2 knob arms,
+# each with a numeric debates/s at SLO) and the SLO it was measured
+# against. A frontier whose headline drops >10% vs the committed value
+# (vs_baseline < 0.9) is a capacity REGRESSION — it fails the gate
+# even though the file is otherwise schema-valid.
+_CAPACITY_REQUIRED: dict[str, tuple[type, ...]] = {
+    "frontier": (dict,),
+    "slo": (dict,),
+}
 
 
 def _check_fields(
@@ -193,6 +203,40 @@ def validate_bench_file(path: Path) -> tuple[dict | None, list[str]]:
                         f"{path.name}: {gate} must be 0, "
                         f"got {payload[gate]}"
                     )
+        if mode == "capacity":
+            problems.extend(
+                _check_fields(payload, _CAPACITY_REQUIRED, path.name)
+            )
+            frontier = payload.get("frontier")
+            if isinstance(frontier, dict):
+                if len(frontier) < 2:
+                    problems.append(
+                        f"{path.name}: frontier needs >=2 knob arms, "
+                        f"got {len(frontier)}"
+                    )
+                for arm, entry in frontier.items():
+                    dps = (
+                        entry.get("debates_per_s")
+                        if isinstance(entry, dict)
+                        else None
+                    )
+                    if not isinstance(dps, (int, float)) or isinstance(
+                        dps, bool
+                    ):
+                        problems.append(
+                            f"{path.name}: frontier arm {arm!r} missing "
+                            f"numeric debates_per_s"
+                        )
+            vs = payload.get("vs_baseline")
+            if (
+                isinstance(vs, (int, float))
+                and not isinstance(vs, bool)
+                and vs < 0.9
+            ):
+                problems.append(
+                    f"{path.name}: capacity frontier dropped >10% vs "
+                    f"the committed value (vs_baseline={vs})"
+                )
         if mode == "kernels":
             problems.extend(
                 _check_fields(payload, _KERNELS_REQUIRED, path.name)

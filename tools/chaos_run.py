@@ -864,8 +864,9 @@ def run_overload(verbose: bool = True) -> tuple[list[str], dict]:
                 failures.append("brownout never entered under the storm")
             if shed_reasons.get("brownout", 0) < 1:
                 failures.append("no typed brownout shed observed")
-            ttfts.sort()
-            p99 = ttfts[max(0, int(len(ttfts) * 0.99) - 1)] if ttfts else 0.0
+            from adversarial_spec_tpu.obs.metrics import percentile
+
+            p99 = percentile(ttfts, 0.99)
             if p99 > _OVERLOAD_TTFT_SLO_S:
                 failures.append(
                     f"interactive p99 TTFT {p99:.3f}s breaches the "

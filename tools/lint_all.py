@@ -20,7 +20,12 @@ stage failed):
    must schema-validate and join into the perf-trajectory table
    (tools/bench_trend.py): a malformed bench file fails the gate
    instead of silently dropping out of the record.
-4. **unroll compile check** (``--full`` only — it jit-compiles an
+4. **replay-smoke** (``--full`` only) — a tiny seeded
+   tools/load_replay.py sweep on the mock daemon must emit a
+   BENCH_capacity.json payload that bench_trend's capacity schema
+   accepts (>=2 knob arms, numeric frontier): the load harness and
+   the capacity gate can never drift apart unnoticed.
+5. **unroll compile check** (``--full`` only — it jit-compiles an
    80-layer config three times, minutes of CPU) — the decode-scan
    unroll cost measurement, tools/unroll_compile_check.py.
 
@@ -33,6 +38,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import os
 import subprocess
 import sys
 from pathlib import Path
@@ -247,6 +253,60 @@ def _stage_bench_trend() -> bool:
     return ok
 
 
+def _stage_replay_smoke() -> bool:
+    """A tiny seeded load_replay sweep must produce a schema-valid
+    capacity payload (tools/bench_trend.py's capacity contract) — the
+    replay harness and the frontier gate can never drift apart
+    unnoticed."""
+    import json
+    import tempfile
+
+    from tools.bench_trend import validate_bench_file
+
+    ok = True
+    with tempfile.TemporaryDirectory(prefix="advspec-replay-smoke-") as td:
+        out = Path(td) / "BENCH_capacity.json"
+        r = subprocess.run(
+            [
+                sys.executable,
+                str(REPO / "tools" / "load_replay.py"),
+                "--smoke",
+                "--bench-out",
+                str(out),
+            ],
+            cwd=REPO,
+            capture_output=True,
+            text=True,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        if r.returncode != 0 or not out.is_file():
+            print(
+                f"lint_all: replay-smoke: load_replay exited "
+                f"{r.returncode}: {r.stderr[-400:]}",
+                file=sys.stderr,
+            )
+            ok = False
+        else:
+            row, problems = validate_bench_file(out)
+            for p in problems:
+                print(f"lint_all: replay-smoke: {p}", file=sys.stderr)
+            payload = json.loads(out.read_text(encoding="utf-8"))
+            arms = payload.get("frontier", {})
+            if len(arms) < 2:
+                print(
+                    f"lint_all: replay-smoke: expected >=2 knob arms, "
+                    f"got {len(arms)}",
+                    file=sys.stderr,
+                )
+                ok = False
+            ok = ok and not problems and row is not None
+    print(
+        f"lint_all: replay-smoke {'OK' if ok else 'FAILED'}",
+        file=sys.stderr,
+    )
+    return ok
+
+
 def _stage_unroll() -> bool:
     r = subprocess.run(
         [sys.executable, str(REPO / "tools" / "unroll_compile_check.py")],
@@ -307,6 +367,7 @@ def main(argv: list[str] | None = None) -> int:
     ok = _stage_journal_schema() and ok
     if args.full:
         ok = _stage_bench_trend() and ok
+        ok = _stage_replay_smoke() and ok
         ok = _stage_unroll() and ok
     print(
         f"lint_all: {'ALL OK' if ok else 'FAILURES'}",

@@ -196,6 +196,31 @@ def summarize(events: list[dict]) -> str:
             )
         if brownouts:
             lines.append(f"  WARNING: brownout entered {brownouts} time(s)")
+        arrivals = [
+            s
+            for s in serve
+            if s["op"] in ("accepted", "shed")
+            and s.get("arrival_s", 0) > 0
+        ]
+        if arrivals:
+            # Armed recording: per-tenant mean arrival rate over the
+            # recorded span — the at-a-glance shape of the trace
+            # load_replay would reconstruct from this dump.
+            span = max(s["arrival_s"] for s in arrivals) - min(
+                s["arrival_s"] for s in arrivals
+            )
+            counts: dict[str, int] = {}
+            for s in arrivals:
+                counts[s["tenant"]] = counts.get(s["tenant"], 0) + 1
+            # A degenerate window (one arrival, zero span) has no
+            # meaningful rate — show counts instead of a silly number.
+            lines.append(
+                f"  arrivals: {len(arrivals)} over {span:.3f}s — "
+                + ", ".join(
+                    f"{t}={n / span:.1f}/s" if span > 1e-6 else f"{t}={n}"
+                    for t, n in sorted(counts.items())
+                )
+            )
     return "\n".join(lines)
 
 
@@ -272,6 +297,11 @@ def occupancy_timeline(events: list[dict], width: int = 16) -> str:
             if s["reason"]:
                 notes.append(f"({s['reason']})")
             notes.append(f"backlog={s['backlog_tokens']}")
+            if s.get("arrival_s", 0) > 0:
+                # Armed recording (ADVSPEC_OBS_ARRIVALS): lead with the
+                # arrival offset so the admission edges read as a
+                # schedule — the column load_replay reconstructs from.
+                notes.insert(0, f"@{s['arrival_s']:.3f}s")
             rows.append(
                 f"seq {s['seq']:>6} [{glyph * width}] "
                 f"{'serve:' + s['op']:<13} " + " ".join(notes)
@@ -448,6 +478,9 @@ def request_log(events: list[dict]) -> str:
     reqs = [e for e in events if e["type"] == "request"]
     if not reqs:
         return "(no request events)"
+    # Armed recordings lead with the arrival offset (@t) so the log
+    # reads as a schedule; unarmed dumps keep the old column set.
+    timed = any(r.get("arrival_s", 0) > 0 for r in reqs)
     rows = []
     for r in reqs:
         extra = (
@@ -455,8 +488,12 @@ def request_log(events: list[dict]) -> str:
         )
         if r.get("span_id"):
             extra += f" span={r['span_id']}"
+        at = ""
+        if timed:
+            a = r.get("arrival_s", 0)
+            at = f"@{a:8.3f}s " if a > 0 else " " * 11
         rows.append(
-            f"seq {r['seq']:>6} req {r['req_id']:>3} "
+            f"{at}seq {r['seq']:>6} req {r['req_id']:>3} "
             f"{r['state']:<9} slot={r['slot']} tokens={r['tokens']}{extra}"
         )
     return "\n".join(rows)

@@ -61,7 +61,18 @@ def collect_requests(events: list[dict]) -> dict[str, dict]:
     out: dict[str, dict] = {}
     routes: dict[str, list[dict]] = {}
     ships: dict[str, int] = {}
+    # Armed recordings (ADVSPEC_OBS_ARRIVALS) stamp the queue-edge
+    # RequestEvent with the monotonic arrival offset; carry it onto the
+    # span record by req_id so the waterfall head shows WHEN each
+    # request entered, not just how long its stages took.
+    arrivals: dict[int, float] = {}
     for e in events:
+        if (
+            e["type"] == "request"
+            and e.get("state") == "queued"
+            and e.get("arrival_s", 0) > 0
+        ):
+            arrivals[e["req_id"]] = e["arrival_s"]
         if (
             e["type"] == "swap"
             and e["op"] == "ship"
@@ -115,6 +126,9 @@ def collect_requests(events: list[dict]) -> dict[str, dict]:
     for span_id, blocks in ships.items():
         if span_id in out:
             out[span_id]["shipped_blocks"] = blocks
+    for rec in out.values():
+        if rec["req_id"] in arrivals:
+            rec["arrival_s"] = arrivals[rec["req_id"]]
     return out
 
 
@@ -160,6 +174,8 @@ def render_waterfall(
     ):
         wall = rec["request_wall"]
         head = f"{span_id}  (req {rec['req_id']}"
+        if rec.get("arrival_s"):
+            head += f", @{rec['arrival_s']:.3f}s"
         head += (
             f", service {wall:.4f}s"
             + (", CANCELLED" if rec.get("cancelled") else "")
